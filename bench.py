@@ -1,0 +1,90 @@
+"""Benchmark: GPT-2 ZeRO-3 training throughput on the available TPU chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": R}
+
+Baseline convention: the reference's headline sustained ZeRO-3(-Offload)
+throughput is 50 TFLOPS/GPU (docs/_posts/2021-03-08-zero3-offload.md:65, see
+BASELINE.md). We convert that to tokens/s for the same model via
+``flops_per_token`` and report vs_baseline = measured/baseline — i.e.
+vs_baseline == measured TFLOPS-per-chip / 50.
+
+Model size auto-scales to fit a single chip's HBM (16 GB on v5e):
+gpt2-760m when >8 GB free-ish, else 350m. On a pod slice the full 1.3b
+config from BASELINE.json applies.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
+
+    n_chips = jax.device_count()
+    # memory-based model choice: Adam training costs ~20 bytes/param HBM
+    # (bf16 params + fp32 grads/master/moments); one 16 GB v5e chip fits 350M,
+    # a 4+ chip slice fits the BASELINE.json 1.3b config under ZeRO-3.
+    if n_chips >= 4:
+        preset = "gpt2-1.3b"
+        micro = 4
+    else:
+        preset = "gpt2-350m"
+        micro = 4
+    seq_len = 1024
+
+    cfg = config_for(preset, n_positions=seq_len, dtype=jnp.bfloat16,
+                     remat=True)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=128)
+
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=ds_config)
+    del params
+
+    global_bs = engine.train_batch_size
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(global_bs, seq_len)), jnp.int32)}
+
+    # warmup/compile. NOTE: sync via host transfer (float(...)) — through the
+    # axon relay block_until_ready returns before remote execution finishes.
+    for _ in range(2):
+        m = engine.train_batch(batch)
+    float(m["loss"])
+
+    steps = 20
+    t0 = time.time()
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+    final_loss = float(m["loss"])
+    dt = time.time() - t0
+
+    tokens_per_step = global_bs * seq_len
+    tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
+    flops_per_token = model.flops_per_token()
+    tflops_per_chip = tokens_per_sec_per_chip * flops_per_token / 1e12
+    baseline_tokens_per_sec = 50e12 / flops_per_token  # 50 TFLOPS/GPU headline
+    print(json.dumps({
+        "metric": f"{preset}_zero3_bf16_seq{seq_len}_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec_per_chip / baseline_tokens_per_sec, 4),
+        "detail": {"chips": n_chips, "tflops_per_chip": round(tflops_per_chip, 2),
+                   "global_batch": global_bs, "loss": round(final_loss, 4)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

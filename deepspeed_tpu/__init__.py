@@ -1,0 +1,52 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Capability parity with DeepSpeed v0.8.0 (reference: ``deepspeed/__init__.py``),
+re-designed for JAX/XLA/Pallas on TPU meshes. Public surface mirrors the
+reference where it makes sense:
+
+* :func:`initialize` — build a training engine (deepspeed/__init__.py:52)
+* :func:`init_inference` — build an inference engine (:233)
+* :mod:`deepspeed_tpu.comm` — collective facade (deepspeed/comm)
+* :func:`add_config_arguments` — argparse helper (:210)
+"""
+from deepspeed_tpu.version import __version__, git_branch, git_hash
+from deepspeed_tpu import comm
+from deepspeed_tpu.config.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState, initialize
+from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+from deepspeed_tpu.utils.logging import logger
+
+
+def init_distributed(dist_backend="xla", **kwargs):
+    """deepspeed.init_distributed analog (deepspeed/__init__.py:29)."""
+    comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """deepspeed.init_inference analog (deepspeed/__init__.py:233)."""
+    try:
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    except ImportError as e:
+        raise NotImplementedError(
+            "the inference engine is not available in this build") from e
+    if config is None:
+        config = {}
+    if isinstance(config, dict):
+        merged = dict(config)
+        merged.update(kwargs)
+        config = DeepSpeedInferenceConfig(**merged)
+    return InferenceEngine(model, config)
+
+
+def add_config_arguments(parser):
+    """Augment an argparse parser with DS flags (deepspeed/__init__.py:210)."""
+    group = parser.add_argument_group("DeepSpeed-TPU",
+                                      "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed-TPU json configuration")
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true",
+                       help="Discover ranks via MPI environment")
+    return parser

@@ -1,0 +1,19 @@
+"""Collective-communication facade (reference: ``deepspeed/comm``)."""
+from deepspeed_tpu.comm.comm import (AVG, MAX, MIN, PROD, SUM, all_gather,
+                                     all_reduce, all_to_all, axis_index,
+                                     barrier, broadcast, broadcast_obj,
+                                     comms_logger, configure,
+                                     get_device_count, get_local_rank,
+                                     get_rank, get_world_size,
+                                     init_distributed, is_initialized,
+                                     log_summary, ppermute, reduce_scatter)
+from deepspeed_tpu.comm.mesh import (MESH_AXES, MeshConfig, build_mesh,
+                                     get_data_parallel_world_size,
+                                     get_expert_parallel_world_size,
+                                     get_global_mesh,
+                                     get_model_parallel_world_size,
+                                     get_pipe_parallel_world_size,
+                                     get_sequence_parallel_world_size,
+                                     has_global_mesh, named_sharding,
+                                     replicated, reset_global_mesh,
+                                     set_global_mesh)

@@ -1,0 +1,150 @@
+"""Device-mesh management — the TPU-native replacement for process groups.
+
+The reference builds named process groups by hand (``deepspeed/utils/groups.py:45``,
+``deepspeed/runtime/pipe/topology.py:249``). On TPU the idiomatic equivalent is a
+single :class:`jax.sharding.Mesh` whose named axes *are* the parallelism
+strategies:
+
+=========  =============================================================
+axis       role (reference analog)
+=========  =============================================================
+``data``   data parallelism / ZeRO partitioning axis (DP groups +
+           ZeRO's intra-DP partitioning — stage_1_and_2.py:167)
+``fsdp``   optional extra ZeRO sharding axis when data parallelism spans
+           DCN but parameter sharding should stay on ICI (hybrid shard)
+``tensor`` tensor/model parallelism (Megatron ``mpu`` seam, groups.py:59)
+``seq``    sequence/context parallelism (absent in the reference — SURVEY
+           §5.7 — first-class here)
+``pipe``   pipeline stages (runtime/pipe/topology.py:232)
+``expert`` expert parallelism for MoE (groups.py:109)
+=========  =============================================================
+
+Collectives over these axes are emitted by XLA (psum / all_gather /
+psum_scatter / ppermute / all_to_all) and ride ICI; axes laid out earliest in
+the device list get the fastest (innermost) interconnect. ``expert`` is folded
+over the data axis at use time (the reference reuses DP ranks for experts).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import logger
+
+# Canonical axis order: innermost (fastest ICI) last. tensor+seq innermost
+# because their collectives are per-layer and latency-bound; data outermost
+# because DP gradient reduction amortizes over the whole step.
+MESH_AXES = ("pipe", "data", "fsdp", "seq", "tensor")
+# Expert parallelism reuses devices from (data × fsdp): see expert_mesh().
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Degrees for each parallel axis; -1 on data = absorb remaining devices."""
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    pipe: int = 1
+
+    def resolve(self, n_devices: int) -> dict:
+        fixed = self.fsdp * self.tensor * self.seq * self.pipe
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by "
+                    f"fsdp*tensor*seq*pipe={fixed}")
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.fsdp}x{self.seq}x{self.tensor}x{self.pipe}"
+                f" != device count {n_devices}")
+        return dict(pipe=self.pipe, data=data, fsdp=self.fsdp, seq=self.seq,
+                    tensor=self.tensor)
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Create a Mesh over the given devices (default: all global devices)."""
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    logger.info(f"global mesh set: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+
+def get_global_mesh() -> Mesh:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = build_mesh()
+    return _GLOBAL_MESH
+
+
+def has_global_mesh() -> bool:
+    return _GLOBAL_MESH is not None
+
+
+def reset_global_mesh() -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = None
+
+
+# ---------------------------------------------------------------------------
+# Axis-size accessors — the analog of deepspeed/utils/groups.py accessors
+# (get_data_parallel_world_size etc., groups.py:287-399).
+# ---------------------------------------------------------------------------
+
+def _axis_size(mesh: Optional[Mesh], axis: str) -> int:
+    mesh = mesh or get_global_mesh()
+    return mesh.shape[axis]
+
+
+def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    # ZeRO partitions over data×fsdp combined (hybrid shard collapses to
+    # plain DP when fsdp == 1).
+    return _axis_size(mesh, "data") * _axis_size(mesh, "fsdp")
+
+
+def get_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh, "tensor")
+
+
+def get_sequence_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh, "seq")
+
+
+def get_pipe_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh, "pipe")
+
+
+def get_expert_parallel_world_size(mesh: Optional[Mesh] = None,
+                                   max_experts: Optional[int] = None) -> int:
+    """Expert parallelism folds over the ZeRO/data axis (reference reuses DP
+    ranks for expert groups — groups.py:109). Capped by number of experts."""
+    ep = get_data_parallel_world_size(mesh)
+    if max_experts is not None:
+        ep = min(ep, max_experts)
+    return ep
+
+
+def named_sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_global_mesh(), P(*spec))
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_global_mesh(), P())

@@ -1,0 +1,287 @@
+"""DeepSpeed-compatible JSON config for the TPU runtime.
+
+Mirrors the schema consumed by ``deepspeed/runtime/config.py:702``
+(``DeepSpeedConfig``): the batch-size triad, optimizer/scheduler sections,
+fp16/bf16 precision sections, ``zero_optimization``, gradient clipping, and
+logging knobs — plus a TPU-specific ``mesh`` section that replaces the
+reference's implicit world-size/process-group wiring with explicit parallel
+axis degrees (SURVEY §7.1).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Literal, Optional, Union
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.comm.mesh import MeshConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------------
+# Precision (reference: runtime/fp16 + bf16 config keys, runtime/config.py)
+# ---------------------------------------------------------------------------
+
+class FP16Config(DeepSpeedConfigModel):
+    """fp16 section (reference keys: runtime/constants.py FP16_*)."""
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    auto_cast: bool = False
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    """bf16 section — the TPU default precision (native MXU dtype)."""
+    enabled: bool = False
+
+
+# ---------------------------------------------------------------------------
+# ZeRO (reference: runtime/zero/config.py:76 DeepSpeedZeroConfig)
+# ---------------------------------------------------------------------------
+
+class OffloadParamConfig(DeepSpeedConfigModel):
+    device: Literal["cpu", "nvme", "none"] = "cpu"
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    pin_memory: bool = False
+
+
+class OffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: Literal["cpu", "nvme", "none"] = "cpu"
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+
+class ZeroConfig(DeepSpeedConfigModel):
+    """zero_optimization section.
+
+    On TPU the stages are sharding policies over the ``data``(+``fsdp``) mesh
+    axis rather than hook machinery (SURVEY §7.1):
+      stage 0 — params/grads/opt-state replicated (plain DP)
+      stage 1 — optimizer state (incl. fp32 master weights) sharded
+      stage 2 — + gradients reduce-scattered to their shard
+      stage 3 — + bf16 params sharded, gathered per-layer by XLA
+    The prefetch/bucket/overlap knobs of the reference
+    (runtime/zero/config.py) are accepted for config compatibility; XLA's
+    latency-hiding scheduler performs the overlap they hand-tuned.
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_hpz_partition_size: int = 1
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+    cpu_offload: Optional[bool] = None  # deprecated alias
+
+    @model_validator(mode="after")
+    def _resolve_deprecated(self):
+        if self.cpu_offload and self.offload_optimizer is None:
+            object.__setattr__(self, "offload_optimizer",
+                               OffloadOptimizerConfig(device="cpu"))
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler sections (reference: runtime/config.py optimizer keys)
+# ---------------------------------------------------------------------------
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "AdamW"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Aux sections
+# ---------------------------------------------------------------------------
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: Optional[str] = None
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """activation_checkpointing section (reference:
+    runtime/activation_checkpointing/checkpointing.py ``configure``).
+    On TPU this maps onto jax.checkpoint policies; ``partition_activations``
+    becomes sharding the saved residuals over the ``tensor``/``seq`` axes."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class GradientAccumulationPluginConfig(DeepSpeedConfigModel):
+    pass
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: Literal["Ignore", "Warn", "Fail", "ignore", "warn", "fail"] = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DeepSpeedConfig:
+    """Top-level config (reference: runtime/config.py:702).
+
+    Accepts a dict or a path to a JSON file. Resolves the
+    train_batch_size = micro_batch * grad_accum * dp_world_size triad exactly
+    as ``_set_batch_related_parameters`` (runtime/config.py:942) does.
+    """
+
+    def __init__(self, config: Union[str, dict], dp_world_size: Optional[int] = None):
+        if isinstance(config, str):
+            with open(config) as f:
+                self._param_dict = json.load(f)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise ValueError(f"expected dict or json path, got {type(config)}")
+
+        pd = self._param_dict
+        self.train_batch_size: Optional[int] = pd.get("train_batch_size")
+        self.train_micro_batch_size_per_gpu: Optional[int] = pd.get(
+            "train_micro_batch_size_per_gpu")
+        self.gradient_accumulation_steps: Optional[int] = pd.get(
+            "gradient_accumulation_steps")
+        self.steps_per_print: int = pd.get("steps_per_print", 10)
+        self.wall_clock_breakdown: bool = pd.get("wall_clock_breakdown", False)
+        self.memory_breakdown: bool = pd.get("memory_breakdown", False)
+        self.prescale_gradients: bool = pd.get("prescale_gradients", False)
+        self.gradient_predivide_factor: float = pd.get("gradient_predivide_factor", 1.0)
+        self.gradient_clipping: float = pd.get("gradient_clipping", 0.0)
+        self.dump_state: bool = pd.get("dump_state", False)
+        self.seed: int = pd.get("seed", 42)
+
+        self.fp16 = FP16Config(**pd.get("fp16", {}))
+        self.bf16 = BF16Config(**pd.get("bf16", pd.get("bfloat16", {})))
+        self.zero_config = ZeroConfig(**pd.get("zero_optimization", {}))
+        self.optimizer = (OptimizerConfig(**pd["optimizer"])
+                          if "optimizer" in pd else None)
+        self.scheduler = (SchedulerConfig(**pd["scheduler"])
+                          if "scheduler" in pd else None)
+        self.comms_logger = CommsLoggerConfig(**pd.get("comms_logger", {}))
+        self.tensorboard = TensorBoardConfig(**pd.get("tensorboard", {}))
+        self.wandb = WandbConfig(**pd.get("wandb", {}))
+        self.csv_monitor = CSVConfig(**pd.get("csv_monitor", {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **pd.get("activation_checkpointing", {}))
+        self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
+        self.mesh = MeshConfig(**pd.get("mesh", {}))
+        self.compile_cache_dir: Optional[str] = pd.get("compile_cache_dir")
+
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+
+        self.zero_enabled = self.zero_config.stage > 0
+        self.zero_optimization_stage = self.zero_config.stage
+
+        if dp_world_size is not None:
+            self.resolve_batch_config(dp_world_size)
+
+    # -- batch triad (reference: runtime/config.py:942 + assertions :918) ----
+    def resolve_batch_config(self, dp_world_size: int) -> None:
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= dp_world_size
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // dp_world_size
+            micro_batch //= grad_acc
+        elif micro_batch is not None and grad_acc is not None:
+            train_batch = micro_batch * grad_acc * dp_world_size
+        elif train_batch is not None:
+            grad_acc = 1
+            micro_batch = train_batch // dp_world_size
+        elif micro_batch is not None:
+            train_batch = micro_batch * dp_world_size
+            grad_acc = 1
+        else:
+            raise ValueError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+        if train_batch <= 0 or micro_batch <= 0 or grad_acc <= 0:
+            raise ValueError(
+                f"batch config resolved to non-positive values: "
+                f"train={train_batch} micro={micro_batch} gas={grad_acc}")
+        if train_batch != micro_batch * grad_acc * dp_world_size:
+            raise ValueError(
+                f"Check batch related parameters. train_batch_size is not equal"
+                f" to micro_batch_per_gpu * gradient_acc_step * world_size "
+                f"{train_batch} != {micro_batch} * {grad_acc} * {dp_world_size}")
+
+        self.train_batch_size = train_batch
+        self.train_micro_batch_size_per_gpu = micro_batch
+        self.gradient_accumulation_steps = grad_acc
+        logger.info(f"batch config: global={train_batch} micro={micro_batch} "
+                    f"gas={grad_acc} dp={dp_world_size}")
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    def print_config(self) -> None:
+        logger.info(json.dumps(self._param_dict, indent=2, sort_keys=True))

@@ -1,0 +1,248 @@
+"""GPT-2 model family (flax) — the flagship training model.
+
+The reference trains GPT-2/Megatron-GPT through user-supplied torch modules
+plus DeepSpeed's fused transformer kernel
+(``csrc/transformer/ds_transformer_cuda.cpp``, wrapper
+``deepspeed/ops/transformer/transformer.py:459``). Here the transformer block
+is a flax module designed for the MXU: bf16 matmuls, fused-by-XLA
+bias/gelu/layernorm epilogues, optional Pallas flash attention
+(deepspeed_tpu.ops.flash_attention), ``jax.checkpoint`` for activation
+rematerialization (analog of runtime/activation_checkpointing), and
+Megatron-style tensor-parallel sharding expressed as PartitionSpecs
+(``tp_specs``) instead of module surgery (module_inject/replace_module.py).
+
+Sizes follow the GPT-2/GPT-3 ladder used by the reference benchmarks
+(BASELINE.json configs: 125M…1.3B).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+DATA_AXES = ("data", "fsdp")
+
+
+def _maybe_constrain(x, spec: P):
+    """Apply a sharding constraint when a mesh with the named axes is in
+    scope (bare-jit unit tests run without one)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    names = set(mesh.axis_names)
+    for entry in spec:
+        for ax in (entry if isinstance(entry, tuple) else (entry,)):
+            if ax is not None and ax not in names:
+                return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash_attention: bool = True
+    # pad vocab to a multiple of 128 (lane width) for MXU efficiency;
+    # Megatron does the same for TP divisibility.
+    vocab_pad_multiple: int = 128
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+
+PRESETS: Dict[str, dict] = {
+    "gpt2-125m": dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-350m": dict(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-760m": dict(n_embd=1536, n_layer=24, n_head=16),
+    "gpt2-1.3b": dict(n_embd=2048, n_layer=24, n_head=16),
+    "gpt2-2.7b": dict(n_embd=2560, n_layer=32, n_head=32),
+    "gpt2-6.7b": dict(n_embd=4096, n_layer=32, n_head=32),
+}
+
+
+def config_for(name: str, **overrides) -> GPT2Config:
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r}: {sorted(PRESETS)}")
+    return GPT2Config(**{**PRESETS[name], **overrides})
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, T, C = x.shape
+        H = cfg.n_head
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, C // H)
+        k = k.reshape(B, T, H, C // H)
+        v = v.reshape(B, T, H, C // H)
+
+        if cfg.use_flash_attention:
+            from deepspeed_tpu.ops.attention import causal_attention
+            y = causal_attention(q, k, v)
+        else:
+            scale = 1.0 / jnp.sqrt(C // H).astype(cfg.dtype)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            att = jnp.where(mask[None, None], att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            if cfg.dropout > 0.0 and not deterministic:
+                att = nn.Dropout(cfg.dropout)(att, deterministic=False)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        y = y.reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.dtype, name="c_proj")(y)
+        if cfg.dropout > 0.0 and not deterministic:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=False)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        C = x.shape[-1]
+        h = nn.Dense(4 * C, dtype=cfg.dtype, name="c_fc")(x)
+        h = jax.nn.gelu(h, approximate=True)
+        h = nn.Dense(C, dtype=cfg.dtype, name="c_proj")(h)
+        if cfg.dropout > 0.0 and not deterministic:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=False)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        # LayerNorm in fp32 for stability, output cast back (the reference's
+        # fused kernels keep LN accumulation in fp32 too: normalize_kernels.cu)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        x = x + CausalSelfAttention(cfg, name="attn")(h, deterministic)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        x = x + MLP(cfg, name="mlp")(h, deterministic)
+        return x
+
+
+class GPT2(nn.Module):
+    """Causal LM. ``__call__`` returns logits; ``loss`` the mean CE loss."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.padded_vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        x = wte.astype(cfg.dtype)[input_ids] + \
+            wpe.astype(cfg.dtype)[jnp.arange(T)][None]
+        x = _maybe_constrain(x, P(DATA_AXES, "seq", None))
+        if cfg.dropout > 0.0 and not deterministic:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=False)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype))
+        return logits
+
+
+class GPT2LMModel:
+    """Engine-facing wrapper: init + loss_fn + tp_specs.
+
+    ``loss_fn(params, batch, rng)`` — batch is ``{"input_ids": [B,T] int32}``
+    (next-token prediction) or ``{"input_ids", "labels"}``.
+    """
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+        self.module = GPT2(config)
+
+    def init(self, rng, example_batch=None, batch_size: int = 2,
+             seq_len: Optional[int] = None):
+        seq_len = seq_len or min(self.config.n_positions, 128)
+        if example_batch is not None:
+            ids = example_batch["input_ids"]
+        else:
+            ids = jnp.zeros((batch_size, seq_len), jnp.int32)
+        variables = self.module.init(rng, ids)
+        return variables["params"]
+
+    def apply(self, params, input_ids, deterministic=True, rngs=None):
+        return self.module.apply({"params": params}, input_ids,
+                                 deterministic=deterministic, rngs=rngs)
+
+    def loss_fn(self, params, batch, rng=None):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        rngs = {"dropout": rng} if (rng is not None and
+                                    self.config.dropout > 0.0) else None
+        logits = self.apply(params, input_ids,
+                            deterministic=rngs is None, rngs=rngs)
+        if labels is None:
+            labels = input_ids[:, 1:]
+            logits = logits[:, :-1]
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        mask = (labels >= 0) & (labels < self.config.vocab_size)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    def tp_specs(self):
+        """Megatron-style tensor-parallel placement: attention qkv + mlp up
+        are column-parallel, the projections row-parallel, embeddings
+        vocab-parallel (module_inject/layers.py:9-61 semantics, as sharding
+        specs instead of module replacement)."""
+        cfg = self.config
+        block = {
+            "ln_1": {"scale": P(), "bias": P()},
+            "ln_2": {"scale": P(), "bias": P()},
+            "attn": {
+                "c_attn": {"kernel": P(None, "tensor"), "bias": P("tensor")},
+                "c_proj": {"kernel": P("tensor", None), "bias": P()},
+            },
+            "mlp": {
+                "c_fc": {"kernel": P(None, "tensor"), "bias": P("tensor")},
+                "c_proj": {"kernel": P("tensor", None), "bias": P()},
+            },
+        }
+        specs = {"wte": P("tensor", None), "wpe": P(),
+                 "ln_f": {"scale": P(), "bias": P()}}
+        for i in range(cfg.n_layer):
+            specs[f"h_{i}"] = block
+        return specs
+
+    def param_count(self, params) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+    def flops_per_token(self) -> float:
+        """~6 * N_params per token (training fwd+bwd)."""
+        cfg = self.config
+        n = (cfg.padded_vocab_size * cfg.n_embd
+             + cfg.n_positions * cfg.n_embd
+             + cfg.n_layer * (12 * cfg.n_embd ** 2))
+        return 6.0 * n

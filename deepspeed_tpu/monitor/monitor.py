@@ -1,0 +1,106 @@
+"""Monitoring fan-out — analog of ``deepspeed/monitor/monitor.py:24``
+(MonitorMaster → TensorBoard/WandB/CSV writers). Events are
+``(name, value, global_sample_count)`` triples exactly as the engine emits
+them (runtime/engine.py:1946)."""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = False
+
+    def write_events(self, event_list: List[Event]):
+        raise NotImplementedError
+
+
+class CsvMonitor(Monitor):
+    def __init__(self, csv_config):
+        self.enabled = csv_config.enabled and jax.process_index() == 0
+        self.output_path = csv_config.output_path or "./csv_monitor"
+        self.job_name = csv_config.job_name
+        self._files = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name),
+                        exist_ok=True)
+
+    def _file(self, name):
+        if name not in self._files:
+            safe = name.replace("/", "_")
+            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+            f = open(path, "a", newline="")
+            self._files[name] = (f, csv.writer(f))
+        return self._files[name]
+
+    def write_events(self, event_list: List[Event]):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            f, writer = self._file(name)
+            writer.writerow([step, value])
+            f.flush()
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, tb_config):
+        self.enabled = tb_config.enabled and jax.process_index() == 0
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                path = os.path.join(tb_config.output_path or "./runs",
+                                    tb_config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=path)
+            except Exception as e:
+                logger.warning(f"tensorboard unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, wandb_config):
+        self.enabled = wandb_config.enabled and jax.process_index() == 0
+        if self.enabled:
+            try:
+                import wandb
+                wandb.init(project=wandb_config.project,
+                           group=wandb_config.group, entity=wandb_config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = CsvMonitor(ds_config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list: List[Event]):
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m.enabled:
+                m.write_events(event_list)

@@ -1,0 +1,52 @@
+"""Attention ops with hardware dispatch.
+
+The hot-path analog of the reference's fused attention kernels
+(``csrc/transformer/softmax_kernels.cu`` for training,
+``softmax_context`` in ``csrc/transformer/inference/csrc/pt_binding.cpp``
+for decode). On TPU the MXU does the matmuls; the win is avoiding the
+O(T²) attention-matrix round-trip to HBM — a Pallas flash-attention kernel
+(deepspeed_tpu/ops/pallas/flash_attention.py) on TPU, with a pure-jnp
+reference path on CPU (used by the unit tests and as the numerics oracle).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def causal_attention_reference(q, k, v):
+    """Numerics oracle: plain softmax attention, fp32 accumulation.
+
+    Shapes: q/k/v ``[B, T, H, D]`` → ``[B, T, H, D]``.
+    """
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    att = jnp.where(mask[None, None], att, -1e30)
+    att = jax.nn.softmax(att, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", att.astype(v.dtype), v)
+
+
+def causal_attention(q, k, v):
+    """Causal self-attention ``[B, T, H, D] -> [B, T, H, D]``."""
+    if _on_tpu() and q.shape[1] >= 256:
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        except ImportError:
+            from deepspeed_tpu.utils.logging import warning_once
+            warning_once("pallas flash attention unavailable; falling back to "
+                         "O(T^2) reference attention")
+        else:
+            return flash_attention(q, k, v, causal=True)
+    return causal_attention_reference(q, k, v)

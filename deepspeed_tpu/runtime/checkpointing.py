@@ -1,0 +1,123 @@
+"""Checkpoint save/load.
+
+Analog of engine.save_checkpoint/load_checkpoint
+(``deepspeed/runtime/engine.py:3061,2706``). The reference writes per-rank
+model files + per-DP-rank ZeRO shards and validates tags across ranks
+(engine.py:3043). Here Orbax/TensorStore writes each *global* sharded array
+once (every host contributing its shards) — the TPU-native equivalent of the
+reference's sharded checkpoint layout, with resharding-on-load for free:
+restore takes the *current* shardings, so a checkpoint written on one mesh
+loads onto another (the universal-checkpoint capability,
+deepspeed/checkpoint/universal_checkpoint.py, is the default path here).
+
+Layout under ``save_dir``::
+
+    latest                  — text file with the newest tag (engine.py:3112)
+    <tag>/state/…           — orbax pytree of the TrainState
+    <tag>/client_state.json — step counters + user state
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def _tag_validation(tag: str, mode: str) -> None:
+    """Cross-process tag agreement check (engine._checkpoint_tag_validation,
+    engine.py:3043)."""
+    if jax.process_count() == 1 or mode.lower() == "ignore":
+        return
+    root_tag = comm.broadcast_obj(tag)
+    if str(root_tag) != str(tag):
+        msg = f"checkpoint tag mismatch: rank {comm.get_rank()} has {tag!r}, " \
+              f"rank 0 has {root_tag!r}"
+        if mode.lower() == "fail":
+            raise ValueError(msg)
+        logger.warning(msg)
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[Dict[str, Any]] = None) -> str:
+    tag = tag if tag is not None else f"global_step{engine.global_steps}"
+    _tag_validation(tag, engine.config.checkpoint_config.tag_validation)
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    state_path = os.path.join(ckpt_dir, "state")
+    cp = _checkpointer()
+    cp.save(os.path.abspath(state_path), engine.state, force=True)
+    cp.wait_until_finished()
+
+    meta = {
+        "global_steps": engine.global_steps,
+        "skipped_steps": engine.skipped_steps,
+        "micro_steps": engine._micro_steps,
+        "zero_stage": engine.zero_stage,
+        "precision": engine.config.precision_dtype,
+        "client_state": client_state or {},
+        "ds_version": _version(),
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(str(tag))
+    comm.barrier()
+    log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+    return ckpt_dir
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    load_lr_scheduler_states: bool = True,
+                    load_module_only: bool = False):
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if not os.path.isfile(latest):
+            logger.warning(f"no 'latest' file under {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    state_path = os.path.abspath(os.path.join(ckpt_dir, "state"))
+
+    # Restore onto the *current* shardings — resharding on mesh change is
+    # handled by orbax/tensorstore (universal checkpoint semantics).
+    abstract = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        engine.state, engine._state_shardings)
+    cp = _checkpointer()
+    restored = cp.restore(state_path, abstract)
+
+    if load_module_only or not load_optimizer_states:
+        restored = restored.replace(opt_state=engine.state.opt_state)
+    engine.state = restored
+
+    meta_path = os.path.join(ckpt_dir, "client_state.json")
+    client_state = {}
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        engine.global_steps = int(meta.get("global_steps", 0))
+        engine.skipped_steps = int(meta.get("skipped_steps", 0))
+        engine._micro_steps = int(meta.get("micro_steps", 0))
+        client_state = meta.get("client_state", {})
+    log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
+    return ckpt_dir, client_state
+
+
+def _version():
+    from deepspeed_tpu.version import __version__
+    return __version__

@@ -1,0 +1,91 @@
+"""Data loading.
+
+Analog of ``deepspeed/runtime/dataloader.py`` (``DeepSpeedDataLoader``). In
+the SPMD model every process feeds *global* batches (jax.Arrays sharded over
+the ``data`` axis); on a multi-host pod each process supplies its addressable
+shard and the loader assembles the global array. Accepts:
+
+* an iterable/iterator of batch pytrees (numpy/jax arrays), or
+* an indexable dataset (``__getitem__`` + ``__len__``) sampled sequentially
+  or shuffled per epoch.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart at StopIteration (reference:
+    runtime/dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    def __init__(self, dataset, batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 collate_fn=None, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.collate_fn = collate_fn
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+        self._epoch = 0
+        if hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
+            self.len = len(dataset) // batch_size
+            self._mode = "indexable"
+        else:
+            self.len = None
+            self._mode = "iterable"
+            self._iter = iter(dataset)
+
+    def __len__(self):
+        if self.len is None:
+            raise TypeError("iterable dataset has no length")
+        return self.len
+
+    def __iter__(self) -> Iterator[Any]:
+        if self._mode == "iterable":
+            return iter(self.dataset)
+        return self._index_iter()
+
+    def _index_iter(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        self._epoch += 1
+        for start in range(0, n - self.batch_size + 1, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            samples = [self.dataset[int(i)] for i in idx]
+            if self.collate_fn is not None:
+                yield self.collate_fn(samples)
+            else:
+                yield jax.tree.map(lambda *xs: np.stack(xs), *samples)
+
+    def __next__(self):
+        if self._mode == "iterable":
+            return next(self._iter)
+        if not hasattr(self, "_active_iter") or self._active_iter is None:
+            self._active_iter = self._index_iter()
+        try:
+            return next(self._active_iter)
+        except StopIteration:
+            self._active_iter = self._index_iter()
+            return next(self._active_iter)

@@ -1,0 +1,121 @@
+"""Learning-rate schedules.
+
+Ports the semantics of ``deepspeed/runtime/lr_schedules.py`` (854 LoC:
+WarmupLR, WarmupDecayLR, OneCycle, LRRangeTest) as optax-style pure
+``step -> lr`` schedule functions, selected by the same JSON ``scheduler``
+section names the reference uses (runtime/config.py scheduler keys).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = WARMUP_LOG_RATE, **_) -> Schedule:
+    """WarmupLR (lr_schedules.py ``WarmupLR``): ramp from min to max over
+    ``warmup_num_steps`` (log or linear), then hold at max."""
+    delta = warmup_max_lr - warmup_min_lr
+    wsteps = max(warmup_num_steps, 1)
+    log_den = math.log(wsteps + 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        if warmup_type == WARMUP_LOG_RATE:
+            frac = jnp.log1p(jnp.minimum(step, wsteps)) / log_den
+        else:
+            frac = jnp.minimum(step, wsteps) / wsteps
+        return jnp.where(step < wsteps, warmup_min_lr + delta * frac,
+                         jnp.float32(warmup_max_lr))
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = WARMUP_LOG_RATE, **_) -> Schedule:
+    """WarmupDecayLR: warmup then linear decay to 0 at ``total_num_steps``."""
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    wsteps = max(warmup_num_steps, 1)
+    decay_steps = max(total_num_steps - wsteps, 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay_frac = jnp.clip((total_num_steps - step) / decay_steps, 0.0, 1.0)
+        return jnp.where(step < wsteps, warm(step), warmup_max_lr * decay_frac)
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0,
+              **_) -> Schedule:
+    """OneCycle (lr_schedules.py ``OneCycle``): ramp up over the first phase,
+    down over the second, then optional decay below min."""
+    second = cycle_second_step_size if cycle_second_step_size is not None \
+        else cycle_first_step_size
+    span = cycle_max_lr - cycle_min_lr
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + span * jnp.minimum(step, cycle_first_step_size) \
+            / cycle_first_step_size
+        down_frac = jnp.clip((step - cycle_first_step_size) / second, 0.0, 1.0)
+        down = cycle_max_lr - span * down_frac
+        post = step - (cycle_first_step_size + second)
+        if decay_step_size > 0:
+            decayed = cycle_min_lr / (1.0 + decay_lr_rate
+                                      * jnp.floor(post / decay_step_size))
+        else:
+            decayed = jnp.float32(cycle_min_lr)
+        return jnp.where(step <= cycle_first_step_size, up,
+                         jnp.where(post <= 0, down, decayed))
+    return schedule
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    """LRRangeTest: linearly (or staircase) increasing LR probe."""
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+    return schedule
+
+
+def constant_lr(lr: float = 1e-3, **_) -> Schedule:
+    return lambda step: jnp.full((), lr, jnp.float32)
+
+
+SCHEDULE_REGISTRY = {
+    "WarmupLR": warmup_lr,
+    "WarmupDecayLR": warmup_decay_lr,
+    "OneCycle": one_cycle,
+    "LRRangeTest": lr_range_test,
+    "ConstantLR": constant_lr,
+}
+
+
+def build_schedule(scheduler_config, optimizer_params: dict = None) -> Schedule:
+    """Build from the JSON scheduler section; fall back to the optimizer's
+    fixed lr when no scheduler is configured (engine.py:1314 behavior)."""
+    if scheduler_config is None:
+        lr = (optimizer_params or {}).get("lr", 1e-3)
+        return constant_lr(lr)
+    name = scheduler_config.type
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(f"unknown scheduler {name!r}; "
+                         f"supported: {sorted(SCHEDULE_REGISTRY)}")
+    return SCHEDULE_REGISTRY[name](**scheduler_config.params)

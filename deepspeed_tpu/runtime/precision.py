@@ -1,0 +1,99 @@
+"""Mixed precision: dtypes + dynamic loss scaling.
+
+Analog of ``deepspeed/runtime/fp16/loss_scaler.py`` (LossScaler /
+DynamicLossScaler) and the bf16 master-weight scheme of
+``runtime/bf16_optimizer.py:38``. On TPU the default is bf16 (native MXU
+dtype) with fp32 master weights and **no** loss scaling; fp16 parity mode
+keeps the reference's dynamic scale semantics, expressed as pure functions on
+a LossScaleState carried in the train state (data-dependent skip happens via
+``lax.cond`` inside the jitted step — SURVEY §7.4 item 5 — so no retrace).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class LossScaleState:
+    scale: jnp.ndarray          # f32 scalar
+    growth_tracker: jnp.ndarray  # i32: consecutive non-overflow steps
+    hysteresis: jnp.ndarray      # i32: remaining tolerated overflows before cut
+    # static config
+    min_scale: float = struct.field(pytree_node=False, default=1.0)
+    growth_interval: int = struct.field(pytree_node=False, default=1000)
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+    init_hysteresis: int = struct.field(pytree_node=False, default=2)
+    dynamic: bool = struct.field(pytree_node=False, default=True)
+
+
+def make_loss_scale(fp16_config=None) -> LossScaleState:
+    """Build from an FP16Config section (static scale when loss_scale != 0,
+    mirroring fp16/loss_scaler.py semantics)."""
+    if fp16_config is None or not fp16_config.enabled:
+        return LossScaleState(scale=jnp.float32(1.0),
+                              growth_tracker=jnp.int32(0),
+                              hysteresis=jnp.int32(1), dynamic=False)
+    dynamic = fp16_config.loss_scale == 0.0
+    init = (2.0 ** fp16_config.initial_scale_power if dynamic
+            else fp16_config.loss_scale)
+    return LossScaleState(
+        scale=jnp.float32(init),
+        growth_tracker=jnp.int32(0),
+        hysteresis=jnp.int32(fp16_config.hysteresis),
+        min_scale=float(fp16_config.min_loss_scale),
+        growth_interval=int(fp16_config.loss_scale_window),
+        init_hysteresis=int(fp16_config.hysteresis),
+        dynamic=dynamic)
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """Global overflow check (reference: CheckOverflow, runtime/utils.py —
+    the cross-rank allreduce is implicit: with sharded grads XLA reduces the
+    local answer to a global one since the reduction is over all elements)."""
+    leaves = jax.tree.leaves(grads)
+    finite = jnp.bool_(True)
+    for leaf in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+    return finite
+
+
+def update_loss_scale(state: LossScaleState, finite: jnp.ndarray) -> LossScaleState:
+    """DynamicLossScaler.update_scale semantics (fp16/loss_scaler.py):
+    on overflow consume hysteresis then back off; on ``growth_interval``
+    consecutive good steps, grow."""
+    if not state.dynamic:
+        return state
+
+    def on_overflow(s):
+        new_hyst = s.hysteresis - 1
+        cut = new_hyst <= 0
+        new_scale = jnp.where(
+            cut, jnp.maximum(s.scale * s.backoff_factor, s.min_scale), s.scale)
+        new_hyst = jnp.where(cut, jnp.int32(s.init_hysteresis), new_hyst)
+        return s.replace(scale=new_scale, growth_tracker=jnp.int32(0),
+                         hysteresis=new_hyst)
+
+    def on_good(s):
+        tracker = s.growth_tracker + 1
+        grow = tracker >= s.growth_interval
+        new_scale = jnp.where(grow, s.scale * s.growth_factor, s.scale)
+        tracker = jnp.where(grow, jnp.int32(0), tracker)
+        return s.replace(scale=new_scale, growth_tracker=tracker)
+
+    return jax.lax.cond(finite, on_good, on_overflow, state)
+
+
+def cast_tree(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree)
+
+
+PRECISION_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}

@@ -1,0 +1,75 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` —
+``logger`` plus ``log_dist`` which only emits on the listed ranks. On a
+multi-host TPU pod "rank" is ``jax.process_index()``; in single-process
+(possibly multi-device) runs it is 0.
+"""
+import logging
+import os
+import sys
+import functools
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name="DeepSpeedTPU", level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    level=log_levels.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+@functools.lru_cache(maxsize=None)
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:  # jax not initialized / no backend
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the listed process ranks (-1 or None = all).
+
+    Mirrors the reference ``log_dist`` (deepspeed/utils/logging.py) with
+    ``jax.process_index()`` standing in for the torch.distributed rank.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+_warned = set()
+
+
+def warning_once(message):
+    if message not in _warned:
+        _warned.add(message)
+        logger.warning(message)

@@ -1,0 +1,132 @@
+"""Wall-clock + throughput timers.
+
+Analog of ``deepspeed/utils/timer.py`` (SynchronizedWallClockTimer /
+ThroughputTimer). "Synchronized" on TPU means ``jax.block_until_ready`` /
+device sync before reading the clock — async dispatch otherwise makes
+host-side timing meaningless.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_GLOBAL_TIMER = "forward"
+BACKWARD_GLOBAL_TIMER = "backward"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _sync():
+    try:
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name):
+        self.name = name
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.count = 0
+
+    def start(self):
+        assert not self.started, f"{self.name} timer already started"
+        _sync()
+        self.start_time = time.time()
+        self.started = True
+
+    def stop(self, reset=False):
+        assert self.started, f"{self.name} timer not started"
+        _sync()
+        self.elapsed_ += time.time() - self.start_time
+        self.count += 1
+        self.started = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.count = 0
+        self.started = False
+
+    def elapsed(self, reset=True):
+        out = self.elapsed_
+        if reset:
+            self.reset()
+        return out
+
+    def mean(self):
+        return self.elapsed_ / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0,
+            reset: bool = True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 \
+                    / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+
+class ThroughputTimer:
+    """samples/sec + optional TFLOPS reporting (reference: ThroughputTimer,
+    utils/timer.py; autotuning metric conventions BASELINE.md)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = max(steps_per_output, 1)
+        self.logging = logging_fn or (lambda m: log_dist(m, ranks=[0]))
+        self.initialized = False
+        self.total_elapsed_time = 0.0
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.start_time = 0.0
+        self.flops_per_sample: Optional[float] = None
+
+    def start(self):
+        if not self.initialized:
+            self.initialized = True
+        self.start_time = time.time()
+
+    def stop(self, global_step: Optional[int] = None, report_speed=False):
+        self.global_step_count = global_step if global_step is not None \
+            else self.global_step_count + 1
+        self.local_step_count += 1
+        if self.local_step_count <= self.start_step:
+            return  # skip warmup/compile steps
+        duration = time.time() - self.start_time
+        self.total_elapsed_time += duration
+        if report_speed and \
+                self.global_step_count % self.steps_per_output == 0:
+            msg = (f"step={self.global_step_count}, "
+                   f"throughput={self.avg_samples_per_sec():.2f} samples/s, "
+                   f"latency={duration*1000:.1f} ms")
+            if self.flops_per_sample:
+                tflops = self.flops_per_sample * self.avg_samples_per_sec() \
+                    / 1e12 / max(jax.device_count(), 1)
+                msg += f", {tflops:.2f} TFLOPS/device"
+            self.logging(msg)
+
+    def avg_samples_per_sec(self):
+        steps = self.local_step_count - self.start_step
+        if steps <= 0 or self.total_elapsed_time == 0.0:
+            return 0.0
+        return self.batch_size / (self.total_elapsed_time / steps)

@@ -1,0 +1,41 @@
+"""Test harness: single-process multi-device simulation.
+
+The reference spawns NCCL process groups per test (tests/unit/common.py
+DistributedExec). The TPU-native equivalent (SURVEY §4) is a virtual
+8-device CPU mesh in one process: every sharding/collective path compiles
+and runs exactly as on an 8-chip slice, minus the ICI performance.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# the environment pins JAX_PLATFORMS=axon (real TPU tunnel); tests always run
+# on the virtual CPU mesh
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    yield
+    from deepspeed_tpu.comm.mesh import reset_global_mesh
+    reset_global_mesh()
+
+
+@pytest.fixture
+def mesh8():
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+    return build_mesh(MeshConfig())
+
+
+def assert_allclose(a, b, rtol=1e-5, atol=1e-5):
+    import numpy as np
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=rtol, atol=atol)

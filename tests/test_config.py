@@ -1,0 +1,98 @@
+"""Config system tests — batch triad semantics mirror
+deepspeed/runtime/config.py:942 (see tests/unit/test_ds_config_dict.py in the
+reference for the shape of these cases)."""
+import pytest
+
+from deepspeed_tpu.config.config import DeepSpeedConfig
+
+
+def test_triad_all_given():
+    c = DeepSpeedConfig({"train_batch_size": 32,
+                         "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, dp_world_size=8)
+    assert (c.train_batch_size, c.train_micro_batch_size_per_gpu,
+            c.gradient_accumulation_steps) == (32, 2, 2)
+
+
+def test_triad_infer_gas():
+    c = DeepSpeedConfig({"train_batch_size": 32,
+                         "train_micro_batch_size_per_gpu": 2}, dp_world_size=8)
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_triad_infer_micro():
+    c = DeepSpeedConfig({"train_batch_size": 32,
+                         "gradient_accumulation_steps": 2}, dp_world_size=8)
+    assert c.train_micro_batch_size_per_gpu == 2
+
+
+def test_triad_infer_global():
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 2}, dp_world_size=8)
+    assert c.train_batch_size == 64
+
+
+def test_triad_only_global():
+    c = DeepSpeedConfig({"train_batch_size": 64}, dp_world_size=8)
+    assert c.train_micro_batch_size_per_gpu == 8
+    assert c.gradient_accumulation_steps == 1
+
+
+def test_triad_inconsistent_raises():
+    with pytest.raises(ValueError, match="not equal"):
+        DeepSpeedConfig({"train_batch_size": 33,
+                         "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, dp_world_size=8)
+
+
+def test_triad_none_raises():
+    with pytest.raises(ValueError, match="needs to be provided"):
+        DeepSpeedConfig({}, dp_world_size=8)
+
+
+def test_precision_exclusive():
+    with pytest.raises(ValueError, match="cannot both"):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}})
+
+
+def test_precision_dtype():
+    assert DeepSpeedConfig({"train_batch_size": 8}).precision_dtype == "float32"
+    assert DeepSpeedConfig({"train_batch_size": 8,
+                            "bf16": {"enabled": True}}).precision_dtype == "bfloat16"
+    assert DeepSpeedConfig({"train_batch_size": 8,
+                            "fp16": {"enabled": True}}).precision_dtype == "float16"
+
+
+def test_zero_section_and_deprecated_cpu_offload():
+    c = DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 2, "cpu_offload": True}})
+    assert c.zero_config.stage == 2
+    assert c.zero_config.offload_optimizer.device == "cpu"
+    assert c.zero_enabled
+
+
+def test_unknown_zero_key_rejected():
+    with pytest.raises(Exception):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stagee": 2}})
+
+
+def test_fp16_dynamic_vs_static():
+    c = DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True, "loss_scale": 128.0}})
+    assert not c.fp16.dynamic_loss_scale
+    c2 = DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}})
+    assert c2.fp16.dynamic_loss_scale
+
+
+def test_json_file_roundtrip(tmp_path):
+    import json
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps({"train_batch_size": 16,
+                             "optimizer": {"type": "AdamW",
+                                           "params": {"lr": 1e-3}}}))
+    c = DeepSpeedConfig(str(p), dp_world_size=8)
+    assert c.train_batch_size == 16
+    assert c.optimizer.type == "AdamW"

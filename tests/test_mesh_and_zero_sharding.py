@@ -1,0 +1,98 @@
+"""Mesh construction + ZeRO sharding-policy unit tests (pure placement
+logic — the analog of the reference's topology tests,
+tests/unit/runtime/pipe/test_topology.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import (MeshConfig, build_mesh,
+                                     get_data_parallel_world_size,
+                                     get_model_parallel_world_size,
+                                     get_pipe_parallel_world_size)
+from deepspeed_tpu.runtime.zero.partition import (ZeroShardingPolicy,
+                                                  shard_leaf_spec)
+
+
+def test_default_mesh_all_data():
+    mesh = build_mesh(MeshConfig())
+    assert get_data_parallel_world_size(mesh) == 8
+    assert get_model_parallel_world_size(mesh) == 1
+
+
+def test_mesh_2d():
+    mesh = build_mesh(MeshConfig(data=4, tensor=2))
+    assert get_data_parallel_world_size(mesh) == 4
+    assert get_model_parallel_world_size(mesh) == 2
+
+
+def test_mesh_3d():
+    mesh = build_mesh(MeshConfig(data=2, tensor=2, pipe=2))
+    assert get_data_parallel_world_size(mesh) == 2
+    assert get_pipe_parallel_world_size(mesh) == 2
+
+
+def test_mesh_indivisible_raises():
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(data=3, tensor=2))
+
+
+def test_shard_leaf_picks_largest_divisible_dim(mesh8):
+    spec = shard_leaf_spec((128, 512), None, mesh8)
+    assert spec == P(None, "data")
+    spec = shard_leaf_spec((1024, 16), None, mesh8)
+    assert spec == P("data", None)
+
+
+def test_shard_leaf_respects_tp_claim():
+    mesh = build_mesh(MeshConfig(data=4, tensor=2))
+    # dim1 claimed by tensor; ZeRO must take dim0
+    spec = shard_leaf_spec((64, 128), P(None, "tensor"), mesh)
+    assert spec == P("data", "tensor")
+
+
+def test_shard_leaf_small_stays_replicated(mesh8):
+    assert shard_leaf_spec((3,), None, mesh8) == P()
+    assert shard_leaf_spec((7, 5), None, mesh8) == P()
+
+
+params = {"dense": {"kernel": jnp.zeros((64, 128)), "bias": jnp.zeros((128,))},
+          "emb": jnp.zeros((256, 64))}
+
+
+@pytest.mark.parametrize("stage,param_sharded,grad_sharded,master_sharded", [
+    (0, False, False, False),
+    (1, False, False, True),
+    (2, False, True, True),
+    (3, True, True, True),
+])
+def test_policy_stages(mesh8, stage, param_sharded, grad_sharded,
+                       master_sharded):
+    policy = ZeroShardingPolicy(stage, mesh8)
+
+    def is_sharded(sh_tree):
+        kernel_spec = sh_tree["dense"]["kernel"].spec
+        return any(e is not None for e in kernel_spec)
+
+    assert is_sharded(policy.param_sharding(params)) == param_sharded
+    assert is_sharded(policy.grad_sharding(params)) == grad_sharded
+    assert is_sharded(policy.master_sharding(params)) == master_sharded
+
+
+def test_policy_stage3_with_tp():
+    mesh = build_mesh(MeshConfig(data=4, tensor=2))
+    tp = {"dense": {"kernel": P(None, "tensor"), "bias": P()}, "emb": None}
+    policy = ZeroShardingPolicy(3, mesh, tp_specs=tp)
+    sh = policy.param_sharding(params)
+    assert sh["dense"]["kernel"].spec == P("data", "tensor")
+    assert sh["emb"].spec in (P("data", None), P(None, "data"))
+
+
+def test_sharded_array_memory_footprint(mesh8):
+    """Stage-3 params must actually occupy 1/8 of the bytes per device."""
+    policy = ZeroShardingPolicy(3, mesh8)
+    sh = policy.param_sharding(params)
+    x = jax.device_put(params["emb"], sh["emb"])
+    shard = x.addressable_shards[0]
+    assert shard.data.size == x.size // 8

@@ -1,0 +1,99 @@
+"""Optimizer numerics vs torch (CPU) — the analog of the reference's op
+parity tests (tests/unit/ops/adam/test_cpu_adam.py compares DeepSpeedCPUAdam
+to torch.optim.AdamW)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.adam import build_optimizer
+from deepspeed_tpu.runtime.lr_schedules import (build_schedule, one_cycle,
+                                                warmup_decay_lr, warmup_lr)
+
+
+def _run_ours(name, params_np, grads_np, lr, steps, **kw):
+    opt = build_optimizer(name, kw)
+    params = {"w": jnp.asarray(params_np)}
+    state = opt.init(params)
+    for _ in range(steps):
+        grads = {"w": jnp.asarray(grads_np)}
+        updates, state = opt.update(grads, state, params, jnp.float32(lr))
+        params = jax.tree.map(jnp.add, params, updates)
+    return np.asarray(params["w"])
+
+
+def test_adamw_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=(37, 13)).astype(np.float32)
+    g = rng.normal(size=(37, 13)).astype(np.float32)
+
+    p = torch.nn.Parameter(torch.tensor(w0))
+    opt = torch.optim.AdamW([p], lr=1e-2, betas=(0.9, 0.999), eps=1e-8,
+                            weight_decay=0.01)
+    for _ in range(5):
+        opt.zero_grad()
+        p.grad = torch.tensor(g)
+        opt.step()
+    ours = _run_ours("adamw", w0, g, 1e-2, 5,
+                     betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01)
+    np.testing.assert_allclose(ours, p.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_plain_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    w0 = rng.normal(size=(11,)).astype(np.float32)
+    g = rng.normal(size=(11,)).astype(np.float32)
+    p = torch.nn.Parameter(torch.tensor(w0))
+    opt = torch.optim.Adam([p], lr=3e-3)
+    for _ in range(3):
+        opt.zero_grad()
+        p.grad = torch.tensor(g)
+        opt.step()
+    ours = _run_ours("adam", w0, g, 3e-3, 3, adam_w_mode=False)
+    np.testing.assert_allclose(ours, p.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_lamb_trust_ratio_bounds():
+    ours = _run_ours("lamb", np.ones((8, 8), np.float32),
+                     np.full((8, 8), 1e-8, np.float32), 1e-2, 1,
+                     min_coeff=0.5, max_coeff=2.0)
+    # trust ratio clamps keep the update bounded
+    assert np.all(np.abs(ours - 1.0) <= 1e-2 * 2.0 * 1.5)
+
+
+def test_sgd_momentum():
+    ours = _run_ours("sgd", np.zeros(4, np.float32),
+                     np.ones(4, np.float32), 0.1, 2, momentum=0.9)
+    # step1: v=1, w=-0.1; step2: v=1.9, w=-0.29
+    np.testing.assert_allclose(ours, np.full(4, -0.29), rtol=1e-6)
+
+
+def test_warmup_lr_endpoints():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1e-3,
+                  warmup_num_steps=100, warmup_type="linear")
+    assert float(s(0)) == 0.0
+    np.testing.assert_allclose(float(s(50)), 5e-4, rtol=1e-5)
+    np.testing.assert_allclose(float(s(100)), 1e-3, rtol=1e-5)
+    np.testing.assert_allclose(float(s(10_000)), 1e-3, rtol=1e-5)
+
+
+def test_warmup_decay_reaches_zero():
+    s = warmup_decay_lr(total_num_steps=200, warmup_max_lr=1e-3,
+                        warmup_num_steps=100, warmup_type="linear")
+    np.testing.assert_allclose(float(s(100)), 1e-3, rtol=1e-4)
+    np.testing.assert_allclose(float(s(200)), 0.0, atol=1e-9)
+
+
+def test_one_cycle_shape():
+    s = one_cycle(cycle_min_lr=1e-4, cycle_max_lr=1e-3,
+                  cycle_first_step_size=10, cycle_second_step_size=10)
+    np.testing.assert_allclose(float(s(10)), 1e-3, rtol=1e-5)
+    assert float(s(0)) < float(s(5)) < float(s(10))
+    assert float(s(10)) > float(s(15)) > float(s(20) - 1e-9)
+
+
+def test_build_schedule_fallback_lr():
+    s = build_schedule(None, {"lr": 0.42})
+    assert float(s(123)) == pytest.approx(0.42)
