@@ -192,6 +192,7 @@ class DeepSpeedConfig:
             raise ValueError(f"expected dict or json path, got {type(config)}")
 
         pd = self._param_dict
+        self._validate_keys(pd)
         self.train_batch_size: Optional[int] = pd.get("train_batch_size")
         self.train_micro_batch_size_per_gpu: Optional[int] = pd.get(
             "train_micro_batch_size_per_gpu")
@@ -231,6 +232,39 @@ class DeepSpeedConfig:
 
         if dp_world_size is not None:
             self.resolve_batch_config(dp_world_size)
+
+    KNOWN_KEYS = frozenset({
+        "train_batch_size", "train_micro_batch_size_per_gpu",
+        "gradient_accumulation_steps", "steps_per_print",
+        "wall_clock_breakdown", "memory_breakdown", "prescale_gradients",
+        "gradient_predivide_factor", "gradient_clipping", "dump_state",
+        "seed", "fp16", "bf16", "bfloat16", "zero_optimization", "optimizer",
+        "scheduler", "comms_logger", "tensorboard", "wandb", "csv_monitor",
+        "activation_checkpointing", "checkpoint", "mesh",
+        "compile_cache_dir", "flops_profiler", "monitor", "elasticity",
+        "autotuning", "compression_training", "data_efficiency",
+        "curriculum_learning", "aio", "sparse_attention",
+        "zero_allow_untested_optimizer", "communication_data_type",
+        "sparse_gradients", "amp", "pipeline", "inference", "data_types",
+        "eigenvalue", "progressive_layer_drop", "quantize_training",
+        "gradient_accumulation_plugin", "timers", "nebula", "hybrid_engine",
+    })
+
+    @classmethod
+    def _validate_keys(cls, pd: dict) -> None:
+        """Reject unknown top-level keys — typos must fail loudly (the
+        reference warns via pydantic extra-field handling; we error, since a
+        silently-ignored ``zero_optimizatoin`` can cost a training run)."""
+        import difflib
+        unknown = [k for k in pd if k not in cls.KNOWN_KEYS]
+        if unknown:
+            hints = []
+            for k in unknown:
+                close = difflib.get_close_matches(k, cls.KNOWN_KEYS, n=1)
+                hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)"
+                                         if close else ""))
+            raise ValueError(
+                f"unknown config key(s): {', '.join(hints)}")
 
     # -- batch triad (reference: runtime/config.py:942 + assertions :918) ----
     def resolve_batch_config(self, dp_world_size: int) -> None:

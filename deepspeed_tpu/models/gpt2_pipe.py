@@ -1,0 +1,128 @@
+"""Pipelined GPT-2 — the flagship model on the ``pipe`` mesh axis.
+
+The reference expresses pipelined GPT as a ``PipelineModule`` of LayerSpecs
+interpreted rank-by-rank (``runtime/pipe/module.py:85``); here the decoder
+stack is a single stacked-parameter pytree (leading dim = n_layer) driven
+through the compiled scan+ppermute executor
+(deepspeed_tpu/parallel/pipe/pipeline.py). Embedding and LM head run outside
+the pipelined region — replicated over ``pipe``, sharded over
+data/tensor/seq like any other layer. Weight tying (wte = unembedding) is
+structural, so the reference's tied-weight allreduce
+(runtime/pipe/module.py:420) is subsumed by autodiff.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.gpt2 import Block, GPT2Config, _maybe_constrain
+from deepspeed_tpu.parallel.pipe.pipeline import pipeline_apply
+
+DATA_AXES = ("data", "fsdp")
+
+
+class GPT2PipeModel:
+    """Engine-facing pipelined GPT-2: init + loss_fn + tp_specs.
+
+    ``num_microbatches`` splits the per-step batch inside the pipeline
+    (the analog of PipelineEngine's micro_batches = gradient accumulation
+    steps, runtime/pipe/engine.py:294).
+    """
+
+    def __init__(self, config: GPT2Config, num_microbatches: int = 4):
+        if config.dropout > 0.0:
+            raise NotImplementedError(
+                "GPT2PipeModel does not thread dropout rngs through the "
+                "pipelined scan yet; set dropout=0.0 (the reference's large-"
+                "model GPT configs train without dropout too)")
+        self.config = config
+        self.num_microbatches = num_microbatches
+        self._block = Block(config)
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng, batch_size: int = 2, seq_len: Optional[int] = None):
+        cfg = self.config
+        seq_len = seq_len or min(cfg.n_positions, 128)
+        k_wte, k_wpe, k_blocks = jax.random.split(rng, 3)
+        wte = jax.random.normal(k_wte, (cfg.padded_vocab_size, cfg.n_embd),
+                                jnp.float32) * 0.02
+        wpe = jax.random.normal(k_wpe, (cfg.n_positions, cfg.n_embd),
+                                jnp.float32) * 0.01
+        dummy = jnp.zeros((1, seq_len, cfg.n_embd), cfg.dtype)
+
+        def init_one(key):
+            return self._block.init(key, dummy)["params"]
+
+        blocks = jax.vmap(init_one)(jax.random.split(k_blocks, cfg.n_layer))
+        ln_f = {"scale": jnp.ones((cfg.n_embd,), jnp.float32),
+                "bias": jnp.zeros((cfg.n_embd,), jnp.float32)}
+        return {"wte": wte, "wpe": wpe, "blocks": blocks, "ln_f": ln_f}
+
+    # -- forward ------------------------------------------------------------
+    def _block_fn(self, layer_params, h):
+        return self._block.apply({"params": layer_params}, h)
+
+    def apply(self, params, input_ids):
+        cfg = self.config
+        B, T = input_ids.shape
+        x = params["wte"].astype(cfg.dtype)[input_ids] + \
+            params["wpe"].astype(cfg.dtype)[jnp.arange(T)][None]
+        x = _maybe_constrain(x, P(DATA_AXES, "seq", None))
+        x = pipeline_apply(self._block_fn, params["blocks"], x,
+                           num_microbatches=self.num_microbatches,
+                           remat=cfg.remat)
+        # final LN in fp32 accumulation, same as the fused reference kernel
+        mean = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+        x32 = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + 1e-5)
+        x = (x32 * params["ln_f"]["scale"] +
+             params["ln_f"]["bias"]).astype(cfg.dtype)
+        return jnp.einsum("btc,vc->btv", x, params["wte"].astype(cfg.dtype))
+
+    def loss_fn(self, params, batch, rng=None):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        logits = self.apply(params, input_ids)
+        if labels is None:
+            labels = input_ids[:, 1:]
+            logits = logits[:, :-1]
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        mask = (labels >= 0) & (labels < self.config.vocab_size)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    # -- sharding -----------------------------------------------------------
+    def tp_specs(self):
+        """Stacked-block leaves get ``pipe`` on dim 0; within-layer dims carry
+        the same Megatron TP placement as the unpipelined model."""
+        def pp(*rest):
+            return P("pipe", *rest)
+        block = {
+            "ln_1": {"scale": pp(), "bias": pp()},
+            "ln_2": {"scale": pp(), "bias": pp()},
+            "attn": {
+                "c_attn": {"kernel": pp(None, "tensor"), "bias": pp("tensor")},
+                "c_proj": {"kernel": pp("tensor", None), "bias": pp()},
+            },
+            "mlp": {
+                "c_fc": {"kernel": pp(None, "tensor"), "bias": pp("tensor")},
+                "c_proj": {"kernel": pp("tensor", None), "bias": pp()},
+            },
+        }
+        return {"wte": P("tensor", None), "wpe": P(), "blocks": block,
+                "ln_f": {"scale": P(), "bias": P()}}
+
+    def param_count(self, params) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+    def flops_per_token(self) -> float:
+        cfg = self.config
+        n = (cfg.padded_vocab_size * cfg.n_embd
+             + cfg.n_positions * cfg.n_embd
+             + cfg.n_layer * (12 * cfg.n_embd ** 2))
+        return 6.0 * n

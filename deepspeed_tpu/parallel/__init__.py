@@ -1,0 +1,9 @@
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                             PipelineParallelGrid,
+                                             PipeModelDataParallelTopology,
+                                             ProcessTopology)
+
+__all__ = [
+    "ProcessTopology", "PipeDataParallelTopology",
+    "PipeModelDataParallelTopology", "PipelineParallelGrid",
+]

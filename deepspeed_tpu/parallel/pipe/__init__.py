@@ -1,0 +1,18 @@
+from deepspeed_tpu.parallel.pipe.module import (LayerSpec, PipelineModule,
+                                                TiedLayerSpec,
+                                                partition_balanced,
+                                                partition_uniform)
+from deepspeed_tpu.parallel.pipe.pipeline import (pipeline_apply,
+                                                  stack_layer_params,
+                                                  unstack_layer_params)
+from deepspeed_tpu.parallel.pipe.schedule import (DataParallelSchedule,
+                                                  InferenceSchedule,
+                                                  TrainSchedule,
+                                                  bubble_fraction)
+
+__all__ = [
+    "LayerSpec", "TiedLayerSpec", "PipelineModule", "partition_uniform",
+    "partition_balanced", "pipeline_apply", "stack_layer_params",
+    "unstack_layer_params", "TrainSchedule", "InferenceSchedule",
+    "DataParallelSchedule", "bubble_fraction",
+]

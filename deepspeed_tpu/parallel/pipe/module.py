@@ -1,0 +1,213 @@
+"""Pipeline model description: LayerSpec / TiedLayerSpec / PipelineModule.
+
+Analog of ``deepspeed/runtime/pipe/module.py`` (LayerSpec :23, TiedLayerSpec
+:71, PipelineModule :85, partitioning :364). The reference builds only the
+local stage's torch modules per rank; under single-controller SPMD every host
+traces the whole program, so PipelineModule here is a *description* object:
+it owns the layer list, the stage partition, and produces the three pieces
+the compiled executor (pipeline.py) consumes — prologue (stage-0-only
+layers), the homogeneous block stack, and epilogue (last-stage-only layers).
+
+Stage partitioning methods match the reference: ``uniform`` (equal layer
+counts), ``parameters`` (equal parameter counts via the same prefix-sum
+balancing), ``type:regex`` (balance layers whose class name matches).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class LayerSpec:
+    """Lazily-built layer: ``LayerSpec(cls, *args, **kwargs)`` (ref :23).
+
+    ``cls`` may be a flax module class, a factory, or any callable returning
+    the layer object. ``build()`` materializes it.
+    """
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable type")
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+    def __repr__(self):
+        return f"LayerSpec({self.name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared with every other layer of the same
+    ``key`` (reference :71 — e.g. embedding/unembedding weight tying). Under
+    SPMD, tying is structural: tied layers read the same param subtree, so
+    the "tied-weight allreduce" (ref module.py:420) is simply autodiff
+    summing both uses' gradients — no extra collective is needed.
+    """
+
+    def __init__(self, key: str, typename: Callable, *module_args,
+                 forward_fn: Optional[Callable] = None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Split num_items into num_parts contiguous ranges; returns P+1 bounds."""
+    parts = [0] * (num_parts + 1)
+    chunk, residual = divmod(num_items, num_parts)
+    for p in range(1, num_parts + 1):
+        parts[p] = parts[p - 1] + chunk + (1 if p <= residual else 0)
+    assert parts[-1] == num_items
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Contiguous partition minimizing the max part weight (ref
+    ds_utils.partition_balanced semantics) — binary search over the
+    bottleneck + greedy check."""
+    weights = list(map(float, weights))
+    n = len(weights)
+    if num_parts >= n:
+        return partition_uniform(n, num_parts)
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    def num_parts_needed(bottleneck: float) -> int:
+        parts, start = 0, 0
+        while start < n:
+            if weights[start] > bottleneck:
+                return num_parts + 1  # impossible
+            # furthest end with sum <= bottleneck
+            end = int(np.searchsorted(prefix, prefix[start] + bottleneck,
+                                      side="right")) - 1
+            end = max(end, start + 1)
+            parts += 1
+            start = end
+        return parts
+
+    lo, hi = max(weights), sum(weights)
+    for _ in range(100):
+        mid = (lo + hi) / 2
+        if num_parts_needed(mid) <= num_parts:
+            hi = mid
+        else:
+            lo = mid
+    bottleneck = hi
+    bounds = [0]
+    start = 0
+    for p in range(num_parts):
+        remaining_parts = num_parts - p - 1
+        if start >= n:
+            bounds.append(n)
+            continue
+        end = int(np.searchsorted(prefix, prefix[start] + bottleneck,
+                                  side="right")) - 1
+        end = max(end, start + 1)
+        end = min(end, n - remaining_parts)
+        bounds.append(end)
+        start = end
+    bounds[-1] = n
+    return bounds
+
+
+class PipelineModule:
+    """Layer-list pipeline description (reference PipelineModule :85).
+
+    Parameters
+    ----------
+    layers: list of LayerSpec (or raw callables, wrapped automatically).
+    num_stages: pipeline depth (mesh ``pipe`` axis size).
+    partition_method: 'uniform' | 'parameters' | 'type:<regex>'.
+    param_counts: optional per-layer parameter counts for 'parameters'
+        partitioning (avoids building layers to count).
+    """
+
+    def __init__(self, layers, num_stages: int,
+                 partition_method: str = "parameters",
+                 param_counts: Optional[Sequence[int]] = None,
+                 loss_fn: Optional[Callable] = None):
+        self._specs: List[LayerSpec] = [
+            s if isinstance(s, LayerSpec) else LayerSpec(lambda s=s: s)
+            for s in layers]
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self.loss_fn = loss_fn
+        self._param_counts = param_counts
+        self.parts = self._partition_layers()
+        # tied-key registry (ref :420-442)
+        self.tied_specs: Dict[str, List[int]] = {}
+        for i, spec in enumerate(self._specs):
+            if isinstance(spec, TiedLayerSpec):
+                self.tied_specs.setdefault(spec.key, []).append(i)
+
+    # -- partitioning ------------------------------------------------------
+    def _layer_weights(self) -> List[float]:
+        method = self.partition_method.lower()
+        n = len(self._specs)
+        if method == "uniform":
+            return [1.0] * n
+        if method == "parameters":
+            if self._param_counts is not None:
+                return list(map(float, self._param_counts))
+            counts = []
+            for spec in self._specs:
+                counts.append(float(self._estimate_params(spec)))
+            return counts
+        if method.startswith("type:"):
+            pattern = method[len("type:"):]
+            return [1.0 if re.search(pattern, s.name, re.IGNORECASE) else 0.0
+                    for s in self._specs]
+        raise NotImplementedError(
+            f"partition_method {self.partition_method!r}")
+
+    @staticmethod
+    def _estimate_params(spec: LayerSpec) -> int:
+        """Parameter count via abstract flax init when possible, else 0."""
+        try:
+            layer = spec.build()
+        except Exception:
+            return 0
+        init = getattr(layer, "lazy_param_count", None)
+        if callable(init):
+            return int(init())
+        return 0
+
+    def _partition_layers(self) -> List[int]:
+        weights = self._layer_weights()
+        if all(w == weights[0] for w in weights):
+            return partition_uniform(len(self._specs), self.num_stages)
+        return partition_balanced(weights, self.num_stages)
+
+    # -- stage views -------------------------------------------------------
+    def stage_layer_indices(self, stage_id: int) -> range:
+        return range(self.parts[stage_id], self.parts[stage_id + 1])
+
+    def stage_specs(self, stage_id: int) -> List[LayerSpec]:
+        return [self._specs[i] for i in self.stage_layer_indices(stage_id)]
+
+    def build_stage(self, stage_id: int) -> List[Any]:
+        return [spec.build() for spec in self.stage_specs(stage_id)]
+
+    @property
+    def num_layers(self) -> int:
+        return len(self._specs)
+
+    def layers_per_stage(self) -> List[int]:
+        return [self.parts[s + 1] - self.parts[s]
+                for s in range(self.num_stages)]
+
+    def describe(self) -> str:
+        lines = []
+        for s in range(self.num_stages):
+            names = [spec.name for spec in self.stage_specs(s)]
+            lines.append(f"stage {s}: {names}")
+        return "\n".join(lines)

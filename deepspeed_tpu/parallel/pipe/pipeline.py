@@ -1,0 +1,144 @@
+"""Compiled SPMD pipeline executor.
+
+The reference executes pipelines with a host-side instruction interpreter
+over torch autograd and NCCL p2p (``deepspeed/runtime/pipe/engine.py:1359``,
+``p2p.py``). The TPU-native formulation compiles the ENTIRE schedule into one
+XLA program: ``jax.shard_map`` manual over the ``pipe`` mesh axis (all other
+axes stay automatic, so ZeRO/TP/SP sharding composes), ``lax.ppermute`` for
+the stage→stage activation handoff (rides ICI), and ``lax.scan`` over
+schedule ticks. Reverse-mode autodiff of this program IS the backward
+schedule: the transpose of ppermute is the reverse hop, the transpose of the
+scan is the drain-direction sweep — DeepSpeed's SendGrad/RecvGrad/
+BackwardPass instructions fall out of AD instead of being hand-interpreted.
+
+Bubble: the scan runs ``M + P - 1`` ticks; stages compute garbage during
+fill/drain (masked out of outputs and gradients) — same wall-clock overhead
+as the reference's idle bubble, fraction ``(P-1)/(M+P-1)``.
+
+Memory: autodiff stashes one residual set per tick — the GPipe profile,
+bounded with ``jax.checkpoint`` on the block fn (pass ``remat=True``).
+DeepSpeed's 1F1B depth-bounded variant (schedule.py) is a host-scheduling
+refinement that XLA's static program cannot express; remat achieves the same
+peak-memory bound by recomputation.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import get_global_mesh
+
+PIPE_AXIS = "pipe"
+
+
+def stack_layer_params(per_layer_params) -> Any:
+    """Stack a list of identical-structure per-layer pytrees into one pytree
+    with a leading layer dimension (the executor's expected layout)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
+
+
+def unstack_layer_params(stacked, num_layers: int):
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(num_layers)]
+
+
+def pipeline_apply(block_fn: Callable,
+                   stacked_params: Any,
+                   x: jax.Array,
+                   *,
+                   num_microbatches: int,
+                   mesh: Optional[Mesh] = None,
+                   remat: bool = True,
+                   extra_broadcast_args: tuple = ()) -> jax.Array:
+    """Apply ``num_layers`` stacked transformer blocks through a ``pipe``-deep
+    pipeline over microbatches split from the leading (batch) dim of ``x``.
+
+    Parameters
+    ----------
+    block_fn: ``(layer_params, h, *extra) -> h`` — one block's forward.
+    stacked_params: pytree, every leaf with leading dim ``num_layers``
+        (divisible by the mesh's ``pipe`` size).
+    x: ``[B, ...]`` activations entering layer 0. ``B % num_microbatches == 0``.
+    extra_broadcast_args: per-call constants passed to every block
+        (e.g. attention masks / position offsets), replicated over pipe.
+
+    Returns ``[B, ...]`` activations after the last layer, replicated over
+    the pipe axis (still sharded over data/tensor/seq axes as before).
+    """
+    mesh = mesh or get_global_mesh()
+    if PIPE_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh has no {PIPE_AXIS!r} axis: {mesh.axis_names}")
+    n_stages = mesh.shape[PIPE_AXIS]
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pipe={n_stages}")
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+
+    if n_stages == 1:
+        # degenerate pipeline: plain scan over layers (no pipe collectives)
+        body = jax.checkpoint(block_fn) if remat else block_fn
+
+        def layer_step(h, pl):
+            return body(pl, h, *extra_broadcast_args), None
+        y, _ = jax.lax.scan(layer_step, x, stacked_params)
+        return y
+
+    block = jax.checkpoint(block_fn) if remat else block_fn
+
+    def stage_apply(stage_params, h, extra):
+        def layer_step(h, pl):
+            return block(pl, h, *extra), None
+        h, _ = jax.lax.scan(layer_step, h, stage_params)
+        return h
+
+    def pipelined(stage_params, x, extra):
+        # stage_params leaves: [num_layers // n_stages, ...] (this stage's)
+        s = jax.lax.axis_index(PIPE_AXIS)
+        mb = B // M
+        xs = x.reshape((M, mb) + x.shape[1:])
+        state = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        ybuf = jnp.zeros((M, mb) + x.shape[1:], x.dtype)
+        shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            state, ybuf = carry
+            # stage 0 ingests microbatch t (clamped during drain ticks)
+            inject = xs[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(s == 0, inject, state)
+            out = stage_apply(stage_params, cur, extra)
+            # last stage emits microbatch t - (P-1) during valid ticks
+            widx = t - (n_stages - 1)
+            valid = jnp.logical_and(s == n_stages - 1,
+                                    jnp.logical_and(widx >= 0, widx < M))
+            written = jax.lax.dynamic_update_index_in_dim(
+                ybuf, out, jnp.clip(widx, 0, M - 1), 0)
+            ybuf = jnp.where(valid, written, ybuf)
+            state = jax.lax.ppermute(out, PIPE_AXIS, shift)
+            return (state, ybuf), None
+
+        (state, ybuf), _ = jax.lax.scan(
+            tick, (state, ybuf), jnp.arange(M + n_stages - 1))
+        # broadcast the last stage's outputs to all pipe ranks (masked psum;
+        # XLA lowers this to a collective-broadcast over the pipe ring)
+        ybuf = jax.lax.psum(
+            jnp.where(s == n_stages - 1, ybuf, jnp.zeros_like(ybuf)),
+            PIPE_AXIS)
+        return ybuf.reshape((B,) + x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stacked_params)
+    extra_specs = jax.tree.map(lambda _: P(), extra_broadcast_args)
+    return jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(param_specs, P(), extra_specs),
+        out_specs=P(),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )(stacked_params, x, extra_broadcast_args)

@@ -1,0 +1,280 @@
+"""Pipeline schedules as instruction streams.
+
+Analog of ``deepspeed/runtime/pipe/schedule.py`` (TrainSchedule 1F1B :182,
+InferenceSchedule :129, instruction dataclasses :317). On TPU the executed
+schedule is a *compiled* scan+ppermute program (pipeline.py) — XLA sees the
+whole schedule at once, so there is no runtime interpreter. These generators
+remain the source of truth for schedule math: bubble accounting, buffer
+counts, and the host-driven multi-slice runner; tests assert the 1F1B
+ordering invariants against them.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """Base instruction; carries kwargs as attributes (reference :317)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (self.__class__ is other.__class__ and
+                self.kwargs == other.kwargs)
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on an activation buffer slot."""
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Generator of per-step instruction lists for one stage.
+
+    Mirrors the reference ABC (schedule.py:8-127): ``steps()`` yields the
+    instruction list for each schedule tick.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range [0,{stages})")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def stage(self) -> int:
+        return self.stage_id
+
+    @property
+    def num_stages(self) -> int:
+        return self.stages
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.stages
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain schedule (reference :129-180)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro_batch_id %
+                                               self.num_pipe_buffers()))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buffer_id=micro_batch_id %
+                                               self.num_pipe_buffers()))
+                cmds.append(ForwardPass(buffer_id=micro_batch_id %
+                                        self.num_pipe_buffers()))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=micro_batch_id %
+                                               self.num_pipe_buffers()))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B schedule (reference :182-290): each stage runs at most
+    ``stages - stage_id`` forwards ahead of its backwards, bounding stashed
+    activations to that depth instead of ``micro_batches``."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+
+            # Exchange with neighbours (reference :205-219): a forward tick
+            # receives the current activation from prev AND returns the
+            # previous backward mb's grad to prev; a backward tick sends the
+            # previous forward mb's activation to next AND receives the
+            # current grad from next.
+            if is_forward:
+                if self._valid_micro_batch(micro_batch_id) and \
+                        self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(
+                        buffer_id=self._buffer_idx(micro_batch_id)))
+                if self._valid_micro_batch(prev_micro_batch_id) and \
+                        self._valid_stage(self.prev_stage):
+                    cmds.append(SendGrad(
+                        buffer_id=self._buffer_idx(prev_micro_batch_id)))
+            else:
+                if self._valid_micro_batch(prev_micro_batch_id) and \
+                        self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(
+                        buffer_id=self._buffer_idx(prev_micro_batch_id)))
+                if self._valid_micro_batch(micro_batch_id) and \
+                        self._valid_stage(self.next_stage):
+                    cmds.append(RecvGrad(
+                        buffer_id=self._buffer_idx(micro_batch_id)))
+
+            # First/last stage loads (last stage needs labels for the loss)
+            if self.is_first_stage or self.is_last_stage:
+                if is_forward and self._valid_micro_batch(micro_batch_id):
+                    cmds.append(LoadMicroBatch(
+                        buffer_id=self._buffer_idx(micro_batch_id)))
+
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    cmds.append(ForwardPass(
+                        buffer_id=self._buffer_idx(micro_batch_id)))
+                else:
+                    cmds.append(BackwardPass(
+                        buffer_id=self._buffer_idx(micro_batch_id)))
+
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        """1F1B bounds live buffers to the distance from the last stage
+        (reference :245-249: min(stages - stage_id + 1, micro_batches))."""
+        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
+        return max(2, buffers)
+
+    def _step_to_micro_batch(self, step_id):
+        """Map schedule tick -> (micro_batch_id, is_forward) (ref :219-262)."""
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        elif _is_odd(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        else:
+            raise AssertionError("unreachable")
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return base - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return base + self.stage_id // 2
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference :292-315)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0),
+                    BackwardPass(buffer_id=0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Pipeline bubble overhead: (P-1)/(M+P-1) of ticks are idle."""
+    return (stages - 1) / (micro_batches + stages - 1)
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0
