@@ -1,0 +1,11 @@
+from deepspeed_tpu.moe.layer import Experts, MoE, TopKGate
+from deepspeed_tpu.moe.sharded_moe import (capacity, moe_dispatch_combine,
+                                           top1_gating, top2_gating)
+from deepspeed_tpu.moe.utils import (is_moe_param_path, moe_param_count,
+                                     split_moe_params)
+
+__all__ = [
+    "MoE", "TopKGate", "Experts", "top1_gating", "top2_gating", "capacity",
+    "moe_dispatch_combine", "split_moe_params", "moe_param_count",
+    "is_moe_param_path",
+]

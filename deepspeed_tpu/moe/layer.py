@@ -1,0 +1,131 @@
+"""MoE layer facade (flax) — analog of ``deepspeed/moe/layer.py``.
+
+The reference's ``MoE`` module (layer.py:15) wires expert process groups,
+a ``TopKGate`` and the all-to-all ``MOELayer``; here the facade is a flax
+module whose expert parameters carry a leading expert dimension sharded over
+the EP axes (see sharded_moe.EP_AXES) — the process-group plumbing
+(``_create_expert_and_data_parallel_groups``, layer.py:90) reduces to
+sharding specs, exposed via :meth:`tp_specs`.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.moe.sharded_moe import (EP_AXES, moe_dispatch_combine,
+                                           top1_gating, top2_gating)
+
+
+class Experts(nn.Module):
+    """Stacked expert FFNs (reference moe/experts.py — a ModuleList there;
+    one stacked einsum here so the MXU sees a single batched matmul)."""
+    num_experts: int
+    d_model: int
+    d_hidden: int
+    dtype: Any = jnp.bfloat16
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):  # x: [E, T, M]
+        E, M, H = self.num_experts, self.d_model, self.d_hidden
+        wi = self.param("wi", nn.initializers.normal(0.02), (E, M, H),
+                        jnp.float32)
+        bi = self.param("bi", nn.initializers.zeros, (E, H), jnp.float32)
+        wo = self.param("wo", nn.initializers.normal(0.02), (E, H, M),
+                        jnp.float32)
+        bo = self.param("bo", nn.initializers.zeros, (E, M), jnp.float32)
+        h = jnp.einsum("etm,emh->eth", x, wi.astype(self.dtype))
+        h = self.activation(h + bi.astype(self.dtype)[:, None])
+        y = jnp.einsum("eth,ehm->etm", h, wo.astype(self.dtype))
+        return y + bo.astype(self.dtype)[:, None]
+
+
+class TopKGate(nn.Module):
+    """Gating head (reference sharded_moe.py:351 TopKGate): linear in fp32
+    then top-1/top-2 gating."""
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, rng=None):
+        if self.k not in (1, 2):
+            raise ValueError("Only top-1 and top-2 gatings are supported")
+        # gate math runs in fp32 regardless of compute dtype (reference
+        # TopKGate.forward casts input to fp32: sharded_moe.py:400)
+        wg = self.param("wg", nn.initializers.normal(0.02),
+                        (x.shape[-1], self.num_experts), jnp.float32)
+        logits = jnp.einsum("gsm,me->gse", x.astype(jnp.float32), wg)
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1_gating(
+                logits, cf, self.min_capacity, rng=rng,
+                noisy_gate_policy=self.noisy_gate_policy if train else None,
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts)
+        return top2_gating(logits, cf, self.min_capacity, rng=rng)
+
+
+class MoE(nn.Module):
+    """Drop-in MoE block (reference deepspeed/moe/layer.py:15 ``MoE``).
+
+    ``__call__(x)`` returns ``(output, l_aux, exp_counts)`` exactly like the
+    reference's forward (layer.py:115).
+    """
+    hidden_size: int
+    num_experts: int = 1
+    ffn_hidden_size: Optional[int] = None
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, rng=None):
+        squeeze = x.ndim == 2
+        if squeeze:  # [T, M] -> single group
+            x = x[None]
+        if rng is None and (self.use_rts or self.k == 2 or
+                            self.noisy_gate_policy):
+            rng = self.make_rng("gating") if self.has_rng("gating") else \
+                jax.random.PRNGKey(0)
+        gate = TopKGate(self.num_experts, self.k, self.capacity_factor,
+                        self.eval_capacity_factor, self.min_capacity,
+                        self.noisy_gate_policy, self.drop_tokens,
+                        self.use_rts, name="gate")
+        l_aux, combine, dispatch, exp_counts = gate(x, train=train, rng=rng)
+        experts = Experts(self.num_experts, self.hidden_size,
+                          self.ffn_hidden_size or 4 * self.hidden_size,
+                          dtype=self.dtype, name="experts")
+        y = moe_dispatch_combine(
+            lambda _, d: experts(d), None, x.astype(self.dtype),
+            combine, dispatch)
+        if squeeze:
+            y = y[0]
+        return y, l_aux, exp_counts
+
+    @staticmethod
+    def tp_specs(num_layers_prefix=()):
+        """Sharding specs for the MoE params: experts sharded over the EP
+        axes on their leading expert dim, gate replicated."""
+        return {
+            "gate": {"wg": P()},
+            "experts": {
+                "wi": P(EP_AXES, None, None),
+                "bi": P(EP_AXES, None),
+                "wo": P(EP_AXES, None, None),
+                "bo": P(EP_AXES, None),
+            },
+        }

@@ -1,0 +1,212 @@
+"""Top-k gated Mixture-of-Experts with expert parallelism.
+
+TPU-native analog of ``deepspeed/moe/sharded_moe.py``: the gating math
+(top-1 :177 and top-2 :278 with capacity, noisy gating, Random Token
+Selection) ports as pure jnp; the dispatch/combine einsums follow the same
+GShard dimension grammar (g=group, s=sequence, e=expert, c=capacity,
+m=model). The explicit ``_AllToAll`` autograd function (:89) disappears:
+dispatched tokens are sharding-constrained from the group(data) axis to the
+expert axis, and XLA's SPMD partitioner emits the all-to-all (and its
+transpose in backward) over ICI.
+
+Capacity is STATIC under jit: computed from static shapes exactly like the
+reference's ``_capacity`` (:155). ``drop_tokens=False`` maps to capacity =
+group size (the no-drop worst case) instead of the reference's dynamic
+max-count allreduce (:214) — dynamic shapes would force retracing.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# expert parallelism folds over the ZeRO/data axes (reference reuses DP ranks
+# for expert groups — deepspeed/utils/groups.py:109)
+EP_AXES = ("data", "fsdp")
+
+
+def _constrain(x, spec: P):
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    names = set(mesh.axis_names)
+    for entry in spec:
+        for ax in (entry if isinstance(entry, tuple) else (entry,)):
+            if ax is not None and ax not in names:
+                return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+             min_capacity: int) -> int:
+    """Static per-expert capacity (reference _capacity, sharded_moe.py:155)."""
+    cap = math.ceil((num_tokens / num_experts) * capacity_factor)
+    return max(cap, min_capacity)
+
+
+def _gumbel(rng, shape):
+    return -jnp.log(-jnp.log(
+        jax.random.uniform(rng, shape, jnp.float32, 1e-20, 1.0 - 1e-10)
+    ) + 1e-20)
+
+
+def _keep_topk_tokens(mask: jax.Array, score: jax.Array, k: int) -> jax.Array:
+    """Per (group, expert), keep only the k highest-scoring tokens of
+    ``mask`` (Random Token Selection uses random scores — reference :225).
+
+    mask, score: [G, S, E]; returns mask with at most k ones per (g, e).
+    """
+    S = mask.shape[1]
+    k = min(k, S)
+    scored = jnp.where(mask > 0, score, -jnp.inf)  # [G, S, E]
+    _, idx = jax.lax.top_k(jnp.swapaxes(scored, 1, 2), k)  # [G, E, k]
+    keep = jax.nn.one_hot(idx, S, dtype=mask.dtype).sum(axis=2)  # [G, E, S]
+    return mask * jnp.swapaxes(keep, 1, 2)
+
+
+def top1_gating(logits: jax.Array,
+                capacity_factor: float = 1.0,
+                min_capacity: int = 4,
+                rng: Optional[jax.Array] = None,
+                noisy_gate_policy: Optional[str] = None,
+                drop_tokens: bool = True,
+                use_rts: bool = True,
+                used_token: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-1 gating (reference top1gating, sharded_moe.py:177).
+
+    logits: [G, S, E] fp32. Returns (l_aux, combine_weights [G,S,E,C],
+    dispatch_mask [G,S,E,C] bool, exp_counts [E]).
+    """
+    G, S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    C = capacity(S, E, capacity_factor, min_capacity)
+    if not drop_tokens:
+        C = S
+    C = min(C, S)
+
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("RSample noisy gating needs an rng")
+        rng, sub = jax.random.split(rng)
+        select_from = logits + _gumbel(sub, logits.shape)
+    else:
+        select_from = gates
+    indices1 = jnp.argmax(select_from, axis=-1)  # [G, S]
+    mask1 = jax.nn.one_hot(indices1, E, dtype=jnp.int32)  # [G, S, E]
+    if used_token is not None:
+        mask1 = mask1 * used_token[..., None].astype(jnp.int32)
+
+    exp_counts = mask1.sum(axis=(0, 1))  # [E]
+
+    # load-balancing loss (reference :220-222)
+    me = jnp.mean(gates, axis=(0, 1))
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=(0, 1))
+    l_aux = jnp.sum(me * ce) * E
+
+    # Random Token Selection: keep a random C-subset instead of the first C
+    # (reference :224-243); deterministic first-come order when disabled.
+    if use_rts:
+        if rng is None:
+            raise ValueError("use_rts needs an rng")
+        score = jax.random.uniform(rng, mask1.shape, jnp.float32)
+    else:
+        # prefer earlier tokens, mirroring pure cumsum-order dropping
+        score = -jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.float32)[None, :, None], mask1.shape)
+    mask1 = _keep_topk_tokens(mask1, score, C)
+
+    locations1 = jnp.cumsum(mask1, axis=1) - 1  # [G, S, E]
+    locations1_s = jnp.sum(locations1 * mask1, axis=-1)  # [G, S]
+
+    gates = gates * mask1.astype(jnp.float32)
+    locations1_sc = jax.nn.one_hot(locations1_s, C, dtype=jnp.float32)
+    combine_weights = jnp.einsum("gse,gsc->gsec", gates, locations1_sc)
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2_gating(logits: jax.Array,
+                capacity_factor: float = 1.0,
+                min_capacity: int = 4,
+                rng: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-2 gating (reference top2gating, sharded_moe.py:278)."""
+    G, S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    C = capacity(S, E, capacity_factor * 2.0, min_capacity)
+    C = min(C, S)
+
+    indices1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(indices1, E, dtype=jnp.int32)
+
+    # second expert via the Gumbel-max trick (reference :297-303)
+    if rng is None:
+        raise ValueError("top2 gating needs an rng for the 2nd-expert noise")
+    logits_w_noise = logits + _gumbel(rng, logits.shape)
+    logits_except1 = jnp.where(mask1 > 0, -jnp.inf, logits_w_noise)
+    indices2 = jnp.argmax(logits_except1, axis=-1)
+    mask2 = jax.nn.one_hot(indices2, E, dtype=jnp.int32)
+
+    locations1 = jnp.cumsum(mask1, axis=1) - 1
+    locations2 = jnp.cumsum(mask2, axis=1) - 1
+    # 2nd-choice tokens queue behind all 1st choices (reference :309)
+    locations2 = locations2 + jnp.sum(mask1, axis=1, keepdims=True)
+
+    exp_counts = mask1.sum(axis=(0, 1))
+
+    me = jnp.mean(gates, axis=(0, 1))
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=(0, 1))
+    l_aux = jnp.mean(me * ce) * E * E
+
+    mask1 = mask1 * (locations1 < C).astype(jnp.int32)
+    mask2 = mask2 * (locations2 < C).astype(jnp.int32)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=-1)
+    locations2_s = jnp.sum(locations2 * mask2, axis=-1)
+
+    mask1f = mask1.astype(jnp.float32)
+    mask2f = mask2.astype(jnp.float32)
+    gates1_s = jnp.einsum("gse,gse->gs", gates, mask1f)
+    gates2_s = jnp.einsum("gse,gse->gs", gates, mask2f)
+    denom = jnp.clip(gates1_s + gates2_s, jnp.finfo(jnp.float32).eps, None)
+    gates1_s = gates1_s / denom
+    gates2_s = gates2_s / denom
+
+    gates1 = jnp.einsum("gs,gse->gse", gates1_s, mask1f)
+    gates2 = jnp.einsum("gs,gse->gse", gates2_s, mask2f)
+    loc1_sc = jax.nn.one_hot(locations1_s, C, dtype=jnp.float32)
+    loc2_sc = jax.nn.one_hot(locations2_s, C, dtype=jnp.float32)
+    combine_weights = (jnp.einsum("gse,gsc->gsec", gates1, loc1_sc) +
+                       jnp.einsum("gse,gsc->gsec", gates2, loc2_sc))
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def moe_dispatch_combine(expert_fn: Callable[[Any, jax.Array], jax.Array],
+                         expert_params: Any,
+                         x: jax.Array,
+                         combine_weights: jax.Array,
+                         dispatch_mask: jax.Array) -> jax.Array:
+    """Dispatch → expert compute → combine (reference MOELayer.forward
+    :491-523). ``x``: [G, S, M]; expert_fn maps [E, G*C, M] -> [E, G*C, M]
+    with expert dim sharded over EP_AXES — the g→e reshard IS the reference's
+    all-to-all (:89), emitted by XLA from the sharding constraints.
+    """
+    G, S, M = x.shape
+    E, C = dispatch_mask.shape[2], dispatch_mask.shape[3]
+    dispatched = jnp.einsum("gsec,gsm->egcm",
+                            dispatch_mask.astype(x.dtype), x)
+    dispatched = _constrain(dispatched, P(EP_AXES, None, None, None))
+    out = expert_fn(expert_params, dispatched.reshape(E, G * C, M))
+    out = out.reshape(E, G, C, M)
+    out = _constrain(out, P(EP_AXES, None, None, None))
+    y = jnp.einsum("gsec,egcm->gsm", combine_weights.astype(x.dtype), out)
+    return _constrain(y, P(EP_AXES, None, None))

@@ -1,0 +1,43 @@
+"""MoE parameter utilities — analog of ``deepspeed/moe/utils.py``.
+
+The reference splits torch param groups so ZeRO partitions expert params
+over expert-data-parallel groups (``split_params_into_different_moe_groups_
+for_optimizer``). Under sharding-by-construction the split is a pytree
+predicate: expert leaves are the ones whose path passes through an
+``experts`` collection, and their EP placement is carried by tp_specs.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+
+
+def is_moe_param_path(path) -> bool:
+    for k in path:
+        key = getattr(k, "key", getattr(k, "name", None))
+        if key is not None and "expert" in str(key):
+            return True
+    return False
+
+
+def split_moe_params(params: Any) -> Tuple[Any, Any]:
+    """Returns (dense_mask, expert_mask) boolean pytrees matching ``params``
+    — usable for per-group optimizer settings (the reference's param-group
+    split) or for counting."""
+    dense = jax.tree_util.tree_map_with_path(
+        lambda p, _: not is_moe_param_path(p), params)
+    expert = jax.tree_util.tree_map_with_path(
+        lambda p, _: is_moe_param_path(p), params)
+    return dense, expert
+
+
+def moe_param_count(params: Any) -> Tuple[int, int]:
+    """(dense_count, expert_count) parameter totals."""
+    dense = expert = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if is_moe_param_path(path):
+            expert += int(leaf.size)
+        else:
+            dense += int(leaf.size)
+    return dense, expert
