@@ -1,0 +1,116 @@
+"""Pallas decode attention over a KV cache — the inference hot path.
+
+TPU-native analog of the reference's fused ``softmax_context`` kernel
+(``csrc/transformer/inference/csrc/pt_binding.cpp:1701-1740`` /
+``softmax.cu``), which attends one new token against the accumulated KV
+cache each generation step. The kernel streams K/V blocks for one
+(batch, head) through VMEM with the online-softmax recurrence and masks
+positions beyond the live cache length — no [S] probability vector ever
+round-trips HBM, and padding positions cost no exp/normalize work beyond
+the masked block.
+
+Layout: q ``[B, H, D]`` (one query token per sequence), cache ``[B, H, S, D]``
+with per-sequence ``lengths [B]`` (scalar-prefetched so the loop bound is
+known before the body runs).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_K = 256
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                   s_max: int, scale: float):
+    b = pl.program_id(0)
+    length = len_ref[b]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [1, D] (block (1,1,1,D))
+
+    m = jnp.full((1, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((1, 1), jnp.float32)
+    acc = jnp.zeros((1, q.shape[-1]), jnp.float32)
+
+    num_kb = pl.cdiv(length, block_k)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [1, BK]
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(col < length, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     lengths: jax.Array,
+                     block_k: int = DEFAULT_BLOCK_K,
+                     scale: float | None = None,
+                     interpret: bool | None = None) -> jax.Array:
+    """One-token attention against the KV cache.
+
+    q: ``[B, H, D]``; k_cache/v_cache: ``[B, H, S, D]``; lengths: ``[B]``
+    int32 live lengths (query attends cache positions ``< lengths[b]``).
+    Returns ``[B, H, D]``.
+    """
+    B, H, D = q.shape
+    S = k_cache.shape[2]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_k = min(block_k, S)
+    if S % block_k:
+        raise ValueError(f"cache size {S} not divisible by block_k {block_k}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    q4 = q[:, :, None, :]  # [B, H, 1, D]
+    kernel = functools.partial(_decode_kernel, block_k=block_k, s_max=S,
+                               scale=float(scale))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, D), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, lens: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, D), lambda b, h, lens: (b, h, 0, 0)),
+    )
+    o4 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q4, k_cache, v_cache)
+    return o4[:, :, 0, :]
+
+
+def decode_attention_reference(q, k_cache, v_cache, lengths):
+    """Numerics oracle (pure jnp, XLA) — also the CPU fallback path."""
+    B, H, D = q.shape
+    S = k_cache.shape[2]
+    s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) / (D ** 0.5)
+    mask = jnp.arange(S)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bhsd->bhd", p,
+                      v_cache.astype(jnp.float32)).astype(q.dtype)

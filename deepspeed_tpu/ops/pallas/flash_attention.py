@@ -1,0 +1,304 @@
+"""Pallas flash attention (training) — fused causal attention for the MXU.
+
+TPU-native replacement for the reference's fused attention-softmax kernels
+(``csrc/transformer/softmax_kernels.cu:attn_softmax``, used by the training
+transformer kernel N10). Instead of materializing the [T, T] attention matrix
+in HBM, the kernel streams K/V blocks through VMEM with the online-softmax
+recurrence, accumulating in fp32 — O(T) memory, MXU-shaped [128, D] matmuls.
+
+Layout: q/k/v ``[B, T, H, D]`` (same as ops/attention.causal_attention).
+The kernel works on ``[B*H, T, D]`` with a (batch-head, q-block) grid; K/V
+for one batch-head live whole in VMEM (T·D·2B·2 ≤ ~8 MB ⇒ T ≤ 16k at
+D=128 — longer sequences shard over the ``seq`` axis via ring attention,
+see ops/ring_attention.py).
+
+Backward is the standard two-kernel flash decomposition (dQ sweep over K
+blocks; dK/dV sweep over Q blocks) wired through ``jax.custom_vjp`` with the
+(out, logsumexp) residuals.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                block_q: int, block_k: int, seq_len: int, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    bq, d = q.shape
+
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    if causal:
+        # only K blocks at or before this Q block's diagonal
+        num_kb = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+    else:
+        num_kb = seq_len // block_k
+
+    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            col = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # [BQ, 1]
+
+
+def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
+    BH, T, D = q3.shape
+    grid = (BH, T // block_q)
+    out_shape = [
+        jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        # trailing singleton lane dim satisfies TPU tiling (block last dim
+        # equals the array dim); keeps lse O(BH·T) instead of the official
+        # kernel's 128-lane broadcast
+        jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+    ]
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                               block_k=block_k, seq_len=T, causal=causal)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale: float, block_q: int, block_k: int,
+                   seq_len: int, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [BQ, 1]
+    delta = delta_ref[0]  # [BQ, 1]
+    bq, d = q.shape
+    dq = jnp.zeros((bq, d), jnp.float32)
+
+    if causal:
+        num_kb = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+    else:
+        num_kb = seq_len // block_k
+    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            col = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb, body, dq)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, block_q: int,
+                    block_k: int, seq_len: int, causal: bool):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    num_qb = seq_len // block_q
+    first_qb = (ki * block_k) // block_q if causal else 0
+    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) \
+            * scale
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]  # [BQ, 1]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            row = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k,
+               causal, interpret):
+    BH, T, D = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, T, 1]
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
+                                  block_q=block_q, block_k=block_k,
+                                  seq_len=T, causal=causal)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   seq_len=T, causal=causal)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, T, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, T, 1), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q3, k3, v3, scale, block_q, block_k, causal):
+    o, _ = _flash_fwd(q3, k3, v3, scale=scale, block_q=block_q,
+                      block_k=block_k, causal=causal,
+                      interpret=_should_interpret())
+    return o
+
+
+def _flash_attention_fwd(q3, k3, v3, scale, block_q, block_k, causal):
+    o, lse = _flash_fwd(q3, k3, v3, scale=scale, block_q=block_q,
+                        block_k=block_k, causal=causal,
+                        interpret=_should_interpret())
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_attention_bwd(scale, block_q, block_k, causal, res, do3):
+    q3, k3, v3, o3, lse = res
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o3, lse, do3, scale=scale,
+                            block_q=block_q, block_k=block_k, causal=causal,
+                            interpret=_should_interpret())
+    return dq, dk, dv
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    scale: float | None = None):
+    """Fused attention, ``[B, T, H, D] -> [B, T, H, D]``.
+
+    Sequence length must be divisible by the block sizes (the model layer
+    pads to n_positions, itself a multiple of 128).
+    """
+    B, T, H, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(f"seq len {T} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    def to3(x):
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+
+    o3 = _flash_attention(to3(q), to3(k), to3(v), float(scale),
+                          block_q, block_k, causal)
+    return jnp.swapaxes(o3.reshape(B, H, T, D), 1, 2)

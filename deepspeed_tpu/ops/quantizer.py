@@ -1,0 +1,120 @@
+"""Groupwise quantization ops.
+
+Analog of the reference quantizer kernels (``csrc/quantization/`` N6:
+``ds_quantize_{fp32,fp16}``, ``ds_sr_quantize*``, ``*_asym*`` —
+``pt_binding.cpp:149-168``) and the python wrapper
+(``deepspeed/ops/quantizer/quantizer.py``). These are bandwidth-bound
+elementwise ops that XLA fuses into adjacent producers/consumers on TPU, so
+the implementation is jnp; the semantics (groupwise symmetric/asymmetric,
+stochastic rounding variants) match the reference op surface.
+
+All functions quantize a flat trailing dimension per group: the input is
+reshaped to ``[groups, -1]`` exactly like the CUDA kernels' block-per-group
+layout.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x: jax.Array, groups: int) -> jax.Array:
+    if x.size % groups:
+        raise ValueError(f"size {x.size} not divisible by groups {groups}")
+    return x.reshape(groups, -1)
+
+
+def quantize_symmetric(x: jax.Array, groups: int, bits: int = 8,
+                       rng: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric groupwise quantization → (int8 values, fp32 scales).
+
+    ``rng`` enables stochastic rounding (the reference's ``ds_sr_quantize``).
+    """
+    orig_shape = x.shape
+    g = _grouped(x.astype(jnp.float32), groups)
+    qmax = float(2 ** (bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    scaled = g / scale
+    if rng is not None:
+        noise = jax.random.uniform(rng, scaled.shape) - 0.5
+        q = jnp.floor(scaled + 0.5 + noise)
+    else:
+        q = jnp.round(scaled)
+    q = jnp.clip(q, -qmax - 1, qmax).astype(jnp.int8)
+    return q.reshape(orig_shape), scale[:, 0]
+
+
+def quantize_asymmetric(x: jax.Array, groups: int, bits: int = 8,
+                        rng: Optional[jax.Array] = None
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Asymmetric groupwise quantization → (int8 values, scales, zero points)
+    (reference ``ds_quantize_asym`` family)."""
+    orig_shape = x.shape
+    g = _grouped(x.astype(jnp.float32), groups)
+    qrange = float(2 ** bits - 1)
+    gmin = jnp.min(g, axis=1, keepdims=True)
+    gmax = jnp.max(g, axis=1, keepdims=True)
+    scale = jnp.where(gmax > gmin, (gmax - gmin) / qrange, 1.0)
+    zero = gmin
+    scaled = (g - zero) / scale
+    if rng is not None:
+        noise = jax.random.uniform(rng, scaled.shape) - 0.5
+        q = jnp.floor(scaled + 0.5 + noise)
+    else:
+        q = jnp.round(scaled)
+    q = (q - 2 ** (bits - 1)).astype(jnp.int8)
+    return q.reshape(orig_shape), scale[:, 0], zero[:, 0]
+
+
+def dequantize_symmetric(q: jax.Array, scale: jax.Array, groups: int,
+                         dtype=jnp.float32) -> jax.Array:
+    orig_shape = q.shape
+    g = _grouped(q.astype(jnp.float32), groups)
+    return (g * scale[:, None]).astype(dtype).reshape(orig_shape)
+
+
+def dequantize_asymmetric(q: jax.Array, scale: jax.Array, zero: jax.Array,
+                          groups: int, dtype=jnp.float32) -> jax.Array:
+    orig_shape = q.shape
+    g = _grouped(q.astype(jnp.float32), groups)
+    bits_half = 128.0  # int8 storage offset used by quantize_asymmetric
+    return ((g + bits_half) * scale[:, None] +
+            zero[:, None]).astype(dtype).reshape(orig_shape)
+
+
+def fake_quantize(x: jax.Array, groups: int, bits: int = 8,
+                  symmetric: bool = True,
+                  rng: Optional[jax.Array] = None) -> jax.Array:
+    """Quantize→dequantize in one step (reference ``fake_quantizer.cu`` —
+    used by MoQ quantize-aware training, runtime/quantize.py)."""
+    if symmetric:
+        q, scale = quantize_symmetric(x, groups, bits, rng)
+        return dequantize_symmetric(q, scale, groups, x.dtype)
+    q, scale, zero = quantize_asymmetric(x, groups, bits, rng)
+    return dequantize_asymmetric(q, scale, zero, groups, x.dtype)
+
+
+class Quantizer:
+    """Object API mirroring ``deepspeed.ops.quantizer.ds_quantizer``
+    (ops/quantizer/quantizer.py:1-29)."""
+
+    def __init__(self, q_bits: int = 8, q_groups: int = 1,
+                 symmetric: bool = True, stochastic: bool = False):
+        self.q_bits = q_bits
+        self.q_groups = q_groups
+        self.symmetric = symmetric
+        self.stochastic = stochastic
+
+    def quantize(self, x, rng=None):
+        rng = rng if self.stochastic else None
+        if self.symmetric:
+            return quantize_symmetric(x, self.q_groups, self.q_bits, rng)
+        return quantize_asymmetric(x, self.q_groups, self.q_bits, rng)
+
+    def fake_quantize(self, x, rng=None):
+        return fake_quantize(x, self.q_groups, self.q_bits, self.symmetric,
+                             rng if self.stochastic else None)

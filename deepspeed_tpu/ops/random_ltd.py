@@ -1,0 +1,70 @@
+"""Random layerwise token dropping (Random-LTD) ops.
+
+Analog of the reference CUDA kernels (``csrc/random_ltd/`` N7:
+``token_sort_``, ``token_gather``, ``token_scatter_``,
+``mask_gather_bert/gpt`` — ``pt_binding.cpp:210-214``) and their wrapper
+(``deepspeed/ops/random_ltd/dropping_utils.py``). On TPU these are
+gather/scatter shapes XLA compiles well — no custom kernel needed
+(SURVEY §2.3 N7 port note).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_indices(rng: jax.Array, seq_len: int, keep: int,
+                         batch: int, sort: bool = True) -> jax.Array:
+    """Sample ``keep`` token positions per sequence (reference
+    ``token_sort_`` samples then sorts so relative order is preserved).
+    Returns [batch, keep] int32."""
+    def one(r):
+        perm = jax.random.permutation(r, seq_len)[:keep]
+        return jnp.sort(perm) if sort else perm
+    return jax.vmap(one)(jax.random.split(rng, batch)).astype(jnp.int32)
+
+
+def token_gather(x: jax.Array, indices: jax.Array) -> jax.Array:
+    """Gather kept tokens: x [B, T, ...], indices [B, K] → [B, K, ...]
+    (reference ``token_gather``)."""
+    return jnp.take_along_axis(
+        x, indices.reshape(indices.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def token_scatter(full: jax.Array, part: jax.Array,
+                  indices: jax.Array) -> jax.Array:
+    """Scatter processed tokens back into the full sequence: full [B, T, ...]
+    (e.g. the layer input, for pass-through of dropped tokens), part
+    [B, K, ...], indices [B, K] (reference ``token_scatter_``)."""
+    def one(f, p, idx):
+        return f.at[idx].set(p)
+    return jax.vmap(one)(full, part, indices)
+
+
+def gpt_attention_mask(indices: jax.Array, seq_len: int) -> jax.Array:
+    """Causal mask restricted to kept tokens (reference ``mask_gather_gpt``):
+    [B, K, K] bool where kept position i attends kept position j iff
+    orig_pos[i] >= orig_pos[j]."""
+    return indices[:, :, None] >= indices[:, None, :]
+
+
+def bert_attention_mask(mask: jax.Array, indices: jax.Array) -> jax.Array:
+    """Gather a [B, T] padding mask down to kept tokens [B, K]
+    (reference ``mask_gather_bert``)."""
+    return jnp.take_along_axis(mask, indices, axis=1)
+
+
+def random_ltd_layer(layer_fn, x: jax.Array, rng: jax.Array,
+                     keep: int) -> jax.Array:
+    """Apply ``layer_fn`` to a random subset of tokens, passing the rest
+    through unchanged (the reference's ``basic_layer.py:117`` wrapper).
+    x: [B, T, C]."""
+    B, T, _ = x.shape
+    if keep >= T:
+        return layer_fn(x)
+    idx = sample_token_indices(rng, T, keep, B)
+    part = token_gather(x, idx)
+    out = layer_fn(part)
+    return token_scatter(x, out, idx)

@@ -1,0 +1,189 @@
+"""Kernel/op tests — numerical parity against jnp oracles (the reference's
+tests/unit/ops strategy: each op vs a torch/numpy reference)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import causal_attention_reference
+from deepspeed_tpu.ops.pallas.decode_attention import (
+    decode_attention, decode_attention_reference)
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas.layer_norm import (fused_layer_norm,
+                                                 fused_residual_layer_norm,
+                                                 layer_norm_reference)
+from deepspeed_tpu.ops.quantizer import (Quantizer, dequantize_asymmetric,
+                                         dequantize_symmetric, fake_quantize,
+                                         quantize_asymmetric,
+                                         quantize_symmetric)
+from deepspeed_tpu.ops import random_ltd
+
+
+class TestFlashAttention:
+    def _qkv(self, B=2, T=256, H=4, D=64, dtype=jnp.float32):
+        key = jax.random.PRNGKey(0)
+        return tuple(jax.random.normal(jax.random.fold_in(key, i),
+                                       (B, T, H, D), dtype) for i in range(3))
+
+    def test_forward_parity(self):
+        q, k, v = self._qkv()
+        o = flash_attention(q, k, v, causal=True)
+        o_ref = causal_attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_noncausal_parity(self):
+        q, k, v = self._qkv(T=128)
+        o = flash_attention(q, k, v, causal=False)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(64)
+        p = jax.nn.softmax(att, axis=-1)
+        o_ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grad_parity(self):
+        q, k, v = self._qkv(T=128)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(causal_attention_reference(q, k, v) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-4)
+
+    def test_rejects_ragged_seq(self):
+        q, k, v = self._qkv(T=96)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, block_q=128, block_k=64)
+
+
+class TestDecodeAttention:
+    def test_parity_with_ragged_lengths(self):
+        B, H, S, D = 3, 4, 512, 64
+        key = jax.random.PRNGKey(1)
+        q = jax.random.normal(jax.random.fold_in(key, 0), (B, H, D))
+        kc = jax.random.normal(jax.random.fold_in(key, 1), (B, H, S, D))
+        vc = jax.random.normal(jax.random.fold_in(key, 2), (B, H, S, D))
+        lengths = jnp.asarray([1, 200, 512], jnp.int32)
+        o = decode_attention(q, kc, vc, lengths)
+        o_ref = decode_attention_reference(q, kc, vc, lengths)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_single_token_is_value(self):
+        # with length 1, the output must equal v_cache[:, :, 0]
+        B, H, S, D = 2, 2, 256, 64
+        key = jax.random.PRNGKey(2)
+        q = jax.random.normal(jax.random.fold_in(key, 0), (B, H, D))
+        kc = jax.random.normal(jax.random.fold_in(key, 1), (B, H, S, D))
+        vc = jax.random.normal(jax.random.fold_in(key, 2), (B, H, S, D))
+        lengths = jnp.ones((B,), jnp.int32)
+        o = decode_attention(q, kc, vc, lengths)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(vc[:, :, 0]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestFusedLayerNorm:
+    def test_forward_parity(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 256))
+        w = jax.random.normal(jax.random.PRNGKey(1), (256,)) + 1.0
+        b = jax.random.normal(jax.random.PRNGKey(2), (256,))
+        o = fused_layer_norm(x, w, b)
+        o_ref = layer_norm_reference(x, w, b)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 128))
+        w = jax.random.normal(jax.random.PRNGKey(1), (128,)) + 1.0
+        b = jnp.zeros((128,))
+
+        def loss_f(x, w, b):
+            return jnp.sum(fused_layer_norm(x, w, b) ** 2)
+
+        def loss_r(x, w, b):
+            return jnp.sum(layer_norm_reference(x, w, b) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, b)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_residual_variant(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 128))
+        r = jax.random.normal(jax.random.PRNGKey(1), (4, 128))
+        w = jnp.ones((128,))
+        b = jnp.zeros((128,))
+        o, s = fused_residual_layer_norm(x, r, w, b)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(x + r))
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(layer_norm_reference(x + r, w, b)),
+            rtol=1e-5, atol=1e-5)
+
+
+class TestQuantizer:
+    def test_symmetric_roundtrip(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 64))
+        q, scale = quantize_symmetric(x, groups=16)
+        y = dequantize_symmetric(q, scale, groups=16)
+        # int8 roundtrip error bounded by scale/2 per group
+        err = np.abs(np.asarray(x) - np.asarray(y))
+        bound = np.asarray(scale)[:, None] * 0.5 + 1e-6
+        assert (err <= bound).all()
+
+    def test_asymmetric_roundtrip(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 128)) + 3.0
+        q, scale, zero = quantize_asymmetric(x, groups=8)
+        y = dequantize_asymmetric(q, scale, zero, groups=8)
+        err = np.abs(np.asarray(x) - np.asarray(y))
+        bound = np.asarray(scale)[:, None] * 0.5 + 1e-6
+        assert (err <= bound).all()
+
+    def test_stochastic_rounding_unbiased(self):
+        x = jnp.full((1, 1024), 0.3)  # value between int steps
+        vals = []
+        for s in range(20):
+            q, scale = quantize_symmetric(x, groups=1, bits=8,
+                                          rng=jax.random.PRNGKey(s))
+            vals.append(float(dequantize_symmetric(q, scale, 1).mean()))
+        # stochastic rounding mean should approach the true value
+        assert abs(np.mean(vals) - 0.3) < 0.02
+
+    def test_quantizer_object(self):
+        qz = Quantizer(q_bits=8, q_groups=4, symmetric=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 256))
+        y = qz.fake_quantize(x)
+        assert y.shape == x.shape
+        assert float(jnp.abs(y - x).max()) < 0.1
+
+
+class TestRandomLTD:
+    def test_gather_scatter_roundtrip(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 8))
+        idx = random_ltd.sample_token_indices(jax.random.PRNGKey(1), 16, 8, 2)
+        part = random_ltd.token_gather(x, idx)
+        assert part.shape == (2, 8, 8)
+        # indices are sorted unique
+        assert (np.diff(np.asarray(idx), axis=1) > 0).all()
+        back = random_ltd.token_scatter(x, part, idx)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+    def test_layer_passthrough(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4))
+        out = random_ltd.random_ltd_layer(
+            lambda t: t * 2.0, x, jax.random.PRNGKey(1), keep=4)
+        doubled = np.isclose(np.asarray(out), 2 * np.asarray(x)).all(axis=-1)
+        kept_counts = doubled.sum(axis=1)
+        assert (kept_counts == 4).all()
+
+    def test_gpt_mask(self):
+        idx = jnp.asarray([[0, 3, 5]])
+        mask = random_ltd.gpt_attention_mask(idx, 8)
+        expected = np.array([[[1, 0, 0], [1, 1, 0], [1, 1, 1]]], bool)
+        np.testing.assert_array_equal(np.asarray(mask), expected)
