@@ -41,6 +41,14 @@ def _maybe_constrain(x, spec: P):
     return jax.lax.with_sharding_constraint(x, spec)
 
 
+def _seq_axis_active() -> bool:
+    from deepspeed_tpu.comm.mesh import has_global_mesh, get_global_mesh
+    if not has_global_mesh():
+        return False
+    mesh = get_global_mesh()
+    return "seq" in mesh.axis_names and mesh.shape["seq"] > 1
+
+
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
     vocab_size: int = 50257
@@ -52,6 +60,9 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
+    # ring attention over the seq mesh axis (capability beyond the reference
+    # — SURVEY §5.7); requires dropout == 0 in the attention core
+    sequence_parallel: bool = False
     # pad vocab to a multiple of 128 (lane width) for MXU efficiency;
     # Megatron does the same for TP divisibility.
     vocab_pad_multiple: int = 128
@@ -92,7 +103,11 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
 
-        if cfg.use_flash_attention:
+        if cfg.sequence_parallel and _seq_axis_active():
+            from deepspeed_tpu.ops.ring_attention import ring_self_attention
+            from deepspeed_tpu.comm.mesh import get_global_mesh
+            y = ring_self_attention(q, k, v, get_global_mesh())
+        elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.attention import causal_attention
             y = causal_attention(q, k, v)
         else:

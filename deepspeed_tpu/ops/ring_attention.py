@@ -1,0 +1,183 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference snapshot has NO sequence parallelism (SURVEY §5.7: predates
+DeepSpeed-Ulysses/ring attention; long sequences are handled there by
+block-sparse attention and activation partitioning). This module is the
+TPU-first capability the new framework adds: Q/K/V stay sharded over the
+``seq`` axis, K/V shards circulate the ring via ``lax.ppermute`` (ICI
+neighbour hops), and each device folds every visiting block into a running
+online-softmax state — attention over the FULL sequence with per-device
+memory O(T/sp) and no all-gather.
+
+Backward is a second ring pass: dK/dV accumulators circulate WITH their K/V
+shards so each shard collects every rank's contribution and arrives home
+complete; dQ accumulates locally. Both passes are wired through
+``jax.custom_vjp`` (the scan-of-ppermute forward would otherwise stash every
+visiting block).
+
+Causal masking uses global positions (q_global >= k_global), so ranks
+holding future K/V blocks contribute fully-masked (zero) terms — the
+classic ring-attention load imbalance; a striped layout is future work.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import get_global_mesh
+
+NEG_INF = -1e30
+SEQ_AXIS = "seq"
+
+
+def _block_scores(q, k, scale, q_start, k_start, causal):
+    """Masked scores s [B, H, Tq, Tk] in fp32 plus the bool mask."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        qpos = q_start + jnp.arange(Tq)
+        kpos = k_start + jnp.arange(Tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+        return s, mask[None, None]
+    return s, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention(q, k, v, axis_name, causal, scale):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return o
+
+
+def _varying(x, axis_name):
+    """Mark a carry init as device-varying over the ring axis (vma typing)."""
+    try:
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        return x
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    m = _varying(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32), axis_name)
+    l = _varying(jnp.zeros((B, H, Tl, 1), jnp.float32), axis_name)
+    acc = _varying(jnp.zeros((B, Tl, H, D), jnp.float32), axis_name)
+    q_start = idx * Tl
+
+    def step_fn(carry, step):
+        m, l, acc, k_cur, v_cur = carry
+        src = (idx - step) % sp
+        s, mask = _block_scores(q, k_cur, scale, q_start, src * Tl, causal)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if mask is not None:
+            p = p * mask
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * jnp.moveaxis(alpha, 1, 2) + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step_fn, (m, l, acc, k, v), jnp.arange(sp))
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (acc / jnp.moveaxis(l_safe, 1, 2)).astype(q.dtype)
+    lse = m + jnp.log(l_safe)  # [B, H, Tl, 1]
+    return o, lse
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, res, do):
+    q, k, v, o, lse = res
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [B, Tl, H]
+    delta = jnp.moveaxis(delta, 1, 2)[..., None]  # [B, H, Tl, 1]
+    q_start = idx * Tl
+
+    dq = _varying(jnp.zeros(q.shape, jnp.float32), axis_name)
+    dk0 = _varying(jnp.zeros(k.shape, jnp.float32), axis_name)
+    dv0 = _varying(jnp.zeros(v.shape, jnp.float32), axis_name)
+
+    def step_fn(carry, step):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (idx - step) % sp
+        s, mask = _block_scores(q, k_cur, scale, q_start, src * Tl, causal)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = p * mask
+        # dv += p^T do ; ds = p*(dp - delta); dk += ds^T q ; dq += ds k
+        dv_cur = dv_cur + jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v_cur.astype(jnp.float32))
+        ds = p * (dp - delta)
+        dk_cur = dk_cur + jnp.einsum(
+            "bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+        dq = dq + jnp.einsum(
+            "bhqk,bkhd->bqhd", ds, k_cur.astype(jnp.float32)) * scale
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
+
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step_fn, (dq, k, v, dk0, dv0), jnp.arange(sp))
+    # after sp hops the accumulators are back at their home rank
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_attention_sharded(q, k, v, axis_name: str = SEQ_AXIS,
+                           causal: bool = True,
+                           scale: Optional[float] = None):
+    """Call INSIDE a shard_map manual over ``axis_name``.
+
+    q/k/v: per-device sequence shards ``[B, T/sp, H, D]``.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _ring_attention(q, k, v, axis_name, causal, float(scale))
+
+
+def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                        causal: bool = True,
+                        scale: Optional[float] = None):
+    """Global-array entry point: shards [B, T, H, D] over the ``seq`` axis
+    and runs the ring. Works inside jit (other mesh axes stay automatic)."""
+    mesh = mesh or get_global_mesh()
+    if SEQ_AXIS not in mesh.axis_names or mesh.shape[SEQ_AXIS] == 1:
+        from deepspeed_tpu.ops.attention import causal_attention_reference
+        if not causal:
+            raise ValueError("non-causal path requires seq axis > 1")
+        return causal_attention_reference(q, k, v)
+    sp = mesh.shape[SEQ_AXIS]
+    if q.shape[1] % sp:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by seq "
+                         f"axis {sp}")
+    fn = functools.partial(ring_attention_sharded, causal=causal, scale=scale)
+    spec = P(None, SEQ_AXIS, None, None)
+    # check_vma must stay ON: axis_index under partial-manual shard_map
+    # needs the varying-manual-axes tracking to type-check
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={SEQ_AXIS})(q, k, v)
