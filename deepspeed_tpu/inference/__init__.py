@@ -1,0 +1,14 @@
+"""Inference subsystem — engine, config, KV cache.
+
+Analog of ``deepspeed/inference/`` (engine.py, config.py); the kernel side
+lives in ``deepspeed_tpu/model_implementations`` and
+``deepspeed_tpu/ops/pallas``.
+"""
+from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
+                                            DeepSpeedMoEConfig,
+                                            DeepSpeedTPConfig)
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.kv_cache import KVCache, init_cache
+
+__all__ = ["DeepSpeedInferenceConfig", "DeepSpeedTPConfig",
+           "DeepSpeedMoEConfig", "InferenceEngine", "KVCache", "init_cache"]

@@ -1,0 +1,115 @@
+"""Inference configuration.
+
+Analog of ``deepspeed/inference/config.py`` (fully-pydantic
+``DeepSpeedInferenceConfig`` with ``DeepSpeedTPConfig`` /
+``DeepSpeedMoEConfig`` / quant sub-models). Field names mirror the
+reference so a user's ``init_inference(..., dict)`` config ports 1:1;
+CUDA-specific knobs (``enable_cuda_graph``) become their XLA analogs
+(jit compile caching is always on) and are accepted as no-ops for
+compatibility.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Tensor-parallel config (reference inference/config.py DeepSpeedTPConfig)."""
+    enabled: bool = True
+    tp_size: int = 1
+    # reference carries mpu/tp_group objects; here the mesh is the group
+    mesh_axis: str = "tensor"
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1])
+    mesh_axis: str = "expert"
+
+
+class QuantTypeConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    num_bits: int = 8
+    group_size: int = 64
+    group_dim: int = 0
+    symmetric: bool = True
+
+
+class BaseQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    num_bits: int = 8
+    group_size: int = 64
+    group_dim: int = 0
+
+
+class WeightQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+    quantized_initialization: dict = Field(default_factory=dict)
+    post_init_quant: dict = Field(default_factory=dict)
+
+
+class ActivationQuantConfig(BaseQuantConfig):
+    enabled: bool = False
+
+
+class QKVQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    activation: ActivationQuantConfig = Field(
+        default_factory=ActivationQuantConfig)
+    weight: WeightQuantConfig = Field(default_factory=WeightQuantConfig)
+    qkv: QKVQuantConfig = Field(default_factory=QKVQuantConfig)
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Top-level inference config (reference: DeepSpeedInferenceConfig)."""
+    replace_with_kernel_inject: bool = Field(default=False,
+                                             alias="kernel_inject")
+    dtype: str = "bfloat16"           # torch.half default on GPU; bf16 on TPU
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    # generation workspace: max tokens the KV cache is sized for
+    # (reference sizes its Context workspace from free HBM,
+    # inference_context.h:124-161; here it is explicit + static for jit)
+    max_out_tokens: int = Field(default=1024, alias="max_tokens")
+    min_out_tokens: int = 1
+    max_batch_size: int = 8
+    # accepted for API parity; jit compile-caching subsumes CUDA graphs
+    enable_cuda_graph: bool = False
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    injection_policy: Optional[dict] = Field(default=None,
+                                             alias="injection_dict")
+    return_tuple: bool = True
+    triangular_masking: bool = Field(default=True, alias="tm")
+    mp_size: int = 1  # legacy alias for tensor_parallel.tp_size
+
+    def model_post_init(self, _ctx) -> None:
+        if self.mp_size != 1 and self.tensor_parallel.tp_size == 1:
+            self.tensor_parallel.tp_size = self.mp_size
+
+    @property
+    def tp_size(self) -> int:
+        return self.tensor_parallel.tp_size
+
+    @property
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+        return {
+            "float32": jnp.float32, "fp32": jnp.float32,
+            "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+            "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+            "int8": jnp.int8,
+        }[str(self.dtype).replace("torch.", "")]

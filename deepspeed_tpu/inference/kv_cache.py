@@ -1,0 +1,80 @@
+"""KV cache — the inference workspace.
+
+Analog of the reference's singleton inference ``Context`` that owns one
+growing KV-cache workspace sized from free GPU memory
+(``csrc/transformer/inference/includes/inference_context.h:48,124-161``).
+On TPU the cache must be a statically-shaped, donated pytree threaded
+through the jitted decode step: ``[L, B, S_max, H_kv, D]`` ring of keys and
+values plus per-sequence live ``lengths [B]``. Allocation is explicit
+(``max_out_tokens`` config) instead of free-memory introspection, and
+"workspace reuse across layers" becomes XLA buffer donation.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class KVCache:
+    k: jnp.ndarray        # [L, B, S, H, D]
+    v: jnp.ndarray        # [L, B, S, H, D]
+    lengths: jnp.ndarray  # [B] int32 — live tokens per sequence
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+
+def init_cache(num_layers: int, batch: int, max_seq: int, num_kv_heads: int,
+               head_dim: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (num_layers, batch, max_seq, num_kv_heads, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((batch,), jnp.int32))
+
+
+def write_prompt(cache: KVCache, layer: int, k: jnp.ndarray, v: jnp.ndarray,
+                 lengths: jnp.ndarray) -> KVCache:
+    """Prefill: write ``[B, T, H, D]`` keys/values at positions 0..T-1.
+
+    Right-padded positions hold garbage; they are either masked by decode
+    (col >= lengths) or overwritten by subsequent appends at position
+    ``lengths[b]``.
+    """
+    T = k.shape[1]
+    newk = jax.lax.dynamic_update_slice(
+        cache.k, k[None].astype(cache.k.dtype), (layer, 0, 0, 0, 0))
+    newv = jax.lax.dynamic_update_slice(
+        cache.v, v[None].astype(cache.v.dtype), (layer, 0, 0, 0, 0))
+    return cache.replace(k=newk, v=newv, lengths=lengths.astype(jnp.int32))
+
+
+def append_token(cache: KVCache, layer: int, k: jnp.ndarray,
+                 v: jnp.ndarray) -> KVCache:
+    """Decode: append one token's ``[B, H, D]`` k/v at ``lengths[b]`` per row.
+
+    Lengths are NOT advanced here (all layers append at the same position);
+    call :func:`advance` once per step after the last layer.
+    """
+    def upd(cache_layer, x, i):
+        # cache_layer [S, H, D], x [H, D]
+        return jax.lax.dynamic_update_slice(cache_layer, x[None], (i, 0, 0))
+
+    newk_l = jax.vmap(upd)(cache.k[layer], k.astype(cache.k.dtype),
+                           cache.lengths)
+    newv_l = jax.vmap(upd)(cache.v[layer], v.astype(cache.v.dtype),
+                           cache.lengths)
+    newk = jax.lax.dynamic_update_index_in_dim(cache.k, newk_l, layer, 0)
+    newv = jax.lax.dynamic_update_index_in_dim(cache.v, newv_l, layer, 0)
+    return cache.replace(k=newk, v=newv)
+
+
+def advance(cache: KVCache, n: int = 1) -> KVCache:
+    return cache.replace(lengths=cache.lengths + n)

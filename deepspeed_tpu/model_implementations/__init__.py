@@ -1,0 +1,17 @@
+"""Fused inference model implementations.
+
+Analog of ``deepspeed/model_implementations/`` +
+``deepspeed/ops/transformer/inference/`` — the reference's
+``DeepSpeedTransformerInference`` fused block
+(``model_implementations/transformers/ds_transformer.py:17``) re-designed as
+a single configurable functional transformer covering the policy zoo
+(GPT-2, GPT-J, GPT-Neo, GPT-NeoX, OPT, BLOOM, BERT, DistilBERT):
+architecture differences (pre/post-LN, rotary/ALiBi/learned positions,
+parallel residual, activation) are config knobs, not separate kernels.
+"""
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, init_params, prefill, decode_step,
+    encoder_forward, tp_param_specs)
+
+__all__ = ["InferenceTransformerConfig", "init_params", "prefill",
+           "decode_step", "encoder_forward", "tp_param_specs"]

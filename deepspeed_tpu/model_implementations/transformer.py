@@ -1,0 +1,434 @@
+"""One fused inference transformer, many architectures.
+
+The reference ships a C++ fused block (``DeepSpeedTransformerInference``,
+``model_implementations/transformers/ds_transformer.py:17``) whose ~40 CUDA
+ops (``csrc/transformer/inference/csrc/pt_binding.cpp:1701-1777``) are
+specialised per policy (rotary for GPT-J/NeoX, ALiBi for BLOOM, pre/post-LN,
+parallel residual). Here the whole block is functional JAX: XLA fuses the
+bias/activation/residual epilogues into the MXU matmuls (the reason the
+reference needed ``fused_gemm_gelu``/``residual_add_bias`` by hand), the
+decode hot path uses the Pallas decode-attention kernel
+(ops/pallas/decode_attention.py = ``softmax_context``), and prefill uses the
+Pallas flash-attention kernel.
+
+Tensor parallelism: weights carry Megatron-style PartitionSpecs
+(:func:`tp_param_specs`) — column-parallel QKV/wi, row-parallel wo — and
+GSPMD places the per-layer all-reduce the reference issues manually after
+attn-out and mlp-out (``module_inject/layers.py:9`` LinearAllreduce).
+
+Parameter schema (pytree of arrays)::
+
+    wte [V, E]   wpe [P, E]?   ln_f {scale, bias}   lm_head [E, V]?
+    layers: list of
+      ln1 {scale, bias}   ln2 {scale, bias}?
+      attn {wq, wk, wv [E, H, D], bq, bk, bv [H, D], wo [H, D, E], bo [E]}
+      mlp  {wi [E, F], bi [F], wo [F, E], bo [E]}
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.inference.kv_cache import (KVCache, advance, append_token,
+                                              write_prompt)
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class InferenceTransformerConfig:
+    vocab_size: int
+    n_positions: int
+    n_embd: int
+    n_layer: int
+    n_head: int
+    n_kv_head: Optional[int] = None          # != n_head → GQA/MQA
+    intermediate_size: Optional[int] = None  # default 4*E
+    pre_layer_norm: bool = True              # False → BERT-style post-LN
+    positional: str = "learned"              # learned | rotary | alibi | none
+    rotary_dim: int = 0                      # 0 → full head dim when rotary
+    rotary_interleaved: bool = False         # True → GPT-J style pairs
+    rotary_base: float = 10000.0
+    parallel_attn_mlp: bool = False          # GPT-J / GPT-NeoX parallel block
+    activation: str = "gelu_new"             # gelu | gelu_new | relu
+    layer_norm_eps: float = 1e-5
+    tied_lm_head: bool = True
+    attn_scale: Optional[float] = None       # default 1/sqrt(head_dim)
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+    @property
+    def ffn(self) -> int:
+        return self.intermediate_size or 4 * self.n_embd
+
+    @property
+    def scale(self) -> float:
+        return self.attn_scale if self.attn_scale is not None else (
+            1.0 / math.sqrt(self.head_dim))
+
+
+# ---------------------------------------------------------------- params
+
+def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
+    """Random init (tests / set_empty_params); policies overwrite with HF
+    weights (module_inject analog, deepspeed_tpu/module_inject/)."""
+    E, H, D, F = cfg.n_embd, cfg.n_head, cfg.head_dim, cfg.ffn
+    KH = cfg.kv_heads
+    keys = iter(jax.random.split(rng, 4 + 8 * cfg.n_layer))
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dt)
+
+    params: Dict[str, Any] = {
+        "wte": dense(next(keys), (cfg.vocab_size, E), E),
+        "ln_f": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+        "layers": [],
+    }
+    if cfg.positional == "learned":
+        params["wpe"] = dense(next(keys), (cfg.n_positions, E), E)
+    if not cfg.tied_lm_head:
+        params["lm_head"] = dense(next(keys), (E, cfg.vocab_size), E)
+    for _ in range(cfg.n_layer):
+        layer = {
+            "ln1": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+            "attn": {
+                "wq": dense(next(keys), (E, H, D), E),
+                "wk": dense(next(keys), (E, KH, D), E),
+                "wv": dense(next(keys), (E, KH, D), E),
+                "bq": jnp.zeros((H, D), dt),
+                "bk": jnp.zeros((KH, D), dt),
+                "bv": jnp.zeros((KH, D), dt),
+                "wo": dense(next(keys), (H, D, E), E),
+                "bo": jnp.zeros((E,), dt),
+            },
+            "mlp": {
+                "wi": dense(next(keys), (E, F), E),
+                "bi": jnp.zeros((F,), dt),
+                "wo": dense(next(keys), (F, E), F),
+                "bo": jnp.zeros((E,), dt),
+            },
+        }
+        if not (cfg.parallel_attn_mlp and cfg.pre_layer_norm
+                and cfg.positional == "rotary" and cfg.rotary_interleaved):
+            layer["ln2"] = {"scale": jnp.ones((E,), dt),
+                            "bias": jnp.zeros((E,), dt)}
+        params["layers"].append(layer)
+    return params
+
+
+def tp_param_specs(params: Dict) -> Dict:
+    """Megatron TP sharding for the param tree over the ``tensor`` mesh axis.
+
+    Column-parallel: wq/wk/wv (head dim), mlp.wi (ffn dim). Row-parallel:
+    attn.wo (head dim), mlp.wo (ffn dim) — GSPMD inserts the psum the
+    reference's LinearAllreduce does by hand. Embeddings/LN replicated
+    (matches reference AutoTP scope)."""
+    def spec_for(path: str) -> P:
+        if path.endswith(("attn.wq", "attn.wk", "attn.wv")):
+            return P(None, "tensor", None)
+        if path.endswith(("attn.bq", "attn.bk", "attn.bv")):
+            return P("tensor", None)
+        if path.endswith("attn.wo"):
+            return P("tensor", None, None)
+        if path.endswith("mlp.wi"):
+            return P(None, "tensor")
+        if path.endswith("mlp.bi"):
+            return P("tensor")
+        if path.endswith("mlp.wo"):
+            return P("tensor", None)
+        return P()
+
+    def walk(tree, path=""):
+        if isinstance(tree, dict):
+            return {k: walk(v, f"{path}.{k}" if path else k)
+                    for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [walk(v, path) for v in tree]
+        return spec_for(path)
+
+    return walk(params)
+
+
+# ---------------------------------------------------------------- math
+
+def _layer_norm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32)
+            + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _act(x, kind):
+    if kind == "relu":
+        return jax.nn.relu(x)
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu(x, approximate=True)  # gelu_new / gelu_fast
+
+
+def _rotary_angles(positions, dim, base):
+    """positions [...]; returns cos/sin [..., dim//2] in fp32."""
+    inv = 1.0 / (base ** (jnp.arange(0, dim, 2, jnp.float32) / dim))
+    ang = positions[..., None].astype(jnp.float32) * inv
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rotary(x, positions, rotary_dim, base, interleaved):
+    """x [..., D] with leading position dims matching ``positions``.
+
+    Analog of ``apply_rotary_pos_emb.cu`` (csrc/transformer/inference).
+    ``interleaved=True`` is the GPT-J pairing (even/odd lanes); False is the
+    NeoX half-split pairing.
+    """
+    D = x.shape[-1]
+    rd = rotary_dim or D
+    cos, sin = _rotary_angles(positions, rd, base)  # [..., rd/2]
+    cos = jnp.expand_dims(cos, -2)  # broadcast over heads [..., 1, rd/2]
+    sin = jnp.expand_dims(sin, -2)
+    rot, rest = x[..., :rd].astype(jnp.float32), x[..., rd:]
+    if interleaved:
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(rot.shape)
+    else:
+        half = rd // 2
+        x1, x2 = rot[..., :half], rot[..., half:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return jnp.concatenate([out.astype(x.dtype), rest], -1)
+
+
+def alibi_slopes(n_head: int) -> jnp.ndarray:
+    """BLOOM ALiBi head slopes (fp32 [H])."""
+    def pow2slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+    if math.log2(n_head).is_integer():
+        s = pow2slopes(n_head)
+    else:
+        closest = 2 ** math.floor(math.log2(n_head))
+        s = pow2slopes(closest)
+        extra = pow2slopes(2 * closest)
+        s += extra[0::2][: n_head - closest]
+    return jnp.asarray(s, jnp.float32)
+
+
+def _repeat_kv(k, n_rep):
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=-2)
+
+
+def _prefill_attention(q, k, v, cfg: InferenceTransformerConfig,
+                       causal: bool = True, key_mask=None):
+    """Attention over a full sequence. q [B, T, H, D], k/v [B, T, KH, D]
+    → [B, T, H, D]. ``key_mask [B, T]`` masks padded keys (encoder path).
+
+    Uses the Pallas flash kernel for the causal no-bias case; ALiBi,
+    bidirectional, and CPU paths use the XLA einsum oracle.
+    """
+    B, T, H, D = q.shape
+    k = _repeat_kv(k, H // k.shape[2])
+    v = _repeat_kv(v, H // v.shape[2])
+    use_flash = (causal and key_mask is None and cfg.positional != "alibi"
+                 and jax.default_backend() == "tpu" and T >= 128 and
+                 T % 128 == 0)
+    if use_flash:
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True, scale=cfg.scale)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                     k.astype(jnp.float32)) * cfg.scale
+    if cfg.positional == "alibi":
+        slopes = alibi_slopes(H)
+        # BLOOM bias: slope * (key_pos - query_pos) under causal mask
+        rel = (jnp.arange(T)[None, :] - jnp.arange(T)[:, None])[None, None]
+        att = att + slopes[None, :, None, None] * rel
+    if causal:
+        att = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], att,
+                        NEG_INF)
+    if key_mask is not None:
+        att = jnp.where(key_mask[:, None, None, :].astype(bool), att,
+                        NEG_INF)
+    p = jax.nn.softmax(att, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _decode_attention(q, k_cache, v_cache, live,
+                      cfg: InferenceTransformerConfig):
+    """One-token attention against the cache. q [B, H, D], cache
+    [B, S, KH, D], ``live [B]`` = number of valid cache positions
+    *including* the just-appended token → [B, H, D]. Pallas
+    ``softmax_context`` analog on TPU; XLA path for ALiBi / GQA / CPU."""
+    B, H, D = q.shape
+    KH = k_cache.shape[2]
+    S = k_cache.shape[1]
+    if cfg.positional != "alibi" and jax.default_backend() == "tpu" \
+            and H == KH:
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+        kc = jnp.swapaxes(k_cache, 1, 2)  # [B, KH, S, D]
+        vc = jnp.swapaxes(v_cache, 1, 2)
+        return decode_attention(q, kc, vc, live, scale=cfg.scale,
+                                block_k=128)
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   _repeat_kv(k_cache, H // KH).astype(jnp.float32))
+    s = s * cfg.scale
+    pos = jnp.arange(S)[None, None, :]
+    if cfg.positional == "alibi":
+        slopes = alibi_slopes(H)
+        qpos = (live - 1)[:, None, None]  # query sits at the last live slot
+        s = s + slopes[None, :, None] * (pos - qpos)
+    s = jnp.where(pos < live[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", p,
+                      _repeat_kv(v_cache, H // KH).astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------- blocks
+
+def _qkv(x, a, cfg, positions):
+    """x [..., E] → q [..., H, D], k/v [..., KH, D] with rotary applied."""
+    q = jnp.einsum("...e,ehd->...hd", x, a["wq"]) + a["bq"]
+    k = jnp.einsum("...e,ehd->...hd", x, a["wk"]) + a["bk"]
+    v = jnp.einsum("...e,ehd->...hd", x, a["wv"]) + a["bv"]
+    if cfg.positional == "rotary":
+        q = apply_rotary(q, positions, cfg.rotary_dim, cfg.rotary_base,
+                         cfg.rotary_interleaved)
+        k = apply_rotary(k, positions, cfg.rotary_dim, cfg.rotary_base,
+                         cfg.rotary_interleaved)
+    return q, k, v
+
+
+def _mlp(x, m, cfg):
+    h = _act((x @ m["wi"] + m["bi"]).astype(jnp.float32), cfg.activation)
+    return h.astype(x.dtype) @ m["wo"] + m["bo"]
+
+
+def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
+               causal=True, key_mask=None):
+    """Full-sequence block (prefill / encoder). x [B, T, E]."""
+    a = layer["attn"]
+    ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
+    h = ln1_out if cfg.pre_layer_norm else x
+    q, k, v = _qkv(h, a, cfg, positions)
+    if cache is not None:
+        cache = write_prompt(cache, layer_idx, k, v, lengths)
+    attn = _prefill_attention(q, k, v, cfg, causal=causal, key_mask=key_mask)
+    attn_out = jnp.einsum("...hd,hde->...e", attn, a["wo"]) + a["bo"]
+    if cfg.parallel_attn_mlp:
+        # GPT-J/NeoX: x + attn(ln1(x)) + mlp(ln(x)); GPT-J shares ln1
+        ln2 = layer.get("ln2")
+        mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)
+                  if ln2 is not None else ln1_out)
+        out = x + attn_out + _mlp(mlp_in, layer["mlp"], cfg)
+        return out, cache
+    if cfg.pre_layer_norm:
+        x = x + attn_out
+        out = x + _mlp(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
+                       layer["mlp"], cfg)
+    else:  # BERT post-LN
+        x = _layer_norm(x + attn_out, layer["ln1"], cfg.layer_norm_eps)
+        out = _layer_norm(x + _mlp(x, layer["mlp"], cfg),
+                          layer["ln2"], cfg.layer_norm_eps)
+    return out, cache
+
+
+def _block_decode(x, layer, cfg, cache, layer_idx):
+    """Single-token block. x [B, E]; appends to cache."""
+    a = layer["attn"]
+    ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
+    h = ln1_out if cfg.pre_layer_norm else x
+    positions = cache.lengths  # new token position per row
+    q, k, v = _qkv(h, a, cfg, positions)
+    cache = append_token(cache, layer_idx, k, v)
+    attn = _decode_attention(q, cache.k[layer_idx], cache.v[layer_idx],
+                             cache.lengths + 1, cfg)
+    attn_out = jnp.einsum("bhd,hde->be", attn, a["wo"]) + a["bo"]
+    if cfg.parallel_attn_mlp:
+        ln2 = layer.get("ln2")
+        mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)
+                  if ln2 is not None else ln1_out)
+        return x + attn_out + _mlp(mlp_in, layer["mlp"], cfg), cache
+    if cfg.pre_layer_norm:
+        x = x + attn_out
+        return x + _mlp(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
+                        layer["mlp"], cfg), cache
+    x = _layer_norm(x + attn_out, layer["ln1"], cfg.layer_norm_eps)
+    return _layer_norm(x + _mlp(x, layer["mlp"], cfg), layer["ln2"],
+                       cfg.layer_norm_eps), cache
+
+
+# ---------------------------------------------------------------- model
+
+def _embed(params, cfg, ids, positions):
+    x = params["wte"][ids].astype(cfg.dtype)
+    if cfg.positional == "learned":
+        x = x + params["wpe"][positions].astype(cfg.dtype)
+    return x
+
+
+def _logits(params, cfg, x):
+    head = (params["wte"].T if cfg.tied_lm_head else params["lm_head"])
+    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+
+
+def prefill(params, cfg: InferenceTransformerConfig, input_ids, lengths,
+            cache: KVCache):
+    """Run the right-padded prompt ``[B, T]`` through the model, populating
+    the cache. Returns (next-token logits ``[B, V]``, cache)."""
+    B, T = input_ids.shape
+    positions = jnp.arange(T)[None, :].repeat(B, 0)
+    x = _embed(params, cfg, input_ids, positions)
+    for i, layer in enumerate(params["layers"]):
+        x, cache = _block_seq(x, layer, cfg, positions, lengths, cache, i)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    # logits at the last live token of each row
+    last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return _logits(params, cfg, last), cache
+
+
+def decode_step(params, cfg: InferenceTransformerConfig, tokens,
+                cache: KVCache):
+    """One generation step: ``tokens [B]`` int32 → (logits [B, V], cache).
+    Appends k/v for the new token and advances lengths."""
+    x = _embed(params, cfg, tokens[:, None], cache.lengths[:, None])[:, 0]
+    for i, layer in enumerate(params["layers"]):
+        x, cache = _block_decode(x, layer, cfg, cache, i)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    return _logits(params, cfg, x), advance(cache)
+
+
+def encoder_forward(params, cfg: InferenceTransformerConfig, input_ids,
+                    attention_mask=None):
+    """Bidirectional encoder forward (BERT/DistilBERT policies). Returns
+    final hidden states ``[B, T, E]``."""
+    B, T = input_ids.shape
+    positions = jnp.arange(T)[None, :].repeat(B, 0)
+    x = _embed(params, cfg, input_ids, positions)
+    if not cfg.pre_layer_norm and "ln_emb" in params:
+        x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps)
+    mask = (attention_mask if attention_mask is not None
+            else jnp.ones((B, T), jnp.int32))
+    lengths = jnp.sum(mask, -1).astype(jnp.int32)
+    for i, layer in enumerate(params["layers"]):
+        x, _ = _block_seq(x, layer, cfg, positions, lengths, None, i,
+                          causal=False, key_mask=mask)
+    if cfg.pre_layer_norm:
+        x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    return x

@@ -1,0 +1,188 @@
+"""Inference engine tests.
+
+Mirrors the reference's tests/unit/inference/test_inference.py strategy
+(sweep architectures × dtype, compare against an oracle) minus HF-hub
+downloads: architectures are exercised via config knobs on the fused
+functional transformer, and the oracle is prefill-vs-decode consistency —
+decode at position t must reproduce what a fresh prefill of t+1 tokens
+computes.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (DeepSpeedInferenceConfig, InferenceEngine,
+                                     init_cache)
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, alibi_slopes, decode_step, encoder_forward,
+    init_params, prefill, tp_param_specs)
+
+V, E, L, H, T = 256, 64, 2, 4, 16
+
+
+def small_cfg(**kw):
+    base = dict(vocab_size=V, n_positions=128, n_embd=E, n_layer=L, n_head=H,
+                dtype=jnp.float32)
+    base.update(kw)
+    return InferenceTransformerConfig(**base)
+
+
+ARCH_KNOBS = {
+    "gpt2": dict(),
+    "opt": dict(activation="relu"),
+    "gptj": dict(positional="rotary", rotary_dim=8, rotary_interleaved=True,
+                 parallel_attn_mlp=True),
+    "gpt-neox": dict(positional="rotary", rotary_dim=8,
+                     parallel_attn_mlp=True),
+    "bloom": dict(positional="alibi"),
+}
+
+
+@pytest.mark.parametrize("arch", sorted(ARCH_KNOBS))
+def test_decode_matches_prefill(arch):
+    """Step-by-step decode == fresh prefill of the same prefix."""
+    cfg = small_cfg(**ARCH_KNOBS[arch])
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, T), 0, V)
+    lengths = jnp.array([T, T - 5], jnp.int32)
+
+    cache = init_cache(L, 2, 64, cfg.kv_heads, cfg.head_dim, jnp.float32)
+    logits_p, cache = prefill(params, cfg, ids, lengths, cache)
+
+    # advance two decode steps, then check against prefill of extended ids
+    next_tok = jnp.argmax(logits_p, -1).astype(jnp.int32)
+    logits_d, cache = decode_step(params, cfg, next_tok, cache)
+
+    ids2 = np.zeros((2, T + 8), np.int32)
+    ids2[:, :T] = np.asarray(ids)
+    for b in range(2):
+        ids2[b, int(lengths[b])] = int(next_tok[b])
+    cache2 = init_cache(L, 2, 64, cfg.kv_heads, cfg.head_dim, jnp.float32)
+    logits_ref, _ = prefill(params, cfg, jnp.asarray(ids2), lengths + 1,
+                            cache2)
+    np.testing.assert_allclose(np.asarray(logits_d), np.asarray(logits_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_greedy_deterministic_and_eos():
+    cfg = small_cfg()
+    eng = InferenceEngine(cfg, DeepSpeedInferenceConfig(dtype="float32"))
+    prompts = [[1, 2, 3, 4], [7, 8]]
+    out1 = eng.generate(prompts, max_new_tokens=6)
+    out2 = eng.generate(prompts, max_new_tokens=6)
+    assert out1 == out2
+    assert len(out1[0]) == 4 + 6 and len(out1[1]) == 2 + 6
+    # eos cuts a row short
+    eos = out1[0][4]  # first generated token of row 0
+    out3 = eng.generate(prompts, max_new_tokens=6, eos_token_id=eos)
+    assert out3[0][-1] == eos and len(out3[0]) <= len(out1[0])
+
+
+def test_generate_continuation_consistency():
+    """Tokens generated greedily must be the argmax continuation the full
+    forward pass would produce (KV-cache correctness end-to-end)."""
+    cfg = small_cfg()
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    eng = InferenceEngine((cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32"))
+    prompt = [5, 6, 7]
+    out = eng.generate([prompt], max_new_tokens=3)[0]
+    # re-score with plain prefill at every prefix
+    for i in range(3):
+        prefix = out[:3 + i]
+        cache = init_cache(L, 1, 64, cfg.kv_heads, cfg.head_dim, jnp.float32)
+        ids = np.zeros((1, 16), np.int32)
+        ids[0, :len(prefix)] = prefix
+        logits, _ = prefill(params, cfg, jnp.asarray(ids),
+                            jnp.array([len(prefix)], jnp.int32), cache)
+        assert int(jnp.argmax(logits, -1)[0]) == out[3 + i]
+
+
+def test_encoder_forward_postln():
+    cfg = small_cfg(pre_layer_norm=False, activation="gelu",
+                    positional="learned")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, T), 0, V)
+    out = encoder_forward(params, cfg, ids)
+    assert out.shape == (2, T, E)
+    # padding mask changes outputs for masked positions' neighbours
+    mask = np.ones((2, T), np.int32)
+    mask[1, 8:] = 0
+    out2 = encoder_forward(params, cfg, ids, jnp.asarray(mask))
+    assert not np.allclose(np.asarray(out[1, :8]), np.asarray(out2[1, :8]))
+
+
+def test_alibi_slopes_bloom_values():
+    s = np.asarray(alibi_slopes(8))
+    np.testing.assert_allclose(s[0], 2 ** -1.0, rtol=1e-6)
+    np.testing.assert_allclose(s[-1], 2 ** -8.0, rtol=1e-6)
+    s12 = np.asarray(alibi_slopes(12))  # non-power-of-two path
+    assert s12.shape == (12,) and np.all(s12 > 0)
+    # extra heads interleave slopes from the doubled ladder (BLOOM formula)
+    np.testing.assert_allclose(s12[8], 2 ** -0.5, rtol=1e-6)
+
+
+def test_tp_specs_cover_tree():
+    cfg = small_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    specs = tp_param_specs(params)
+    jax.tree.map(lambda a, b: None, params, specs)  # same structure
+    a0 = specs["layers"][0]["attn"]
+    assert a0["wq"] == jax.sharding.PartitionSpec(None, "tensor", None)
+    assert a0["wo"] == jax.sharding.PartitionSpec("tensor", None, None)
+
+
+def test_tensor_parallel_matches_single():
+    """tp=4 over the virtual CPU mesh must reproduce tp=1 logits."""
+    cfg = small_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ref = InferenceEngine((cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32"))
+    tp = InferenceEngine((cfg, params),
+                         DeepSpeedInferenceConfig(dtype="float32",
+                                                  tensor_parallel={"tp_size": 4}))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, T), 0, V)
+    np.testing.assert_allclose(np.asarray(ref.forward(ids)),
+                               np.asarray(tp.forward(ids)),
+                               rtol=2e-4, atol=2e-4)
+    out_ref = ref.generate([[1, 2, 3]], max_new_tokens=4)
+    out_tp = tp.generate([[1, 2, 3]], max_new_tokens=4)
+    assert out_ref == out_tp
+
+
+def test_decode_kernel_mask_matches_model_semantics():
+    """The Pallas decode kernel (interpret mode) must agree with the XLA
+    decode path for the same ``live`` lengths — guards the exclusive-mask
+    (col < live) convention at the model boundary."""
+    from deepspeed_tpu.model_implementations.transformer import \
+        _decode_attention
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    cfg = small_cfg()
+    B, S, Hh, D = 2, 128, cfg.n_head, cfg.head_dim
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (B, Hh, D), jnp.float32)
+    kc = jax.random.normal(jax.random.PRNGKey(1), (B, S, Hh, D), jnp.float32)
+    vc = jax.random.normal(jax.random.PRNGKey(2), (B, S, Hh, D), jnp.float32)
+    live = jnp.array([5, 17], jnp.int32)
+    xla = _decode_attention(q, kc, vc, live, cfg)
+    pallas = decode_attention(q, jnp.swapaxes(kc, 1, 2),
+                              jnp.swapaxes(vc, 1, 2), live,
+                              scale=cfg.scale, block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(xla), np.asarray(pallas),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_generate_rejects_overrunning_cache_budget():
+    cfg = small_cfg()
+    eng = InferenceEngine(cfg, DeepSpeedInferenceConfig(dtype="float32",
+                                                        max_out_tokens=128))
+    with pytest.raises(ValueError, match="max_out_tokens"):
+        eng.generate([[1] * 100], max_new_tokens=100)
+
+
+def test_config_aliases():
+    c = DeepSpeedInferenceConfig(mp_size=4)
+    assert c.tp_size == 4
+    c2 = DeepSpeedInferenceConfig(dtype="half")
+    assert c2.jnp_dtype == jnp.float16
