@@ -32,19 +32,12 @@ class DeepSpeedMoEConfig(DeepSpeedConfigModel):
     mesh_axis: str = "expert"
 
 
-class QuantTypeConfig(DeepSpeedConfigModel):
-    enabled: bool = True
-    num_bits: int = 8
-    group_size: int = 64
-    group_dim: int = 0
-    symmetric: bool = True
-
-
 class BaseQuantConfig(DeepSpeedConfigModel):
     enabled: bool = True
     num_bits: int = 8
     group_size: int = 64
     group_dim: int = 0
+    symmetric: bool = True
 
 
 class WeightQuantConfig(BaseQuantConfig):

@@ -23,12 +23,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import dataclasses
+
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.kv_cache import KVCache, init_cache
 from deepspeed_tpu.model_implementations.transformer import (
     InferenceTransformerConfig, decode_step, encoder_forward, init_params,
     prefill, tp_param_specs)
-from deepspeed_tpu.utils.logging import logger
 
 
 def _round_up(n: int, m: int) -> int:
@@ -62,7 +63,19 @@ class InferenceEngine:
                     "params) instead") from e
             self.model_config, params = convert_hf_model(
                 model, dtype=self.config.jnp_dtype)
+        # engine dtype wins over the model config's (one source of truth):
+        # activations are cast to model_config.dtype inside the forward
+        self.model_config = dataclasses.replace(self.model_config,
+                                                dtype=self.config.jnp_dtype)
         self.mesh = mesh or self._build_mesh()
+        if self.mesh is not None:
+            tp = self.config.tp_size
+            if self.model_config.kv_heads % tp or \
+                    self.model_config.n_head % tp:
+                raise ValueError(
+                    f"tp_size={tp} must divide n_head="
+                    f"{self.model_config.n_head} and kv_heads="
+                    f"{self.model_config.kv_heads}")
         self.params = self._place_params(params)
         self._prefill_jit = jax.jit(
             functools.partial(prefill, cfg=self.model_config),
@@ -131,15 +144,16 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_token_id: Optional[int] = None,
-                 seed: int = 0) -> np.ndarray:
-        """Greedy/sampled generation. ``input_ids``: right-padded ``[B, T]``
-        (list of lists or array; pad id irrelevant — lengths come from
-        ``attention`` over non-negative ids or can be passed via kwargs).
+                 attention_mask=None, seed: int = 0) -> list:
+        """Greedy/sampled generation. ``input_ids``: a list of token lists
+        (per-row lengths inferred), or a right-padded ``[B, T]`` array — in
+        which case pass the HF-style ``attention_mask`` so pad columns are
+        not scored as context. Returns a list of token lists.
 
         Mirrors ``InferenceEngine._generate`` (inference/engine.py:523); the
         per-token hot path is the jitted decode step with a donated cache.
         """
-        ids, lengths = _pad_batch(input_ids)
+        ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
         max_seq = _round_up(int(lengths.max()) + max_new_tokens, 128)
         if max_seq > _round_up(self.config.max_out_tokens, 128):
@@ -156,8 +170,7 @@ class InferenceEngine:
         rng = jax.random.PRNGKey(seed)
         out = [np.asarray(ids[b, :lengths[b]]).tolist() for b in range(B)]
         done = np.zeros((B,), bool)
-        tokens = None
-        for _ in range(max_new_tokens):
+        for step in range(max_new_tokens):
             rng, sub = jax.random.split(rng)
             tokens = _select(logits, temperature, top_k, sub)
             toks = np.asarray(tokens)
@@ -166,7 +179,7 @@ class InferenceEngine:
                     out[b].append(int(toks[b]))
                     if eos_token_id is not None and toks[b] == eos_token_id:
                         done[b] = True
-            if done.all():
+            if done.all() or step == max_new_tokens - 1:
                 break
             logits, cache = self._decode_jit(self.params, tokens=tokens,
                                              cache=cache)
@@ -183,7 +196,7 @@ def _select(logits, temperature, top_k, rng):
     return jax.random.categorical(rng, logits, -1).astype(jnp.int32)
 
 
-def _pad_batch(input_ids):
+def _pad_batch(input_ids, attention_mask=None):
     if isinstance(input_ids, (list, tuple)):
         lengths = np.asarray([len(r) for r in input_ids], np.int32)
         T = _round_up(max(int(lengths.max()), 1), 128)
@@ -192,7 +205,10 @@ def _pad_batch(input_ids):
             ids[i, :len(row)] = row
         return ids, lengths
     ids = np.asarray(input_ids, np.int32)
-    lengths = np.full((ids.shape[0],), ids.shape[1], np.int32)
+    if attention_mask is not None:
+        lengths = np.asarray(attention_mask).sum(-1).astype(np.int32)
+    else:
+        lengths = np.full((ids.shape[0],), ids.shape[1], np.int32)
     if ids.shape[1] % 128:
         padded = np.zeros((ids.shape[0], _round_up(ids.shape[1], 128)),
                           np.int32)

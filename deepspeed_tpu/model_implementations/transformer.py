@@ -59,6 +59,9 @@ class InferenceTransformerConfig:
     layer_norm_eps: float = 1e-5
     tied_lm_head: bool = True
     attn_scale: Optional[float] = None       # default 1/sqrt(head_dim)
+    # per-layer sliding-window size (None = global) — GPT-Neo alternates
+    # global/local(256); length n_layer when set
+    local_windows: Optional[tuple] = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -236,17 +239,19 @@ def _repeat_kv(k, n_rep):
 
 
 def _prefill_attention(q, k, v, cfg: InferenceTransformerConfig,
-                       causal: bool = True, key_mask=None):
+                       causal: bool = True, key_mask=None, window=None):
     """Attention over a full sequence. q [B, T, H, D], k/v [B, T, KH, D]
-    → [B, T, H, D]. ``key_mask [B, T]`` masks padded keys (encoder path).
+    → [B, T, H, D]. ``key_mask [B, T]`` masks padded keys (encoder path);
+    ``window`` is a sliding-window size (GPT-Neo local layers).
 
     Uses the Pallas flash kernel for the causal no-bias case; ALiBi,
-    bidirectional, and CPU paths use the XLA einsum oracle.
+    windowed, bidirectional, and CPU paths use the XLA einsum oracle.
     """
     B, T, H, D = q.shape
     k = _repeat_kv(k, H // k.shape[2])
     v = _repeat_kv(v, H // v.shape[2])
-    use_flash = (causal and key_mask is None and cfg.positional != "alibi"
+    use_flash = (causal and key_mask is None and window is None
+                 and cfg.positional != "alibi"
                  and jax.default_backend() == "tpu" and T >= 128 and
                  T % 128 == 0)
     if use_flash:
@@ -260,8 +265,11 @@ def _prefill_attention(q, k, v, cfg: InferenceTransformerConfig,
         rel = (jnp.arange(T)[None, :] - jnp.arange(T)[:, None])[None, None]
         att = att + slopes[None, :, None, None] * rel
     if causal:
-        att = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], att,
-                        NEG_INF)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        if window is not None:  # HF GPT-Neo: query i sees keys in (i-w, i]
+            mask &= (jnp.arange(T)[:, None] - jnp.arange(T)[None, :]
+                     < window)
+        att = jnp.where(mask[None, None], att, NEG_INF)
     if key_mask is not None:
         att = jnp.where(key_mask[:, None, None, :].astype(bool), att,
                         NEG_INF)
@@ -270,16 +278,17 @@ def _prefill_attention(q, k, v, cfg: InferenceTransformerConfig,
 
 
 def _decode_attention(q, k_cache, v_cache, live,
-                      cfg: InferenceTransformerConfig):
+                      cfg: InferenceTransformerConfig, window=None):
     """One-token attention against the cache. q [B, H, D], cache
     [B, S, KH, D], ``live [B]`` = number of valid cache positions
     *including* the just-appended token → [B, H, D]. Pallas
-    ``softmax_context`` analog on TPU; XLA path for ALiBi / GQA / CPU."""
+    ``softmax_context`` analog on TPU; XLA path for ALiBi / windowed /
+    GQA / CPU."""
     B, H, D = q.shape
     KH = k_cache.shape[2]
     S = k_cache.shape[1]
-    if cfg.positional != "alibi" and jax.default_backend() == "tpu" \
-            and H == KH:
+    if cfg.positional != "alibi" and window is None \
+            and jax.default_backend() == "tpu" and H == KH:
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         kc = jnp.swapaxes(k_cache, 1, 2)  # [B, KH, S, D]
         vc = jnp.swapaxes(v_cache, 1, 2)
@@ -294,6 +303,8 @@ def _decode_attention(q, k_cache, v_cache, live,
         qpos = (live - 1)[:, None, None]  # query sits at the last live slot
         s = s + slopes[None, :, None] * (pos - qpos)
     s = jnp.where(pos < live[:, None, None], s, NEG_INF)
+    if window is not None:
+        s = jnp.where(pos > (live - 1 - window)[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhs,bshd->bhd", p,
                       _repeat_kv(v_cache, H // KH).astype(jnp.float32)
@@ -329,7 +340,9 @@ def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
     q, k, v = _qkv(h, a, cfg, positions)
     if cache is not None:
         cache = write_prompt(cache, layer_idx, k, v, lengths)
-    attn = _prefill_attention(q, k, v, cfg, causal=causal, key_mask=key_mask)
+    window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
+    attn = _prefill_attention(q, k, v, cfg, causal=causal, key_mask=key_mask,
+                              window=window)
     attn_out = jnp.einsum("...hd,hde->...e", attn, a["wo"]) + a["bo"]
     if cfg.parallel_attn_mlp:
         # GPT-J/NeoX: x + attn(ln1(x)) + mlp(ln(x)); GPT-J shares ln1
@@ -357,8 +370,9 @@ def _block_decode(x, layer, cfg, cache, layer_idx):
     positions = cache.lengths  # new token position per row
     q, k, v = _qkv(h, a, cfg, positions)
     cache = append_token(cache, layer_idx, k, v)
+    window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
     attn = _decode_attention(q, cache.k[layer_idx], cache.v[layer_idx],
-                             cache.lengths + 1, cfg)
+                             cache.lengths + 1, cfg, window=window)
     attn_out = jnp.einsum("bhd,hde->be", attn, a["wo"]) + a["bo"]
     if cfg.parallel_attn_mlp:
         ln2 = layer.get("ln2")
@@ -376,16 +390,25 @@ def _block_decode(x, layer, cfg, cache, layer_idx):
 
 # ---------------------------------------------------------------- model
 
-def _embed(params, cfg, ids, positions):
+def _embed(params, cfg, ids, positions, token_type_ids=None):
     x = params["wte"][ids].astype(cfg.dtype)
     if cfg.positional == "learned":
         x = x + params["wpe"][positions].astype(cfg.dtype)
+    if "wtte" in params:  # BERT token-type embeddings
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(ids))
+        x = x + params["wtte"][tt].astype(cfg.dtype)
+    if "ln_emb" in params:  # BLOOM word_embeddings_layernorm / BERT emb LN
+        x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps)
     return x
 
 
 def _logits(params, cfg, x):
     head = (params["wte"].T if cfg.tied_lm_head else params["lm_head"])
-    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+    out = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if "lm_head_bias" in params:  # GPT-J ships a biased lm_head
+        out = out + params["lm_head_bias"].astype(jnp.float32)
+    return out
 
 
 def prefill(params, cfg: InferenceTransformerConfig, input_ids, lengths,
@@ -415,14 +438,12 @@ def decode_step(params, cfg: InferenceTransformerConfig, tokens,
 
 
 def encoder_forward(params, cfg: InferenceTransformerConfig, input_ids,
-                    attention_mask=None):
+                    attention_mask=None, token_type_ids=None):
     """Bidirectional encoder forward (BERT/DistilBERT policies). Returns
     final hidden states ``[B, T, E]``."""
     B, T = input_ids.shape
     positions = jnp.arange(T)[None, :].repeat(B, 0)
-    x = _embed(params, cfg, input_ids, positions)
-    if not cfg.pre_layer_norm and "ln_emb" in params:
-        x = _layer_norm(x, params["ln_emb"], cfg.layer_norm_eps)
+    x = _embed(params, cfg, input_ids, positions, token_type_ids)
     mask = (attention_mask if attention_mask is not None
             else jnp.ones((B, T), jnp.int32))
     lengths = jnp.sum(mask, -1).astype(jnp.int32)

@@ -1,0 +1,22 @@
+"""HF-model → fused-transformer conversion (module_inject analog).
+
+The reference performs *live module surgery*: policy classes
+(``deepspeed/module_inject/replace_policy.py:175-808``) describe where each
+HF/Megatron architecture keeps its weights, and ``replace_transformer_layer``
+(``replace_module.py:297``) swaps layers for fused CUDA modules, slicing
+weights across TP ranks (``ReplaceWithTensorSlicing``, ``:20``).
+
+On TPU the same policy table drives *checkpoint conversion*: each policy maps
+an HF torch model's state into the fused functional transformer's param
+pytree + an :class:`InferenceTransformerConfig`; TP slicing becomes GSPMD
+PartitionSpecs (model_implementations.tp_param_specs) applied at placement,
+and int8 weight quantization (``GroupQuantizer``, ``replace_module.py:140``)
+is groupwise quantization at conversion time.
+"""
+from deepspeed_tpu.module_inject.policies import (POLICIES, HFPolicy,
+                                                  convert_hf_model,
+                                                  register_policy)
+from deepspeed_tpu.module_inject.quantize import GroupQuantizer
+
+__all__ = ["convert_hf_model", "POLICIES", "HFPolicy", "register_policy",
+           "GroupQuantizer"]

@@ -1,0 +1,426 @@
+"""Architecture policy table: HF torch checkpoints → fused param pytrees.
+
+Port of the reference's policy classes (``replace_policy.py``):
+HFGPT2LayerPolicy, HFGPTNEOLayerPolicy, HFGPTJLayerPolicy,
+GPTNEOXLayerPolicy, BLOOMLayerPolicy, HFOPTLayerPolicy, HFBertLayerPolicy,
+HFDistilBertLayerPolicy. Each policy knows (a) where the architecture keeps
+its weights and (b) which config knobs the fused functional transformer
+needs (rotary pairing, ALiBi, parallel residual, LN placement, attention
+scaling). Megatron/CLIP/diffusers policies are out of scope for the text
+stack (tracked in README).
+
+Weight-layout facts encoded below (verified against HF transformers):
+* GPT-2 Conv1D stores ``[in, out]`` (y = x @ W); nn.Linear stores
+  ``[out, in]`` (y = x @ W.T).
+* GPT-NeoX / BLOOM fuse QKV per-head: ``[H, 3, D]`` interleave, not three
+  stacked blocks like GPT-2.
+* OPT's learned positional embedding carries a +2 offset
+  (OPTLearnedPositionalEmbedding).
+* GPT-Neo does NOT scale attention scores (attn_scale=1.0) and alternates
+  global/local(window) attention layers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple, Type
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig)
+
+POLICIES: List[Type["HFPolicy"]] = []
+
+
+def register_policy(cls):
+    POLICIES.append(cls)
+    return cls
+
+
+def _t2j(t, dtype):
+    return jnp.asarray(np.asarray(t.detach().to("cpu").float().numpy()),
+                       dtype=dtype)
+
+
+def _ln(mod, dtype):
+    return {"scale": _t2j(mod.weight, dtype), "bias": _t2j(mod.bias, dtype)}
+
+
+def _linear_w(mod, dtype):
+    """nn.Linear weight as [in, out]."""
+    return _t2j(mod.weight, dtype).T
+
+
+class HFPolicy:
+    """Base policy. Subclasses set ``model_types`` and implement convert."""
+    model_types: Tuple[str, ...] = ()
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        return getattr(hf_config, "model_type", None) in cls.model_types
+
+    def convert(self, model, dtype) -> Tuple[InferenceTransformerConfig,
+                                             Dict[str, Any]]:
+        raise NotImplementedError
+
+
+def convert_hf_model(model, dtype=jnp.bfloat16):
+    """Dispatch on the HF config's ``model_type`` (analog of the
+    ``replace_module`` policy walk, replace_module.py:1035)."""
+    hf_cfg = getattr(model, "config", None)
+    if hf_cfg is None:
+        raise ValueError("expected a HF transformers model with .config")
+    for pol in POLICIES:
+        if pol.matches(hf_cfg):
+            return pol().convert(model, dtype)
+    raise NotImplementedError(
+        f"no policy for model_type={getattr(hf_cfg, 'model_type', '?')}; "
+        f"supported: {sorted(t for p in POLICIES for t in p.model_types)}")
+
+
+def _split_fused_stacked(W, b, E, H, D, dtype_unused=None):
+    """GPT-2 style fused qkv: [in, 3E] = [q | k | v] blocks."""
+    wq = W[:, :E].reshape(E, H, D)
+    wk = W[:, E:2 * E].reshape(E, H, D)
+    wv = W[:, 2 * E:].reshape(E, H, D)
+    bq = b[:E].reshape(H, D)
+    bk = b[E:2 * E].reshape(H, D)
+    bv = b[2 * E:].reshape(H, D)
+    return wq, wk, wv, bq, bk, bv
+
+
+def _split_fused_per_head(W, b, E, H, D):
+    """GPT-NeoX / BLOOM fused qkv: [in, 3E] with per-head [H, 3, D] layout."""
+    Wr = W.reshape(E, H, 3, D)
+    br = b.reshape(H, 3, D)
+    return (Wr[:, :, 0], Wr[:, :, 1], Wr[:, :, 2],
+            br[:, 0], br[:, 1], br[:, 2])
+
+
+def _attn_params(wq, wk, wv, bq, bk, bv, wo, bo):
+    return {"wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+            "wo": wo, "bo": bo}
+
+
+def _zeros_b(H, D, dtype):
+    return jnp.zeros((H, D), dtype)
+
+
+@register_policy
+class GPT2Policy(HFPolicy):
+    model_types = ("gpt2",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.n_embd, hf.n_head, hf.n_layer
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.n_positions, n_embd=E,
+            n_layer=L, n_head=H, activation=hf.activation_function,
+            layer_norm_eps=hf.layer_norm_epsilon, dtype=dtype)
+        tr = model.transformer if hasattr(model, "transformer") else model
+        params = {"wte": _t2j(tr.wte.weight, dtype),
+                  "wpe": _t2j(tr.wpe.weight, dtype),
+                  "ln_f": _ln(tr.ln_f, dtype), "layers": []}
+        for b in tr.h:
+            W = _t2j(b.attn.c_attn.weight, dtype)        # Conv1D [E, 3E]
+            bias = _t2j(b.attn.c_attn.bias, dtype)
+            wq, wk, wv, bq, bk, bv = _split_fused_stacked(W, bias, E, H, D)
+            wo = _t2j(b.attn.c_proj.weight, dtype).reshape(H, D, E)
+            params["layers"].append({
+                "ln1": _ln(b.ln_1, dtype), "ln2": _ln(b.ln_2, dtype),
+                "attn": _attn_params(wq, wk, wv, bq, bk, bv, wo,
+                                     _t2j(b.attn.c_proj.bias, dtype)),
+                "mlp": {"wi": _t2j(b.mlp.c_fc.weight, dtype),
+                        "bi": _t2j(b.mlp.c_fc.bias, dtype),
+                        "wo": _t2j(b.mlp.c_proj.weight, dtype),
+                        "bo": _t2j(b.mlp.c_proj.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class GPTNeoPolicy(HFPolicy):
+    model_types = ("gpt_neo",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_heads, hf.num_layers
+        D = E // H
+        windows = tuple(hf.window_size if t == "local" else None
+                        for t in hf.attention_layers)
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H,
+            intermediate_size=hf.intermediate_size or 4 * E,
+            activation=hf.activation_function,
+            layer_norm_eps=hf.layer_norm_epsilon,
+            attn_scale=1.0,                 # GPT-Neo never scales scores
+            local_windows=windows, dtype=dtype)
+        tr = model.transformer if hasattr(model, "transformer") else model
+        params = {"wte": _t2j(tr.wte.weight, dtype),
+                  "wpe": _t2j(tr.wpe.weight, dtype),
+                  "ln_f": _ln(tr.ln_f, dtype), "layers": []}
+        zeros = _zeros_b(H, D, dtype)
+        for b in tr.h:
+            at = b.attn.attention
+            params["layers"].append({
+                "ln1": _ln(b.ln_1, dtype), "ln2": _ln(b.ln_2, dtype),
+                "attn": _attn_params(
+                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.k_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.v_proj, dtype).reshape(E, H, D),
+                    zeros, zeros, zeros,   # q/k/v_proj carry no bias
+                    _linear_w(at.out_proj, dtype).reshape(H, D, E),
+                    _t2j(at.out_proj.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.c_fc, dtype),
+                        "bi": _t2j(b.mlp.c_fc.bias, dtype),
+                        "wo": _linear_w(b.mlp.c_proj, dtype),
+                        "bo": _t2j(b.mlp.c_proj.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class OPTPolicy(HFPolicy):
+    model_types = ("opt",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, hf.num_hidden_layers
+        D = E // H
+        if getattr(hf, "word_embed_proj_dim", E) != E:
+            raise NotImplementedError("OPT word_embed_proj_dim != hidden")
+        if not getattr(hf, "do_layer_norm_before", True):
+            raise NotImplementedError("OPT do_layer_norm_before=False")
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H, intermediate_size=hf.ffn_dim,
+            activation=hf.activation_function, dtype=dtype)
+        dec = model.model.decoder if hasattr(model, "model") else model.decoder
+        params = {"wte": _t2j(dec.embed_tokens.weight, dtype),
+                  # OPTLearnedPositionalEmbedding: position p reads row p+2
+                  "wpe": _t2j(dec.embed_positions.weight, dtype)[2:],
+                  "ln_f": _ln(dec.final_layer_norm, dtype), "layers": []}
+        for b in dec.layers:
+            at = b.self_attn
+            params["layers"].append({
+                "ln1": _ln(b.self_attn_layer_norm, dtype),
+                "ln2": _ln(b.final_layer_norm, dtype),
+                "attn": _attn_params(
+                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.k_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.v_proj, dtype).reshape(E, H, D),
+                    _t2j(at.q_proj.bias, dtype).reshape(H, D),
+                    _t2j(at.k_proj.bias, dtype).reshape(H, D),
+                    _t2j(at.v_proj.bias, dtype).reshape(H, D),
+                    _linear_w(at.out_proj, dtype).reshape(H, D, E),
+                    _t2j(at.out_proj.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.fc1, dtype),
+                        "bi": _t2j(b.fc1.bias, dtype),
+                        "wo": _linear_w(b.fc2, dtype),
+                        "bo": _t2j(b.fc2.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class GPTJPolicy(HFPolicy):
+    model_types = ("gptj",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.n_embd, hf.n_head, hf.n_layer
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.n_positions, n_embd=E,
+            n_layer=L, n_head=H, positional="rotary",
+            rotary_dim=hf.rotary_dim or D, rotary_interleaved=True,
+            parallel_attn_mlp=True, activation=hf.activation_function,
+            layer_norm_eps=hf.layer_norm_epsilon,
+            tied_lm_head=not hasattr(model, "lm_head"), dtype=dtype)
+        tr = model.transformer if hasattr(model, "transformer") else model
+        params = {"wte": _t2j(tr.wte.weight, dtype),
+                  "ln_f": _ln(tr.ln_f, dtype), "layers": []}
+        if hasattr(model, "lm_head"):
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+            if model.lm_head.bias is not None:
+                params["lm_head_bias"] = _t2j(model.lm_head.bias, dtype)
+        zeros = _zeros_b(H, D, dtype)
+        for b in tr.h:
+            at = b.attn
+            params["layers"].append({
+                "ln1": _ln(b.ln_1, dtype),   # shared by attn+mlp (no ln2)
+                "attn": _attn_params(
+                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.k_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.v_proj, dtype).reshape(E, H, D),
+                    zeros, zeros, zeros,
+                    _linear_w(at.out_proj, dtype).reshape(H, D, E),
+                    jnp.zeros((E,), dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.fc_in, dtype),
+                        "bi": _t2j(b.mlp.fc_in.bias, dtype),
+                        "wo": _linear_w(b.mlp.fc_out, dtype),
+                        "bo": _t2j(b.mlp.fc_out.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class GPTNeoXPolicy(HFPolicy):
+    model_types = ("gpt_neox",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, hf.num_hidden_layers
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H,
+            intermediate_size=hf.intermediate_size, positional="rotary",
+            rotary_dim=int(D * hf.rotary_pct),
+            rotary_base=getattr(hf, "rotary_emb_base", 10000.0),
+            parallel_attn_mlp=bool(getattr(hf, "use_parallel_residual",
+                                           True)),
+            activation=hf.hidden_act, layer_norm_eps=hf.layer_norm_eps,
+            tied_lm_head=not hasattr(model, "embed_out"), dtype=dtype)
+        base = model.gpt_neox if hasattr(model, "gpt_neox") else model
+        params = {"wte": _t2j(base.embed_in.weight, dtype),
+                  "ln_f": _ln(base.final_layer_norm, dtype), "layers": []}
+        if hasattr(model, "embed_out"):
+            params["lm_head"] = _linear_w(model.embed_out, dtype)
+        for b in base.layers:
+            at = b.attention
+            W = _linear_w(at.query_key_value, dtype)    # [E, 3E]
+            bias = _t2j(at.query_key_value.bias, dtype)
+            wq, wk, wv, bq, bk, bv = _split_fused_per_head(W, bias, E, H, D)
+            params["layers"].append({
+                "ln1": _ln(b.input_layernorm, dtype),
+                "ln2": _ln(b.post_attention_layernorm, dtype),
+                "attn": _attn_params(
+                    wq, wk, wv, bq, bk, bv,
+                    _linear_w(at.dense, dtype).reshape(H, D, E),
+                    _t2j(at.dense.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.dense_h_to_4h, dtype),
+                        "bi": _t2j(b.mlp.dense_h_to_4h.bias, dtype),
+                        "wo": _linear_w(b.mlp.dense_4h_to_h, dtype),
+                        "bo": _t2j(b.mlp.dense_4h_to_h.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class BLOOMPolicy(HFPolicy):
+    model_types = ("bloom",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.n_head, hf.n_layer
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=2048, n_embd=E, n_layer=L,
+            n_head=H, positional="alibi", activation="gelu_new",
+            layer_norm_eps=hf.layer_norm_epsilon, dtype=dtype)
+        tr = model.transformer if hasattr(model, "transformer") else model
+        params = {"wte": _t2j(tr.word_embeddings.weight, dtype),
+                  "ln_emb": _ln(tr.word_embeddings_layernorm, dtype),
+                  "ln_f": _ln(tr.ln_f, dtype), "layers": []}
+        for b in tr.h:
+            at = b.self_attention
+            W = _linear_w(at.query_key_value, dtype)
+            bias = _t2j(at.query_key_value.bias, dtype)
+            wq, wk, wv, bq, bk, bv = _split_fused_per_head(W, bias, E, H, D)
+            params["layers"].append({
+                "ln1": _ln(b.input_layernorm, dtype),
+                "ln2": _ln(b.post_attention_layernorm, dtype),
+                "attn": _attn_params(
+                    wq, wk, wv, bq, bk, bv,
+                    _linear_w(at.dense, dtype).reshape(H, D, E),
+                    _t2j(at.dense.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.dense_h_to_4h, dtype),
+                        "bi": _t2j(b.mlp.dense_h_to_4h.bias, dtype),
+                        "wo": _linear_w(b.mlp.dense_4h_to_h, dtype),
+                        "bo": _t2j(b.mlp.dense_4h_to_h.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class BertPolicy(HFPolicy):
+    model_types = ("bert",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, hf.num_hidden_layers
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H,
+            intermediate_size=hf.intermediate_size, pre_layer_norm=False,
+            activation=hf.hidden_act, layer_norm_eps=hf.layer_norm_eps,
+            dtype=dtype)
+        base = model.bert if hasattr(model, "bert") else model
+        emb = base.embeddings
+        params = {"wte": _t2j(emb.word_embeddings.weight, dtype),
+                  "wpe": _t2j(emb.position_embeddings.weight, dtype),
+                  "wtte": _t2j(emb.token_type_embeddings.weight, dtype),
+                  "ln_emb": _ln(emb.LayerNorm, dtype),
+                  "ln_f": {"scale": jnp.ones((E,), dtype),
+                           "bias": jnp.zeros((E,), dtype)},
+                  "layers": []}
+        for b in base.encoder.layer:
+            sa = b.attention.self
+            params["layers"].append({
+                "ln1": _ln(b.attention.output.LayerNorm, dtype),
+                "ln2": _ln(b.output.LayerNorm, dtype),
+                "attn": _attn_params(
+                    _linear_w(sa.query, dtype).reshape(E, H, D),
+                    _linear_w(sa.key, dtype).reshape(E, H, D),
+                    _linear_w(sa.value, dtype).reshape(E, H, D),
+                    _t2j(sa.query.bias, dtype).reshape(H, D),
+                    _t2j(sa.key.bias, dtype).reshape(H, D),
+                    _t2j(sa.value.bias, dtype).reshape(H, D),
+                    _linear_w(b.attention.output.dense,
+                              dtype).reshape(H, D, E),
+                    _t2j(b.attention.output.dense.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.intermediate.dense, dtype),
+                        "bi": _t2j(b.intermediate.dense.bias, dtype),
+                        "wo": _linear_w(b.output.dense, dtype),
+                        "bo": _t2j(b.output.dense.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class DistilBertPolicy(HFPolicy):
+    model_types = ("distilbert",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.dim, hf.n_heads, hf.n_layers
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H, intermediate_size=hf.hidden_dim,
+            pre_layer_norm=False, activation=hf.activation,
+            layer_norm_eps=1e-12, dtype=dtype)
+        base = (model.distilbert if hasattr(model, "distilbert") else model)
+        emb = base.embeddings
+        params = {"wte": _t2j(emb.word_embeddings.weight, dtype),
+                  "wpe": _t2j(emb.position_embeddings.weight, dtype),
+                  "ln_emb": _ln(emb.LayerNorm, dtype),
+                  "ln_f": {"scale": jnp.ones((E,), dtype),
+                           "bias": jnp.zeros((E,), dtype)},
+                  "layers": []}
+        for b in base.transformer.layer:
+            at = b.attention
+            params["layers"].append({
+                "ln1": _ln(b.sa_layer_norm, dtype),
+                "ln2": _ln(b.output_layer_norm, dtype),
+                "attn": _attn_params(
+                    _linear_w(at.q_lin, dtype).reshape(E, H, D),
+                    _linear_w(at.k_lin, dtype).reshape(E, H, D),
+                    _linear_w(at.v_lin, dtype).reshape(E, H, D),
+                    _t2j(at.q_lin.bias, dtype).reshape(H, D),
+                    _t2j(at.k_lin.bias, dtype).reshape(H, D),
+                    _t2j(at.v_lin.bias, dtype).reshape(H, D),
+                    _linear_w(at.out_lin, dtype).reshape(H, D, E),
+                    _t2j(at.out_lin.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.ffn.lin1, dtype),
+                        "bi": _t2j(b.ffn.lin1.bias, dtype),
+                        "wo": _linear_w(b.ffn.lin2, dtype),
+                        "bo": _t2j(b.ffn.lin2.bias, dtype)}})
+        return cfg, params
