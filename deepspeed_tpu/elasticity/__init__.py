@@ -1,0 +1,21 @@
+"""Elastic training: batch-size-compatible world-size math.
+
+Analog of ``deepspeed/elasticity/`` — the v0.1/v0.2 algorithms port as pure
+arithmetic; the torch-elastic ``DSElasticAgent`` has no TPU analog (slice
+membership is fixed per job), so recovery is re-mesh + universal-checkpoint
+restore (deepspeed_tpu.checkpoint).
+"""
+from deepspeed_tpu.elasticity.config import (ElasticityConfig,
+                                             ElasticityConfigError,
+                                             ElasticityError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 elasticity_enabled,
+                                                 ensure_immutable_elastic_config,
+                                                 get_candidate_batch_sizes,
+                                                 get_valid_gpus)
+
+__all__ = ["ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+           "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+           "elasticity_enabled", "ensure_immutable_elastic_config",
+           "get_candidate_batch_sizes", "get_valid_gpus"]

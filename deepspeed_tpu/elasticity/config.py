@@ -1,0 +1,71 @@
+"""Elasticity config (analog of ``deepspeed/elasticity/config.py``).
+
+Keys keep the reference spelling (``min_gpus``/``max_gpus`` etc.) so elastic
+config json ports unchanged; on TPU a "gpu" is a chip and
+``num_gpus_per_node`` is chips-per-host (e.g. 4 on v5e hosts).
+"""
+from __future__ import annotations
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity errors."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Elasticity configuration error."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size incompatible with the elastic config."""
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityConfig:
+    """Constructed from the ``elasticity`` section of the DS config:
+
+    {"enabled": true, "max_train_batch_size": 2000,
+     "micro_batch_sizes": [2,4,6], "min_gpus": 1, "max_gpus": 10000,
+     "min_time": 20, "version": 0.2, "num_gpus_per_node": 4,
+     "model_parallel_size": 1}
+    """
+
+    def __init__(self, param_dict: dict):
+        self.enabled = param_dict.get("enabled", False)
+        if not self.enabled:
+            return
+        try:
+            self.max_acceptable_batch_size = param_dict[
+                "max_train_batch_size"]
+            self.micro_batches = param_dict["micro_batch_sizes"]
+        except KeyError as e:
+            raise ElasticityConfigError(
+                f"missing required elasticity key: {e}") from e
+        if not isinstance(self.micro_batches, list) or \
+                not self.micro_batches:
+            raise ElasticityConfigError(
+                "micro_batch_sizes must be a non-empty list")
+        if any((not isinstance(m, int)) or m <= 0
+               for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got "
+                f"{self.micro_batches}")
+        self.min_gpus = param_dict.get("min_gpus", 1)
+        self.max_gpus = param_dict.get("max_gpus", -1)
+        if self.min_gpus < 1 or self.max_gpus == 0 or \
+                (self.max_gpus != -1 and self.max_gpus < self.min_gpus):
+            raise ElasticityConfigError(
+                f"invalid min_gpus={self.min_gpus} max_gpus={self.max_gpus}")
+        self.model_parallel_size = param_dict.get("model_parallel_size", 1)
+        self.num_gpus_per_node = param_dict.get("num_gpus_per_node", 1)
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = float(param_dict.get("version", 0.1))
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch",
+                                                       True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+
+    def repr(self):
+        return self.__dict__

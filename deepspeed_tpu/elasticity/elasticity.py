@@ -1,0 +1,213 @@
+"""Elastic batch/world-size math.
+
+Analog of ``deepspeed/elasticity/elasticity.py`` (v0.1 ``:125``, v0.2
+``:173``, ``compute_elastic_config`` ``:287``): choose one global batch size
+whose (micro_batch × grad-accumulation × world) factorisations cover the
+largest set of chip counts, so a job can scale up/down across that set with
+bit-identical convergence behavior. Pure arithmetic — ports as math, not
+code; on TPU "gpus" are chips and v0.2's node granularity is
+host granularity (chips-per-host).
+"""
+from __future__ import annotations
+
+import math
+from functools import reduce
+from typing import List, Optional, Tuple
+
+from deepspeed_tpu.elasticity.config import (ElasticityConfig,
+                                             ElasticityConfigError,
+                                             ElasticityError,
+                                             ElasticityIncompatibleWorldSize,
+                                             LATEST_ELASTICITY_VERSION)
+
+# highly composite numbers — dense divisor sets make good batch multipliers
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+            45360, 50400]
+
+
+def _lcm(nums: List[int]) -> int:
+    return reduce(lambda a, b: a * b // math.gcd(a, b), nums)
+
+
+def get_candidate_batch_sizes(base_list: List[int],
+                              max_batch: int) -> List[int]:
+    """For each base, scale by the largest HCN that keeps base*hcn ≤ max."""
+    out = set()
+    for base in base_list:
+        if base >= max_batch:
+            out.add(base)
+        else:
+            best = 1
+            for h in HCN_LIST:
+                if base * h > max_batch:
+                    break
+                best = h
+            out.add(base * best)
+    return sorted(out)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_gpus: int, max_gpus: int) -> List[int]:
+    """All chip counts g in [min,max] such that batch_size = micro * k * g
+    for some configured micro batch and integer gradient-accumulation k."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        max_g = batch_size // micro
+        if min_gpus <= max_g <= max_gpus:
+            valid.add(max_g)
+        for g in range(1, max_g // 2 + 1):
+            if g > max_gpus:
+                break
+            if g < min_gpus:
+                continue
+            if max_g % g == 0:
+                valid.add(g)
+    return sorted(valid)
+
+
+def _best_candidate(candidates, micro_batches, min_gpus, max_gpus,
+                    prefer_larger) -> Tuple[int, List[int]]:
+    best_batch = min(micro_batches)
+    best_valid: List[int] = []
+    for batch in candidates:
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        better = (len(valid) > len(best_valid)
+                  or (len(valid) == len(best_valid)
+                      and ((prefer_larger and batch > best_batch)
+                           or (not prefer_larger and batch < best_batch))))
+        if better:
+            best_batch, best_valid = batch, valid
+    return best_batch, best_valid
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                             min_gpus=None, max_gpus=None,
+                             prefer_larger=True):
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(m <= max_acceptable_batch_size for m in micro_batches):
+        raise ValueError("all micro batches must be ≤ "
+                         f"max_train_batch_size={max_acceptable_batch_size}")
+    bases = list(micro_batches) + [_lcm(micro_batches)]
+    candidates = get_candidate_batch_sizes(bases, max_acceptable_batch_size)
+    return _best_candidate(candidates, micro_batches, min_gpus, max_gpus,
+                           prefer_larger)
+
+
+def _get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size,
+                             current_num_gpus, min_gpus=None, max_gpus=None,
+                             prefer_larger=True, num_gpus_per_node=1,
+                             model_parallel_size=1):
+    """v0.2 works at host granularity and is MP-aware: the data-parallel
+    world is chips/mp, and batch candidates are per-host multiples."""
+    if num_gpus_per_node % model_parallel_size:
+        raise ElasticityError(
+            f"chips per host {num_gpus_per_node} must be divisible by "
+            f"model_parallel_size {model_parallel_size}")
+    dp_per_node = num_gpus_per_node // model_parallel_size
+
+    def microbatch_for(batch):
+        cand = None
+        for m in micro_batches:
+            if (batch // current_num_gpus) % m == 0:
+                if cand is None or (prefer_larger and m > cand):
+                    cand = m
+        return cand
+
+    batch, valid_nodes = _get_compatible_gpus_v01(
+        micro_batches, int(max_acceptable_batch_size / dp_per_node),
+        int(min_gpus / num_gpus_per_node) if min_gpus else None,
+        int(max_gpus / num_gpus_per_node) if max_gpus else None,
+        prefer_larger=prefer_larger)
+    batch = int(batch) * dp_per_node
+    valid_dp = [n * dp_per_node for n in valid_nodes]
+    if current_num_gpus // model_parallel_size in valid_dp:
+        return batch, valid_dp, microbatch_for(batch)
+
+    # current world not covered: fall back to the best batch for exactly it
+    current_dp = (current_num_gpus / num_gpus_per_node) * dp_per_node
+    cands = [m * current_dp * math.floor(
+        max_acceptable_batch_size / (m * current_dp))
+        for m in micro_batches]
+    batch = int(max(cands) if prefer_larger else min(cands))
+    return batch, [int(current_dp)], microbatch_for(batch)
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return ds_config.get("elasticity", {}).get("enabled", False)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
+    """The scheduler computed resources from this config — it must not
+    change at runtime (reference ``:254``)."""
+    import os
+    import json
+    frozen = os.environ.get("DEEPSPEED_ELASTICITY_CONFIG")
+    if frozen:
+        if json.loads(frozen) != runtime_elastic_config_dict:
+            raise ElasticityConfigError(
+                "elastic config changed between scheduling and runtime")
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch=False):
+    """Given an elastic config section, return (final_batch_size,
+    valid_gpus[, micro_batch]) — deterministic for a given config
+    (reference ``compute_elastic_config`` ``:287``)."""
+    if not isinstance(ds_config, dict):
+        raise ValueError("ds_config must be a dict")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError("'elasticity' section missing")
+    cfg = ElasticityConfig(ds_config["elasticity"])
+    if not cfg.enabled:
+        raise ElasticityConfigError("elasticity is disabled")
+    if cfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityError(f"unsupported elasticity version {cfg.version}")
+
+    max_gpus = (cfg.max_gpus if cfg.max_gpus > 0
+                else cfg.max_acceptable_batch_size // min(cfg.micro_batches))
+    micro = None
+    if cfg.version >= 0.2:
+        import os
+        if world_size:
+            current = world_size
+        elif str(os.environ.get("WORLD_SIZE", "")).isnumeric():
+            current = int(os.environ["WORLD_SIZE"])
+        else:
+            raise ElasticityConfigError(
+                "elasticity v0.2 needs WORLD_SIZE (argument or env) to "
+                "compute a valid batch size")
+        batch, valid, micro = _get_compatible_gpus_v02(
+            cfg.micro_batches, cfg.max_acceptable_batch_size, current,
+            min_gpus=cfg.min_gpus, max_gpus=max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size,
+            num_gpus_per_node=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
+    else:
+        batch, valid = _get_compatible_gpus_v01(
+            cfg.micro_batches, cfg.max_acceptable_batch_size,
+            min_gpus=cfg.min_gpus, max_gpus=max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size)
+
+    if world_size:
+        # v0.2's valid list is in data-parallel units (chips / mp)
+        check = (world_size // cfg.model_parallel_size
+                 if cfg.version >= 0.2 else world_size)
+        if check not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} (dp={check}) not in valid set "
+                f"{valid}")
+    if return_microbatch:
+        if micro is None:
+            ws = world_size or max(valid)
+            per_rank = batch // ws
+            fits = [m for m in cfg.micro_batches if per_rank % m == 0]
+            if not fits:
+                raise ElasticityError(
+                    f"no micro batch fits batch={batch} world={ws}")
+            micro = max(fits) if cfg.prefer_larger_batch_size else min(fits)
+        return batch, valid, micro
+    return batch, valid

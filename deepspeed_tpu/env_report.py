@@ -1,0 +1,93 @@
+"""``dstpu_report`` — environment & op compatibility report.
+
+Analog of ``deepspeed/env_report.py`` (``ds_report`` CLI, 143 LoC): prints
+the framework/runtime version matrix and an op-availability table. On TPU
+"op installed" means the Pallas kernel imports and traces (no JIT C++
+builds), plus the native host-side ops (C++ CPU-Adam / AIO) when built.
+"""
+from __future__ import annotations
+
+import importlib
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+OPS = {
+    "flash_attention": "deepspeed_tpu.ops.pallas.flash_attention",
+    "decode_attention": "deepspeed_tpu.ops.pallas.decode_attention",
+    "fused_layer_norm": "deepspeed_tpu.ops.pallas.layer_norm",
+    "quantizer": "deepspeed_tpu.ops.quantizer",
+    "random_ltd": "deepspeed_tpu.ops.random_ltd",
+    "ring_attention": "deepspeed_tpu.ops.ring_attention",
+    "optimizers": "deepspeed_tpu.ops.adam",
+}
+
+
+def op_report():
+    rows = []
+    for name, mod in sorted(OPS.items()):
+        try:
+            importlib.import_module(mod)
+            rows.append((name, True, ""))
+        except Exception as e:  # pragma: no cover - env specific
+            rows.append((name, False, str(e)[:60]))
+    return rows
+
+
+def versions():
+    out = {}
+    import deepspeed_tpu
+    out["deepspeed_tpu"] = deepspeed_tpu.__version__
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+                "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            out[mod] = getattr(m, "__version__", "?")
+        except Exception:
+            out[mod] = "not installed"
+    return out
+
+
+def device_info():
+    try:
+        import jax
+        devs = jax.devices()
+        return {"backend": jax.default_backend(),
+                "device_count": len(devs),
+                "devices": [str(d) for d in devs[:8]]}
+    except Exception as e:  # pragma: no cover
+        return {"backend": f"unavailable: {e}", "device_count": 0,
+                "devices": []}
+
+
+def main(hide_operator_status=False, hide_errors_and_warnings=False):
+    print("-" * 64)
+    print("DeepSpeed-TPU C++/Pallas op report")
+    print("-" * 64)
+    if not hide_operator_status:
+        print(f"{'op name':<24}{'status':<12}")
+        print("-" * 64)
+        for name, ok, err in op_report():
+            status = GREEN_OK if ok else RED_NO
+            line = f"{name:<24}{status:<12}"
+            if err and not hide_errors_and_warnings:
+                line += f"  {err}"
+            print(line)
+    print("-" * 64)
+    print("DeepSpeed-TPU general environment info:")
+    for k, v in versions().items():
+        print(f"{k:<24}{v}")
+    for k, v in device_info().items():
+        print(f"{k:<24}{v}")
+    print("-" * 64)
+    return 0
+
+
+def cli_main():  # console entry
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    cli_main()

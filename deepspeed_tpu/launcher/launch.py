@@ -1,0 +1,132 @@
+"""Per-host process launcher.
+
+Analog of ``deepspeed/launcher/launch.py``: spawn the user script on this
+host, export the distributed rendezvous env, install signal handlers, and
+kill the whole process tree if any child dies (``launch.py:115-358``).
+
+TPU difference: on GPU the reference spawns one process per local GPU; a
+TPU host runs ONE process that owns all its local chips (JAX's
+one-process-per-host model), so ``--num_local_procs`` defaults to 1 and the
+rendezvous env is the `jax.distributed.initialize` triple
+(COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID) instead of
+RANK/LOCAL_RANK/WORLD_SIZE (still exported for script compatibility).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu per-host launcher")
+    p.add_argument("--node_rank", type=int, default=0,
+                   help="rank of this host")
+    p.add_argument("--nnodes", type=int, default=1, help="number of hosts")
+    p.add_argument("--master_addr", type=str, default="127.0.0.1",
+                   help="coordinator address")
+    p.add_argument("--master_port", type=int, default=29500,
+                   help="coordinator port")
+    p.add_argument("--num_local_procs", type=int, default=1,
+                   help="processes on this host (1 = JAX per-host model)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(args)
+
+
+def build_env(node_rank: int, nnodes: int, master_addr: str,
+              master_port: int, local_proc: int = 0,
+              num_local_procs: int = 1) -> dict:
+    env = dict(os.environ)
+    world = nnodes * num_local_procs
+    rank = node_rank * num_local_procs + local_proc
+    env.update({
+        # JAX multi-host rendezvous
+        "COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+        "NUM_PROCESSES": str(world),
+        "PROCESS_ID": str(rank),
+        # reference-compatible names (launch.py:129)
+        "RANK": str(rank),
+        "LOCAL_RANK": str(local_proc),
+        "WORLD_SIZE": str(world),
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(master_port),
+    })
+    return env
+
+
+def _kill_tree(procs):
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def resolve_node_rank(node_rank: int) -> int:
+    """--node_rank=-1 → read the TPU-VM worker index from metadata env."""
+    if node_rank >= 0:
+        return node_rank
+    for var in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"):
+        val = os.environ.get(var, "")
+        if val.isnumeric():
+            return int(val)
+    raise RuntimeError(
+        "--node_rank=-1 requires TPU_WORKER_ID or CLOUD_TPU_TASK_ID in the "
+        "environment (TPU-VM worker metadata); none found")
+
+
+def main(args=None):
+    args = parse_args(args)
+    args.node_rank = resolve_node_rank(args.node_rank)
+    procs = []
+
+    def handler(signum, frame):
+        logger.info(f"signal {signum}: killing process tree")
+        _kill_tree(procs)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+
+    for lp in range(args.num_local_procs):
+        env = build_env(args.node_rank, args.nnodes, args.master_addr,
+                        args.master_port, lp, args.num_local_procs)
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        logger.info(f"launching local proc {lp}: {' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env,
+                                      start_new_session=True))
+
+    # babysit: if any child exits non-zero, kill the rest (reference
+    # launch.py sigkill_handler semantics)
+    exit_code = 0
+    try:
+        alive = list(procs)
+        while alive:
+            for p in list(alive):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                alive.remove(p)
+                if rc != 0:
+                    logger.error(f"proc {p.pid} died rc={rc}; "
+                                 "terminating remaining procs")
+                    _kill_tree(alive)
+                    exit_code = rc
+                    alive = []
+                    break
+            time.sleep(0.5)
+    finally:
+        _kill_tree(procs)
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

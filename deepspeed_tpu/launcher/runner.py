@@ -1,0 +1,226 @@
+"""`dstpu` — the launch CLI.
+
+Analog of ``deepspeed/launcher/runner.py`` (main ``:380``): parse a
+hostfile, apply --include/--exclude filters, propagate the environment
+(``.deepspeed_env``), pick a multinode runner (ssh/pdsh/gcloud), or spawn
+locally for single-host jobs. Elastic configs are validated via
+deepspeed_tpu.elasticity before launch.
+
+Hostfile format (reference ``:184``)::
+
+    worker-0 slots=4
+    worker-1 slots=4
+
+Filters (reference ``:245-344``)::
+
+    --include "worker-0@worker-1:0,2"   # whole host / specific chips
+    --exclude "worker-1:1"
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict
+
+from deepspeed_tpu.launcher.multinode_runner import (GcloudRunner, PDSHRunner,
+                                                     SSHRunner)
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+# NOTE: deliberately NOT PATH/LD_LIBRARY_PATH — clobbering a remote host's
+# interpreter resolution breaks heterogeneous fleets; use .deepspeed_env to
+# opt into forwarding those.
+EXPORT_ENVS = ["PYTHONPATH", "TPU_", "JAX_", "XLA_", "LIBTPU_"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE)
+    p.add_argument("-i", "--include", type=str, default="")
+    p.add_argument("-e", "--exclude", type=str, default="")
+    p.add_argument("--num_nodes", type=int, default=-1)
+    p.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int,
+                   default=-1, help="chips per host to use")
+    p.add_argument("--master_addr", type=str, default="")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--launcher", type=str, default="ssh",
+                   choices=["ssh", "pdsh", "gcloud"])
+    p.add_argument("--tpu_name", type=str, default="",
+                   help="TPU resource name (gcloud launcher)")
+    p.add_argument("--elastic_training", action="store_true")
+    p.add_argument("--force_multi", action="store_true")
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(args)
+
+
+def fetch_hostfile(path: str) -> "OrderedDict[str, int]":
+    if not os.path.isfile(path):
+        return OrderedDict()
+    return parse_hostfile(open(path).read().splitlines())
+
+
+def parse_hostfile(lines) -> "OrderedDict[str, int]":
+    """'host slots=N' per line; '#' comments (reference ``:197``)."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    for line in lines:
+        line = line.split("#")[0].strip()
+        if not line:
+            continue
+        try:
+            host, slots = line.split()
+            key, val = slots.split("=")
+            assert key == "slots"
+            if host in resources:
+                raise ValueError(f"duplicate host {host}")
+            resources[host] = int(val)
+        except ValueError:
+            raise
+        except Exception as e:
+            raise ValueError(f"malformed hostfile line: {line!r}") from e
+    return resources
+
+
+def parse_inclusion_exclusion(resources: Dict[str, int], include: str,
+                              exclude: str) -> "OrderedDict[str, list]":
+    """Expand slots then apply filters (reference ``parse_resource_filter``).
+
+    Returns host -> list of chip indices.
+    """
+    pool = OrderedDict((h, list(range(n))) for h, n in resources.items())
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+
+    def parse_filter(spec):
+        out = OrderedDict()
+        for part in spec.split("@"):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                host, idx = part.split(":")
+                out[host] = sorted(int(i) for i in idx.split(","))
+            else:
+                out[part] = None  # whole host
+        return out
+
+    if include:
+        filt = parse_filter(include)
+        result = OrderedDict()
+        for host, idxs in filt.items():
+            if host not in pool:
+                raise ValueError(f"include host {host} not in hostfile")
+            use = idxs if idxs is not None else pool[host]
+            for i in use:
+                if i not in pool[host]:
+                    raise ValueError(f"chip {host}:{i} not available")
+            result[host] = use
+        return result
+    if exclude:
+        filt = parse_filter(exclude)
+        for host, idxs in filt.items():
+            if host not in pool:
+                raise ValueError(f"exclude host {host} not in hostfile")
+            if idxs is None:
+                del pool[host]
+            else:
+                pool[host] = [i for i in pool[host] if i not in idxs]
+                if not pool[host]:
+                    del pool[host]
+        return pool
+    return pool
+
+
+def encode_world_info(active: Dict[str, list]) -> str:
+    return json.dumps({h: list(v) for h, v in active.items()})
+
+
+def gather_propagated_env() -> Dict[str, str]:
+    """Env forwarded to remote hosts: whitelisted prefixes + .deepspeed_env
+    lines (reference PDSH exports + ``:118``)."""
+    env = {}
+    for k, v in os.environ.items():
+        if any(k == p or (p.endswith("_") and k.startswith(p))
+               for p in EXPORT_ENVS):
+            env[k] = v
+    env_file = os.path.join(os.path.expanduser("~"),
+                            DEEPSPEED_ENVIRONMENT_NAME)
+    if os.path.isfile(env_file):
+        for line in open(env_file):
+            line = line.strip()
+            if line and "=" in line:
+                k, v = line.split("=", 1)
+                env[k] = v
+    return env
+
+
+def _find_config_path(user_args) -> str:
+    for i, arg in enumerate(user_args):
+        for flag in ("--deepspeed_config", "--config"):
+            if arg == flag:
+                if i + 1 >= len(user_args):
+                    raise ValueError(f"{flag} given without a value")
+                return user_args[i + 1]
+            if arg.startswith(flag + "="):
+                return arg.split("=", 1)[1]
+    return ""
+
+
+def _validate_elastic(args, active) -> None:
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    cfg_path = _find_config_path(args.user_args)
+    if not cfg_path:
+        return
+    world = sum(len(v) for v in active.values()) if active else 1
+    batch, valid = compute_elastic_config(json.load(open(cfg_path)),
+                                          world_size=world)[:2]
+    logger.info(f"elastic: batch={batch} world={world} valid={valid}")
+
+
+def main(args=None):
+    args = parse_args(args)
+    resources = fetch_hostfile(args.hostfile)
+
+    if not resources and not args.force_multi:
+        if args.elastic_training:
+            chips = max(args.num_gpus, 1)
+            _validate_elastic(args, {"localhost": list(range(chips))})
+        # single host: exec the per-host launcher directly
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               "--node_rank=0", "--nnodes=1",
+               f"--master_addr={args.master_addr or '127.0.0.1'}",
+               f"--master_port={args.master_port}",
+               args.user_script, *args.user_args]
+        logger.info(f"single-host launch: {' '.join(cmd)}")
+        return subprocess.call(cmd)
+
+    active = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    if args.num_gpus > 0:   # limit chips per host
+        active = OrderedDict((h, v[:args.num_gpus])
+                             for h, v in active.items())
+    if args.elastic_training:
+        _validate_elastic(args, active)
+    if not args.master_addr:
+        args.master_addr = next(iter(active))
+    runner_cls = {"ssh": SSHRunner, "pdsh": PDSHRunner,
+                  "gcloud": GcloudRunner}[args.launcher]
+    runner = runner_cls(args, {h: len(v) for h, v in active.items()})
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {args.launcher} not available")
+    env = gather_propagated_env()
+    env["DS_TPU_WORLD_INFO"] = encode_world_info(active)
+    logger.info(f"multi-host launch on {list(active)} via {runner.name}")
+    return runner.launch(env, active)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,229 @@
+"""Launcher + elasticity tests (mirror tests/unit/launcher and
+tests/unit/elasticity in the reference)."""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config,
+                                      get_valid_gpus)
+from deepspeed_tpu.launcher.launch import build_env
+from deepspeed_tpu.launcher.multinode_runner import (GcloudRunner, PDSHRunner,
+                                                     SSHRunner)
+from deepspeed_tpu.launcher.runner import (encode_world_info,
+                                           parse_hostfile,
+                                           parse_inclusion_exclusion)
+
+# ------------------------------------------------------------ hostfile
+
+def test_parse_hostfile():
+    hf = parse_hostfile(["worker-0 slots=4", "worker-1 slots=8",
+                         "# comment", "", "worker-2 slots=2  # trailing"])
+    assert hf == {"worker-0": 4, "worker-1": 8, "worker-2": 2}
+
+
+def test_parse_hostfile_malformed_and_duplicate():
+    with pytest.raises(ValueError):
+        parse_hostfile(["worker-0 gpus=4"])
+    with pytest.raises(ValueError):
+        parse_hostfile(["a slots=1", "a slots=2"])
+
+
+def test_include_exclude_filters():
+    res = {"w0": 4, "w1": 4}
+    # whole-host include
+    act = parse_inclusion_exclusion(res, "w0", "")
+    assert act == {"w0": [0, 1, 2, 3]}
+    # chip-level include
+    act = parse_inclusion_exclusion(res, "w1:0,2", "")
+    assert act == {"w1": [0, 2]}
+    # exclude chips
+    act = parse_inclusion_exclusion(res, "", "w1:1")
+    assert act["w1"] == [0, 2, 3] and act["w0"] == [0, 1, 2, 3]
+    # exclude whole host
+    act = parse_inclusion_exclusion(res, "", "w0")
+    assert list(act) == ["w1"]
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(res, "w0", "w1")   # mutually exclusive
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(res, "nope", "")
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(res, "w0:9", "")
+
+
+def test_build_env_rendezvous():
+    env = build_env(node_rank=2, nnodes=4, master_addr="h0",
+                    master_port=1234)
+    assert env["COORDINATOR_ADDRESS"] == "h0:1234"
+    assert env["NUM_PROCESSES"] == "4" and env["PROCESS_ID"] == "2"
+    assert env["RANK"] == "2" and env["WORLD_SIZE"] == "4"
+
+
+class _Args:
+    master_addr = "h0"
+    master_port = 29500
+    user_script = "train.py"
+    user_args = ["--x", "1"]
+    tpu_name = "my-tpu"
+
+
+def test_ssh_runner_cmd_construction():
+    active = {"h0": [0, 1], "h1": [0, 1]}
+    r = SSHRunner(_Args(), {h: len(v) for h, v in active.items()})
+    cmds = r.get_cmd({"PYTHONPATH": "/x"}, active)
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and cmds[0][-2] == "h0"
+    remote = cmds[1][-1]
+    assert "--node_rank=1" in remote and "--nnodes=2" in remote
+    assert "export PYTHONPATH=/x;" in remote and "train.py" in remote
+
+
+def test_gcloud_runner_cmd_construction():
+    active = {"h0": [0], "h1": [0]}
+    r = GcloudRunner(_Args(), {h: 1 for h in active})
+    (cmd,) = r.get_cmd({}, active)
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh"]
+    assert "my-tpu" in cmd and "--worker=all" in cmd
+
+
+def test_world_info_roundtrip():
+    enc = encode_world_info({"a": [0, 1]})
+    assert json.loads(enc) == {"a": [0, 1]}
+
+
+# ------------------------------------------------------------ elasticity
+
+def _cfg(**kw):
+    base = {"enabled": True, "max_train_batch_size": 10000,
+            "micro_batch_sizes": [8, 12, 16, 17], "min_gpus": 32,
+            "max_gpus": 1500, "version": 0.1}
+    base.update(kw)
+    return {"elasticity": base}
+
+
+def test_valid_gpus_math():
+    # batch 24, micro [4, 6]: worlds = divisors of 6 and 4 within range
+    valid = get_valid_gpus(24, [4, 6], 1, 100)
+    assert valid == [1, 2, 3, 4, 6]
+    assert get_valid_gpus(24, [4, 6], 2, 4) == [2, 3, 4]
+
+
+def test_compute_elastic_config_v01_deterministic():
+    b1, v1 = compute_elastic_config(_cfg())
+    b2, v2 = compute_elastic_config(_cfg())
+    assert (b1, v1) == (b2, v2)
+    assert b1 <= 10000 and v1
+    # every valid world factors the batch through some micro batch
+    for w in v1[:20]:
+        assert any(b1 % (m * w) == 0 for m in [8, 12, 16, 17])
+
+
+def test_compute_elastic_config_world_size_check():
+    batch, valid = compute_elastic_config(_cfg())
+    bad = max(valid) + 1
+    while bad in valid:
+        bad += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(_cfg(), world_size=bad)
+    # a valid world size returns a concrete micro batch
+    b, v, micro = compute_elastic_config(_cfg(), world_size=valid[0],
+                                         return_microbatch=True)
+    assert micro in [8, 12, 16, 17]
+    assert b % (micro * valid[0]) == 0
+
+
+def test_compute_elastic_config_v02_node_granularity():
+    cfg = _cfg(version=0.2, num_gpus_per_node=4)
+    batch, valid, micro = compute_elastic_config(cfg, world_size=64,
+                                                 return_microbatch=True)
+    # v0.2 works per host: valid dp worlds are multiples of chips-per-host
+    assert all(w % 4 == 0 for w in valid)
+    assert 64 in valid
+    assert micro in [8, 12, 16, 17]
+    assert (batch // 64) % micro == 0
+    # v0.2 without world_size or WORLD_SIZE env → config error
+    import os
+    os.environ.pop("WORLD_SIZE", None)
+    with pytest.raises(ElasticityConfigError, match="WORLD_SIZE"):
+        compute_elastic_config(cfg)
+
+
+def test_elasticity_errors():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"no_elasticity": {}})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": True}})  # missing keys
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(
+            {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                            "micro_batch_sizes": [0, 2]}})
+
+
+def test_compute_elastic_config_v02_model_parallel_world_check():
+    """world_size is chips; the valid list is dp units (chips/mp)."""
+    cfg = _cfg(version=0.2, num_gpus_per_node=4, model_parallel_size=2)
+    batch, valid, micro = compute_elastic_config(cfg, world_size=64,
+                                                 return_microbatch=True)
+    assert 64 // 2 in valid and micro in [8, 12, 16, 17]
+
+
+def test_runner_quotes_user_args():
+    class A(_Args):
+        user_args = ["--run_name", "my run; rm -rf /"]
+    r = SSHRunner(A(), {"h0": 2})
+    (cmd,) = r.get_cmd({}, {"h0": [0, 1]})
+    assert "'my run; rm -rf /'" in cmd[-1]
+
+
+def test_launch_node_rank_metadata_resolution(monkeypatch):
+    from deepspeed_tpu.launcher.launch import resolve_node_rank
+    assert resolve_node_rank(3) == 3
+    monkeypatch.setenv("TPU_WORKER_ID", "5")
+    assert resolve_node_rank(-1) == 5
+    monkeypatch.delenv("TPU_WORKER_ID")
+    monkeypatch.setenv("CLOUD_TPU_TASK_ID", "2")
+    assert resolve_node_rank(-1) == 2
+    monkeypatch.delenv("CLOUD_TPU_TASK_ID")
+    with pytest.raises(RuntimeError, match="TPU_WORKER_ID"):
+        resolve_node_rank(-1)
+
+
+def test_find_config_path_forms():
+    from deepspeed_tpu.launcher.runner import _find_config_path
+    assert _find_config_path(["--deepspeed_config", "a.json"]) == "a.json"
+    assert _find_config_path(["--config=b.json"]) == "b.json"
+    assert _find_config_path(["--lr", "3"]) == ""
+    with pytest.raises(ValueError, match="without a value"):
+        _find_config_path(["--config"])
+
+
+# ------------------------------------------------------------ env report
+
+def test_env_report_runs():
+    from deepspeed_tpu.env_report import main, op_report
+    rows = op_report()
+    assert all(ok for _, ok, _ in rows), rows
+    assert main() == 0
+
+
+def test_single_host_launch_end_to_end(tmp_path):
+    """dstpu on one host actually runs the user script with rendezvous env."""
+    script = tmp_path / "user.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({'rank': os.environ['RANK'],"
+        " 'world': os.environ['WORLD_SIZE'],"
+        " 'coord': os.environ['COORDINATOR_ADDRESS']}))\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", "/nonexistent", str(script)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["rank"] == "0" and payload["world"] == "1"
+    assert payload["coord"].endswith(":29500")
