@@ -1,0 +1,57 @@
+"""Async file IO handle (analog of ``deepspeed/ops/aio`` over csrc/aio).
+
+Reads/writes numpy buffers against swap files on a C++ thread pool; the
+Python thread returns immediately and synchronizes with ``wait()`` —
+the reference's ``aio_handle`` semantics (csrc/aio/py_lib/py_ds_aio.cpp).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    def __init__(self, num_threads: int = 4):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.dstpu_aio_create(num_threads)
+        if not self._h:
+            raise RuntimeError("failed to create aio handle")
+
+    def pwrite(self, path: str, buf: np.ndarray, offset: int = 0) -> None:
+        assert buf.flags["C_CONTIGUOUS"]
+        self._keepalive = getattr(self, "_keepalive", [])
+        self._keepalive.append(buf)   # pin until wait()
+        self._lib.dstpu_aio_pwrite(self._h, os.fsencode(path),
+                                   buf.ctypes.data_as(ctypes.c_void_p),
+                                   buf.nbytes, offset)
+
+    def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> None:
+        assert buf.flags["C_CONTIGUOUS"] and buf.flags["WRITEABLE"]
+        self._keepalive = getattr(self, "_keepalive", [])
+        self._keepalive.append(buf)
+        self._lib.dstpu_aio_pread(self._h, os.fsencode(path),
+                                  buf.ctypes.data_as(ctypes.c_void_p),
+                                  buf.nbytes, offset)
+
+    def wait(self) -> int:
+        """Block until all pending requests finish; returns error count."""
+        errs = int(self._lib.dstpu_aio_wait(self._h))
+        self._keepalive = []
+        return errs
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self.wait()
+            self._lib.dstpu_aio_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,12 @@
+"""Native op build system (analog of ``op_builder/``).
+
+The reference JIT-builds CUDA extensions via torch ``cpp_extension.load``
+(``op_builder/builder.py:452,464``) with an ``ALL_OPS`` registry
+(``all_ops.py:31``). Here native ops are host-side C++ (TPU device code is
+Pallas, which needs no build step): g++ compiles ``csrc/*.cpp`` into cached
+shared objects bound via ctypes.
+"""
+from deepspeed_tpu.ops.op_builder.builder import (ALL_OPS, CPUAdamBuilder,
+                                                  AsyncIOBuilder, OpBuilder)
+
+__all__ = ["OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder", "ALL_OPS"]

@@ -1,0 +1,136 @@
+"""JIT g++ builder + ctypes loader for the host-side native ops.
+
+Analog of ``op_builder/builder.py``: ``load()`` returns a bound module,
+building on first use into a content-hashed cache dir
+(``~/.cache/deepspeed_tpu_ops`` or ``$DSTPU_EXTENSIONS_DIR`` — the
+``TORCH_EXTENSIONS_DIR`` analog). ``is_compatible()`` gates tests the way
+the reference skips unbuildable CUDA ops.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+
+
+def _cache_dir() -> Path:
+    d = os.environ.get("DSTPU_EXTENSIONS_DIR",
+                       os.path.expanduser("~/.cache/deepspeed_tpu_ops"))
+    p = Path(d)
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+class OpBuilder:
+    name: str = "base"
+    sources: List[str] = []          # relative to repo csrc/
+    extra_flags: List[str] = []
+
+    _loaded: Dict[str, ctypes.CDLL] = {}
+
+    def compiler(self) -> Optional[str]:
+        return shutil.which("g++") or shutil.which("c++")
+
+    def is_compatible(self) -> bool:
+        return self.compiler() is not None and all(
+            (_REPO_ROOT / "csrc" / s).is_file() for s in self.sources)
+
+    def _source_paths(self) -> List[Path]:
+        return [_REPO_ROOT / "csrc" / s for s in self.sources]
+
+    def _hash(self) -> str:
+        h = hashlib.sha256()
+        for p in self._source_paths():
+            h.update(p.read_bytes())
+        h.update(" ".join(self.extra_flags).encode())
+        return h.hexdigest()[:16]
+
+    def load(self) -> ctypes.CDLL:
+        """Build (if needed) and dlopen the op library."""
+        if self.name in OpBuilder._loaded:
+            return OpBuilder._loaded[self.name]
+        so = _cache_dir() / f"{self.name}-{self._hash()}.so"
+        if not so.is_file():
+            cxx = self.compiler()
+            if cxx is None:
+                raise RuntimeError(f"no C++ compiler for op {self.name}")
+            cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-march=native", "-fopenmp",
+                   *self.extra_flags,
+                   *[str(p) for p in self._source_paths()],
+                   "-o", str(so) + ".tmp"]
+            logger.info(f"building native op {self.name}: {' '.join(cmd)}")
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
+            except subprocess.CalledProcessError as e:
+                # -march=native / -fopenmp may be unsupported: retry plain
+                cmd = [c for c in cmd
+                       if c not in ("-march=native", "-fopenmp")]
+                try:
+                    subprocess.run(cmd, check=True, capture_output=True,
+                                   text=True)
+                except subprocess.CalledProcessError as e2:
+                    raise RuntimeError(
+                        f"failed to build {self.name}:\n{e.stderr}\n"
+                        f"{e2.stderr}") from e2
+            os.replace(str(so) + ".tmp", so)
+        lib = ctypes.CDLL(str(so))
+        self._bind(lib)
+        OpBuilder._loaded[self.name] = lib
+        return lib
+
+    def _bind(self, lib: ctypes.CDLL) -> None:
+        """Set argtypes/restype on the exported functions."""
+
+
+c_f32p = ctypes.POINTER(ctypes.c_float)
+c_u16p = ctypes.POINTER(ctypes.c_uint16)
+c_i64 = ctypes.c_int64
+c_f32 = ctypes.c_float
+
+
+class CPUAdamBuilder(OpBuilder):
+    """csrc/adam/cpu_adam.cpp analog (op_builder/cpu_adam.py)."""
+    name = "cpu_adam"
+    sources = ["cpu_adam.cpp"]
+
+    def _bind(self, lib):
+        lib.dstpu_adam_update.argtypes = [
+            c_f32p, c_f32p, c_f32p, c_f32p, c_i64, c_i64, c_f32, c_f32,
+            c_f32, c_f32, c_f32, ctypes.c_int, c_u16p]
+        lib.dstpu_adam_update.restype = None
+        lib.dstpu_adagrad_update.argtypes = [
+            c_f32p, c_f32p, c_f32p, c_i64, c_f32, c_f32, c_f32, c_u16p]
+        lib.dstpu_adagrad_update.restype = None
+        lib.dstpu_simd_width.restype = ctypes.c_int
+        lib.dstpu_num_threads.restype = ctypes.c_int
+
+
+class AsyncIOBuilder(OpBuilder):
+    """csrc/aio analog (op_builder/async_io.py)."""
+    name = "async_io"
+    sources = ["aio.cpp"]
+
+    def _bind(self, lib):
+        lib.dstpu_aio_create.argtypes = [ctypes.c_int]
+        lib.dstpu_aio_create.restype = ctypes.c_void_p
+        lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_destroy.restype = None
+        for fn in (lib.dstpu_aio_pwrite, lib.dstpu_aio_pread):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_void_p, c_i64, c_i64]
+            fn.restype = None
+        lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_wait.restype = c_i64
+
+
+ALL_OPS = {b.name: b for b in (CPUAdamBuilder(), AsyncIOBuilder())}

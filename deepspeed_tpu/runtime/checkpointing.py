@@ -60,6 +60,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     cp.save(os.path.abspath(state_path), engine.state, force=True)
     cp.wait_until_finished()
 
+    if getattr(engine, "host_opt", None) is not None and \
+            jax.process_index() == 0:
+        # ZeRO-Offload: fp32 master + moments live on host/NVMe — the
+        # analog of the per-DP-rank zero shard files (engine.py:3384)
+        sd = engine.host_opt.state_dict()
+        blob = {"step": np.int64(sd["step"])}
+        for k, w in sd["master"].items():
+            blob[f"master::{k}"] = w
+        for k, st in sd["state"].items():
+            for part, arr in st.items():
+                blob[f"state::{k}::{part}"] = arr
+        np.savez(os.path.join(ckpt_dir, "host_optimizer.npz"), **blob)
+
     meta = {
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
@@ -69,6 +82,12 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
         "ds_version": _version(),
     }
+    if getattr(engine, "host_opt", None) is not None:
+        ls = engine._host_loss_scale
+        meta["host_loss_scale"] = {
+            "scale": float(ls.scale),
+            "growth_tracker": int(ls.growth_tracker),
+            "hysteresis": int(ls.hysteresis)}
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
@@ -105,6 +124,25 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         restored = restored.replace(opt_state=engine.state.opt_state)
     engine.state = restored
 
+    host_path = os.path.join(ckpt_dir, "host_optimizer.npz")
+    if getattr(engine, "host_opt", None) is not None:
+        if os.path.isfile(host_path) and load_optimizer_states and \
+                not load_module_only:
+            blob = np.load(host_path)
+            sd = {"step": int(blob["step"]), "master": {}, "state": {}}
+            for key in blob.files:
+                if key.startswith("master::"):
+                    sd["master"][key[len("master::"):]] = blob[key]
+                elif key.startswith("state::"):
+                    _, leaf, part = key.split("::")
+                    sd["state"].setdefault(leaf, {})[part] = blob[key]
+            engine.host_opt.load_state_dict(sd)
+        else:
+            # no host state restored: re-seed the fp32 master from the
+            # restored params, else the next step would overwrite them
+            # with the construction-time master (fresh-start semantics)
+            engine.host_opt.sync_master_from(engine.state.params)
+
     meta_path = os.path.join(ckpt_dir, "client_state.json")
     client_state = {}
     if os.path.isfile(meta_path):
@@ -114,6 +152,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.skipped_steps = int(meta.get("skipped_steps", 0))
         engine._micro_steps = int(meta.get("micro_steps", 0))
         client_state = meta.get("client_state", {})
+        hls = meta.get("host_loss_scale")
+        if hls and getattr(engine, "host_opt", None) is not None:
+            import jax.numpy as jnp
+            engine._host_loss_scale = engine._host_loss_scale.replace(
+                scale=jnp.float32(hls["scale"]),
+                growth_tracker=jnp.int32(hls["growth_tracker"]),
+                hysteresis=jnp.int32(hls["hysteresis"]))
     log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
     return ckpt_dir, client_state
 

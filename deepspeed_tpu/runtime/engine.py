@@ -102,7 +102,27 @@ class DeepSpeedEngine:
             param_persistence_threshold=(
                 config.zero_config.stage3_param_persistence_threshold
                 if self.zero_stage >= 3 else 0))
+        oc = config.zero_config.offload_optimizer
+        self._offload_cfg = oc if (oc is not None and
+                                   oc.device != "none") else None
         self.state = self._init_state(params)
+        self.host_opt = None
+        if self._offload_cfg is not None:
+            opt_type = (opt_cfg.type if opt_cfg else "AdamW").lower()
+            if opt_type not in ("adam", "adamw", "fusedadam", "cpuadam"):
+                raise ValueError(
+                    f"offload_optimizer supports Adam-family only, got "
+                    f"{opt_type} (reference pairs cpu_offload with "
+                    "DeepSpeedCPUAdam, engine.py:1314)")
+            from deepspeed_tpu.runtime.zero.offload import (
+                HostOffloadOptimizer)
+            self.host_opt = HostOffloadOptimizer(
+                params, opt_cfg.params if opt_cfg else {},
+                device=self._offload_cfg.device,
+                nvme_path=self._offload_cfg.nvme_path)
+            self._host_loss_scale = make_loss_scale(
+                config.fp16 if config.fp16.enabled else None)
+            self._offload_grad_fn = None
         self.training_dataloader = self._build_dataloader(training_data,
                                                           collate_fn)
 
@@ -139,26 +159,35 @@ class DeepSpeedEngine:
         compute_dtype = self.compute_dtype
         mixed = self.mixed_precision
         opt_init = self.optimizer.init
+        # host offload: fp32 master + moments live in host RAM/NVMe
+        # (runtime/zero/offload.py) — nothing optimizer-shaped on device
+        offload = self._offload_cfg is not None
 
         def init_fn(p):
             p32 = cast_tree(p, jnp.float32)
-            master = p32 if mixed else None
+            master = p32 if (mixed and not offload) else None
             compute = cast_tree(p32, compute_dtype)
-            return compute, master, opt_init(p32)
+            opt = () if offload else opt_init(p32)
+            return compute, master, opt
 
-        # opt-state mirrors params per-leaf (moments) plus scalar counters;
-        # shard moments like the master weights, replicate scalars.
-        opt_shape = jax.eval_shape(opt_init, jax.eval_shape(
-            lambda q: cast_tree(q, jnp.float32), params))
+        if offload:
+            opt_sh = ()
+        else:
+            # opt-state mirrors params per-leaf (moments) plus scalar
+            # counters; shard moments like the master, replicate scalars.
+            opt_shape = jax.eval_shape(opt_init, jax.eval_shape(
+                lambda q: cast_tree(q, jnp.float32), params))
 
-        def opt_leaf_sharding(leaf):
-            return NamedSharding(self.mesh, P())
-        opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
-        # moments live under .mu/.nu (or .accum) and must follow master spec
-        for field in ("mu", "nu", "accum"):
-            if hasattr(opt_shape, field) and getattr(opt_shape, field) is not None:
-                opt_sh = opt_sh.replace(**{field: master_sh})
+            def opt_leaf_sharding(leaf):
+                return NamedSharding(self.mesh, P())
+            opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
+            # moments live under .mu/.nu (or .accum), follow master spec
+            for field in ("mu", "nu", "accum"):
+                if hasattr(opt_shape, field) and \
+                        getattr(opt_shape, field) is not None:
+                    opt_sh = opt_sh.replace(**{field: master_sh})
 
+        mixed = mixed and not offload
         shardings = (param_sh, master_sh if mixed else None, opt_sh)
         compute, master, opt_state = jax.jit(
             init_fn, out_shardings=shardings)(params)
@@ -183,22 +212,23 @@ class DeepSpeedEngine:
         return jax.tree.map(
             lambda x: NamedSharding(self.mesh, P(DATA_AXES)), batch)
 
-    def _make_step_fn(self):
+    def _make_grad_core(self):
+        """The shared gradient producer: gas-scan accumulation, fp16
+        unscale, finite check, global-norm clip. Used by both the fused
+        in-HBM step and the host-offload step so the two paths cannot
+        drift (they share bias/clip/epsilon semantics by construction)."""
         gas = self.gas
         loss_fn = self.loss_fn
-        optimizer = self.optimizer
-        schedule = self.lr_scheduler
-        mixed = self.mixed_precision
         fp16 = self.config.fp16.enabled
         clip = self.config.gradient_clipping
         grad_spec = self.policy.spec_of(
             self.policy.grad_sharding(self.state.params))
         mesh = self.mesh
 
-        def constrain(tree, specs):
+        def constrain(tree):
             return jax.tree.map(
                 lambda x, s: jax.lax.with_sharding_constraint(
-                    x, NamedSharding(mesh, s)), tree, specs)
+                    x, NamedSharding(mesh, s)), tree, grad_spec)
 
         def micro_grads(params, scale, mb, rng):
             def scaled_loss(p):
@@ -208,32 +238,29 @@ class DeepSpeedEngine:
                 scaled_loss, has_aux=True)(params)
             return loss, grads
 
-        def step_fn(state: TrainState, batch, rng):
-            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
-
+        def grad_core(params, scale, batch, rng):
+            """→ (grads fp32 clipped+unscaled, mean_loss, gnorm, finite)."""
             if gas > 1:
                 def mb_body(carry, mb_rng):
                     acc, loss_sum = carry
                     mb, r = mb_rng
-                    loss, grads = micro_grads(state.params, scale, mb, r)
+                    loss, grads = micro_grads(params, scale, mb, r)
                     grads = cast_tree(grads, jnp.float32)
-                    acc = constrain(
-                        jax.tree.map(jnp.add, acc, grads), grad_spec)
+                    acc = constrain(jax.tree.map(jnp.add, acc, grads))
                     return (acc, loss_sum + loss), None
 
-                zero_grads = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-                zero_grads = constrain(zero_grads, grad_spec)
+                zero_grads = constrain(jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params))
                 mbs = jax.tree.map(
-                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
-                    batch)
+                    lambda x: x.reshape((gas, x.shape[0] // gas)
+                                        + x.shape[1:]), batch)
                 rngs = jax.random.split(rng, gas)
                 (grads, loss_sum), _ = jax.lax.scan(
                     mb_body, (zero_grads, jnp.float32(0.0)), (mbs, rngs))
                 mean_loss = loss_sum / gas
             else:
-                mean_loss, grads = micro_grads(state.params, scale, batch, rng)
-                grads = constrain(cast_tree(grads, jnp.float32), grad_spec)
+                mean_loss, grads = micro_grads(params, scale, batch, rng)
+                grads = constrain(cast_tree(grads, jnp.float32))
 
             # unscale (fp16) — gas scaling already folded into the loss
             inv = 1.0 / scale
@@ -242,15 +269,26 @@ class DeepSpeedEngine:
 
             # global grad-norm clip (runtime/utils.py clip_grad_norm_ —
             # MP-awareness is free: grads are global arrays)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads)))
             if clip > 0.0:
-                gnorm = jnp.sqrt(sum(
-                    jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads)))
                 coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
-            else:
-                gnorm = jnp.sqrt(sum(
-                    jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads)))
+            return grads, mean_loss, gnorm, finite
 
+        return grad_core
+
+    def _make_step_fn(self):
+        optimizer = self.optimizer
+        schedule = self.lr_scheduler
+        mixed = self.mixed_precision
+        fp16 = self.config.fp16.enabled
+        grad_core = self._make_grad_core()
+
+        def step_fn(state: TrainState, batch, rng):
+            scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
+            grads, mean_loss, gnorm, finite = grad_core(
+                state.params, scale, batch, rng)
             lr = schedule(state.step)
             master = state.master if mixed else state.params
 
@@ -301,6 +339,66 @@ class DeepSpeedEngine:
             donate_argnums=(0,))
 
     # ------------------------------------------------------------------
+    # ZeRO-Offload step: device grads → host SIMD Adam → device params
+    # (runtime/zero/offload.py; reference stage_1_and_2.py:1069-1219)
+    # ------------------------------------------------------------------
+    def _compile_offload_grad_fn(self, batch):
+        grad_core = self._make_grad_core()
+
+        def grad_fn(params, scale, batch, rng):
+            grads, loss, gnorm, finite = grad_core(params, scale, batch,
+                                                   rng)
+            return grads, {"loss": loss, "grad_norm": gnorm,
+                           "finite": finite}
+
+        batch_sh = self._batch_sharding(batch)
+        self._offload_grad_fn = jax.jit(
+            grad_fn,
+            in_shardings=(self._state_shardings.params, None, batch_sh,
+                          None))
+
+    def _offload_train_batch(self, batch) -> Dict[str, Any]:
+        if self._offload_grad_fn is None:
+            self._compile_offload_grad_fn(batch)
+        self.tput_timer.start()
+        self._rng, rng = jax.random.split(self._rng)
+        fp16 = self.config.fp16.enabled
+        scale = float(self._host_loss_scale.scale) if fp16 else 1.0
+        grads, metrics = self._offload_grad_fn(
+            self.state.params, jnp.float32(scale), batch, rng)
+        finite = bool(metrics["finite"])
+        lr = float(self.lr_scheduler(self.state.step))
+        skipped = fp16 and not finite
+        if not skipped:
+            from deepspeed_tpu.runtime.zero.offload import (
+                _flatten_with_names)
+            grads_host = {k: np.asarray(v, np.float32).reshape(-1)
+                          for k, v in _flatten_with_names(grads).items()}
+            new_params = self.host_opt.step(grads_host, lr,
+                                            self.compute_dtype)
+            new_params = jax.device_put(new_params,
+                                        self._state_shardings.params)
+            self.state = self.state.replace(params=new_params)
+        # step advances even when skipped — matches the in-HBM step_fn so
+        # the lr schedule is identical across both paths
+        self.state = self.state.replace(step=self.state.step + 1)
+        if fp16:
+            # exact same dynamics as the device path: reuse precision.py
+            self._host_loss_scale = update_loss_scale(
+                self._host_loss_scale, jnp.bool_(finite))
+            self.skipped_steps += int(skipped)
+        self.global_steps += 1
+        self._micro_steps += self.gas
+        self.tput_timer.stop(global_step=self.global_steps,
+                             report_speed=True)
+        out = {"loss": metrics["loss"], "grad_norm": metrics["grad_norm"],
+               "lr": lr, "loss_scale": scale, "skipped": skipped}
+        if self.monitor is not None and self.monitor.enabled and \
+                self.global_steps % self.config.steps_per_print == 0:
+            self._write_monitor_events(out)
+        return out
+
+    # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def train_batch(self, batch=None) -> Dict[str, Any]:
@@ -317,6 +415,8 @@ class DeepSpeedEngine:
             raise ValueError(
                 f"global batch leading dim {leading} != "
                 f"micro*gas*dp = {expected}")
+        if self.host_opt is not None:
+            return self._offload_train_batch(batch)
         if self._step_fn is None:
             self._compile_step(batch)
         self.tput_timer.start()
@@ -347,6 +447,11 @@ class DeepSpeedEngine:
         Collective-wise this matches DS with GAS: grads accumulate locally
         (sharded per policy) and the reduction happens where the sharding
         says, every micro-step, overlapped by XLA."""
+        if self.host_opt is not None:
+            raise RuntimeError(
+                "the micro-batch backward()/step() API is not supported "
+                "under ZeRO-Offload — use train_batch(), which fuses the "
+                "host optimizer step")
         if self._grad_fn is None:
             self._build_grad_fn()
         self._rng, rng = jax.random.split(self._rng)

@@ -1,0 +1,4 @@
+"""NVMe tensor swapping (analog of ``runtime/swap_tensor/``)."""
+from deepspeed_tpu.runtime.swap_tensor.swapper import OptimizerStateSwapper
+
+__all__ = ["OptimizerStateSwapper"]

@@ -1,0 +1,254 @@
+"""Native host ops + ZeRO-Offload tests.
+
+Mirrors the reference's tests/unit/ops/adam (CPU-Adam parity vs torch),
+tests/unit/ops/aio (read/write round-trips), and the cpu_offload engine
+configs in runtime/half_precision tests: the offload engine must track the
+in-HBM engine's loss trajectory.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.cpu_adam import (DeepSpeedCPUAdam,
+                                        DeepSpeedCPUAdagrad,
+                                        _f32_to_bf16_np)
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder, CPUAdamBuilder
+
+native_available = CPUAdamBuilder().is_compatible()
+
+
+# ------------------------------------------------------------ cpu adam
+
+def _run_adam(native: bool, steps=5, adamw=True, wd=0.01):
+    rng = np.random.RandomState(0)
+    w = rng.randn(1000).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2, weight_decay=wd, adamw_mode=adamw,
+                           use_native=native)
+    if native and not opt.native:
+        pytest.skip("native cpu_adam unavailable")
+    master = {"w": w.copy()}
+    state = opt.init_state(master)
+    for s in range(steps):
+        g = rng.randn(1000).astype(np.float32)
+        rng2 = np.random.RandomState(100 + s)  # same grads both runs
+        g = rng2.randn(1000).astype(np.float32)
+        opt.step(master, {"w": g}, state)
+    return master["w"], state["w"]
+
+
+@pytest.mark.skipif(not native_available, reason="no C++ toolchain")
+@pytest.mark.parametrize("adamw", [True, False])
+def test_native_adam_matches_numpy(adamw):
+    w_native, st_native = _run_adam(True, adamw=adamw)
+    w_numpy, st_numpy = _run_adam(False, adamw=adamw)
+    np.testing.assert_allclose(w_native, w_numpy, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(st_native["m"], st_numpy["m"], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_numpy_adam_matches_optax():
+    """The host optimizer must implement the same AdamW as the device one."""
+    import optax
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(64).astype(np.float32)
+    grads = [np.random.RandomState(s).randn(64).astype(np.float32)
+             for s in range(4)]
+
+    opt = DeepSpeedCPUAdam(lr=1e-2, weight_decay=0.01, use_native=False)
+    master = {"w": w0.copy()}
+    state = opt.init_state(master)
+    for g in grads:
+        opt.step(master, {"w": g}, state)
+
+    tx = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    p = jnp.asarray(w0)
+    s = tx.init(p)
+    for g in grads:
+        up, s = tx.update(jnp.asarray(g), s, p)
+        p = optax.apply_updates(p, up)
+    np.testing.assert_allclose(master["w"], np.asarray(p), rtol=2e-5,
+                               atol=2e-6)
+
+
+@pytest.mark.skipif(not native_available, reason="no C++ toolchain")
+def test_native_adam_bf16_output():
+    rng = np.random.RandomState(0)
+    w = rng.randn(256).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2, use_native=True)
+    if not opt.native:
+        pytest.skip("native unavailable")
+    master = {"w": w.copy()}
+    state = opt.init_state(master)
+    out = {"w": np.empty(256, np.uint16)}
+    opt.step(master, {"w": rng.randn(256).astype(np.float32)}, state,
+             bf16_out=out)
+    np.testing.assert_array_equal(out["w"], _f32_to_bf16_np(master["w"]))
+
+
+def test_cpu_adagrad():
+    rng = np.random.RandomState(0)
+    w = rng.randn(128).astype(np.float32)
+    opt = DeepSpeedCPUAdagrad(lr=1e-2, use_native=False)
+    master = {"w": w.copy()}
+    state = opt.init_state(master)
+    g = rng.randn(128).astype(np.float32)
+    opt.step(master, {"w": g}, state)
+    expect = w - 1e-2 * g / (np.abs(g) + 1e-10)
+    np.testing.assert_allclose(master["w"], expect, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------ aio
+
+@pytest.mark.skipif(not AsyncIOBuilder().is_compatible(),
+                    reason="no C++ toolchain")
+def test_aio_roundtrip(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(num_threads=2)
+    rng = np.random.RandomState(0)
+    bufs = [rng.randn(1 << 14).astype(np.float32) for _ in range(4)]
+    for i, b in enumerate(bufs):
+        h.pwrite(str(tmp_path / f"f{i}.swp"), b)
+    assert h.wait() == 0
+    outs = [np.empty_like(b) for b in bufs]
+    for i, o in enumerate(outs):
+        h.pread(str(tmp_path / f"f{i}.swp"), o)
+    assert h.wait() == 0
+    for b, o in zip(bufs, outs):
+        np.testing.assert_array_equal(b, o)
+    # read of a missing file reports an error instead of hanging
+    h.pread(str(tmp_path / "missing.swp"), np.empty(4, np.float32))
+    assert h.wait() == 1
+    h.close()
+
+
+@pytest.mark.skipif(not AsyncIOBuilder().is_compatible(),
+                    reason="no C++ toolchain")
+def test_swapper_pipelined(tmp_path):
+    from deepspeed_tpu.runtime.swap_tensor import OptimizerStateSwapper
+    sw = OptimizerStateSwapper(str(tmp_path), num_threads=2)
+    keys = [f"k{i}" for i in range(3)]
+    data = {k: {"m": np.full(64, i, np.float32),
+                "v": np.full(64, 10 + i, np.float32)}
+            for i, k in enumerate(keys)}
+    for k in keys:
+        sw.write_state(k, data[k], sync=True)
+
+    seen = {}
+    for k, bufs in sw.iter_pipelined(
+            keys, lambda k: {"m": np.empty(64, np.float32),
+                             "v": np.empty(64, np.float32)}):
+        seen[k] = {p: a.copy() for p, a in bufs.items()}
+        bufs["m"] += 100  # mutate → write-back
+    for k in keys:
+        np.testing.assert_array_equal(seen[k]["m"], data[k]["m"])
+    # second pass sees the written-back mutation
+    bufs = {"m": np.empty(64, np.float32), "v": np.empty(64, np.float32)}
+    sw.read_state(keys[0], bufs, sync=True)
+    np.testing.assert_array_equal(bufs["m"], data[keys[0]]["m"] + 100)
+
+
+# ------------------------------------------------------------ engine
+
+def _make_engine(extra_zero=None, dtype="bf16"):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    cfg = GPT2Config(n_embd=64, n_layer=2, n_head=4, n_positions=128,
+                     vocab_size=256, dtype=jnp.bfloat16, remat=False)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=32)
+    zero = {"stage": 1}
+    if extra_zero:
+        zero.update(extra_zero)
+    ds = {"train_micro_batch_size_per_gpu": 2,
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "bf16" if dtype == "bf16" else "fp16": {"enabled": True},
+          "zero_optimization": zero}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                            model_parameters=params,
+                                            config=ds)
+    return eng
+
+
+def _losses(eng, n=5):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        ids = jnp.asarray(rng.randint(0, 256, (eng.train_batch_size, 32)))
+        out.append(float(eng.train_batch({"input_ids": ids})["loss"]))
+    return out
+
+
+def test_offload_cpu_matches_in_hbm_engine():
+    base = _losses(_make_engine())
+    off = _losses(_make_engine(
+        {"offload_optimizer": {"device": "cpu"}}))
+    assert off[-1] < off[0]  # learning
+    np.testing.assert_allclose(off, base, rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.skipif(not AsyncIOBuilder().is_compatible(),
+                    reason="no C++ toolchain")
+def test_offload_nvme_matches_cpu_offload(tmp_path):
+    cpu = _losses(_make_engine({"offload_optimizer": {"device": "cpu"}}))
+    nvme = _losses(_make_engine(
+        {"offload_optimizer": {"device": "nvme",
+                               "nvme_path": str(tmp_path)}}))
+    np.testing.assert_allclose(nvme, cpu, rtol=1e-4, atol=1e-4)
+    assert any(f.endswith(".swp") for f in os.listdir(tmp_path))
+
+
+def test_offload_checkpoint_roundtrip(tmp_path):
+    eng = _make_engine({"offload_optimizer": {"device": "cpu"}})
+    _losses(eng, 3)
+    eng.save_checkpoint(str(tmp_path / "ckpt"))
+    m_before = {k: v.copy() for k, v in eng.host_opt.master.items()}
+
+    eng2 = _make_engine({"offload_optimizer": {"device": "cpu"}})
+    eng2.load_checkpoint(str(tmp_path / "ckpt"))
+    assert eng2.global_steps == 3
+    assert eng2.host_opt.adam.step_count == 3
+    for k in m_before:
+        np.testing.assert_array_equal(eng2.host_opt.master[k], m_before[k])
+
+
+def test_offload_micro_api_guarded():
+    eng = _make_engine({"offload_optimizer": {"device": "cpu"}})
+    with pytest.raises(RuntimeError, match="train_batch"):
+        eng.backward({"input_ids": jnp.zeros((2, 32), jnp.int32)})
+
+
+def test_offload_load_module_only_resyncs_master(tmp_path):
+    eng = _make_engine({"offload_optimizer": {"device": "cpu"}})
+    _losses(eng, 2)
+    eng.save_checkpoint(str(tmp_path / "ckpt"))
+    trained = {k: v.copy() for k, v in eng.host_opt.master.items()}
+
+    eng2 = _make_engine({"offload_optimizer": {"device": "cpu"}})
+    eng2.load_checkpoint(str(tmp_path / "ckpt"),
+                         load_optimizer_states=False)
+    # master must mirror the restored (trained) params, not init values —
+    # modulo the bf16 quantization of the stored params
+    for k in trained:
+        np.testing.assert_allclose(eng2.host_opt.master[k], trained[k],
+                                   rtol=1e-2, atol=1e-2)
+
+
+def test_offload_rejects_non_adam():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    cfg = GPT2Config(n_embd=32, n_layer=1, n_head=2, n_positions=64,
+                     vocab_size=128, dtype=jnp.bfloat16, remat=False)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+    ds = {"train_micro_batch_size_per_gpu": 1,
+          "optimizer": {"type": "SGD", "params": {"lr": 1e-3}},
+          "zero_optimization": {"stage": 1,
+                                "offload_optimizer": {"device": "cpu"}}}
+    with pytest.raises(ValueError, match="Adam-family"):
+        deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                 config=ds)
