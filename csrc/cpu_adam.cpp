@@ -24,10 +24,13 @@
 
 extern "C" {
 
-// Round-to-nearest-even fp32 -> bf16.
+// Round-to-nearest-even fp32 -> bf16. NaN must stay NaN: the RNE carry
+// can overflow a NaN mantissa into the Inf pattern, so NaN truncates.
 static inline uint16_t f32_to_bf16(float f) {
     uint32_t x;
     std::memcpy(&x, &f, 4);
+    if ((x & 0x7fffffffu) > 0x7f800000u)      // NaN
+        return (uint16_t)((x >> 16) | 0x0040); // quieted, sign kept
     uint32_t lsb = (x >> 16) & 1;
     x += 0x7fff + lsb;
     return (uint16_t)(x >> 16);

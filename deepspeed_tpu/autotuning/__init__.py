@@ -1,0 +1,4 @@
+"""Autotuning (analog of ``deepspeed/autotuning/``)."""
+from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+__all__ = ["Autotuner"]

@@ -1,0 +1,9 @@
+"""Model compression (analog of ``deepspeed/compression/``)."""
+from deepspeed_tpu.compression.compress import (apply_compression,
+                                                init_compression,
+                                                redundancy_clean,
+                                                seed_masks)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+
+__all__ = ["init_compression", "apply_compression", "redundancy_clean",
+           "seed_masks", "CompressionScheduler"]

@@ -1,0 +1,225 @@
+"""Config-driven compression over functional param trees.
+
+Analog of ``deepspeed/compression/compress.py`` (``init_compression``
+``:97``, ``redundancy_clean`` ``:127``) and the compressed-module zoo
+(``basic_layer.py:61-887``). The reference swaps nn.Modules for
+``LinearLayer_Compress``; with functional params the same techniques are
+*tree transforms* applied inside the train step:
+
+* weight quantization — groupwise fake-quant (QAT), bit-width annealed
+  from ``start_bits`` to ``target_bits`` every ``quantization_period``
+  steps after ``schedule_offset``
+* sparse pruning — l1/topk magnitude masks at ``dense_ratio``
+* row pruning — structured row masks on matched matrices
+* head pruning — attention-head masks on [E, H, D]-shaped projections
+
+Config keys mirror the reference (``shared_parameters`` /
+``different_groups`` with ``modules`` glob-ish matching on param paths).
+``redundancy_clean`` physically drops pruned rows/heads after training.
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.quantizer import fake_quantize
+
+
+@dataclasses.dataclass
+class TechniqueSpec:
+    kind: str                    # weight_quantization | sparse_pruning | ...
+    schedule_offset: int
+    params: Dict[str, Any]
+    modules: List[str]
+
+    def matches(self, path: str) -> bool:
+        return any(m == "*" or fnmatch.fnmatch(path, f"*{m}*")
+                   for m in self.modules)
+
+
+@dataclasses.dataclass
+class CompressionSpec:
+    techniques: List[TechniqueSpec]
+    masks: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    def for_path(self, path: str) -> List[TechniqueSpec]:
+        return [t for t in self.techniques if t.matches(path)]
+
+
+_KINDS = ("weight_quantization", "sparse_pruning", "row_pruning",
+          "head_pruning", "channel_pruning", "activation_quantization")
+
+
+def _flatten(tree) -> Dict[str, Any]:
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path)] = leaf
+    return out
+
+
+def init_compression(params, compression_config: Dict) -> CompressionSpec:
+    """Parse the ``compression_training`` config section into a spec bound
+    to the param tree (validates that each group matches something)."""
+    cfg = compression_config.get("compression_training",
+                                 compression_config)
+    techniques: List[TechniqueSpec] = []
+    for kind in _KINDS:
+        section = cfg.get(kind)
+        if not section:
+            continue
+        shared = section.get("shared_parameters", {})
+        if not shared.get("enabled", False):
+            continue
+        offset = shared.get("schedule_offset", 0)
+        for gname, group in section.get("different_groups", {}).items():
+            techniques.append(TechniqueSpec(
+                kind=kind, schedule_offset=offset,
+                params={**shared, **group.get("params", {})},
+                modules=group.get("modules", ["*"])))
+    spec = CompressionSpec(techniques=techniques)
+    flat = _flatten(params)
+    for t in spec.techniques:
+        if not any(t.matches(p) for p in flat):
+            raise ValueError(
+                f"compression group for {t.kind} matches no parameter "
+                f"(modules={t.modules})")
+    return spec
+
+
+def _current_bits(t: TechniqueSpec, step: int) -> int:
+    start = int(t.params.get("start_bits", 8))
+    target = int(t.params.get("target_bits", 8))
+    period = int(t.params.get("quantization_period", 1) or 1)
+    active = max(0, step - t.schedule_offset)
+    drops = active // period
+    return max(target, start - drops)
+
+
+def apply_compression(params, spec: CompressionSpec, step: int):
+    """Return the compressed view of ``params`` for this step — apply
+    inside the forward/loss so QAT gradients flow (straight-through via
+    fake-quant) and masks stay applied."""
+    flat = _flatten(params)
+    new_flat = dict(flat)
+    for path, w in flat.items():
+        if not hasattr(w, "ndim") or w.ndim < 2:
+            continue
+        for t in spec.for_path(path):
+            if step < t.schedule_offset:
+                continue
+            if t.kind == "weight_quantization":
+                bits = _current_bits(t, step)
+                groups = int(t.params.get("quantize_groups", 1))
+                sym = t.params.get("quantization_type",
+                                   "symmetric") == "symmetric"
+                w2 = w.reshape(-1, w.shape[-1])
+                g = max(1, min(groups, w2.shape[0]))
+                while w2.shape[0] % g:
+                    g -= 1
+                w = fake_quantize(w2, groups=g, bits=bits,
+                                  symmetric=sym).reshape(w.shape)
+            elif t.kind in ("sparse_pruning", "row_pruning",
+                            "channel_pruning", "head_pruning"):
+                mask = _get_mask(spec, path, t, w)
+                w = w * mask.astype(w.dtype)
+        new_flat[path] = w
+    treedef = jax.tree_util.tree_structure(params)
+    order = list(_flatten(params))
+    return jax.tree_util.tree_unflatten(
+        treedef, [new_flat[k] for k in order])
+
+
+def seed_masks(params, spec: CompressionSpec, step: int) -> None:
+    """Eagerly compute all pruning masks from the current (concrete)
+    weights. Call once before jitting a train step that applies
+    compression — masks are data-dependent and cannot be derived inside a
+    trace (the reference likewise snapshots masks on module init)."""
+    flat = _flatten(params)
+    for path, w in flat.items():
+        if not hasattr(w, "ndim") or w.ndim < 2:
+            continue
+        for t in spec.for_path(path):
+            if step < t.schedule_offset or t.kind == "weight_quantization":
+                continue
+            _get_mask(spec, path, t, w)
+
+
+def _get_mask(spec: CompressionSpec, path: str, t: TechniqueSpec, w):
+    key = f"{t.kind}::{path}"
+    if key in spec.masks:
+        return jnp.asarray(spec.masks[key])
+    if isinstance(w, jax.core.Tracer):
+        raise ValueError(
+            f"pruning mask for {path} requested inside a jit/grad trace "
+            "before it was computed — call seed_masks(params, spec, step) "
+            "eagerly first (masks are derived from concrete weights)")
+    ratio = float(t.params.get("dense_ratio", 0.5))
+    wnp = np.asarray(jax.device_get(w), np.float32)
+    if t.kind == "sparse_pruning":
+        method = t.params.get("method", "l1")
+        flat = np.abs(wnp).reshape(-1)
+        k = max(1, int(len(flat) * ratio))
+        if method in ("l1", "topk"):
+            thresh = np.partition(flat, -k)[-k]
+            mask = (np.abs(wnp) >= thresh).astype(np.float32)
+        else:
+            raise ValueError(f"unknown sparse method {method}")
+    elif t.kind in ("row_pruning", "channel_pruning"):
+        axis = 0 if t.kind == "row_pruning" else -1
+        scores = np.abs(wnp).sum(axis=tuple(
+            a for a in range(wnp.ndim) if a != (axis % wnp.ndim)))
+        k = max(1, int(len(scores) * ratio))
+        keep = np.argsort(scores)[-k:]
+        mask = np.zeros_like(scores)
+        mask[keep] = 1.0
+        shape = [1] * wnp.ndim
+        shape[axis % wnp.ndim] = len(scores)
+        mask = mask.reshape(shape)
+    elif t.kind == "head_pruning":
+        if wnp.ndim != 3:
+            return jnp.ones_like(jnp.asarray(wnp))
+        num_heads = wnp.shape[1]
+        keep_n = max(1, int(num_heads * ratio))
+        scores = np.abs(wnp).sum(axis=(0, 2))
+        keep = np.argsort(scores)[-keep_n:]
+        mask = np.zeros((1, num_heads, 1), np.float32)
+        mask[0, keep, 0] = 1.0
+    else:
+        raise ValueError(t.kind)
+    spec.masks[key] = mask
+    return jnp.asarray(mask)
+
+
+def redundancy_clean(params, spec: CompressionSpec):
+    """Physically remove rows/heads that are fully masked (reference
+    ``redundancy_clean`` compress.py:127). Returns (clean_params, report).
+    Only leaves whose masks zero entire slices shrink; quantized weights
+    are left fake-quantized (storage quantization is the serving writer's
+    job)."""
+    flat = _flatten(params)
+    report = {}
+    new_flat = dict(flat)
+    for key, mask in spec.masks.items():
+        kind, path = key.split("::", 1)
+        if path not in flat or kind not in ("row_pruning", "head_pruning",
+                                            "channel_pruning"):
+            continue
+        w = np.asarray(jax.device_get(flat[path]))
+        m = np.asarray(mask)
+        axis = int(np.argmax([s > 1 for s in m.shape]))
+        keep = np.nonzero(m.reshape(-1) > 0)[0]
+        neww = np.take(w, keep, axis=axis)
+        new_flat[path] = jnp.asarray(neww)
+        report[path] = {"kind": kind, "axis": axis,
+                        "kept": int(len(keep)),
+                        "of": int(m.reshape(-1).shape[0])}
+    treedef = jax.tree_util.tree_structure(params)
+    order = list(flat)
+    return jax.tree_util.tree_unflatten(
+        treedef, [new_flat[k] for k in order]), report

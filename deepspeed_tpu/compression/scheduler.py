@@ -1,0 +1,36 @@
+"""Compression scheduler (analog of ``deepspeed/compression/scheduler.py``):
+tracks which techniques are live at the current step and exposes the
+verbose one-shot logging the reference does when a technique activates."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from deepspeed_tpu.compression.compress import (CompressionSpec,
+                                                _current_bits)
+from deepspeed_tpu.utils.logging import logger
+
+
+class CompressionScheduler:
+    def __init__(self, spec: CompressionSpec):
+        self.spec = spec
+        self._announced = set()
+
+    def active(self, step: int) -> List[str]:
+        out = []
+        for i, t in enumerate(self.spec.techniques):
+            if step >= t.schedule_offset:
+                out.append(t.kind)
+                if i not in self._announced:
+                    self._announced.add(i)
+                    logger.info(f"compression activated at step {step}: "
+                                f"{t.kind} modules={t.modules}")
+        return out
+
+    def status(self, step: int) -> Dict[str, Dict]:
+        st = {}
+        for t in self.spec.techniques:
+            entry = {"active": step >= t.schedule_offset}
+            if t.kind == "weight_quantization":
+                entry["bits"] = _current_bits(t, step)
+            st.setdefault(t.kind, entry)
+        return st

@@ -163,6 +163,16 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     profile: bool = False
 
 
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    """flops_profiler section (reference profiling/config.py)."""
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
 class GradientAccumulationPluginConfig(DeepSpeedConfigModel):
     pass
 
@@ -223,6 +233,14 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
         self.mesh = MeshConfig(**pd.get("mesh", {}))
         self.compile_cache_dir: Optional[str] = pd.get("compile_cache_dir")
+        self.flops_profiler = FlopsProfilerConfig(
+            **pd.get("flops_profiler", {}))
+        # data-efficiency: either the modern nested section or the legacy
+        # top-level curriculum_learning (engine.py:1807)
+        de = pd.get("data_efficiency", {})
+        self.curriculum_learning: dict = pd.get(
+            "curriculum_learning",
+            de.get("data_sampling", {}).get("curriculum_learning", {}))
 
         if self.fp16.enabled and self.bf16.enabled:
             raise ValueError("fp16 and bf16 cannot both be enabled")

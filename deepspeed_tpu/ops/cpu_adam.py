@@ -141,7 +141,10 @@ class DeepSpeedCPUAdagrad:
 
 
 def _f32_to_bf16_np(w: np.ndarray) -> np.ndarray:
-    """Round-to-nearest-even fp32→bf16, returned as uint16 payload."""
+    """Round-to-nearest-even fp32→bf16 (uint16 payload); NaN stays NaN
+    (RNE carry would overflow a NaN mantissa into the Inf pattern)."""
     x = w.view(np.uint32)
     lsb = (x >> 16) & 1
-    return ((x + 0x7FFF + lsb) >> 16).astype(np.uint16)
+    rounded = ((x + 0x7FFF + lsb) >> 16).astype(np.uint16)
+    nan = (x & 0x7FFFFFFF) > 0x7F800000
+    return np.where(nan, ((x >> 16) | 0x0040).astype(np.uint16), rounded)

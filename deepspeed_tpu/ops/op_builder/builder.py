@@ -62,11 +62,13 @@ class OpBuilder:
             cxx = self.compiler()
             if cxx is None:
                 raise RuntimeError(f"no C++ compiler for op {self.name}")
+            tmp = f"{so}.{os.getpid()}.tmp"   # unique per process: two
+            # concurrent first-use builds must not clobber one tmp file
             cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC",
                    "-march=native", "-fopenmp",
                    *self.extra_flags,
                    *[str(p) for p in self._source_paths()],
-                   "-o", str(so) + ".tmp"]
+                   "-o", tmp]
             logger.info(f"building native op {self.name}: {' '.join(cmd)}")
             try:
                 subprocess.run(cmd, check=True, capture_output=True,
@@ -82,7 +84,7 @@ class OpBuilder:
                     raise RuntimeError(
                         f"failed to build {self.name}:\n{e.stderr}\n"
                         f"{e2.stderr}") from e2
-            os.replace(str(so) + ".tmp", so)
+            os.replace(tmp, so)
         lib = ctypes.CDLL(str(so))
         self._bind(lib)
         OpBuilder._loaded[self.name] = lib

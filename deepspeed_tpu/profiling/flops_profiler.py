@@ -1,0 +1,141 @@
+"""Flops profiler.
+
+Analog of ``deepspeed/profiling/flops_profiler/profiler.py`` (1,226 LoC of
+torch monkey-patching to count MACs per module). On TPU the compiler
+already knows: XLA's cost analysis reports exact flops/bytes for the
+*optimized* computation, so the profiler asks the compiled executable
+instead of shimming every op — more accurate (post-fusion) and zero
+overhead in the hot path.
+
+``get_model_profile(fn, args)`` mirrors the reference's standalone API;
+:class:`FlopsProfiler` mirrors the engine-integrated start/stop/print flow
+(``runtime/engine.py:1779-1798``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _params_count(tree) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree)
+               if hasattr(x, "shape"))
+
+
+def _cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):   # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    return {"flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "compiled": compiled}
+
+
+def number_to_string(num: float, units: Optional[str] = None,
+                     precision: int = 2) -> str:
+    """Human units like the reference's flops_to_string/params_to_string."""
+    for threshold, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                              (1e3, "K")):
+        if units == suffix or (units is None and abs(num) >= threshold):
+            return f"{num / threshold:.{precision}f} {suffix}"
+    return f"{num:.{precision}f}"
+
+
+def get_model_profile(fn: Callable, args: Tuple = (), kwargs: Dict = None,
+                      warm_up: int = 1, num_steps: int = 3,
+                      as_string: bool = False,
+                      params: Any = None) -> Dict[str, Any]:
+    """Profile a jittable callable: flops, HBM bytes, params, latency,
+    achieved FLOP/s (reference ``get_model_profile``)."""
+    kwargs = kwargs or {}
+    cost = _cost_analysis(fn, *args, **kwargs)
+    compiled = cost.pop("compiled")
+    for _ in range(max(warm_up, 1)):
+        out = compiled(*args, **kwargs)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(max(num_steps, 1)):
+        out = compiled(*args, **kwargs)
+    # force a host sync (block_until_ready alone can return early through
+    # remote-device relays — see .claude/skills/verify/SKILL.md)
+    np.asarray(jax.tree.leaves(out)[0])
+    latency = (time.perf_counter() - t0) / max(num_steps, 1)
+
+    prof = {
+        "flops": cost["flops"],
+        "bytes_accessed": cost["bytes_accessed"],
+        "params": _params_count(params if params is not None else args),
+        "latency_s": latency,
+        "flops_per_s": cost["flops"] / latency if latency > 0 else 0.0,
+    }
+    if as_string:
+        prof = {
+            "flops": number_to_string(prof["flops"]) + "FLOPs",
+            "bytes_accessed": number_to_string(prof["bytes_accessed"]) + "B",
+            "params": number_to_string(prof["params"]),
+            "latency_s": f"{latency * 1e3:.2f} ms",
+            "flops_per_s": number_to_string(prof["flops_per_s"]) + "FLOPS",
+        }
+    return prof
+
+
+class FlopsProfiler:
+    """Engine-integrated profiler (config section ``flops_profiler``):
+    records the step's cost analysis + wall time at ``profile_step`` and
+    prints the reference-style summary."""
+
+    def __init__(self, engine=None, profile_step: int = 1,
+                 top_modules: int = 1, detailed: bool = True,
+                 output_file: Optional[str] = None):
+        self.engine = engine
+        self.profile_step = profile_step
+        self.output_file = output_file
+        self.started = False
+        self._t0 = 0.0
+        self.results: Dict[str, Any] = {}
+
+    def start_profile(self) -> None:
+        self.started = True
+        self._latency = None
+        self._t0 = time.perf_counter()
+
+    def mark_step_done(self) -> None:
+        """Call right after the host sync — freezes the latency BEFORE any
+        cost-analysis work so compile/analysis time never pollutes it."""
+        if self.started:
+            self._latency = time.perf_counter() - self._t0
+
+    def stop_profile(self, flops: float = 0.0, params: int = 0) -> None:
+        if not self.started:
+            return
+        latency = (self._latency if self._latency is not None
+                   else time.perf_counter() - self._t0)
+        self.results = {
+            "flops": flops, "params": params, "latency_s": latency,
+            "flops_per_s": flops / latency if latency > 0 else 0.0}
+        self.started = False
+
+    def print_model_profile(self) -> str:
+        r = self.results
+        lines = [
+            "-" * 60,
+            "DeepSpeed-TPU Flops Profiler",
+            f"params:               {number_to_string(r.get('params', 0))}",
+            f"fwd+bwd+step flops:   {number_to_string(r.get('flops', 0))}",
+            f"step latency:         {r.get('latency_s', 0) * 1e3:.2f} ms",
+            f"achieved:             "
+            f"{number_to_string(r.get('flops_per_s', 0))}FLOPS",
+            "-" * 60,
+        ]
+        text = "\n".join(lines)
+        if self.output_file:
+            with open(self.output_file, "a") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        return text

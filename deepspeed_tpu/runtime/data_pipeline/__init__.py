@@ -1,0 +1,12 @@
+"""Data-efficiency pipeline (analog of ``deepspeed/runtime/data_pipeline/``):
+curriculum learning, difficulty-based data sampling, Random-LTD routing.
+"""
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+    DeepSpeedDataSampler)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd_scheduler import (
+    RandomLTDScheduler)
+
+__all__ = ["CurriculumScheduler", "DeepSpeedDataSampler",
+           "RandomLTDScheduler"]

@@ -1,0 +1,91 @@
+"""Curriculum scheduler.
+
+Analog of ``runtime/data_pipeline/curriculum_scheduler.py`` (182 LoC):
+maps the global step to a difficulty value (typically max sequence length)
+under fixed_linear / fixed_root / fixed_discrete / custom schedules. Pure
+math; identical config keys. The legacy engine-level curriculum
+(``engine.py:1807-1813``) is this scheduler with curriculum_type=seqlen.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        self.state: Dict = {}
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum config missing '{key}'")
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        self.state["schedule_type"] = config["schedule_type"]
+        self._custom_fn: Optional[Callable[[int], int]] = None
+        cfg_key = "schedule_config"
+        if self.state["schedule_type"] == "fixed_discrete":
+            sc = config[cfg_key]
+            if len(sc["difficulty"]) != len(sc["max_step"]) + 1:
+                raise ValueError(
+                    "fixed_discrete needs len(difficulty) == "
+                    "len(max_step) + 1")
+            self.state[cfg_key] = sc
+        elif self.state["schedule_type"] in ("fixed_linear", "fixed_root"):
+            sc = dict(config[cfg_key])
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in sc:
+                    raise ValueError(f"schedule_config missing '{key}'")
+            if self.state["schedule_type"] == "fixed_root" and \
+                    "root_degree" not in sc:
+                raise ValueError("fixed_root needs 'root_degree'")
+            if sc["difficulty_step"] % 8:
+                # the reference warns: non-multiple-of-8 seqlen hurts tensor
+                # cores; on TPU the lane width makes it 128, but 8 keeps
+                # config compat
+                pass
+            self.state[cfg_key] = sc
+        elif self.state["schedule_type"] == "custom":
+            self.state[cfg_key] = config.get(cfg_key, {})
+        else:
+            raise ValueError(
+                f"unknown schedule_type {self.state['schedule_type']}")
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self._custom_fn = fn
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == "fixed_discrete":
+            sc = self.state["schedule_config"]
+            idx = 0
+            for i, ms in enumerate(sc["max_step"]):
+                if global_steps > ms:
+                    idx = i + 1
+            return sc["difficulty"][min(idx, len(sc["difficulty"]) - 1)]
+        if stype == "custom":
+            if self._custom_fn is None:
+                raise ValueError("custom schedule needs "
+                                 "set_custom_get_difficulty()")
+            return self._custom_fn(global_steps)
+        sc = self.state["schedule_config"]
+        total = sc["total_curriculum_step"]
+        if stype == "fixed_linear":
+            frac = min(1.0, global_steps / total)
+        else:  # fixed_root
+            frac = min(1.0, (global_steps / total) **
+                       (1.0 / sc["root_degree"]))
+        diff = self.state["min_difficulty"] + frac * (
+            self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = sc["difficulty_step"]
+        diff = int(diff / step) * step
+        return max(self.state["min_difficulty"],
+                   min(diff, self.state["max_difficulty"]))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]

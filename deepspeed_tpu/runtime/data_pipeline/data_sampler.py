@@ -1,0 +1,85 @@
+"""Curriculum-aware distributed data sampler.
+
+Analog of ``runtime/data_pipeline/data_sampling/data_sampler.py`` (389 LoC,
+``DeepSpeedDataSampler``): given a per-sample difficulty array (the offline
+``data_analyzer.py`` product — e.g. sequence length), each epoch yields
+only samples whose difficulty ≤ the curriculum's current value, sharded
+across data-parallel ranks, deterministically per (seed, epoch). The
+Megatron indexed-dataset machinery reduces to a numpy difficulty array on
+TPU (the analyzer below builds it).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+
+
+def analyze_seqlen(dataset: Sequence, field: str = "input_ids") -> np.ndarray:
+    """Minimal ``data_analyzer`` — per-sample difficulty = sequence length."""
+    out = np.empty(len(dataset), np.int64)
+    for i in range(len(dataset)):
+        sample = dataset[i]
+        x = sample[field] if isinstance(sample, dict) else sample
+        out[i] = len(x)
+    return out
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, num_samples: int,
+                 difficulties: Optional[np.ndarray] = None,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 batch_size: int = 1, data_parallel_rank: int = 0,
+                 data_parallel_size: int = 1, shuffle: bool = True,
+                 seed: int = 1234, drop_last: bool = True):
+        self.num_samples = num_samples
+        self.difficulties = difficulties
+        self.curriculum = curriculum
+        self.batch_size = batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.global_steps = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def set_step(self, global_steps: int) -> None:
+        self.global_steps = global_steps
+        if self.curriculum is not None:
+            self.curriculum.update_difficulty(global_steps)
+
+    def _eligible(self) -> np.ndarray:
+        idx = np.arange(self.num_samples)
+        if self.curriculum is not None and self.difficulties is not None:
+            cap = self.curriculum.get_current_difficulty()
+            idx = idx[self.difficulties[: self.num_samples] <= cap]
+        return idx
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        idx = self._eligible()
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            idx = idx[rng.permutation(len(idx))]
+        # shard across DP ranks, then batch
+        per_rank = len(idx) // self.dp_size if self.drop_last else \
+            -(-len(idx) // self.dp_size)
+        start = self.dp_rank * per_rank
+        mine = idx[start: start + per_rank]
+        n_batches = len(mine) // self.batch_size if self.drop_last else \
+            -(-len(mine) // self.batch_size)
+        for b in range(n_batches):
+            yield mine[b * self.batch_size: (b + 1) * self.batch_size]
+
+    def __len__(self) -> int:
+        n = len(self._eligible())
+        if self.drop_last:
+            return (n // self.dp_size) // self.batch_size
+        per_rank = -(-n // self.dp_size)
+        return -(-per_rank // self.batch_size)

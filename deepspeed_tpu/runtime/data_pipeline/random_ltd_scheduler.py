@@ -1,0 +1,51 @@
+"""Random-LTD (layerwise token dropping) scheduler.
+
+Analog of ``runtime/data_pipeline/data_routing/scheduler.py:112``
+(RandomLTDScheduler): ramps the number of *kept* tokens
+(``reserved_length``) from an initial value to the full sequence length
+over a schedule; layers inside the random-LTD window train on the sampled
+subset (gather/scatter ops in deepspeed_tpu.ops.random_ltd — the N7 CUDA
+kernels are jnp gathers on TPU). Config keys mirror the reference's
+``random_ltd`` section.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+
+class RandomLTDScheduler:
+    def __init__(self, config: Dict):
+        rl = config.get("random_ltd", config)
+        self.enabled = rl.get("random_ltd_enabled", True)
+        self.total_layers = rl["total_layer_num"]
+        self.ltd_layers = rl["random_ltd_layer_num"]
+        self.layer_ids = rl.get("random_ltd_layer_id",
+                                list(range(self.ltd_layers)))
+        sched = rl["random_ltd_schedule"]
+        self.min_value = sched["min_value"]
+        self.max_value = sched["max_value"]
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        sc = sched["schedule_config"]
+        self.require_steps = sc["require_steps"]
+        self.seq_per_step = sc["seq_per_step"]
+        self.current_seq = self.min_value
+        self.state = {"current_seq": self.current_seq, "global_steps": 0}
+
+    def get_current_seq(self) -> int:
+        return self.state["current_seq"]
+
+    def get_total_layer_tokens(self, seq_len: int) -> int:
+        """Effective token-layers per sample at the current schedule —
+        the reference's layer-token accounting for LR scaling."""
+        kept = self.state["current_seq"]
+        return (self.total_layers - self.ltd_layers) * seq_len + \
+            self.ltd_layers * min(kept, seq_len)
+
+    def update_seq(self, global_steps: int) -> int:
+        if self.schedule_type != "fixed_linear":
+            raise ValueError(f"unknown schedule {self.schedule_type}")
+        inc = (global_steps // self.require_steps) * self.seq_per_step
+        seq = min(self.min_value + inc, self.max_value)
+        self.state["current_seq"] = seq
+        self.state["global_steps"] = global_steps
+        return seq

@@ -1,0 +1,72 @@
+"""Hessian eigenvalue estimation via power iteration.
+
+Analog of ``runtime/eigenvalue.py`` (MoQ precision switching: layers with
+small curvature quantize earlier). The reference power-iterates with
+autograd grad-of-grad per layer; JAX gives the Hessian-vector product
+directly (forward-over-reverse), so each iteration is one ``jvp`` of
+``grad`` — no graph retention tricks.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.verbose = verbose
+
+    def _normalize(self, v):
+        norm = jnp.sqrt(sum(jnp.vdot(x, x).real
+                            for x in jax.tree.leaves(v)))
+        norm = jnp.maximum(norm, self.stability)
+        return jax.tree.map(lambda x: x / norm, v), norm
+
+    def compute_eigenvalue(self, loss_fn: Callable[[Any], jnp.ndarray],
+                           params: Any, rng: jax.Array) -> float:
+        """Dominant |eigenvalue| of the loss Hessian at ``params``."""
+        grad_fn = jax.grad(lambda p: loss_fn(p).astype(jnp.float32))
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        hvp = jax.jit(hvp)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        v = jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(k, x.shape, jnp.float32)
+            for k, x in zip(keys, leaves)])
+        v, _ = self._normalize(v)
+        eig = 0.0
+        for _ in range(self.max_iter):
+            hv = hvp(v)
+            v, norm = self._normalize(hv)
+            new_eig = float(norm)
+            if abs(new_eig - eig) <= self.tol * max(abs(eig), 1e-12):
+                eig = new_eig
+                break
+            eig = new_eig
+        return eig
+
+    def compute_per_layer(self, loss_fn, params: Dict[str, Any],
+                          rng: jax.Array) -> Dict[str, float]:
+        """Eigenvalue per top-level param subtree (layer granularity)."""
+        out = {}
+        for i, key in enumerate(params):
+            sub_rng = jax.random.fold_in(rng, i)
+
+            def sub_loss(sub):
+                merged = {**params, key: sub}
+                return loss_fn(merged)
+
+            out[key] = self.compute_eigenvalue(sub_loss, params[key],
+                                               sub_rng)
+        return out

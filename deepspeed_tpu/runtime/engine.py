@@ -138,6 +138,18 @@ class DeepSpeedEngine:
             batch_size=self.train_batch_size,
             steps_per_output=config.steps_per_print)
         self.monitor = self._build_monitor()
+        self.curriculum_scheduler = None
+        if config.curriculum_learning.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline import (
+                CurriculumScheduler)
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum_learning)
+        self.flops_profiler = None
+        if config.flops_profiler.enabled:
+            from deepspeed_tpu.profiling import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(
+                self, profile_step=config.flops_profiler.profile_step,
+                output_file=config.flops_profiler.output_file)
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} "
             f"dtype={config.precision_dtype} mesh="
@@ -415,13 +427,33 @@ class DeepSpeedEngine:
             raise ValueError(
                 f"global batch leading dim {leading} != "
                 f"micro*gas*dp = {expected}")
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
         if self.host_opt is not None:
             return self._offload_train_batch(batch)
         if self._step_fn is None:
             self._compile_step(batch)
+        profiling = (self.flops_profiler is not None and
+                     self.global_steps + 1 ==
+                     self.flops_profiler.profile_step)
+        if profiling:
+            self.flops_profiler.start_profile()
         self.tput_timer.start()
         self._rng, rng = jax.random.split(self._rng)
         self.state, metrics = self._step_fn(self.state, batch, rng)
+        if profiling:
+            jax.block_until_ready(metrics["loss"])
+            float(metrics["loss"])   # host sync through remote relays
+            self.flops_profiler.mark_step_done()  # latency frozen here
+            cost = self._step_fn.lower(
+                self.state, batch, rng).compile().cost_analysis() or {}
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            n_params = sum(int(np.prod(p.shape))
+                           for p in jax.tree.leaves(self.state.params))
+            self.flops_profiler.stop_profile(
+                flops=float(cost.get("flops", 0.0)), params=n_params)
+            self.flops_profiler.print_model_profile()
         self.global_steps += 1
         self._micro_steps += self.gas
         if self.config.fp16.enabled and bool(metrics["skipped"]):

@@ -1,0 +1,28 @@
+"""Progressive Layer Drop (analog of ``runtime/progressive_layer_drop.py``).
+
+Keep-probability schedule theta(t) = (1 - theta_inf)·exp(-gamma·t) +
+theta_inf; models that support stochastic depth read ``get_theta()`` each
+step and drop transformer blocks with probability 1-theta (scaled residual
+branch under ``lax.cond``-free Bernoulli masking on TPU).
+"""
+from __future__ import annotations
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta      # theta_inf: final keep probability
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta

@@ -1,0 +1,349 @@
+"""Aux subsystem tests: flops profiler, curriculum/Random-LTD/data sampler,
+compression, autotuner, PLD, eigenvalue (reference: tests/unit/{profiling,
+compression,autotuning} + data-efficiency configs)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+# ------------------------------------------------------------ profiler
+
+def test_flops_profiler_matmul():
+    from deepspeed_tpu.profiling import get_model_profile
+
+    def fn(a, b):
+        return a @ b
+
+    a = jnp.ones((256, 512), jnp.float32)
+    b = jnp.ones((512, 128), jnp.float32)
+    prof = get_model_profile(fn, (a, b), num_steps=2)
+    # 2*M*N*K flops
+    assert prof["flops"] == pytest.approx(2 * 256 * 512 * 128, rel=0.1)
+    assert prof["latency_s"] > 0 and prof["flops_per_s"] > 0
+    s = get_model_profile(fn, (a, b), num_steps=1, as_string=True)
+    assert "FLOPs" in s["flops"]
+
+
+def test_number_to_string():
+    from deepspeed_tpu.profiling.flops_profiler import number_to_string
+    assert number_to_string(2.5e12) == "2.50 T"
+    assert number_to_string(3.1e6) == "3.10 M"
+    assert number_to_string(12.0) == "12.00"
+
+
+# ------------------------------------------------------------ curriculum
+
+def _cl_cfg(**kw):
+    base = {"curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 64, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}}
+    base.update(kw)
+    return base
+
+
+def test_curriculum_fixed_linear():
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+    cs = CurriculumScheduler(_cl_cfg())
+    assert cs.get_difficulty(0) == 8
+    assert cs.get_difficulty(50) == 8 + (64 - 8) // 2 // 8 * 8
+    assert cs.get_difficulty(100) == 64
+    assert cs.get_difficulty(10**6) == 64
+    # difficulty is always a multiple of difficulty_step (8)
+    for s in range(0, 120, 7):
+        assert cs.get_difficulty(s) % 8 == 0
+
+
+def test_curriculum_fixed_root_and_discrete():
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+    root = CurriculumScheduler(_cl_cfg(
+        schedule_type="fixed_root",
+        schedule_config={"total_curriculum_step": 100,
+                         "difficulty_step": 8, "root_degree": 2}))
+    # sqrt ramp is ahead of linear mid-schedule
+    lin = CurriculumScheduler(_cl_cfg())
+    assert root.get_difficulty(25) >= lin.get_difficulty(25)
+    disc = CurriculumScheduler(_cl_cfg(
+        schedule_type="fixed_discrete",
+        schedule_config={"difficulty": [8, 16, 64], "max_step": [10, 20]}))
+    assert disc.get_difficulty(5) == 8
+    assert disc.get_difficulty(15) == 16
+    assert disc.get_difficulty(25) == 64
+
+
+def test_curriculum_validation():
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+    with pytest.raises(ValueError, match="missing"):
+        CurriculumScheduler({"curriculum_type": "seqlen"})
+    with pytest.raises(ValueError, match="max_step"):
+        CurriculumScheduler(_cl_cfg(
+            schedule_type="fixed_discrete",
+            schedule_config={"difficulty": [8, 16], "max_step": [10, 20]}))
+
+
+# ------------------------------------------------------------ random-ltd
+
+def test_random_ltd_scheduler():
+    from deepspeed_tpu.runtime.data_pipeline import RandomLTDScheduler
+    cfg = {"random_ltd_enabled": True, "total_layer_num": 12,
+           "random_ltd_layer_num": 8,
+           "random_ltd_schedule": {
+               "min_value": 128, "max_value": 512,
+               "schedule_type": "fixed_linear",
+               "schedule_config": {"require_steps": 10,
+                                   "seq_per_step": 64}}}
+    sch = RandomLTDScheduler(cfg)
+    assert sch.update_seq(0) == 128
+    assert sch.update_seq(10) == 192
+    assert sch.update_seq(100) == 512   # capped
+    # token accounting: 4 full layers * 512 + 8 ltd layers * current
+    sch.update_seq(0)
+    assert sch.get_total_layer_tokens(512) == 4 * 512 + 8 * 128
+
+
+# ------------------------------------------------------------ sampler
+
+def test_data_sampler_curriculum_and_sharding():
+    from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                     DeepSpeedDataSampler)
+    diffs = np.arange(100)  # sample i has difficulty i
+    cs = CurriculumScheduler(_cl_cfg(max_difficulty=96))
+    samplers = [DeepSpeedDataSampler(
+        100, difficulties=diffs, curriculum=cs, batch_size=4,
+        data_parallel_rank=r, data_parallel_size=2) for r in range(2)]
+    for s in samplers:
+        s.set_step(0)  # difficulty 8
+    batches = [list(s) for s in samplers]
+    seen = np.concatenate([np.concatenate(b) for b in batches])
+    assert np.all(diffs[seen] <= 8)
+    # ranks see disjoint samples
+    assert not set(np.concatenate(batches[0]).tolist()) & \
+        set(np.concatenate(batches[1]).tolist())
+    # later step → more eligible data → more batches
+    for s in samplers:
+        s.set_step(100)  # difficulty 96
+    assert len(list(samplers[0])) > len(batches[0])
+    # deterministic per epoch
+    a = [b.tolist() for b in samplers[0]]
+    b = [b.tolist() for b in samplers[0]]
+    assert a == b
+
+
+def test_analyze_seqlen():
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        analyze_seqlen)
+    ds = [{"input_ids": list(range(n))} for n in (3, 7, 5)]
+    np.testing.assert_array_equal(analyze_seqlen(ds), [3, 7, 5])
+
+
+# ------------------------------------------------------------ compression
+
+def _tree():
+    rng = np.random.RandomState(0)
+    return {"layer0": {"attn": {"wq": jnp.asarray(
+                rng.randn(16, 4, 8).astype(np.float32))},
+                       "mlp": {"wi": jnp.asarray(
+                           rng.randn(16, 64).astype(np.float32))}},
+            "ln": {"scale": jnp.ones((16,), jnp.float32)}}
+
+
+def test_compression_weight_quant_anneal():
+    from deepspeed_tpu.compression import (apply_compression,
+                                           init_compression)
+    params = _tree()
+    spec = init_compression(params, {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"wq1": {
+                "params": {"start_bits": 8, "target_bits": 4,
+                           "quantization_period": 10},
+                "modules": ["mlp"]}}}})
+    before = apply_compression(params, spec, step=0)   # offset not reached
+    np.testing.assert_array_equal(np.asarray(before["layer0"]["mlp"]["wi"]),
+                                  np.asarray(params["layer0"]["mlp"]["wi"]))
+    q8 = apply_compression(params, spec, step=6)
+    assert not np.array_equal(np.asarray(q8["layer0"]["mlp"]["wi"]),
+                              np.asarray(params["layer0"]["mlp"]["wi"]))
+    # attn untouched (module filter)
+    np.testing.assert_array_equal(np.asarray(q8["layer0"]["attn"]["wq"]),
+                                  np.asarray(params["layer0"]["attn"]["wq"]))
+    # annealed to 4 bits → coarser grid than 8 bits
+    q4 = apply_compression(params, spec, step=60)
+    assert len(np.unique(np.asarray(q4["layer0"]["mlp"]["wi"]))) < \
+        len(np.unique(np.asarray(q8["layer0"]["mlp"]["wi"])))
+
+
+def test_compression_pruning_and_clean():
+    from deepspeed_tpu.compression import (apply_compression,
+                                           init_compression,
+                                           redundancy_clean)
+    params = _tree()
+    spec = init_compression(params, {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "method": "l1"},
+            "different_groups": {"s1": {"params": {"dense_ratio": 0.25},
+                                        "modules": ["mlp"]}}},
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"h1": {"params": {"dense_ratio": 0.5},
+                                        "modules": ["attn"]}}}})
+    out = apply_compression(params, spec, step=1)
+    wi = np.asarray(out["layer0"]["mlp"]["wi"])
+    assert (wi == 0).mean() == pytest.approx(0.75, abs=0.02)
+    wq = np.asarray(out["layer0"]["attn"]["wq"])
+    dead_heads = [(np.abs(wq[:, h]).sum() == 0) for h in range(4)]
+    assert sum(dead_heads) == 2
+    clean, report = redundancy_clean(out, spec)
+    assert clean["layer0"]["attn"]["wq"].shape == (16, 2, 8)
+    assert any(r["kind"] == "head_pruning" for r in report.values())
+
+
+def test_compression_masks_under_jit_via_seed():
+    from deepspeed_tpu.compression import (apply_compression,
+                                           init_compression, seed_masks)
+    params = _tree()
+    cfg = {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"s": {"params": {"dense_ratio": 0.5},
+                                   "modules": ["mlp"]}}}}
+    spec = init_compression(params, cfg)
+    with pytest.raises(ValueError, match="seed_masks"):
+        jax.jit(lambda p: apply_compression(p, spec, 1))(params)
+    seed_masks(params, spec, step=1)
+    out = jax.jit(lambda p: apply_compression(p, spec, 1))(params)
+    assert (np.asarray(out["layer0"]["mlp"]["wi"]) == 0).mean() \
+        == pytest.approx(0.5, abs=0.02)
+
+
+def test_bf16_conversion_nan_safe():
+    from deepspeed_tpu.ops.cpu_adam import _f32_to_bf16_np
+    import ml_dtypes
+    x = np.array([1.0, np.nan, -np.nan, np.inf, 3.14], np.float32)
+    out = _f32_to_bf16_np(x).view(ml_dtypes.bfloat16)
+    assert np.isnan(out[1]) and np.isnan(out[2])
+    assert np.isinf(out[3]) and float(out[0]) == 1.0
+
+
+def test_sampler_len_matches_iter_no_drop_last():
+    from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler
+    s = DeepSpeedDataSampler(10, batch_size=4, data_parallel_rank=0,
+                             data_parallel_size=4, drop_last=False)
+    assert len(list(s)) == len(s) == 1
+
+
+def test_compression_unmatched_group_raises():
+    from deepspeed_tpu.compression import init_compression
+    with pytest.raises(ValueError, match="matches no parameter"):
+        init_compression(_tree(), {
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {"g": {"modules": ["nonexistent"]}}}})
+
+
+def test_compression_scheduler():
+    from deepspeed_tpu.compression import (CompressionScheduler,
+                                           init_compression)
+    spec = init_compression(_tree(), {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 10},
+            "different_groups": {"g": {
+                "params": {"start_bits": 8, "target_bits": 4,
+                           "quantization_period": 5},
+                "modules": ["mlp"]}}}})
+    sch = CompressionScheduler(spec)
+    assert sch.active(5) == []
+    assert sch.active(10) == ["weight_quantization"]
+    assert sch.status(20)["weight_quantization"]["bits"] == 6
+
+
+# ------------------------------------------------------------ pld / eig
+
+def test_progressive_layer_drop():
+    from deepspeed_tpu.runtime.progressive_layer_drop import (
+        ProgressiveLayerDrop)
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    thetas = [pld.update_state(s) for s in (0, 100, 1000, 10**6)]
+    assert thetas[0] == pytest.approx(1.0)
+    assert all(a >= b for a, b in zip(thetas, thetas[1:]))
+    assert thetas[-1] == pytest.approx(0.5, abs=1e-6)
+    assert pld.get_state()["progressive_layer_drop"]
+
+
+def test_eigenvalue_quadratic():
+    """For loss = 0.5 xᵀAx the dominant Hessian eigenvalue is max|λ(A)|."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    rng = np.random.RandomState(0)
+    Q = np.linalg.qr(rng.randn(8, 8))[0]
+    lams = np.array([5.0, 3.0, 1.0, 0.5, 0.2, 0.1, 0.05, 0.01])
+    A = jnp.asarray(Q @ np.diag(lams) @ Q.T, jnp.float32)
+
+    def loss(p):
+        x = p["x"]
+        return 0.5 * x @ A @ x
+
+    eig = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(
+        loss, {"x": jnp.asarray(rng.randn(8).astype(np.float32))},
+        jax.random.PRNGKey(0))
+    assert eig == pytest.approx(5.0, rel=1e-2)
+
+
+def test_engine_flops_profiler_and_curriculum_integration(capsys):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    cfg = GPT2Config(n_embd=32, n_layer=1, n_head=2, n_positions=64,
+                     vocab_size=128, dtype=jnp.bfloat16, remat=False)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+    ds = {"train_micro_batch_size_per_gpu": 1,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "bf16": {"enabled": True},
+          "flops_profiler": {"enabled": True, "profile_step": 2},
+          "curriculum_learning": {
+              "enabled": True, "curriculum_type": "seqlen",
+              "min_difficulty": 8, "max_difficulty": 16,
+              "schedule_type": "fixed_linear",
+              "schedule_config": {"total_curriculum_step": 4,
+                                  "difficulty_step": 8}}}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                            model_parameters=params,
+                                            config=ds)
+    batch = {"input_ids": jnp.zeros((eng.train_batch_size, 16), jnp.int32)}
+    for _ in range(5):
+        eng.train_batch(batch)
+    out = capsys.readouterr().out
+    assert "Flops Profiler" in out and "achieved:" in out
+    # last update ran at global_steps=4 == total_curriculum_step → max
+    assert eng.curriculum_scheduler.get_current_difficulty() == 16
+
+
+# ------------------------------------------------------------ autotuner
+
+def test_autotuner_picks_best():
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    cfg = GPT2Config(n_embd=32, n_layer=1, n_head=2, n_positions=64,
+                     vocab_size=128, dtype=jnp.bfloat16, remat=False)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+
+    def engine_builder(ds_cfg):
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=ds_cfg)
+        return eng
+
+    def batch_builder(global_bs):
+        return {"input_ids": jnp.zeros((global_bs, 16), jnp.int32)}
+
+    base = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True}}
+    tuner = Autotuner(engine_builder, batch_builder, base,
+                      micro_batches=(1, 2), zero_stages=(1, 3),
+                      num_steps=1, warmup_steps=1)
+    out = tuner.tune()
+    assert out["best_config"]["zero_optimization"]["stage"] in (1, 3)
+    assert out["best_metrics"]["throughput"] > 0
+    assert len(out["results"]) == 4
