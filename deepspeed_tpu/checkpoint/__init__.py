@@ -1,0 +1,19 @@
+"""Checkpoint toolkit (analog of ``deepspeed/checkpoint/`` +
+``runtime/checkpoint_engine/``): engine abstraction (sync/async), universal
+checkpoint inspection/reshaping, ZeRO→fp32 consolidation."""
+from deepspeed_tpu.checkpoint.checkpoint_engine import (
+    AsyncCheckpointEngine, CheckpointEngine, OrbaxCheckpointEngine,
+    make_checkpoint_engine)
+from deepspeed_tpu.checkpoint.universal import (DeepSpeedCheckpoint,
+                                                reshape_checkpoint)
+from deepspeed_tpu.checkpoint.zero_to_fp32 import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+    load_state_dict_from_zero_checkpoint)
+
+__all__ = ["CheckpointEngine", "OrbaxCheckpointEngine",
+           "AsyncCheckpointEngine", "make_checkpoint_engine",
+           "DeepSpeedCheckpoint", "reshape_checkpoint",
+           "get_fp32_state_dict_from_zero_checkpoint",
+           "convert_zero_checkpoint_to_fp32_state_dict",
+           "load_state_dict_from_zero_checkpoint"]

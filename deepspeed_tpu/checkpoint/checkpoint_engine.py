@@ -1,0 +1,110 @@
+"""Checkpoint engine abstraction.
+
+Analog of ``runtime/checkpoint_engine/checkpoint_engine.py:1-19``
+(CheckpointEngine ABC with create/save/load/commit) plus its two
+implementations: Torch (sync) and Nebula (async tiered service). On TPU the
+implementations are Orbax sync and Orbax *async* — async checkpointing IS
+the Nebula capability (snapshot to host, persist in background, commit on
+completion) without the proprietary service.
+"""
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from typing import Any
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class CheckpointEngine(ABC):
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag: str) -> None:
+        """Log/prepare for a save under ``tag`` (reference ``create``)."""
+        log_dist(f"[ckpt-engine] saving {tag}", ranks=[0])
+
+    @abstractmethod
+    def save(self, state_dict: Any, path: str) -> None: ...
+
+    @abstractmethod
+    def load(self, path: str, abstract_state: Any = None,
+             map_location=None) -> Any: ...
+
+    @abstractmethod
+    def commit(self, tag: str) -> bool:
+        """Block until ``tag`` is durable (reference ``commit``)."""
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Synchronous save/restore (TorchCheckpointEngine analog)."""
+
+    def _cp(self):
+        import orbax.checkpoint as ocp
+        return ocp.StandardCheckpointer()
+
+    def save(self, state_dict: Any, path: str) -> None:
+        cp = self._cp()
+        cp.save(os.path.abspath(path), state_dict, force=True)
+        cp.wait_until_finished()
+
+    def load(self, path: str, abstract_state: Any = None,
+             map_location=None) -> Any:
+        cp = self._cp()
+        if abstract_state is None:
+            return cp.restore(os.path.abspath(path))
+        return cp.restore(os.path.abspath(path), abstract_state)
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background persistence (NebulaCheckpointEngine analog,
+    ``nebula_checkpoint_engine.py``): ``save`` snapshots device arrays and
+    returns immediately; ``commit`` waits for durability. Training overlaps
+    the write — the reason Nebula exists."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._cp = None
+
+    def _ensure(self):
+        if self._cp is None:
+            import orbax.checkpoint as ocp
+            self._cp = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+        return self._cp
+
+    def save(self, state_dict: Any, path: str) -> None:
+        import orbax.checkpoint as ocp
+        self._ensure().save(
+            os.path.abspath(path),
+            args=ocp.args.StandardSave(state_dict), force=True)
+
+    def load(self, path: str, abstract_state: Any = None,
+             map_location=None) -> Any:
+        import orbax.checkpoint as ocp
+        self._ensure().wait_until_finished()
+        if abstract_state is None:
+            return self._ensure().restore(os.path.abspath(path))
+        return self._ensure().restore(
+            os.path.abspath(path),
+            args=ocp.args.StandardRestore(abstract_state))
+
+    def commit(self, tag: str) -> bool:
+        self._ensure().wait_until_finished()
+        log_dist(f"[ckpt-engine] committed {tag}", ranks=[0])
+        return True
+
+
+def make_checkpoint_engine(kind: str = "sync",
+                           config_params=None) -> CheckpointEngine:
+    if kind in ("sync", "torch", "orbax"):
+        return OrbaxCheckpointEngine(config_params)
+    if kind in ("async", "nebula"):
+        return AsyncCheckpointEngine(config_params)
+    raise ValueError(f"unknown checkpoint engine {kind!r}")

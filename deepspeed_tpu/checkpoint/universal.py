@@ -1,0 +1,109 @@
+"""Universal checkpoint: inspect + reshape across parallelism degrees.
+
+Analog of ``deepspeed/checkpoint/`` (``DeepSpeedCheckpoint``,
+``reshape_meg_2d.py``, ``universal_checkpoint.py``). The reference stores
+per-rank shard FILES, so changing TP/PP/DP degree requires an offline
+merge/split toolkit. Here every array is saved *globally* (each host writes
+its shards into one logical array via TensorStore), so:
+
+* DP/TP/FSDP degree changes are a no-op — restore takes the new sharding.
+* :class:`DeepSpeedCheckpoint` provides the reference's inspection API
+  (tags, step, per-param shapes/dtypes) against the Orbax metadata.
+* :func:`reshape_checkpoint` rewrites a checkpoint for a different target
+  topology eagerly (host-memory pass) — only needed to *materialize* a
+  resharded copy, e.g. to hand off to another cluster.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _tags(load_dir: str) -> List[str]:
+    """Numeric-aware sort: global_step10 must rank above global_step9."""
+    import re
+
+    def key(tag: str):
+        nums = re.findall(r"\d+", tag)
+        return (tag if not nums else re.sub(r"\d+", "", tag),
+                [int(n) for n in nums])
+
+    return sorted((d for d in os.listdir(load_dir)
+                   if os.path.isdir(os.path.join(load_dir, d))), key=key)
+
+
+class DeepSpeedCheckpoint:
+    """Inspection API over a saved engine checkpoint directory
+    (reference ``deepspeed_checkpoint.py``)."""
+
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        self.root = ckpt_dir
+        if tag is None:
+            latest = os.path.join(ckpt_dir, "latest")
+            if os.path.isfile(latest):
+                tag = open(latest).read().strip()
+            else:
+                tags = _tags(ckpt_dir)
+                if not tags:
+                    raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+                tag = tags[-1]
+        self.tag = tag
+        self.dir = os.path.join(ckpt_dir, tag)
+        self.state_path = os.path.join(self.dir, "state")
+        meta = os.path.join(self.dir, "client_state.json")
+        self.meta: Dict[str, Any] = {}
+        if os.path.isfile(meta):
+            self.meta = json.load(open(meta))
+
+    @property
+    def global_steps(self) -> int:
+        return int(self.meta.get("global_steps", 0))
+
+    @property
+    def zero_stage(self) -> int:
+        return int(self.meta.get("zero_stage", 0))
+
+    def tags(self) -> List[str]:
+        return [t for t in _tags(self.root) if t != "latest"]
+
+    def metadata(self) -> Dict[str, Any]:
+        """Per-array shape/dtype tree from the orbax metadata (no data
+        read) — the reference's header-scan equivalent."""
+        import orbax.checkpoint as ocp
+        cp = ocp.StandardCheckpointer()
+        return cp.metadata(os.path.abspath(self.state_path))
+
+    def load(self, abstract_state: Any = None) -> Any:
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            OrbaxCheckpointEngine)
+        return OrbaxCheckpointEngine().load(self.state_path, abstract_state)
+
+
+def reshape_checkpoint(src_dir: str, dst_dir: str,
+                       tag: Optional[str] = None) -> str:
+    """Materialize a topology-independent copy: read every array to host
+    (unsharded) and rewrite. The result loads onto ANY mesh. (With global-
+    array checkpoints this is the whole reshape toolkit —
+    reshape_meg_2d/reshape_3d_utils collapse to an identity copy.)"""
+    src = DeepSpeedCheckpoint(src_dir, tag)
+    state = src.load()
+    state = jax.tree.map(lambda x: np.asarray(x), state)
+    os.makedirs(os.path.join(dst_dir, src.tag), exist_ok=True)
+    from deepspeed_tpu.checkpoint.checkpoint_engine import (
+        OrbaxCheckpointEngine)
+    OrbaxCheckpointEngine().save(
+        state, os.path.join(dst_dir, src.tag, "state"))
+    if src.meta:
+        with open(os.path.join(dst_dir, src.tag, "client_state.json"),
+                  "w") as f:
+            json.dump(src.meta, f, indent=2, default=str)
+    with open(os.path.join(dst_dir, "latest"), "w") as f:
+        f.write(src.tag)
+    logger.info(f"reshaped checkpoint {src.tag}: {src_dir} → {dst_dir}")
+    return os.path.join(dst_dir, src.tag)

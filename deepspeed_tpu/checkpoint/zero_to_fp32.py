@@ -1,0 +1,95 @@
+"""Consolidate a (ZeRO-sharded) checkpoint into one fp32 state dict.
+
+Analog of ``deepspeed/utils/zero_to_fp32.py`` (482 LoC offline CLI) and the
+live ``_zero3_consolidated_16bit_state_dict`` (``engine.py:3396``). The
+reference stitches per-DP-rank flat shards back into parameters; here the
+checkpoint already holds global arrays, so consolidation = load master (or
+params), cast fp32, write one npz.
+
+CLI::
+
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out.npz>
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.checkpoint.universal import DeepSpeedCheckpoint
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.tree import flatten_with_names as _flat_names
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Return {param_path: fp32 ndarray} — master weights when present
+    (bf16/fp16 training), else the params themselves."""
+    ck = DeepSpeedCheckpoint(ckpt_dir, tag)
+    state = ck.load()
+    master = state.get("master") if isinstance(state, dict) else \
+        getattr(state, "master", None)
+    params = state.get("params") if isinstance(state, dict) else \
+        getattr(state, "params", None)
+    # host-offload checkpoints keep the master beside the orbax state
+    host_npz = os.path.join(ck.dir, "host_optimizer.npz")
+    if master is None and os.path.isfile(host_npz):
+        blob = np.load(host_npz)
+        shapes = {k: np.asarray(v).shape
+                  for k, v in _flat_names(params).items()}
+        out = {}
+        for key in blob.files:
+            if key.startswith("master::"):
+                name = key[len("master::"):]
+                out[name] = blob[key].astype(np.float32).reshape(
+                    shapes.get(name, blob[key].shape))
+        if out:
+            return out
+    source = master if master is not None else params
+    if source is None:
+        raise ValueError(f"checkpoint {ckpt_dir} has no params/master")
+    return {k: np.asarray(v, np.float32)
+            for k, v in _flat_names(source).items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        ckpt_dir: str, output_file: str, tag: Optional[str] = None) -> str:
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(v.size for v in sd.values())
+    logger.info(f"consolidated {len(sd)} tensors ({total / 1e6:.1f}M "
+                f"params) → {output_file}")
+    return output_file
+
+
+def load_state_dict_from_zero_checkpoint(params_like, ckpt_dir: str,
+                                         tag: Optional[str] = None):
+    """Return a pytree shaped like ``params_like`` filled with the
+    consolidated fp32 weights (reference's load_state_dict_from_zero_
+    checkpoint, applied functionally)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    flat = _flat_names(params_like)
+    missing = set(flat) - set(sd)
+    if missing:
+        raise KeyError(f"checkpoint missing params: {sorted(missing)[:5]}")
+    treedef = jax.tree_util.tree_structure(params_like)
+    return jax.tree_util.tree_unflatten(
+        treedef, [sd[k].reshape(np.asarray(flat[k]).shape)
+                  for k in flat])
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("-t", "--tag", default=None)
+    a = p.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(a.checkpoint_dir,
+                                               a.output_file, tag=a.tag)
+
+
+if __name__ == "__main__":
+    main()

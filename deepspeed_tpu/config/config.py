@@ -182,6 +182,8 @@ class CheckpointConfig(DeepSpeedConfigModel):
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    # "sync" (Torch engine analog) | "async"/"nebula" (background persist)
+    engine: Literal["sync", "async", "nebula", "orbax", "torch"] = "sync"
 
 
 class DeepSpeedConfig:

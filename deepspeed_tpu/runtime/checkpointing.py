@@ -29,9 +29,17 @@ from deepspeed_tpu import comm
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
-def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.StandardCheckpointer()
+def _engine_for(engine) -> "CheckpointEngine":
+    """One checkpoint engine per training engine — an AsyncCheckpointer
+    owns background threads, so per-call construction would leak them and
+    defeat the overlap."""
+    ce = getattr(engine, "_ckpt_engine", None)
+    if ce is None:
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            make_checkpoint_engine)
+        ce = make_checkpoint_engine(engine.config.checkpoint_config.engine)
+        engine._ckpt_engine = ce
+    return ce
 
 
 def _tag_validation(tag: str, mode: str) -> None:
@@ -56,9 +64,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     os.makedirs(ckpt_dir, exist_ok=True)
 
     state_path = os.path.join(ckpt_dir, "state")
-    cp = _checkpointer()
-    cp.save(os.path.abspath(state_path), engine.state, force=True)
-    cp.wait_until_finished()
+    ce = _engine_for(engine)
+    ce.create(tag)
+    ce.save(engine.state, state_path)
 
     if getattr(engine, "host_opt", None) is not None and \
             jax.process_index() == 0:
@@ -72,6 +80,33 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             for part, arr in st.items():
                 blob[f"state::{k}::{part}"] = arr
         np.savez(os.path.join(ckpt_dir, "host_optimizer.npz"), **blob)
+
+    # durability ordering: 'latest' must only name a COMMITTED checkpoint
+    # — a crash between an async save and commit must not leave 'latest'
+    # pointing at a half-written tag. Async engines (single-process)
+    # finalize in the background so training overlaps the persist.
+    def _finalize():
+        ce.commit(tag)
+        _write_meta_and_latest(engine, save_dir, ckpt_dir, tag,
+                               client_state)
+        log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+
+    is_async = engine.config.checkpoint_config.engine in ("async", "nebula")
+    prev = getattr(engine, "_ckpt_finalize_thread", None)
+    if prev is not None and prev.is_alive():
+        prev.join()
+    if is_async and jax.process_count() == 1:
+        import threading
+        t = threading.Thread(target=_finalize, daemon=True)
+        t.start()
+        engine._ckpt_finalize_thread = t
+    else:
+        _finalize()
+        comm.barrier()
+    return ckpt_dir
+
+
+def _write_meta_and_latest(engine, save_dir, ckpt_dir, tag, client_state):
 
     meta = {
         "global_steps": engine.global_steps,
@@ -93,15 +128,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             json.dump(meta, f, indent=2, default=str)
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(str(tag))
-    comm.barrier()
-    log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
-    return ckpt_dir
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_lr_scheduler_states: bool = True,
                     load_module_only: bool = False):
+    prev = getattr(engine, "_ckpt_finalize_thread", None)
+    if prev is not None and prev.is_alive():
+        prev.join()   # an async save may still be finalizing 'latest'
     if tag is None:
         latest = os.path.join(load_dir, "latest")
         if not os.path.isfile(latest):
@@ -117,8 +152,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     abstract = jax.tree.map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         engine.state, engine._state_shardings)
-    cp = _checkpointer()
-    restored = cp.restore(state_path, abstract)
+    restored = _engine_for(engine).load(state_path, abstract)
 
     if load_module_only or not load_optimizer_states:
         restored = restored.replace(opt_state=engine.state.opt_state)

@@ -27,15 +27,10 @@ import numpy as np
 
 from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
 from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.tree import flatten_with_names
 
-
-def _flatten_with_names(tree) -> Dict[str, Any]:
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                        for p in path)
-        flat[name] = leaf
-    return flat
+# backwards-compat alias (engine imports this name)
+_flatten_with_names = flatten_with_names
 
 
 class HostOffloadOptimizer:

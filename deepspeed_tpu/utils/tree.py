@@ -1,0 +1,17 @@
+"""Pytree path utilities shared by offload, checkpointing, compression."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+
+def flatten_with_names(tree) -> Dict[str, Any]:
+    """{'a/b/0/c': leaf} with '/'-joined dict keys and sequence indices —
+    the canonical key format for host-side state blobs
+    (host_optimizer.npz, fp32 consolidation)."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path)] = leaf
+    return out
