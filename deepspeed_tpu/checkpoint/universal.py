@@ -99,10 +99,14 @@ def reshape_checkpoint(src_dir: str, dst_dir: str,
         OrbaxCheckpointEngine)
     OrbaxCheckpointEngine().save(
         state, os.path.join(dst_dir, src.tag, "state"))
-    if src.meta:
-        with open(os.path.join(dst_dir, src.tag, "client_state.json"),
-                  "w") as f:
-            json.dump(src.meta, f, indent=2, default=str)
+    # sidecar files (host_optimizer.npz, client_state.json, user blobs)
+    # travel with the checkpoint — dropping host_optimizer.npz would
+    # silently reset offloaded Adam moments on restore
+    import shutil
+    for name in os.listdir(src.dir):
+        src_path = os.path.join(src.dir, name)
+        if name != "state" and os.path.isfile(src_path):
+            shutil.copy2(src_path, os.path.join(dst_dir, src.tag, name))
     with open(os.path.join(dst_dir, "latest"), "w") as f:
         f.write(src.tag)
     logger.info(f"reshaped checkpoint {src.tag}: {src_dir} → {dst_dir}")

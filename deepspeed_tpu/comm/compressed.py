@@ -1,0 +1,76 @@
+"""Error-compensated 1-bit compressed collectives.
+
+Analog of the reference's compressed backends
+(``runtime/comm/nccl.py:51`` ``compressed_allreduce``: sign compression +
+per-chunk scale with worker AND server error feedback, igather/allgather
+two-phase). On TPU the two-phase server structure maps onto one
+``psum``/``pmean`` over the mesh axis — ICI makes the bandwidth argument
+moot intra-slice, but the op earns its keep on multi-slice DCN axes (the
+reference's Ethernet case), so it is expressed as a pure function usable
+inside ``shard_map`` over any axis.
+
+Compression model (per tensor, per step)::
+
+    corrected  = x + worker_error
+    scale_w    = mean(|corrected|)            # per-worker scalar
+    worker_err = corrected - scale_w·sign(corrected)
+    gathered   = pmean(scale_w·sign(corrected))     # server average
+    served     = gathered + server_error
+    scale_s    = mean(|served|)
+    server_err = served - scale_s·sign(served)
+    result     = scale_s·sign(served)          # identical on all workers
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _sign(x):
+    # sign(0) := +1 — a 1-bit code has no zero (reference packs sign bits)
+    return jnp.where(x >= 0, 1.0, -1.0).astype(jnp.float32)
+
+
+def compress(x, error):
+    """One-sided compression step → (compressed, new_error)."""
+    corrected = x.astype(jnp.float32) + error
+    scale = jnp.mean(jnp.abs(corrected))
+    comp = scale * _sign(corrected)
+    return comp, corrected - comp
+
+
+def compressed_allreduce(x: jax.Array, worker_error: jax.Array,
+                         server_error: jax.Array, axis_name: str
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """1-bit all-reduce (mean) with double error feedback. Call inside
+    ``shard_map``/``pjit`` with ``axis_name`` bound. Returns
+    (result, new_worker_error, new_server_error)."""
+    comp, new_worker_error = compress(x, worker_error)
+    gathered = jax.lax.pmean(comp, axis_name)
+    served, new_server_error = compress(gathered, server_error)
+    return served, new_worker_error, new_server_error
+
+
+def init_error_feedback(x: Any):
+    """Zero worker+server error buffers shaped like ``x`` (pytree ok)."""
+    zeros = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), x)
+    return zeros, jax.tree.map(jnp.copy, zeros)
+
+
+def compressed_allreduce_tree(grads: Any, worker_error: Any,
+                              server_error: Any, axis_name: str):
+    """Tree-mapped :func:`compressed_allreduce`."""
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_w = treedef.flatten_up_to(worker_error)
+    flat_s = treedef.flatten_up_to(server_error)
+    out, new_w, new_s = [], [], []
+    for g, w, s in zip(flat_g, flat_w, flat_s):
+        o, nw, ns = compressed_allreduce(g, w, s, axis_name)
+        out.append(o)
+        new_w.append(nw)
+        new_s.append(ns)
+    return (jax.tree_util.tree_unflatten(treedef, out),
+            jax.tree_util.tree_unflatten(treedef, new_w),
+            jax.tree_util.tree_unflatten(treedef, new_s))

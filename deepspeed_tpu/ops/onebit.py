@@ -1,0 +1,99 @@
+"""1-bit Adam / 0-1 Adam style optimizers.
+
+Analog of ``runtime/fp16/onebit/{adam,zoadam}.py``: exact Adam during a
+warmup of ``freeze_step`` steps; afterwards the second moment is FROZEN
+and only the (compressible) momentum is synchronized — with error-feedback
+sign compression from deepspeed_tpu.comm.compressed when running inside a
+``shard_map`` with per-worker gradients.
+
+Two usage modes:
+* engine mode (``axis_name=None``): gradients arrive already averaged
+  (GSPMD inserted the reduction); the optimizer still applies the
+  freeze-variance schedule — the convergence behavior of 1-bit Adam
+  without the wire format.
+* comm mode (``axis_name='data'`` under shard_map): grads are LOCAL;
+  warmup averages them exactly (pmean), the compression stage averages
+  sign-compressed momentum — the full reference algorithm.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from deepspeed_tpu.comm.compressed import (compressed_allreduce_tree,
+                                           init_error_feedback)
+from deepspeed_tpu.ops.adam import Optimizer, _tree_zeros_like
+
+
+@struct.dataclass
+class OnebitAdamState:
+    count: jnp.ndarray
+    mu: any
+    nu: any
+    worker_error: any
+    server_error: any
+
+
+def onebit_adam(betas=(0.9, 0.999), eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100,
+                axis_name: Optional[str] = None,
+                cuda_aware: bool = False, comm_backend_name: str = "xla",
+                **_) -> Optimizer:
+    b1, b2 = betas
+
+    def init(params):
+        w_err, s_err = init_error_feedback(
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+        return OnebitAdamState(count=jnp.zeros((), jnp.int32),
+                               mu=_tree_zeros_like(params),
+                               nu=_tree_zeros_like(params),
+                               worker_error=w_err, server_error=s_err)
+
+    def update(grads, state, params, lr):
+        count = state.count + 1
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        frozen = count > freeze_step
+
+        def warmup_stage(op):
+            g, st = op
+            if axis_name is not None:
+                g = jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), g)
+            mu = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x, st.mu, g)
+            nu = jax.tree.map(lambda v, x: b2 * v + (1 - b2) * x * x,
+                              st.nu, g)
+            return mu, nu, st.worker_error, st.server_error
+
+        def frozen_stage(op):
+            g, st = op
+            mu = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x, st.mu, g)
+            if axis_name is not None:
+                mu, w_err, s_err = compressed_allreduce_tree(
+                    mu, st.worker_error, st.server_error, axis_name)
+            else:
+                w_err, s_err = st.worker_error, st.server_error
+            return mu, st.nu, w_err, s_err   # variance frozen
+
+        mu, nu, w_err, s_err = jax.lax.cond(frozen, frozen_stage,
+                                            warmup_stage, (grads, state))
+        # bias corrections pin at the freeze boundary: nu is frozen, so a
+        # still-growing bc2 would silently raise the effective lr ~3x
+        # (the reference drops corrections in the compression stage)
+        cf = jnp.minimum(count, freeze_step).astype(jnp.float32)
+        bc1 = 1.0 - b1 ** cf
+        bc2 = 1.0 - b2 ** cf
+
+        def leaf(m, v, p):
+            upd = -lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay > 0.0:
+                upd = upd - lr * weight_decay * p
+            return upd.astype(p.dtype)
+
+        updates = jax.tree.map(leaf, mu, nu, params)
+        return updates, OnebitAdamState(count=count, mu=mu, nu=nu,
+                                        worker_error=w_err,
+                                        server_error=s_err)
+
+    return Optimizer(init=init, update=update)

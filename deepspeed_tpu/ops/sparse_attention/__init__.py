@@ -1,0 +1,23 @@
+"""Block-sparse attention (analog of ``deepspeed/ops/sparse_attention/``).
+
+The reference implements Triton SDD/DSD/DDS block matmuls + fused softmax
+(``matmul.py``, ``softmax.py``) driven by block layouts from the
+SparsityConfig family, with a C++ LUT builder
+(``csrc/sparse_attention/utils.cpp``). On TPU the layout family ports as
+pure numpy, the LUT is built host-side (utils.cpp analog), and the kernel
+is one Pallas flash-attention variant that iterates only each query
+block's active key blocks — the SDD→softmax→DSD chain fused into a single
+online-softmax kernel (no block-sparse intermediate ever exists).
+"""
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparsityConfig,
+    VariableSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention, sparse_attention, sparse_attention_reference)
+
+__all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+           "VariableSparsityConfig", "BigBirdSparsityConfig",
+           "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
+           "SparseSelfAttention", "sparse_attention",
+           "sparse_attention_reference"]

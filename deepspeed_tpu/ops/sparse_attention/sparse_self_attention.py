@@ -1,0 +1,135 @@
+"""SparseSelfAttention front-end.
+
+Analog of ``sparse_self_attention.py`` (+ the BertSparseSelfAttention
+wrapper): takes q/k/v and a :class:`SparsityConfig`, caches the layout+LUT
+per sequence length, and runs the Pallas block-sparse kernel on TPU (or
+the dense-masked XLA oracle elsewhere). The reference's HF model patcher
+(``sparse_attention_utils.py``) is torch module surgery — its TPU analog
+is passing ``use_sparse_attention`` through the model config (see
+models/gpt2.py) rather than editing live modules.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+    block_sparse_attention, build_lut)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    FixedSparsityConfig, SparsityConfig)
+
+NEG_INF = -1e30
+
+
+def layout_to_dense_mask(layout: np.ndarray, block: int,
+                         causal: bool) -> np.ndarray:
+    """[H, nb, nb] block layout → [H, T, T] element mask (oracle path)."""
+    H, nb, _ = layout.shape
+    T = nb * block
+    mask = np.kron(layout, np.ones((block, block), np.int64)).astype(bool)
+    if causal:
+        mask &= np.tril(np.ones((T, T), bool))[None]
+    return mask
+
+
+def sparse_attention_reference(q, k, v, layout: np.ndarray, block: int,
+                               causal: bool) -> jax.Array:
+    """Dense-masked numerics oracle. q/k/v [B, T, H, D]."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                     k.astype(jnp.float32)) * scale
+    mask = jnp.asarray(layout_to_dense_mask(layout, block, causal))
+    att = jnp.where(mask[None], att, NEG_INF)
+    p = jax.nn.softmax(att, axis=-1)
+    # fully-masked rows (no active block) produce zeros like the kernel
+    any_active = mask.any(-1)[None, :, :, None]     # [1, H, T, 1]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return jnp.where(any_active.transpose(0, 2, 1, 3), out,
+                     0.0).astype(q.dtype)
+
+
+def sparse_attention(q, k, v, layout: np.ndarray, block: int,
+                     causal: bool = False,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Block-sparse attention. q/k/v ``[B, T, H, D]`` → same shape."""
+    lut, counts = build_lut(layout)
+    qt = jnp.swapaxes(q, 1, 2)   # [B, H, T, D]
+    out = block_sparse_attention(
+        qt, jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+        jnp.asarray(lut), jnp.asarray(counts), block=block, causal=causal,
+        interpret=interpret)
+    return jnp.swapaxes(out, 1, 2)
+
+
+class SparseSelfAttention:
+    """Drop-in sparse attention op (reference ``SparseSelfAttention``).
+
+    >>> op = SparseSelfAttention(FixedSparsityConfig(num_heads=16,
+    ...                                              block=128))
+    >>> ctx = op(q, k, v)   # [B, T, H, D]
+    """
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(
+            num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._cache: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] \
+            = {}
+
+    @property
+    def causal(self) -> bool:
+        return getattr(self.sparsity_config, "attention",
+                       "bidirectional") == "unidirectional"
+
+    def layout(self, seq_len: int) -> np.ndarray:
+        return self._entry(seq_len)[0]
+
+    def _entry(self, seq_len: int):
+        if seq_len not in self._cache:
+            lay = self.sparsity_config.make_layout(seq_len)
+            lut, counts = build_lut(lay)
+            # device-resident once: the per-call host rebuild + transfer
+            # is exactly what the reference's LUT cache avoids
+            self._cache[seq_len] = (lay, jnp.asarray(lut),
+                                    jnp.asarray(counts))
+        return self._cache[seq_len]
+
+    def __call__(self, query, key, value, key_padding_mask=None,
+                 interpret: Optional[bool] = None):
+        B, T, H, D = query.shape
+        if H != self.sparsity_config.num_heads:
+            raise ValueError(
+                f"q has {H} heads but sparsity config was built for "
+                f"{self.sparsity_config.num_heads}")
+        lay, lut, counts = self._entry(T)
+        if key_padding_mask is not None:
+            # padded keys masked in the oracle path (reference applies the
+            # same inside its softmax kernel)
+            scale = 1.0 / (D ** 0.5)
+            att = jnp.einsum("bqhd,bkhd->bhqk",
+                             query.astype(jnp.float32),
+                             key.astype(jnp.float32)) * scale
+            mask = jnp.asarray(layout_to_dense_mask(
+                lay, self.sparsity_config.block, self.causal))[None]
+            mask = mask & key_padding_mask[:, None, None, :].astype(bool)
+            att = jnp.where(mask, att, NEG_INF)
+            p = jax.nn.softmax(att, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(value.dtype),
+                             value)
+            # fully-masked rows (all keys padded) output zeros, matching
+            # the kernel and the oracle — not the uniform-softmax mean(v)
+            row_live = mask.any(-1)                       # [B, H, T]
+            return jnp.where(jnp.swapaxes(row_live, 1, 2)[..., None],
+                             out, 0.0)
+        out = block_sparse_attention(
+            jnp.swapaxes(query, 1, 2), jnp.swapaxes(key, 1, 2),
+            jnp.swapaxes(value, 1, 2), lut, counts,
+            block=self.sparsity_config.block, causal=self.causal,
+            interpret=interpret)
+        return jnp.swapaxes(out, 1, 2)

@@ -81,33 +81,8 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                 blob[f"state::{k}::{part}"] = arr
         np.savez(os.path.join(ckpt_dir, "host_optimizer.npz"), **blob)
 
-    # durability ordering: 'latest' must only name a COMMITTED checkpoint
-    # — a crash between an async save and commit must not leave 'latest'
-    # pointing at a half-written tag. Async engines (single-process)
-    # finalize in the background so training overlaps the persist.
-    def _finalize():
-        ce.commit(tag)
-        _write_meta_and_latest(engine, save_dir, ckpt_dir, tag,
-                               client_state)
-        log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
-
-    is_async = engine.config.checkpoint_config.engine in ("async", "nebula")
-    prev = getattr(engine, "_ckpt_finalize_thread", None)
-    if prev is not None and prev.is_alive():
-        prev.join()
-    if is_async and jax.process_count() == 1:
-        import threading
-        t = threading.Thread(target=_finalize, daemon=True)
-        t.start()
-        engine._ckpt_finalize_thread = t
-    else:
-        _finalize()
-        comm.barrier()
-    return ckpt_dir
-
-
-def _write_meta_and_latest(engine, save_dir, ckpt_dir, tag, client_state):
-
+    # Counters are snapshotted NOW: an async finalize that read them live
+    # at commit time would stamp a later step onto this state snapshot.
     meta = {
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
@@ -123,6 +98,34 @@ def _write_meta_and_latest(engine, save_dir, ckpt_dir, tag, client_state):
             "scale": float(ls.scale),
             "growth_tracker": int(ls.growth_tracker),
             "hysteresis": int(ls.hysteresis)}
+
+    # durability ordering: 'latest' must only name a COMMITTED checkpoint
+    # — a crash between an async save and commit must not leave 'latest'
+    # pointing at a half-written tag. Async engines (single-process)
+    # finalize in the background so training overlaps the persist.
+    def _finalize():
+        ce.commit(tag)
+        _write_meta_and_latest(save_dir, ckpt_dir, tag, meta)
+        log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+
+    is_async = engine.config.checkpoint_config.engine in ("async", "nebula")
+    prev = getattr(engine, "_ckpt_finalize_thread", None)
+    if prev is not None and prev.is_alive():
+        prev.join()
+    if is_async and jax.process_count() == 1:
+        import threading
+        # non-daemon: interpreter exit waits for the finalize, so a save
+        # issued as a script's last act is never silently lost
+        t = threading.Thread(target=_finalize, daemon=False)
+        t.start()
+        engine._ckpt_finalize_thread = t
+    else:
+        _finalize()
+        comm.barrier()
+    return ckpt_dir
+
+
+def _write_meta_and_latest(save_dir, ckpt_dir, tag, meta):
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
