@@ -28,8 +28,8 @@ import dataclasses
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.kv_cache import KVCache, init_cache
 from deepspeed_tpu.model_implementations.transformer import (
-    InferenceTransformerConfig, decode_step, encoder_forward, init_params,
-    prefill, tp_param_specs)
+    InferenceTransformerConfig, causal_forward, decode_step, encoder_forward,
+    init_params, prefill, tp_param_specs)
 
 
 def _round_up(n: int, m: int) -> int:
@@ -85,6 +85,9 @@ class InferenceEngine:
             donate_argnames=("cache",))
         self._encoder_jit = jax.jit(
             functools.partial(encoder_forward, cfg=self.model_config))
+        self._causal_fwd_jit = jax.jit(
+            functools.partial(causal_forward, cfg=self.model_config))
+        self._gen_loops: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------ setup
 
@@ -125,19 +128,19 @@ class InferenceEngine:
     # ------------------------------------------------------------ API
 
     def forward(self, input_ids, attention_mask=None):
-        """Encoder forward (BERT-family) or next-token logits (causal)."""
+        """Encoder forward (BERT-family) → hidden states, or full-sequence
+        logits ``[B, T, V]`` for causal models — matching the reference
+        ``InferenceEngine.forward`` (inference/engine.py:495), so callers
+        scoring ``logits[:, i]`` port 1:1. ``generate`` keeps the KV-cache
+        fast path internally."""
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if not self.model_config.pre_layer_norm:
             return self._encoder_jit(self.params, input_ids=input_ids,
                                      attention_mask=attention_mask)
-        B, T = input_ids.shape
-        lengths = (jnp.sum(attention_mask, -1).astype(jnp.int32)
-                   if attention_mask is not None
-                   else jnp.full((B,), T, jnp.int32))
-        cache = self._make_cache(B, _round_up(T, 128))
-        logits, _ = self._prefill_jit(self.params, input_ids=input_ids,
-                                      lengths=lengths, cache=cache)
-        return logits
+        if attention_mask is not None:
+            attention_mask = jnp.asarray(attention_mask, jnp.int32)
+        return self._causal_fwd_jit(self.params, input_ids=input_ids,
+                                    attention_mask=attention_mask)
 
     __call__ = forward
 
@@ -155,6 +158,9 @@ class InferenceEngine:
         """
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
+        if max_new_tokens <= 0:   # no-op budget: prompts unchanged
+            return [np.asarray(ids[b, :lengths[b]]).tolist()
+                    for b in range(B)]
         max_seq = _round_up(int(lengths.max()) + max_new_tokens, 128)
         if max_seq > _round_up(self.config.max_out_tokens, 128):
             raise ValueError(
@@ -167,33 +173,80 @@ class InferenceEngine:
             self.params, input_ids=jnp.asarray(ids),
             lengths=jnp.asarray(lengths), cache=cache)
 
-        rng = jax.random.PRNGKey(seed)
-        out = [np.asarray(ids[b, :lengths[b]]).tolist() for b in range(B)]
-        done = np.zeros((B,), bool)
-        for step in range(max_new_tokens):
+        loop = self._generate_loop(max_new_tokens, float(temperature) > 0.0,
+                                   int(top_k) > 0)
+        out_buf, n_gen, _ = loop(
+            self.params, logits, cache, jax.random.PRNGKey(seed),
+            jnp.float32(temperature), jnp.int32(top_k),
+            jnp.int32(-1 if eos_token_id is None else eos_token_id))
+        # ONE host sync per generation (the reference built CUDA graphs to
+        # kill per-token launch overhead, inference/engine.py:454-473; the
+        # per-token RTT through a remote relay is the TPU analog).
+        out_np = np.asarray(out_buf)
+        n_np = np.asarray(n_gen)
+        return [np.asarray(ids[b, :lengths[b]]).tolist()
+                + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
+
+    def _generate_loop(self, max_new_tokens: int, sampled: bool,
+                       top_k_on: bool):
+        """Compile (and cache) the whole decode loop as ONE program: a
+        ``lax.while_loop`` over the donated KV cache with on-device
+        sampling and EOS bookkeeping. Early-exits when every row is done.
+        Only structure is baked into the compile key (length, greedy vs
+        sampled, top-k on/off); temperature/top_k/eos ride as traced
+        scalars so sweeps over them don't recompile."""
+        key = (max_new_tokens, sampled, top_k_on)
+        loop = self._gen_loops.get(key)
+        if loop is not None:
+            return loop
+        cfg = self.model_config
+
+        def select(lg, rng, temperature, top_k):
+            if not sampled:
+                return jnp.argmax(lg, -1).astype(jnp.int32)
+            lg = lg / temperature
+            if top_k_on:
+                kth = jnp.take_along_axis(
+                    jnp.sort(lg, -1), lg.shape[-1] - top_k[None, None],
+                    axis=-1)
+                lg = jnp.where(lg < kth, -1e30, lg)
+            return jax.random.categorical(rng, lg, -1).astype(jnp.int32)
+
+        def run(params, logits, cache, rng, temperature, top_k, eos):
+            B = logits.shape[0]
+            # token 0 comes from the prefill logits; each loop iteration
+            # decodes the previous token first, so the final token never
+            # pays a wasted trailing decode_step. eos == -1 disables EOS
+            # stopping (token ids are non-negative).
             rng, sub = jax.random.split(rng)
-            tokens = _select(logits, temperature, top_k, sub)
-            toks = np.asarray(tokens)
-            for b in range(B):
-                if not done[b]:
-                    out[b].append(int(toks[b]))
-                    if eos_token_id is not None and toks[b] == eos_token_id:
-                        done[b] = True
-            if done.all() or step == max_new_tokens - 1:
-                break
-            logits, cache = self._decode_jit(self.params, tokens=tokens,
-                                             cache=cache)
-        return out
+            tok = select(logits, sub, temperature, top_k)
+            out = jnp.zeros((B, max_new_tokens), jnp.int32).at[:, 0].set(tok)
+            done = tok == eos
+            n_gen = jnp.ones((B,), jnp.int32)
 
+            def cond(c):
+                step, _, _, done, _, _, _ = c
+                return (step < max_new_tokens) & jnp.logical_not(done.all())
 
-def _select(logits, temperature, top_k, rng):
-    if temperature <= 0.0:
-        return jnp.argmax(logits, -1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k > 0:
-        kth = jnp.sort(logits, -1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
-    return jax.random.categorical(rng, logits, -1).astype(jnp.int32)
+            def body(c):
+                step, tok, cache, done, out, n_gen, rng = c
+                lg, cache = decode_step(params, cfg, tok, cache)
+                rng, sub = jax.random.split(rng)
+                nxt = select(lg, sub, temperature, top_k)
+                out = out.at[:, step].set(jnp.where(done, 0, nxt))
+                n_gen = n_gen + jnp.where(done, 0, 1)
+                done = done | (nxt == eos)
+                return step + 1, nxt, cache, done, out, n_gen, rng
+
+            carry = (jnp.int32(1), tok, cache, done, out, n_gen, rng)
+            carry = jax.lax.while_loop(cond, body, carry)
+            # the final cache is returned (and dropped by the caller) so
+            # the donated input cache can actually alias an output
+            return carry[4], carry[5], carry[2]
+
+        loop = jax.jit(run, donate_argnames=("cache",))
+        self._gen_loops[key] = loop
+        return loop
 
 
 def _pad_batch(input_ids, attention_mask=None):

@@ -411,16 +411,24 @@ def _logits(params, cfg, x):
     return out
 
 
-def prefill(params, cfg: InferenceTransformerConfig, input_ids, lengths,
-            cache: KVCache):
-    """Run the right-padded prompt ``[B, T]`` through the model, populating
-    the cache. Returns (next-token logits ``[B, V]``, cache)."""
+def _causal_trunk(params, cfg, input_ids, lengths, cache, key_mask=None):
+    """Shared causal forward trunk: embed → blocks → final LN. ``prefill``
+    and ``causal_forward`` both run through here so full-sequence scoring
+    can never diverge from generation."""
     B, T = input_ids.shape
     positions = jnp.arange(T)[None, :].repeat(B, 0)
     x = _embed(params, cfg, input_ids, positions)
     for i, layer in enumerate(params["layers"]):
-        x, cache = _block_seq(x, layer, cfg, positions, lengths, cache, i)
-    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+        x, cache = _block_seq(x, layer, cfg, positions, lengths, cache, i,
+                              causal=True, key_mask=key_mask)
+    return _layer_norm(x, params["ln_f"], cfg.layer_norm_eps), cache
+
+
+def prefill(params, cfg: InferenceTransformerConfig, input_ids, lengths,
+            cache: KVCache):
+    """Run the right-padded prompt ``[B, T]`` through the model, populating
+    the cache. Returns (next-token logits ``[B, V]``, cache)."""
+    x, cache = _causal_trunk(params, cfg, input_ids, lengths, cache)
     # logits at the last live token of each row
     last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)[:, 0]
     return _logits(params, cfg, last), cache
@@ -435,6 +443,19 @@ def decode_step(params, cfg: InferenceTransformerConfig, tokens,
         x, cache = _block_decode(x, layer, cfg, cache, i)
     x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
     return _logits(params, cfg, x), advance(cache)
+
+
+def causal_forward(params, cfg: InferenceTransformerConfig, input_ids,
+                   attention_mask=None):
+    """Full-sequence logits ``[B, T, V]`` for causal models — the shape the
+    reference ``InferenceEngine.forward`` returns (inference/engine.py:495),
+    so scoring/perplexity loops indexing ``logits[:, i]`` port unchanged.
+    ``attention_mask [B, T]`` masks pad keys (HF semantics) so padded rows
+    are not scored against pad context. No cache; ``generate`` keeps the
+    last-token fast path."""
+    x, _ = _causal_trunk(params, cfg, input_ids, None, None,
+                         key_mask=attention_mask)
+    return _logits(params, cfg, x)
 
 
 def encoder_forward(params, cfg: InferenceTransformerConfig, input_ids,

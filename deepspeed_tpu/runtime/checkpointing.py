@@ -59,6 +59,9 @@ def _tag_validation(tag: str, mode: str) -> None:
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> str:
     tag = tag if tag is not None else f"global_step{engine.global_steps}"
+    # surface a failed previous async finalize BEFORE writing anything —
+    # else we'd burn a full state write and leave an uncommitted tag dir
+    _join_pending_finalize(engine)
     _tag_validation(tag, engine.config.checkpoint_config.tag_validation)
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -109,20 +112,47 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
 
     is_async = engine.config.checkpoint_config.engine in ("async", "nebula")
-    prev = getattr(engine, "_ckpt_finalize_thread", None)
-    if prev is not None and prev.is_alive():
-        prev.join()
     if is_async and jax.process_count() == 1:
         import threading
+
+        # A failure here (orbax commit error, disk full writing 'latest')
+        # must not vanish with the thread: log it NOW (the save may be the
+        # script's last act, with no later join point) and stash it to
+        # re-raise at the next save/load, else 'latest' silently stays
+        # stale.
+        def _finalize_captured():
+            try:
+                _finalize()
+            except BaseException as e:  # noqa: BLE001
+                logger.error(
+                    f"async checkpoint finalize for tag {tag!r} failed; "
+                    f"'latest' was NOT updated: {e!r}")
+                engine._ckpt_finalize_error = e
+
         # non-daemon: interpreter exit waits for the finalize, so a save
         # issued as a script's last act is never silently lost
-        t = threading.Thread(target=_finalize, daemon=False)
+        t = threading.Thread(target=_finalize_captured, daemon=False)
         t.start()
         engine._ckpt_finalize_thread = t
     else:
         _finalize()
         comm.barrier()
     return ckpt_dir
+
+
+def _join_pending_finalize(engine) -> None:
+    """Join an in-flight async finalize and surface its failure, if any —
+    the caller (next save/load) must not proceed believing the previous
+    checkpoint committed when it did not."""
+    prev = getattr(engine, "_ckpt_finalize_thread", None)
+    if prev is not None and prev.is_alive():
+        prev.join()
+    err = getattr(engine, "_ckpt_finalize_error", None)
+    if err is not None:
+        engine._ckpt_finalize_error = None
+        raise RuntimeError(
+            "async checkpoint finalize failed; 'latest' was not updated "
+            "for the previous save") from err
 
 
 def _write_meta_and_latest(save_dir, ckpt_dir, tag, meta):
@@ -137,9 +167,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_lr_scheduler_states: bool = True,
                     load_module_only: bool = False):
-    prev = getattr(engine, "_ckpt_finalize_thread", None)
-    if prev is not None and prev.is_alive():
-        prev.join()   # an async save may still be finalizing 'latest'
+    _join_pending_finalize(engine)  # an async save may still be finalizing
     if tag is None:
         latest = os.path.join(load_dir, "latest")
         if not os.path.isfile(latest):

@@ -139,6 +139,30 @@ def test_async_checkpoint_engine(tmp_path):
     assert eng2.global_steps == 1
 
 
+def test_async_finalize_error_surfaces(tmp_path, monkeypatch):
+    """A failure in the background finalize (orbax commit error, disk
+    full writing 'latest') must re-raise at the next save/load join, not
+    vanish with the thread (ADVICE r1: runtime/checkpointing.py:119)."""
+    from deepspeed_tpu.runtime.checkpointing import _engine_for
+    eng = _make_engine(ckpt_engine="async")
+    _step(eng, 1)
+    ce = _engine_for(eng)
+
+    def boom(tag):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ce, "commit", boom)
+    eng.save_checkpoint(str(tmp_path / "ck"))  # finalize fails in thread
+    eng._ckpt_finalize_thread.join()  # ensure boom ran before un-patching
+    monkeypatch.undo()
+    with pytest.raises(RuntimeError, match="finalize failed"):
+        eng.save_checkpoint(str(tmp_path / "ck"))
+    # error was consumed: the retry save above ran, so a further save works
+    eng.save_checkpoint(str(tmp_path / "ck"))
+    eng._ckpt_finalize_thread.join()
+    assert eng._ckpt_finalize_error is None
+
+
 def test_make_checkpoint_engine_kinds():
     assert isinstance(make_checkpoint_engine("sync"), OrbaxCheckpointEngine)
     assert isinstance(make_checkpoint_engine("nebula"),
