@@ -1,49 +1,65 @@
-"""Benchmark: GPT-2 ZeRO-3 training throughput on the available TPU chip(s).
+"""Benchmark driver: GPT-2 ZeRO-3 training throughput + DS-Inference p50.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": R}
+Prints EXACTLY ONE JSON line on stdout at the end, no matter what:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": R,
+   "detail": {...}}
+
+Everything else (stage-by-stage progress with timestamps) goes to stderr.
+
+Design notes (why this is structured as subprocess phases):
+* Round-1 ran everything in one process and the first ``train_batch`` of the
+  flagship config (350M, seq 1024, Pallas flash attention under remat) never
+  returned through the axon relay — the driver killed the whole benchmark
+  with rc=124 and NO number was recorded. Each phase now runs in its own
+  subprocess with its own timeout, cheapest/safest first, so one hanging
+  Mosaic compile (or an unavailable TPU backend, which blocks ~10 min in
+  device init before raising UNAVAILABLE) can only lose its own phase.
+* Through the axon relay ``block_until_ready`` returns before remote
+  execution finishes — all timing syncs use a host transfer (``float``).
 
 Baseline convention: the reference's headline sustained ZeRO-3(-Offload)
 throughput is 50 TFLOPS/GPU (docs/_posts/2021-03-08-zero3-offload.md:65, see
-BASELINE.md). We convert that to tokens/s for the same model via
-``flops_per_token`` and report vs_baseline = measured/baseline — i.e.
-vs_baseline == measured TFLOPS-per-chip / 50.
-
-Model size auto-scales to fit a single chip's HBM (16 GB on v5e):
-gpt2-760m when >8 GB free-ish, else 350m. On a pod slice the full 1.3b
-config from BASELINE.json applies.
+BASELINE.md); vs_baseline = measured TFLOPS-per-chip / 50. The inference
+phase mirrors benchmarks/inference/{gpt,bert}-bench.py (p50 after warmup
+trim) and is reported in ``detail``.
 """
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+T0 = time.time()
 
 
-def main():
+def log(msg: str) -> None:
+    print(f"[bench {time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+# ---------------------------------------------------------------- phases
+# Each phase is `python bench.py --phase NAME [args]` in a fresh process;
+# it prints ONE JSON line on stdout. Order: cheapest/safest first so a
+# tight driver budget still records a number.
+
+def phase_train(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    log(f"backend={jax.default_backend()} devices={jax.device_count()}")
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
 
     n_chips = jax.device_count()
-    # memory-based model choice: Adam training costs ~20 bytes/param HBM
-    # (bf16 params + fp32 grads/master/moments); one 16 GB v5e chip fits 350M,
-    # a 4+ chip slice fits the BASELINE.json 1.3b config under ZeRO-3.
-    if n_chips >= 4:
-        preset = "gpt2-1.3b"
-        micro = 4
-    else:
-        preset = "gpt2-350m"
-        micro = 4
-    seq_len = 1024
-
-    cfg = config_for(preset, n_positions=seq_len, dtype=jnp.bfloat16,
-                     remat=True)
+    cfg = config_for(args.preset, n_positions=args.seq, dtype=jnp.bfloat16,
+                     remat=True, use_flash_attention=not args.no_flash)
     model = GPT2LMModel(cfg)
+    log(f"init {args.preset} seq={args.seq} flash={not args.no_flash}")
     params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=128)
 
     ds_config = {
-        "train_micro_batch_size_per_gpu": micro,
+        "train_micro_batch_size_per_gpu": args.micro,
         "gradient_accumulation_steps": 1,
         "bf16": {"enabled": True},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
@@ -52,38 +68,205 @@ def main():
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, config=ds_config)
     del params
+    log("engine ready")
 
     global_bs = engine.train_batch_size
     rng = np.random.default_rng(0)
     batch = {"input_ids": jnp.asarray(
-        rng.integers(0, cfg.vocab_size, size=(global_bs, seq_len)), jnp.int32)}
+        rng.integers(0, cfg.vocab_size, size=(global_bs, args.seq)),
+        jnp.int32)}
 
-    # warmup/compile. NOTE: sync via host transfer (float(...)) — through the
-    # axon relay block_until_ready returns before remote execution finishes.
-    for _ in range(2):
-        m = engine.train_batch(batch)
+    t = time.time()
+    m = engine.train_batch(batch)
+    loss0 = float(m["loss"])  # host sync — the only reliable barrier here
+    log(f"step 1 (compile) done in {time.time() - t:.1f}s loss={loss0:.3f}")
+    t = time.time()
+    m = engine.train_batch(batch)
     float(m["loss"])
+    log(f"step 2 (warm) done in {time.time() - t:.1f}s")
 
-    steps = 20
+    steps = args.steps
     t0 = time.time()
     for _ in range(steps):
         m = engine.train_batch(batch)
-    final_loss = float(m["loss"])
+    final_loss = float(m["loss"])  # sync once; steps pipeline through relay
     dt = time.time() - t0
+    log(f"{steps} steps in {dt:.2f}s ({dt / steps * 1e3:.0f} ms/step)")
 
-    tokens_per_step = global_bs * seq_len
-    tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
-    flops_per_token = model.flops_per_token()
-    tflops_per_chip = tokens_per_sec_per_chip * flops_per_token / 1e12
-    baseline_tokens_per_sec = 50e12 / flops_per_token  # 50 TFLOPS/GPU headline
+    tokens_per_step = global_bs * args.seq
+    tps_chip = tokens_per_step * steps / dt / n_chips
+    fpt = model.flops_per_token()
+    return {
+        "phase": f"train-{args.preset}" + ("-noflash" if args.no_flash else ""),
+        "preset": args.preset,
+        "tokens_per_sec_per_chip": round(tps_chip, 2),
+        "tflops_per_chip": round(tps_chip * fpt / 1e12, 2),
+        "flops_per_token": fpt,
+        "seq": args.seq,
+        "global_batch": global_bs,
+        "chips": n_chips,
+        "ms_per_step": round(dt / steps * 1e3, 1),
+        "loss": round(final_loss, 4),
+    }
+
+
+def phase_infer(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    log(f"backend={jax.default_backend()} devices={jax.device_count()}")
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig)
+
+    out: dict = {"phase": "inference"}
+
+    # --- GPT per-token decode latency (benchmarks/inference/gpt-bench.py)
+    gpt_cfg = InferenceTransformerConfig(
+        vocab_size=50257, n_positions=1024, n_embd=768, n_layer=12,
+        n_head=12, dtype=jnp.bfloat16)
+    eng = InferenceEngine(gpt_cfg, DeepSpeedInferenceConfig(
+        max_out_tokens=512))
+    prompt = [list(range(1, 129))]
+    new_tokens = 64
+    t = time.time()
+    eng.generate(prompt, max_new_tokens=new_tokens)  # compile
+    log(f"gpt generate compile+run in {time.time() - t:.1f}s")
+    lat = []
+    for i in range(args.iters):
+        t = time.time()
+        eng.generate(prompt, max_new_tokens=new_tokens, seed=i)
+        lat.append((time.time() - t) / new_tokens * 1e3)
+    lat.sort()
+    out["gpt_token_p50_ms"] = round(lat[len(lat) // 2], 3)
+    out["gpt_token_p90_ms"] = round(lat[int(len(lat) * 0.9)], 3)
+    log(f"gpt decode p50={out['gpt_token_p50_ms']} ms/token")
+
+    # --- BERT-large encoder forward latency (bert-bench.py conventions)
+    bert_cfg = InferenceTransformerConfig(
+        vocab_size=30522, n_positions=512, n_embd=1024, n_layer=24,
+        n_head=16, pre_layer_norm=False, activation="gelu",
+        dtype=jnp.bfloat16)
+    beng = InferenceEngine(bert_cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, 30522, size=(1, 128)), jnp.int32)
+    t = time.time()
+    float(jnp.sum(beng.forward(ids)))  # compile + sync
+    log(f"bert forward compile+run in {time.time() - t:.1f}s")
+    lat = []
+    for _ in range(args.iters):
+        t = time.time()
+        float(jnp.sum(beng.forward(ids)))
+        lat.append((time.time() - t) * 1e3)
+    lat.sort()
+    trim = lat[1:-1] if len(lat) > 4 else lat  # warmup-trim convention
+    out["bert_fwd_p50_ms"] = round(trim[len(trim) // 2], 3)
+    log(f"bert fwd p50={out['bert_fwd_p50_ms']} ms")
+    return out
+
+
+PHASES = {
+    # name -> (builder of extra argv, subprocess timeout seconds)
+    "train-125m": (["--preset", "gpt2-125m", "--no-flash"], 420),
+    "train-350m-noflash": (["--preset", "gpt2-350m", "--no-flash"], 480),
+    "train-350m-flash": (["--preset", "gpt2-350m"], 480),
+    "inference": ([], 420),
+}
+
+
+def run_phase(name: str, budget_left: float):
+    extra, cap = PHASES[name]
+    timeout = min(cap, budget_left - 30)
+    if timeout < 120:
+        log(f"phase {name}: SKIPPED (only {budget_left:.0f}s budget left)")
+        return None
+    cmd = [sys.executable, os.path.abspath(__file__), "--phase", name] + extra
+    log(f"phase {name}: start (timeout {timeout:.0f}s)")
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log(f"phase {name}: TIMEOUT after {timeout:.0f}s — killed; "
+            "continuing with remaining phases")
+        return None
+    if proc.returncode != 0:
+        log(f"phase {name}: FAILED rc={proc.returncode}")
+        return None
+    for line in reversed(proc.stdout.decode().strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    log(f"phase {name}: no JSON in output")
+    return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", default=None,
+                    help="internal: run one phase in-process")
+    ap.add_argument("--preset", default="gpt2-350m")
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--micro", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--no-flash", action="store_true")
+    ap.add_argument("--budget", type=float, default=float(
+        os.environ.get("DSTPU_BENCH_BUDGET_S", "1500")))
+    ap.add_argument("--phases", default=None,
+                    help="comma-separated subset of phases to run")
+    args = ap.parse_args()
+
+    if args.phase:  # child mode: one phase, one JSON line on stdout
+        plat = os.environ.get("DSTPU_BENCH_PLATFORM")
+        if plat:  # testing hook — the axon sitecustomize pins JAX_PLATFORMS
+            import jax
+            jax.config.update("jax_platforms", plat)
+        fn = phase_infer if args.phase == "inference" else phase_train
+        print(json.dumps(fn(args)), flush=True)
+        return
+
+    results: dict = {}
+    order = (args.phases.split(",") if args.phases else list(PHASES))
+    try:
+        for name in order:
+            left = args.budget - (time.time() - T0)
+            r = run_phase(name, left)
+            if r is not None:
+                results[name] = r
+    except Exception as e:  # noqa: BLE001 — the JSON line must still print
+        log(f"orchestrator error: {e!r}")
+
+    # headline: flagship (350m) phase if any completed, else 125m fallback
+    best = None
+    for name in ("train-350m-flash", "train-350m-noflash", "train-125m"):
+        if name in results:
+            best = results[name]
+            break
+    detail = {"phases": results,
+              "wall_s": round(time.time() - T0, 1)}
+    infer = results.get("inference")
+    if infer:
+        detail["inference_p50"] = {
+            k: v for k, v in infer.items() if k != "phase"}
+    if best is None:
+        print(json.dumps({
+            "metric": "zero3_bf16_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": "no training phase completed within budget",
+            "detail": detail}), flush=True)
+        return
+    tps = best["tokens_per_sec_per_chip"]
+    baseline_tps = 50e12 / best["flops_per_token"]  # 50 TFLOPS headline
     print(json.dumps({
-        "metric": f"{preset}_zero3_bf16_seq{seq_len}_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec_per_chip, 2),
+        "metric": (f"{best['preset']}_zero3_bf16_seq{best['seq']}"
+                   "_tokens_per_sec_per_chip"),
+        "value": tps,
         "unit": "tokens/s/chip",
-        "vs_baseline": round(tokens_per_sec_per_chip / baseline_tokens_per_sec, 4),
-        "detail": {"chips": n_chips, "tflops_per_chip": round(tflops_per_chip, 2),
-                   "global_batch": global_bs, "loss": round(final_loss, 4)},
-    }))
+        "vs_baseline": round(tps / baseline_tps, 4),
+        "detail": {**{k: best[k] for k in
+                      ("tflops_per_chip", "chips", "global_batch",
+                       "ms_per_step", "loss")}, **detail}}), flush=True)
 
 
 if __name__ == "__main__":

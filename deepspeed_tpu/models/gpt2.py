@@ -66,6 +66,11 @@ class GPT2Config:
     # pad vocab to a multiple of 128 (lane width) for MXU efficiency;
     # Megatron does the same for TP divisibility.
     vocab_pad_multiple: int = 128
+    # ZeRO-3 offload_param cooperation: params live in TPU-host memory
+    # (engine places them; stage3.py:448) and every block fetches its own
+    # weights into HBM *inside* its remat region — backward re-fetches, so
+    # HBM holds only a few layers of weights at a time.
+    offload_params: bool = False
 
     @property
     def padded_vocab_size(self) -> int:
@@ -156,6 +161,36 @@ class Block(nn.Module):
         return x
 
 
+# offload_param fetch shardings, set by the engine via
+# GPT2LMModel.set_param_fetch_shardings (keyed by role). Explicit
+# NamedShardings are required under SPMD: a bare memory-space transfer
+# leaves the partitioner's placement annotation unsharded and it rejects
+# the program. The bare-Space fallback covers single-device standalone use.
+_PARAM_FETCH_SHARDINGS: Dict[str, Any] = {"active": True}
+
+
+def _fetch_to_device(tree, role: str = "block"):
+    """Host-memory param subtree → HBM (offload_param in-step fetch).
+    Inactive (identity) when the engine stages params eagerly instead —
+    non-TPU SPMD cannot express in-jit memory-space transfers. Concrete
+    (non-traced) values pass through untouched: the fetch only makes sense
+    inside the compiled step; during eager ``model.init`` a device_put
+    would commit fresh params to one device."""
+    if not _PARAM_FETCH_SHARDINGS.get("active", True):
+        return tree
+    sh = _PARAM_FETCH_SHARDINGS.get(role)
+
+    def put(x, s=None):
+        if not isinstance(x, jax.core.Tracer):
+            return x
+        return jax.device_put(
+            x, s if s is not None else jax.memory.Space.Device)
+
+    if sh is not None:
+        return jax.tree.map(put, tree, sh)
+    return jax.tree.map(put, tree)
+
+
 class GPT2(nn.Module):
     """Causal LM. ``__call__`` returns logits; ``loss`` the mean CE loss."""
     config: GPT2Config
@@ -168,6 +203,9 @@ class GPT2(nn.Module):
                          (cfg.padded_vocab_size, cfg.n_embd), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
+        if cfg.offload_params:
+            wte = _fetch_to_device(wte, "wte")
+            wpe = _fetch_to_device(wpe, "wpe")
         x = wte.astype(cfg.dtype)[input_ids] + \
             wpe.astype(cfg.dtype)[jnp.arange(T)][None]
         x = _maybe_constrain(x, P(DATA_AXES, "seq", None))
@@ -175,13 +213,28 @@ class GPT2(nn.Module):
             x = nn.Dropout(cfg.dropout)(x, deterministic=False)
 
         block = Block
+        if cfg.offload_params:
+            # the fetch sits INSIDE the remat region below, so backward
+            # re-fetches this block's weights instead of pinning them in
+            # HBM across the whole fwd+bwd (coordinator-prefetch analog —
+            # XLA's scheduler overlaps the DMA with neighbouring compute)
+            block = nn.map_variables(block, "params",
+                                     trans_in_fn=_fetch_to_device,
+                                     trans_out_fn=lambda t: t,
+                                     mutable=True, init=True)
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False,
+            block = nn.remat(block, prevent_cse=False,
                              policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
         for i in range(cfg.n_layer):
             x = block(cfg, name=f"h_{i}")(x, deterministic)
 
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        ln_f = nn.LayerNorm
+        if cfg.offload_params:
+            ln_f = nn.map_variables(
+                ln_f, "params",
+                trans_in_fn=lambda t: _fetch_to_device(t, "ln_f"),
+                trans_out_fn=lambda t: t, mutable=True, init=True)
+        x = ln_f(dtype=cfg.dtype, name="ln_f")(x)
         logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype))
         return logits
 
@@ -197,6 +250,28 @@ class GPT2LMModel:
         self.config = config
         self.module = GPT2(config)
 
+    @property
+    def handles_param_offload(self) -> bool:
+        """Engine hint: with ``offload_params`` the model performs its own
+        per-layer HBM fetches, so the engine must not coarse-fetch the
+        whole tree at step start."""
+        return self.config.offload_params
+
+    def set_param_fetch_shardings(self, device_shardings) -> None:
+        """Engine-provided device placements for the in-step fetches (the
+        ZeRO policy's param shardings with memory_kind='device'). All
+        blocks share one structure, so h_0's subtree serves every layer.
+        ``None`` deactivates the in-jit fetches (engine stages eagerly)."""
+        if device_shardings is None:
+            _PARAM_FETCH_SHARDINGS["active"] = False
+            return
+        _PARAM_FETCH_SHARDINGS["active"] = True
+        _PARAM_FETCH_SHARDINGS["wte"] = device_shardings["wte"]
+        _PARAM_FETCH_SHARDINGS["wpe"] = device_shardings["wpe"]
+        _PARAM_FETCH_SHARDINGS["ln_f"] = device_shardings["ln_f"]
+        if "h_0" in device_shardings:
+            _PARAM_FETCH_SHARDINGS["block"] = device_shardings["h_0"]
+
     def init(self, rng, example_batch=None, batch_size: int = 2,
              seq_len: Optional[int] = None):
         seq_len = seq_len or min(self.config.n_positions, 128)
@@ -204,7 +279,15 @@ class GPT2LMModel:
             ids = example_batch["input_ids"]
         else:
             ids = jnp.zeros((batch_size, seq_len), jnp.int32)
-        variables = self.module.init(rng, ids)
+        # offload fetches are step-time only; flax jits init internally,
+        # so without this guard the fetch would commit fresh params to one
+        # device before the engine shards them
+        prev = _PARAM_FETCH_SHARDINGS.get("active", True)
+        _PARAM_FETCH_SHARDINGS["active"] = False
+        try:
+            variables = self.module.init(rng, ids)
+        finally:
+            _PARAM_FETCH_SHARDINGS["active"] = prev
         return variables["params"]
 
     def apply(self, params, input_ids, deterministic=True, rngs=None):

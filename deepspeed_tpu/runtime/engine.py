@@ -73,7 +73,8 @@ class DeepSpeedEngine:
                  tp_specs=None,
                  training_data=None,
                  collate_fn=None,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 model_handles_param_offload: bool = False):
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         set_global_mesh(self.mesh)
         self.config = config
@@ -105,6 +106,31 @@ class DeepSpeedEngine:
         oc = config.zero_config.offload_optimizer
         self._offload_cfg = oc if (oc is not None and
                                    oc.device != "none") else None
+        # ZeRO-3 parameter offload (stage3.py:448; partitioned_param_swapper)
+        pc = config.zero_config.offload_param
+        self._param_offload_cfg = pc if (pc is not None and
+                                         pc.device != "none") else None
+        if self._param_offload_cfg is not None and self.zero_stage < 3:
+            raise ValueError(
+                "offload_param requires ZeRO stage 3 (reference "
+                "stage3.py:448 — parameter offload is a stage-3 feature)")
+        self._model_fetches_params = bool(model_handles_param_offload)
+        # In-jit host→HBM streaming (per-layer fetch inside the step) needs
+        # SPMD support for memory-space annotations — present on TPU, absent
+        # in XLA:CPU. Non-TPU backends stage the whole tree eagerly around
+        # the step instead (eviction between steps is identical).
+        self._param_offload_in_jit = (
+            self._param_offload_cfg is not None and
+            jax.default_backend() == "tpu")
+        self._param_swapper = None
+        if self._param_offload_cfg is not None and \
+                self._param_offload_cfg.device == "nvme":
+            if not self._param_offload_cfg.nvme_path:
+                raise ValueError("offload_param.device=nvme requires "
+                                 "nvme_path")
+            from deepspeed_tpu.runtime.zero.param_offload import ParamSwapper
+            self._param_swapper = ParamSwapper(
+                self._param_offload_cfg.nvme_path)
         self.state = self._init_state(params)
         self.host_opt = None
         if self._offload_cfg is not None:
@@ -203,6 +229,19 @@ class DeepSpeedEngine:
         shardings = (param_sh, master_sh if mixed else None, opt_sh)
         compute, master, opt_state = jax.jit(
             init_fn, out_shardings=shardings)(params)
+        self._device_param_shardings = param_sh
+        if self._param_offload_cfg is not None:
+            # bf16 params live in TPU-host memory between (and during)
+            # steps — the jitted step fetches per-layer into HBM at use
+            # sites (stage3.py:448 offload_param; coordinator prefetch ≈
+            # XLA latency-hiding DMA scheduling). Eager placement: the CPU
+            # test backend lacks host-memory out_shardings.
+            param_sh = jax.tree.map(
+                lambda s: s.with_memory_kind("pinned_host"), param_sh)
+            compute = jax.device_put(compute, param_sh)
+            log_dist(
+                f"offload_param: bf16 params placed in host memory "
+                f"(device={self._param_offload_cfg.device})", ranks=[0])
         loss_scale = make_loss_scale(
             self.config.fp16 if self.config.fp16.enabled else None)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=compute,
@@ -236,6 +275,13 @@ class DeepSpeedEngine:
         grad_spec = self.policy.spec_of(
             self.policy.grad_sharding(self.state.params))
         mesh = self.mesh
+        # offload_param with a model that doesn't fetch its own layers:
+        # bring the whole tree into device memory at step start (coarse —
+        # params live in HBM for the step, host between steps). Models that
+        # declare handles_param_offload fetch per-layer inside their remat
+        # regions instead, bounding HBM to a few layers (stage3.py:448).
+        param_offload = self._param_offload_in_jit
+        coarse_fetch = param_offload and not self._model_fetches_params
 
         def constrain(tree):
             return jax.tree.map(
@@ -248,10 +294,25 @@ class DeepSpeedEngine:
                 return (loss * scale / gas).astype(jnp.float32), loss
             (_, loss), grads = jax.value_and_grad(
                 scaled_loss, has_aux=True)(params)
+            if param_offload:
+                # cotangents of host-resident params may inherit the host
+                # memory space; the update pipeline runs in device memory.
+                # Explicit NamedShardings: bare memory-space transfers leave
+                # the SPMD partitioner's placement annotations unsharded.
+                grads = jax.tree.map(
+                    lambda g, s: jax.device_put(
+                        g, NamedSharding(mesh, s, memory_kind="device")),
+                    grads, grad_spec)
             return loss, grads
+
+        fetch_sh = jax.tree.map(
+            lambda s: s.with_memory_kind("device"),
+            self._device_param_shardings) if coarse_fetch else None
 
         def grad_core(params, scale, batch, rng):
             """→ (grads fp32 clipped+unscaled, mean_loss, gnorm, finite)."""
+            if coarse_fetch:
+                params = jax.tree.map(jax.device_put, params, fetch_sh)
             if gas > 1:
                 def mb_body(carry, mb_rng):
                     acc, loss_sum = carry
@@ -344,10 +405,21 @@ class DeepSpeedEngine:
 
     def _compile_step(self, batch):
         batch_sh = self._batch_sharding(batch)
+        in_sh = self._state_shardings
+        out_sh = self._state_shardings
+        self._eager_param_staging = False
+        if self._param_offload_cfg is not None and \
+                not self._param_offload_in_jit:
+            # non-TPU backends: the compiled step sees device-resident
+            # params; train_batch stages host→device before and device→host
+            # after each step (between-step eviction preserved).
+            in_sh = in_sh.replace(params=self._device_param_shardings)
+            out_sh = out_sh.replace(params=self._device_param_shardings)
+            self._eager_param_staging = True
         self._step_fn = jax.jit(
             self._make_step_fn(),
-            in_shardings=(self._state_shardings, batch_sh, None),
-            out_shardings=(self._state_shardings, None),
+            in_shardings=(in_sh, batch_sh, None),
+            out_shardings=(out_sh, None),
             donate_argnums=(0,))
 
     # ------------------------------------------------------------------
@@ -364,10 +436,15 @@ class DeepSpeedEngine:
                            "finite": finite}
 
         batch_sh = self._batch_sharding(batch)
+        param_in_sh = self._state_shardings.params
+        self._offload_grad_stage = False
+        if self._param_offload_cfg is not None and \
+                not self._param_offload_in_jit:
+            param_in_sh = self._device_param_shardings
+            self._offload_grad_stage = True
         self._offload_grad_fn = jax.jit(
             grad_fn,
-            in_shardings=(self._state_shardings.params, None, batch_sh,
-                          None))
+            in_shardings=(param_in_sh, None, batch_sh, None))
 
     def _offload_train_batch(self, batch) -> Dict[str, Any]:
         if self._offload_grad_fn is None:
@@ -376,8 +453,12 @@ class DeepSpeedEngine:
         self._rng, rng = jax.random.split(self._rng)
         fp16 = self.config.fp16.enabled
         scale = float(self._host_loss_scale.scale) if fp16 else 1.0
+        params_in = self.state.params
+        if self._offload_grad_stage:
+            params_in = jax.device_put(params_in,
+                                       self._device_param_shardings)
         grads, metrics = self._offload_grad_fn(
-            self.state.params, jnp.float32(scale), batch, rng)
+            params_in, jnp.float32(scale), batch, rng)
         finite = bool(metrics["finite"])
         lr = float(self.lr_scheduler(self.state.step))
         skipped = fp16 and not finite
@@ -429,8 +510,13 @@ class DeepSpeedEngine:
                 f"micro*gas*dp = {expected}")
         if self.curriculum_scheduler is not None:
             self.curriculum_scheduler.update_difficulty(self.global_steps)
+        # NVMe tier: params spent the inter-step window in swap files
+        # (partitioned_param_swapper.py semantics); restore for the step
+        self._ensure_params_resident()
         if self.host_opt is not None:
-            return self._offload_train_batch(batch)
+            out = self._offload_train_batch(batch)
+            self._maybe_swap_params_out()
+            return out
         if self._step_fn is None:
             self._compile_step(batch)
         profiling = (self.flops_profiler is not None and
@@ -440,7 +526,14 @@ class DeepSpeedEngine:
             self.flops_profiler.start_profile()
         self.tput_timer.start()
         self._rng, rng = jax.random.split(self._rng)
+        if self._eager_param_staging:
+            self.state = self.state.replace(params=jax.device_put(
+                self.state.params, self._device_param_shardings))
         self.state, metrics = self._step_fn(self.state, batch, rng)
+        if self._eager_param_staging:
+            self.state = self.state.replace(params=jax.device_put(
+                self.state.params, self._state_shardings.params))
+        self._maybe_swap_params_out()
         if profiling:
             jax.block_until_ready(metrics["loss"])
             float(metrics["loss"])   # host sync through remote relays
@@ -465,11 +558,29 @@ class DeepSpeedEngine:
                 self._write_monitor_events(metrics)
         return metrics
 
+    def _maybe_swap_params_out(self):
+        """NVMe param tier: after the step, spill the host-resident params
+        to swap files and drop the host arrays (inter-step host RAM is
+        bounded by the aio buffers, not the model)."""
+        if self._param_swapper is not None:
+            self.state = self.state.replace(
+                params=self._param_swapper.swap_out(self.state.params))
+
+    def _ensure_params_resident(self):
+        """Restore NVMe-swapped params before any consumer that reads
+        ``state.params`` outside train_batch (checkpointing, eval,
+        micro-batch API)."""
+        if self._param_swapper is not None and self._param_swapper.on_disk:
+            self.state = self.state.replace(
+                params=self._param_swapper.swap_in(
+                    self._state_shardings.params))
+
     # -- DS-shaped micro-batch API -------------------------------------
     def forward(self, batch):
         """Loss for one micro-batch (no grad) — engine.forward analog."""
         if self._grad_fn is None:
             self._build_grad_fn()
+        self._ensure_params_resident()
         self._rng, rng = jax.random.split(self._rng)
         return self._loss_only_fn(self.state.params, batch, rng)
 
@@ -486,6 +597,7 @@ class DeepSpeedEngine:
                 "host optimizer step")
         if self._grad_fn is None:
             self._build_grad_fn()
+        self._ensure_params_resident()
         self._rng, rng = jax.random.split(self._rng)
         loss, grads = self._grad_fn(self.state.params,
                                     self.state.loss_scale.scale, batch, rng)
@@ -625,6 +737,7 @@ class DeepSpeedEngine:
         """Consolidated fp32 weights (analog of
         _zero3_consolidated_16bit_state_dict / zero_to_fp32, engine.py:3396):
         shardings make this a simple device_get of global arrays."""
+        self._ensure_params_resident()
         master = self.state.master if self.mixed_precision else self.state.params
         return jax.device_get(cast_tree(master, jnp.float32))
 
@@ -633,6 +746,7 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         from deepspeed_tpu.runtime.checkpointing import save_checkpoint
+        self._ensure_params_resident()
         return save_checkpoint(self, save_dir, tag=tag,
                                client_state=client_state or {})
 
@@ -708,6 +822,18 @@ def initialize(args=None,
     engine = DeepSpeedEngine(loss_fn=loss_fn, params=model_parameters,
                              config=cfg, mesh=mesh, optimizer=optimizer,
                              lr_scheduler=lr_scheduler, tp_specs=tp_specs,
-                             training_data=training_data, rng=rng)
+                             training_data=training_data, rng=rng,
+                             model_handles_param_offload=bool(
+                                 getattr(model, "handles_param_offload",
+                                         False)))
+    if engine._param_offload_cfg is not None and \
+            engine._model_fetches_params:
+        setter = getattr(model, "set_param_fetch_shardings", None)
+        if callable(setter):
+            # None disables the model's in-jit fetches on backends where
+            # the engine stages params eagerly instead (non-TPU SPMD)
+            setter(jax.tree.map(lambda s: s.with_memory_kind("device"),
+                                engine._device_param_shardings)
+                   if engine._param_offload_in_jit else None)
     return engine, engine.optimizer, engine.training_dataloader, \
         engine.lr_scheduler

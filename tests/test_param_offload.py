@@ -1,0 +1,189 @@
+"""ZeRO-3 parameter offload (VERDICT r1 #2): host-memory placement, NVMe
+param swapper, model-cooperative per-layer fetch. Mirrors the reference's
+offload_param tests (stage3.py:448, partitioned_param_swapper.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+
+
+def _model(offload_flag=False):
+    cfg = GPT2Config(n_embd=32, n_layer=2, n_head=2, n_positions=64,
+                     vocab_size=128, dtype=jnp.bfloat16, remat=True,
+                     use_flash_attention=False, offload_params=offload_flag)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+    return model, params
+
+
+def _engine(model, params, offload_param=None, offload_optimizer=None,
+            stage=3):
+    zero = {"stage": stage}
+    if offload_param:
+        zero["offload_param"] = offload_param
+    if offload_optimizer:
+        zero["offload_optimizer"] = offload_optimizer
+    ds = {"train_micro_batch_size_per_gpu": 2,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "bf16": {"enabled": True},
+          "zero_optimization": zero}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                            model_parameters=params,
+                                            config=ds)
+    return eng
+
+
+def _batches(eng, n=3, seq=16):
+    rng = np.random.RandomState(0)
+    return [{"input_ids": jnp.asarray(
+        rng.randint(0, 128, (eng.train_batch_size, seq)))} for _ in range(n)]
+
+
+def test_offload_param_cpu_parity_and_eviction():
+    """Params must actually live in host memory (not a silent config no-op)
+    and training must match the in-HBM stage-3 path bit-for-bit."""
+    model, params = _model()
+    ref = _engine(model, params)
+    model2, params2 = _model()
+    off = _engine(model2, params2, offload_param={"device": "cpu"})
+
+    # eviction proof: every param leaf sits in pinned_host memory
+    kinds = {p.sharding.memory_kind for p in jax.tree.leaves(off.state.params)}
+    assert kinds == {"pinned_host"}, kinds
+
+    for b in _batches(ref):
+        m_ref = ref.train_batch(b)
+        m_off = off.train_batch(b)
+        np.testing.assert_allclose(float(m_ref["loss"]), float(m_off["loss"]),
+                                   rtol=1e-5)
+    # params stay host-resident after stepping
+    kinds = {p.sharding.memory_kind for p in jax.tree.leaves(off.state.params)}
+    assert kinds == {"pinned_host"}
+
+
+def test_offload_param_model_cooperative_fetch():
+    """GPT2 offload_params=True under the engine: on non-TPU backends the
+    in-jit fetch deactivates (engine stages eagerly) but numerics must
+    match the plain offload path either way."""
+    model, params = _model(offload_flag=True)
+    assert model.handles_param_offload
+    eng = _engine(model, params, offload_param={"device": "cpu"})
+    assert eng._model_fetches_params
+    losses = [float(eng.train_batch(b)["loss"]) for b in _batches(eng)]
+    model2, params2 = _model(offload_flag=False)
+    ref = _engine(model2, params2, offload_param={"device": "cpu"})
+    ref_losses = [float(ref.train_batch(b)["loss"]) for b in _batches(ref)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+
+def test_model_in_jit_fetch_single_device():
+    """The TPU in-jit streaming path's mechanics (per-block device_put
+    inside remat via map_variables) exercised under bare single-device jit
+    — the only place XLA:CPU accepts memory-space transfers. Gradients
+    through host-resident params must match the all-device reference."""
+    from jax.sharding import SingleDeviceSharding
+    from deepspeed_tpu.models.gpt2 import _PARAM_FETCH_SHARDINGS
+    saved = dict(_PARAM_FETCH_SHARDINGS)
+    _PARAM_FETCH_SHARDINGS.clear()
+    _PARAM_FETCH_SHARDINGS["active"] = True
+    try:
+        model, params = _model(offload_flag=True)
+        ref_model, ref_params = _model(offload_flag=False)
+        batch = {"input_ids": jnp.asarray(
+            np.random.RandomState(0).randint(0, 128, (2, 16)))}
+        host_s = SingleDeviceSharding(jax.devices()[0],
+                                      memory_kind="pinned_host")
+        host_params = jax.tree.map(
+            lambda x: jax.device_put(x, host_s), params)
+        kinds = {p.sharding.memory_kind
+                 for p in jax.tree.leaves(host_params)}
+        assert kinds == {"pinned_host"}
+
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: model.loss_fn(p, batch)))(host_params)
+        ref_loss, ref_grads = jax.jit(jax.value_and_grad(
+            lambda p: ref_model.loss_fn(p, batch)))(ref_params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-2, atol=1e-4),
+            grads, ref_grads)
+    finally:
+        _PARAM_FETCH_SHARDINGS.clear()
+        _PARAM_FETCH_SHARDINGS.update(saved)
+
+
+def test_offload_param_nvme_swaps_between_steps(tmp_path):
+    model, params = _model()
+    swap = str(tmp_path / "swap")
+    eng = _engine(model, params, offload_param={"device": "nvme",
+                                                "nvme_path": swap})
+    batches = _batches(eng)
+    m1 = eng.train_batch(batches[0])
+    loss1 = float(m1["loss"])
+    # between steps: params are ShapeDtypeStructs, payload is in swap files
+    assert eng._param_swapper.on_disk
+    leaves = jax.tree.leaves(eng.state.params)
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+    files = [f for f in os.listdir(swap) if f.startswith("param_")]
+    assert len(files) == len(leaves)
+
+    m2 = eng.train_batch(batches[1])
+    assert float(m2["loss"]) < loss1 + 1.0  # still training sanely
+
+    # parity vs cpu-offload over identical batches
+    model2, params2 = _model()
+    ref = _engine(model2, params2, offload_param={"device": "cpu"})
+    ref1 = float(ref.train_batch(batches[0])["loss"])
+    ref2 = float(ref.train_batch(batches[1])["loss"])
+    np.testing.assert_allclose([loss1, float(m2["loss"])], [ref1, ref2],
+                               rtol=1e-5)
+
+
+def test_offload_param_nvme_checkpoint_roundtrip(tmp_path):
+    """save/load while params are swapped out must transparently restore."""
+    model, params = _model()
+    eng = _engine(model, params, offload_param={
+        "device": "nvme", "nvme_path": str(tmp_path / "swap")})
+    b = _batches(eng, 1)[0]
+    eng.train_batch(b)
+    assert eng._param_swapper.on_disk
+    eng.save_checkpoint(str(tmp_path / "ck"))
+
+    model2, params2 = _model()
+    eng2 = _engine(model2, params2, offload_param={
+        "device": "nvme", "nvme_path": str(tmp_path / "swap2")})
+    eng2.load_checkpoint(str(tmp_path / "ck"))
+    a = jax.tree.map(np.asarray, jax.device_get(eng.state.params))
+    c = jax.tree.map(np.asarray, jax.device_get(eng2.state.params))
+    jax.tree.map(np.testing.assert_array_equal, a, c)
+
+
+def test_offload_param_composes_with_host_optimizer():
+    """ZeRO-Infinity shape: params in host memory + host SIMD Adam."""
+    model, params = _model()
+    eng = _engine(model, params, offload_param={"device": "cpu"},
+                  offload_optimizer={"device": "cpu"})
+    losses = [float(eng.train_batch(b)["loss"]) for b in _batches(eng, 4)]
+    assert losses[-1] < losses[0]
+    kinds = {p.sharding.memory_kind for p in jax.tree.leaves(eng.state.params)}
+    assert kinds == {"pinned_host"}
+
+
+def test_offload_param_requires_stage3():
+    model, params = _model()
+    with pytest.raises(ValueError, match="stage 3"):
+        _engine(model, params, offload_param={"device": "cpu"}, stage=2)
+
+
+def test_offload_param_nvme_requires_path():
+    model, params = _model()
+    with pytest.raises(ValueError, match="nvme_path"):
+        _engine(model, params, offload_param={"device": "nvme"})
