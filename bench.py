@@ -167,11 +167,16 @@ def phase_infer(args) -> dict:
 
 
 PHASES = {
-    # name -> (builder of extra argv, subprocess timeout seconds)
+    # name -> (builder of extra argv, subprocess timeout seconds).
+    # ORDER MATTERS: killing a phase mid-Mosaic-compile wedges the axon
+    # relay — the server keeps compiling and every later phase blocks in
+    # device init (observed r02: inference emitted nothing for 420 s after
+    # the flash phase was killed). The Pallas-flash phase therefore runs
+    # LAST, where a hang can only lose itself.
     "train-125m": (["--preset", "gpt2-125m", "--no-flash"], 420),
     "train-350m-noflash": (["--preset", "gpt2-350m", "--no-flash"], 480),
-    "train-350m-flash": (["--preset", "gpt2-350m"], 480),
     "inference": ([], 420),
+    "train-350m-flash": (["--preset", "gpt2-350m"], 480),
 }
 
 
@@ -228,14 +233,15 @@ def main() -> None:
 
     results: dict = {}
     order = (args.phases.split(",") if args.phases else list(PHASES))
-    try:
-        for name in order:
+    for name in order:
+        try:
             left = args.budget - (time.time() - T0)
             r = run_phase(name, left)
             if r is not None:
                 results[name] = r
-    except Exception as e:  # noqa: BLE001 — the JSON line must still print
-        log(f"orchestrator error: {e!r}")
+        except Exception as e:  # noqa: BLE001 — one phase's failure must
+            log(f"phase {name}: orchestrator error: {e!r}")  # not stop the rest
+
 
     # headline: flagship (350m) phase if any completed, else 125m fallback
     best = None

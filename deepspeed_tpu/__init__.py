@@ -11,6 +11,10 @@ reference where it makes sense:
 """
 from deepspeed_tpu.version import __version__, git_branch, git_hash
 from deepspeed_tpu import comm
+# deepspeed.checkpointing analog (activation checkpointing, NOT model
+# save/load — that lives on the engine): reference runtime/
+# activation_checkpointing/checkpointing.py
+from deepspeed_tpu.runtime import activation_checkpointing as checkpointing
 from deepspeed_tpu.config.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState, initialize
 from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh

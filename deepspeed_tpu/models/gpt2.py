@@ -161,39 +161,50 @@ class Block(nn.Module):
         return x
 
 
-# offload_param fetch shardings, set by the engine via
-# GPT2LMModel.set_param_fetch_shardings (keyed by role). Explicit
-# NamedShardings are required under SPMD: a bare memory-space transfer
-# leaves the partitioner's placement annotation unsharded and it rejects
-# the program. The bare-Space fallback covers single-device standalone use.
-_PARAM_FETCH_SHARDINGS: Dict[str, Any] = {"active": True}
-
-
-def _fetch_to_device(tree, role: str = "block"):
+def _fetch_to_device(tree, role: str, table: Optional[Dict[str, Any]]):
     """Host-memory param subtree → HBM (offload_param in-step fetch).
-    Inactive (identity) when the engine stages params eagerly instead —
-    non-TPU SPMD cannot express in-jit memory-space transfers. Concrete
-    (non-traced) values pass through untouched: the fetch only makes sense
-    inside the compiled step; during eager ``model.init`` a device_put
-    would commit fresh params to one device."""
-    if not _PARAM_FETCH_SHARDINGS.get("active", True):
-        return tree
-    sh = _PARAM_FETCH_SHARDINGS.get(role)
 
-    def put(x, s=None):
+    ``table`` is the owning :class:`GPT2LMModel`'s fetch table (instance
+    state, filled in by the engine via ``set_param_fetch_shardings`` —
+    role → NamedSharding subtree with memory_kind='device'). Explicit
+    NamedShardings are required under SPMD: a bare memory-space transfer
+    leaves the partitioner's placement annotation unsharded and it rejects
+    the program. Identity when no engine installed shardings (standalone
+    use, eager-staging engines, non-TPU backends) and for concrete
+    (non-traced) values: the fetch only makes sense inside the compiled
+    step — during eager ``model.init`` a device_put would commit fresh
+    params to one device."""
+    if table is None or not table.get("active", False):
+        return tree
+    sh = table.get(role)
+    if sh is None:
+        return tree
+
+    def put(x, s):
         if not isinstance(x, jax.core.Tracer):
             return x
-        return jax.device_put(
-            x, s if s is not None else jax.memory.Space.Device)
+        return jax.device_put(x, s)
 
-    if sh is not None:
-        return jax.tree.map(put, tree, sh)
-    return jax.tree.map(put, tree)
+    # flax hands the block subtree in as a FrozenDict while the engine's
+    # sharding subtree is a plain dict — isomorphic but not tree_map
+    # compatible. Both flatten in sorted-key order, so zip by leaf.
+    leaves, treedef = jax.tree.flatten(tree)
+    sh_leaves = jax.tree.leaves(sh)
+    if len(sh_leaves) != len(leaves):
+        raise ValueError(
+            f"offload_param fetch shardings for role {role!r} have "
+            f"{len(sh_leaves)} leaves, params have {len(leaves)}")
+    return jax.tree.unflatten(
+        treedef, [put(x, s) for x, s in zip(leaves, sh_leaves)])
 
 
 class GPT2(nn.Module):
     """Causal LM. ``__call__`` returns logits; ``loss`` the mean CE loss."""
     config: GPT2Config
+    # offload_param fetch table owned by the GPT2LMModel wrapper (mutable
+    # dict shared by reference; per-model so two engines cannot clobber
+    # each other's placements)
+    fetch_table: Optional[Dict[str, Any]] = None
 
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True):
@@ -204,8 +215,8 @@ class GPT2(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
         if cfg.offload_params:
-            wte = _fetch_to_device(wte, "wte")
-            wpe = _fetch_to_device(wpe, "wpe")
+            wte = _fetch_to_device(wte, "wte", self.fetch_table)
+            wpe = _fetch_to_device(wpe, "wpe", self.fetch_table)
         x = wte.astype(cfg.dtype)[input_ids] + \
             wpe.astype(cfg.dtype)[jnp.arange(T)][None]
         x = _maybe_constrain(x, P(DATA_AXES, "seq", None))
@@ -218,10 +229,11 @@ class GPT2(nn.Module):
             # re-fetches this block's weights instead of pinning them in
             # HBM across the whole fwd+bwd (coordinator-prefetch analog —
             # XLA's scheduler overlaps the DMA with neighbouring compute)
-            block = nn.map_variables(block, "params",
-                                     trans_in_fn=_fetch_to_device,
-                                     trans_out_fn=lambda t: t,
-                                     mutable=True, init=True)
+            block = nn.map_variables(
+                block, "params",
+                trans_in_fn=lambda t: _fetch_to_device(
+                    t, "block", self.fetch_table),
+                trans_out_fn=lambda t: t, mutable=True, init=True)
         if cfg.remat:
             block = nn.remat(block, prevent_cse=False,
                              policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
@@ -232,7 +244,8 @@ class GPT2(nn.Module):
         if cfg.offload_params:
             ln_f = nn.map_variables(
                 ln_f, "params",
-                trans_in_fn=lambda t: _fetch_to_device(t, "ln_f"),
+                trans_in_fn=lambda t: _fetch_to_device(
+                    t, "ln_f", self.fetch_table),
                 trans_out_fn=lambda t: t, mutable=True, init=True)
         x = ln_f(dtype=cfg.dtype, name="ln_f")(x)
         logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype))
@@ -248,7 +261,8 @@ class GPT2LMModel:
 
     def __init__(self, config: GPT2Config):
         self.config = config
-        self.module = GPT2(config)
+        self._fetch_table: Dict[str, Any] = {"active": False}
+        self.module = GPT2(config, fetch_table=self._fetch_table)
 
     @property
     def handles_param_offload(self) -> bool:
@@ -263,14 +277,14 @@ class GPT2LMModel:
         blocks share one structure, so h_0's subtree serves every layer.
         ``None`` deactivates the in-jit fetches (engine stages eagerly)."""
         if device_shardings is None:
-            _PARAM_FETCH_SHARDINGS["active"] = False
+            self._fetch_table["active"] = False
             return
-        _PARAM_FETCH_SHARDINGS["active"] = True
-        _PARAM_FETCH_SHARDINGS["wte"] = device_shardings["wte"]
-        _PARAM_FETCH_SHARDINGS["wpe"] = device_shardings["wpe"]
-        _PARAM_FETCH_SHARDINGS["ln_f"] = device_shardings["ln_f"]
+        self._fetch_table["active"] = True
+        self._fetch_table["wte"] = device_shardings["wte"]
+        self._fetch_table["wpe"] = device_shardings["wpe"]
+        self._fetch_table["ln_f"] = device_shardings["ln_f"]
         if "h_0" in device_shardings:
-            _PARAM_FETCH_SHARDINGS["block"] = device_shardings["h_0"]
+            self._fetch_table["block"] = device_shardings["h_0"]
 
     def init(self, rng, example_batch=None, batch_size: int = 2,
              seq_len: Optional[int] = None):
@@ -282,12 +296,12 @@ class GPT2LMModel:
         # offload fetches are step-time only; flax jits init internally,
         # so without this guard the fetch would commit fresh params to one
         # device before the engine shards them
-        prev = _PARAM_FETCH_SHARDINGS.get("active", True)
-        _PARAM_FETCH_SHARDINGS["active"] = False
+        prev = self._fetch_table.get("active", False)
+        self._fetch_table["active"] = False
         try:
             variables = self.module.init(rng, ids)
         finally:
-            _PARAM_FETCH_SHARDINGS["active"] = prev
+            self._fetch_table["active"] = prev
         return variables["params"]
 
     def apply(self, params, input_ids, deterministic=True, rngs=None):

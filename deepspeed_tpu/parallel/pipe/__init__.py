@@ -1,3 +1,4 @@
+from deepspeed_tpu.parallel.pipe.executor import PipelineEngine
 from deepspeed_tpu.parallel.pipe.module import (LayerSpec, PipelineModule,
                                                 TiedLayerSpec,
                                                 partition_balanced,
@@ -11,8 +12,8 @@ from deepspeed_tpu.parallel.pipe.schedule import (DataParallelSchedule,
                                                   bubble_fraction)
 
 __all__ = [
-    "LayerSpec", "TiedLayerSpec", "PipelineModule", "partition_uniform",
-    "partition_balanced", "pipeline_apply", "stack_layer_params",
-    "unstack_layer_params", "TrainSchedule", "InferenceSchedule",
-    "DataParallelSchedule", "bubble_fraction",
+    "LayerSpec", "TiedLayerSpec", "PipelineModule", "PipelineEngine",
+    "partition_uniform", "partition_balanced", "pipeline_apply",
+    "stack_layer_params", "unstack_layer_params", "TrainSchedule",
+    "InferenceSchedule", "DataParallelSchedule", "bubble_fraction",
 ]

@@ -1,12 +1,12 @@
 """Pipeline schedules as instruction streams.
 
 Analog of ``deepspeed/runtime/pipe/schedule.py`` (TrainSchedule 1F1B :182,
-InferenceSchedule :129, instruction dataclasses :317). On TPU the executed
-schedule is a *compiled* scan+ppermute program (pipeline.py) — XLA sees the
-whole schedule at once, so there is no runtime interpreter. These generators
-remain the source of truth for schedule math: bubble accounting, buffer
-counts, and the host-driven multi-slice runner; tests assert the 1F1B
-ordering invariants against them.
+InferenceSchedule :129, instruction dataclasses :317). Two executors consume
+these streams: the host-driven 1F1B interpreter (``executor.py`` — true
+depth-bounded activation memory, the reference's runtime shape) walks them
+instruction by instruction, while the *compiled* scan+ppermute program
+(``pipeline.py``) bakes the equivalent fill-drain dataflow into one XLA
+program. Tests additionally assert the 1F1B ordering invariants directly.
 """
 from __future__ import annotations
 
@@ -214,38 +214,24 @@ class TrainSchedule(PipeSchedule):
         return max(2, buffers)
 
     def _step_to_micro_batch(self, step_id):
-        """Map schedule tick -> (micro_batch_id, is_forward) (ref :219-262)."""
-        if _is_even(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._even_step_forward_id(step_id)
-            is_forward = True
-        elif _is_odd(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._odd_step_forward_id(step_id)
-            is_forward = True
-        elif _is_even(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._even_step_backward_id(step_id)
-            is_forward = False
-        elif _is_odd(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._odd_step_backward_id(step_id)
-            is_forward = False
-        else:
-            raise AssertionError("unreachable")
-        return micro_batch_id, is_forward
+        """Map schedule tick -> (micro_batch_id, is_forward).
 
-    def _even_step_forward_id(self, step_id):
-        base = step_id // 2
-        return base - self.stage_id // 2
-
-    def _odd_step_forward_id(self, step_id):
-        base = (step_id - 1) // 2
-        return base - self.stage_id // 2
-
-    def _even_step_backward_id(self, step_id):
-        base = step_id // 2
-        return base - self.stages + (self.stage_id + 1) // 2
-
-    def _odd_step_backward_id(self, step_id):
-        base = ((step_id - 1) // 2) - self.stages + 1
-        return base + self.stage_id // 2
+        Wave view of 1F1B: stages alternate forward/backward ticks, so each
+        wavefront advances one stage per tick at half a microbatch per tick.
+        The forward front of microbatch ``m`` reaches stage ``s`` at tick
+        ``2m + s``; the backward front reflects off the last stage and
+        reaches stage ``s`` at tick ``2m + (2*stages - 1 - s)``. The two
+        offsets differ by an odd amount, so exactly one parity matches any
+        tick — that parity decides the direction, the offset recovers ``m``
+        (negative / >= M values are filtered by ``_valid_micro_batch``:
+        those are the stage's idle bubble ticks).
+        """
+        fwd_t = step_id - self.stage_id
+        if fwd_t % 2 == 0:
+            return fwd_t // 2, True
+        bwd_t = step_id - (2 * self.stages - 1 - self.stage_id)
+        assert bwd_t % 2 == 0, "parities of the two waves must alternate"
+        return bwd_t // 2, False
 
     def _buffer_idx(self, micro_batch_id):
         assert self._valid_micro_batch(micro_batch_id)
@@ -270,11 +256,3 @@ class DataParallelSchedule(PipeSchedule):
 def bubble_fraction(micro_batches: int, stages: int) -> float:
     """Pipeline bubble overhead: (P-1)/(M+P-1) of ticks are idle."""
     return (stages - 1) / (micro_batches + stages - 1)
-
-
-def _is_even(x: int) -> bool:
-    return x % 2 == 0
-
-
-def _is_odd(x: int) -> bool:
-    return x % 2 != 0

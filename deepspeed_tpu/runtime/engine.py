@@ -96,6 +96,19 @@ class DeepSpeedEngine:
         self.lr_scheduler = lr_scheduler or build_schedule(
             config.scheduler, opt_cfg.params if opt_cfg else None)
 
+        # Activation checkpointing (reference engine _configure_checkpointing
+        # → deepspeed.checkpointing.configure): install the JSON section so
+        # model code using deepspeed_tpu.checkpointing.checkpoint() sees it;
+        # configure() itself rejects the fields XLA cannot honor.
+        ac = config.activation_checkpointing
+        from deepspeed_tpu.runtime import activation_checkpointing
+        if ac != type(ac)():
+            activation_checkpointing.configure(ac, _by_engine=True)
+        else:
+            # a previous ENGINE's config must not leak into this engine's
+            # models; a user's direct configure() call is preserved
+            activation_checkpointing.reset(only_engine_installed=True)
+
         # ---- sharding policy & state materialization ----
         self.zero_stage = config.zero_config.stage
         self.policy = ZeroShardingPolicy(
@@ -254,6 +267,13 @@ class DeepSpeedEngine:
             opt_state=opt_sh,
             loss_scale=jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
                                     loss_scale))
+        # Commit EVERY leaf — including the step/loss_scale scalars built
+        # eagerly above — to its sharding. Uncommitted scalars enter the
+        # first step with empty-sharding avals while the step's outputs are
+        # mesh-committed, so the second train_batch would retrace and
+        # recompile the entire program (the r01 bench-timeout pathology:
+        # ~double compile time before any steady-state step runs).
+        state = jax.device_put(state, self._state_shardings)
         return state
 
     # ------------------------------------------------------------------
@@ -442,9 +462,16 @@ class DeepSpeedEngine:
                 not self._param_offload_in_jit:
             param_in_sh = self._device_param_shardings
             self._offload_grad_stage = True
+        # Donate the incoming param buffers: they are replaced wholesale by
+        # the host update, so holding both copies through the step doubles
+        # param HBM for nothing. Exception: fp16 with un-staged params —
+        # an overflow-skipped step must keep the old params alive.
+        donate = ((0,) if (self._offload_grad_stage or
+                           not self.config.fp16.enabled) else ())
         self._offload_grad_fn = jax.jit(
             grad_fn,
-            in_shardings=(param_in_sh, None, batch_sh, None))
+            in_shardings=(param_in_sh, None, batch_sh, None),
+            donate_argnums=donate)
 
     def _offload_train_batch(self, batch) -> Dict[str, Any]:
         if self._offload_grad_fn is None:
@@ -465,12 +492,22 @@ class DeepSpeedEngine:
         if not skipped:
             from deepspeed_tpu.runtime.zero.offload import (
                 _flatten_with_names)
-            grads_host = {k: np.asarray(v, np.float32).reshape(-1)
-                          for k, v in _flatten_with_names(grads).items()}
-            new_params = self.host_opt.step(grads_host, lr,
-                                            self.compute_dtype)
-            new_params = jax.device_put(new_params,
-                                        self._state_shardings.params)
+            if self.host_opt.swapper is None:
+                # leaf-pipelined: D2H ∥ host Adam ∥ async H2D per leaf
+                # (reference stage_1_and_2.py:1069-1219 overlap machinery)
+                leaf_sh = _flatten_with_names(self._state_shardings.params)
+                new_params = self.host_opt.step_streamed(
+                    _flatten_with_names(grads), lr, self.compute_dtype,
+                    put=lambda k, payload: jax.device_put(
+                        payload, leaf_sh[k]))
+            else:
+                # NVMe moments: whole-tree step (pipelined through the aio
+                # double buffer instead)
+                grads_host = {k: np.asarray(v, np.float32).reshape(-1)
+                              for k, v in _flatten_with_names(grads).items()}
+                new_params = jax.device_put(
+                    self.host_opt.step(grads_host, lr, self.compute_dtype),
+                    self._state_shardings.params)
             self.state = self.state.replace(params=new_params)
         # step advances even when skipped — matches the in-HBM step_fn so
         # the lr schedule is identical across both paths

@@ -55,6 +55,7 @@ class HostOffloadOptimizer:
                        for k, v in leaves.items()}
         self.keys = list(self.master)
         self._bf16_out = None
+        self._bf16_ring = None
         self._arenas = None
         self._arena_idx = 0
         self.swapper = None
@@ -109,6 +110,60 @@ class HostOffloadOptimizer:
             new_leaves = [self.master[k].astype(
                 np.dtype(param_dtype)).reshape(self.shapes[k])
                 for k in self.keys]
+        return jax.tree_util.tree_unflatten(self.treedef, new_leaves)
+
+    def step_streamed(self, grads_device: Dict[str, Any], lr: float,
+                      param_dtype=jnp.bfloat16, put=None) -> Any:
+        """Leaf-pipelined update — the overlap machinery of the reference's
+        cpu_offload path (``stage_1_and_2.py:1069-1219``: grads stream into
+        pinned buffers while backward continues; CPU Adam and the fp16
+        copy-back overlap with communication). Stages, all concurrent
+        across *different* leaves:
+
+          device backward still producing later grads
+          ∥ D2H of finished grad leaves (``copy_to_host_async`` on all)
+          ∥ host SIMD Adam on the leaf that just landed
+          ∥ async H2D ``put`` of the previously updated leaf
+
+        Numerically identical to :meth:`step` (same kernel, same
+        bias-correction step pinned across leaves). NVMe-swapped moments
+        keep using :meth:`step` — their pipeline is the aio double buffer.
+
+        ``grads_device``: name → device array (unflattened fp32 grads).
+        ``put``: callable ``(leaf_name, numpy_payload) -> device array``
+        (async ``jax.device_put`` with the leaf's sharding).
+        """
+        if self.swapper is not None:
+            raise RuntimeError("step_streamed does not support NVMe-swapped "
+                               "moments; use step()")
+        bf16 = param_dtype == jnp.bfloat16
+        if bf16 and self._bf16_ring is None:
+            # two alternating buffer sets: the async H2D of step N may
+            # still be reading buffer A while step N+1's Adam writes B
+            self._bf16_ring = [
+                {k: np.empty(w.shape, np.uint16)
+                 for k, w in self.master.items()} for _ in range(2)]
+        out_views = (self._bf16_ring[self.adam.step_count % 2]
+                     if bf16 else None)
+        for arr in grads_device.values():
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        step = self.adam.step_count + 1
+        new_leaves = []
+        for k in self.keys:
+            g = np.asarray(grads_device[k], np.float32).reshape(-1)
+            self.adam.step({k: self.master[k]}, {k: g},
+                           {k: self.state[k]}, lr=lr,
+                           bf16_out=None if out_views is None
+                           else {k: out_views[k]}, step=step)
+            if bf16:
+                payload = out_views[k].view(ml_dtypes.bfloat16).reshape(
+                    self.shapes[k])
+            else:
+                payload = self.master[k].astype(
+                    np.dtype(param_dtype)).reshape(self.shapes[k])
+            new_leaves.append(payload if put is None else put(k, payload))
         return jax.tree_util.tree_unflatten(self.treedef, new_leaves)
 
     def _nvme_buffers(self, key: str) -> Dict[str, np.ndarray]:

@@ -88,36 +88,33 @@ def test_model_in_jit_fetch_single_device():
     — the only place XLA:CPU accepts memory-space transfers. Gradients
     through host-resident params must match the all-device reference."""
     from jax.sharding import SingleDeviceSharding
-    from deepspeed_tpu.models.gpt2 import _PARAM_FETCH_SHARDINGS
-    saved = dict(_PARAM_FETCH_SHARDINGS)
-    _PARAM_FETCH_SHARDINGS.clear()
-    _PARAM_FETCH_SHARDINGS["active"] = True
-    try:
-        model, params = _model(offload_flag=True)
-        ref_model, ref_params = _model(offload_flag=False)
-        batch = {"input_ids": jnp.asarray(
-            np.random.RandomState(0).randint(0, 128, (2, 16)))}
-        host_s = SingleDeviceSharding(jax.devices()[0],
-                                      memory_kind="pinned_host")
-        host_params = jax.tree.map(
-            lambda x: jax.device_put(x, host_s), params)
-        kinds = {p.sharding.memory_kind
-                 for p in jax.tree.leaves(host_params)}
-        assert kinds == {"pinned_host"}
+    model, params = _model(offload_flag=True)
+    ref_model, ref_params = _model(offload_flag=False)
+    batch = {"input_ids": jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 16)))}
+    host_s = SingleDeviceSharding(jax.devices()[0],
+                                  memory_kind="pinned_host")
+    host_params = jax.tree.map(
+        lambda x: jax.device_put(x, host_s), params)
+    kinds = {p.sharding.memory_kind
+             for p in jax.tree.leaves(host_params)}
+    assert kinds == {"pinned_host"}
 
-        loss, grads = jax.jit(jax.value_and_grad(
-            lambda p: model.loss_fn(p, batch)))(host_params)
-        ref_loss, ref_grads = jax.jit(jax.value_and_grad(
-            lambda p: ref_model.loss_fn(p, batch)))(ref_params)
-        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
-        jax.tree.map(
-            lambda a, b: np.testing.assert_allclose(
-                np.asarray(a, np.float32), np.asarray(b, np.float32),
-                rtol=2e-2, atol=1e-4),
-            grads, ref_grads)
-    finally:
-        _PARAM_FETCH_SHARDINGS.clear()
-        _PARAM_FETCH_SHARDINGS.update(saved)
+    # install per-model fetch placements the way the engine does
+    dev_s = SingleDeviceSharding(jax.devices()[0], memory_kind="device")
+    model.set_param_fetch_shardings(
+        jax.tree.map(lambda _: dev_s, params))
+
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda p: model.loss_fn(p, batch)))(host_params)
+    ref_loss, ref_grads = jax.jit(jax.value_and_grad(
+        lambda p: ref_model.loss_fn(p, batch)))(ref_params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-2, atol=1e-4),
+        grads, ref_grads)
 
 
 def test_offload_param_nvme_swaps_between_steps(tmp_path):
