@@ -203,23 +203,15 @@ OPTIMIZER_REGISTRY = {
     "sgd": lambda p: sgd(**p),
     "adagrad": lambda p: adagrad(**p),
     "cpuadagrad": lambda p: adagrad(**p),
-    "onebitadam": lambda p: _onebit(p),
-    "zerooneadam": lambda p: _onebit(p),
-    "onebitlamb": lambda p: _onebit_lamb_unsupported(),
+    "onebitadam": lambda p: _onebit("onebit_adam", p),
+    "zerooneadam": lambda p: _onebit("zero_one_adam", p),
+    "onebitlamb": lambda p: _onebit("onebit_lamb", p),
 }
 
 
-def _onebit_lamb_unsupported():
-    raise NotImplementedError(
-        "OnebitLamb (layerwise trust-ratio + compressed momentum) is not "
-        "implemented yet — silently substituting 1-bit Adam would drop the "
-        "trust-ratio scaling large-batch configs rely on. Use OnebitAdam "
-        "or Lamb.")
-
-
-def _onebit(p):
-    from deepspeed_tpu.ops.onebit import onebit_adam
-    return onebit_adam(**p)
+def _onebit(which: str, p):
+    from deepspeed_tpu.ops import onebit
+    return getattr(onebit, which)(**p)
 
 
 def build_optimizer(name: str, params: Optional[dict] = None) -> Optimizer:

@@ -1,19 +1,21 @@
-"""1-bit Adam / 0-1 Adam style optimizers.
+"""The 1-bit optimizer family: OnebitAdam, 0/1 Adam, OnebitLamb.
 
-Analog of ``runtime/fp16/onebit/{adam,zoadam}.py``: exact Adam during a
-warmup of ``freeze_step`` steps; afterwards the second moment is FROZEN
-and only the (compressible) momentum is synchronized — with error-feedback
-sign compression from deepspeed_tpu.comm.compressed when running inside a
-``shard_map`` with per-worker gradients.
+Analog of ``runtime/fp16/onebit/{adam,zoadam,lamb}.py``: exact optimization
+during a warmup phase; afterwards the second moment is FROZEN and only the
+(compressible) momentum — or, for 0/1 Adam, an update accumulator on an
+exponentially-sparsifying schedule — is synchronized, with error-feedback
+sign compression from ``deepspeed_tpu.comm.compressed``.
 
 Two usage modes:
 * engine mode (``axis_name=None``): gradients arrive already averaged
-  (GSPMD inserted the reduction); the optimizer still applies the
-  freeze-variance schedule — the convergence behavior of 1-bit Adam
-  without the wire format.
-* comm mode (``axis_name='data'`` under shard_map): grads are LOCAL;
-  warmup averages them exactly (pmean), the compression stage averages
-  sign-compressed momentum — the full reference algorithm.
+  (GSPMD inserted the reduction); the optimizers still apply their
+  freeze/local-step schedules — the convergence behavior without the wire
+  format.
+* comm mode (``axis_name=('data',...)`` under shard_map): grads are LOCAL;
+  warmup averages them exactly (pmean), the compression stage exchanges
+  sign-compressed state — the full reference algorithm on the wire. The
+  engine enters this mode automatically for pure-DP meshes
+  (``DeepSpeedEngine._make_compressed_step_fn``).
 """
 from __future__ import annotations
 
@@ -40,8 +42,8 @@ class OnebitAdamState:
 def onebit_adam(betas=(0.9, 0.999), eps: float = 1e-8,
                 weight_decay: float = 0.0, freeze_step: int = 100,
                 axis_name: Optional[str] = None,
-                cuda_aware: bool = False, comm_backend_name: str = "xla",
-                **_) -> Optimizer:
+                cuda_aware: bool = False,
+                comm_backend_name: str = "xla") -> Optimizer:
     b1, b2 = betas
 
     def init(params):
@@ -95,5 +97,357 @@ def onebit_adam(betas=(0.9, 0.999), eps: float = 1e-8,
         return updates, OnebitAdamState(count=count, mu=mu, nu=nu,
                                         worker_error=w_err,
                                         server_error=s_err)
+
+    return Optimizer(init=init, update=update)
+
+
+@struct.dataclass
+class ZeroOneAdamState:
+    count: jnp.ndarray
+    mu: any
+    nu: any
+    accum: any                 # u in the paper: sum of applied local deltas
+    lrs: jnp.ndarray           # accumulated lr over the local-step window
+    var_interval: jnp.ndarray  # current variance-update interval (doubles)
+    var_counter: jnp.ndarray
+    local_interval: jnp.ndarray
+    local_counter: jnp.ndarray
+    worker_error: any
+    server_error: any
+
+
+def zero_one_adam(betas=(0.9, 0.999), eps: float = 1e-8,
+                  weight_decay: float = 0.0,
+                  var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16,
+                  local_step_scaler: int = 32678,
+                  local_step_clipper: int = 16,
+                  axis_name: Optional[str] = None,
+                  cuda_aware: bool = False,
+                  comm_backend_name: str = "xla") -> Optimizer:
+    """0/1 Adam (arXiv:2202.06009; reference runtime/fp16/onebit/zoadam.py).
+
+    Two phases, switching at ``var_freeze_step``:
+
+    * **Adaptive-variance phase**: the second moment (and an exact-gradient
+      momentum update) refresh only every ``var_interval`` steps, and that
+      interval doubles after every ``var_update_scaler`` refreshes (the
+      paper's kappa). Between refreshes, the momentum advances with the
+      1-bit error-feedback-compressed gradient exchange.
+    * **Local-step phase** (variance frozen): momentum advances with the
+      purely LOCAL gradient — no communication at all — while an
+      accumulator records the applied updates. Every ``local_interval``
+      steps the local updates are rolled back, the accumulator is
+      1-bit-allreduced, the synced update is applied and the momentum is
+      re-seeded from it; the interval doubles every ``local_step_scaler``
+      syncs up to ``local_step_clipper``. This is the 0/1 in the name:
+      most steps exchange 0 bits.
+
+    No bias correction, matching the reference update rule. In engine mode
+    (``axis_name=None``) the exchanges are identity (gradients arrive
+    pre-reduced); under ``shard_map`` with per-worker grads the wire
+    behavior is exact.
+    """
+    b1, b2 = betas
+
+    def init(params):
+        zeros = _tree_zeros_like(params)
+        w_err, s_err = init_error_feedback(
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+        return ZeroOneAdamState(
+            count=jnp.zeros((), jnp.int32), mu=_tree_zeros_like(params),
+            nu=_tree_zeros_like(params), accum=zeros,
+            lrs=jnp.zeros((), jnp.float32),
+            var_interval=jnp.ones((), jnp.int32),
+            var_counter=jnp.zeros((), jnp.int32),
+            local_interval=jnp.ones((), jnp.int32),
+            local_counter=jnp.zeros((), jnp.int32),
+            worker_error=w_err, server_error=s_err)
+
+    def update(grads, state, params, lr):
+        count = state.count + 1
+        lr = jnp.asarray(lr, jnp.float32)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        frozen = count > var_freeze_step
+
+        # ---- phase 1: adaptive variance -------------------------------
+        def warmup(op):
+            g, st = op
+            var_step = (count % st.var_interval) == 0
+
+            def refresh(op2):
+                g, st = op2
+                if axis_name is not None:
+                    g = jax.tree.map(lambda x: jax.lax.pmean(x, axis_name),
+                                     g)
+                mu = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x,
+                                  st.mu, g)
+                nu = jax.tree.map(lambda v, x: b2 * v + (1 - b2) * x * x,
+                                  st.nu, g)
+                # exponential interval growth (kappa refreshes per level)
+                vc = st.var_counter + 1
+                grow = vc >= var_update_scaler
+                return (mu, nu, st.worker_error, st.server_error,
+                        jnp.where(grow, 0, vc),
+                        jnp.where(grow, st.var_interval * 2,
+                                  st.var_interval))
+
+            def between(op2):
+                g, st = op2
+                if axis_name is not None:
+                    g, w_err, s_err = compressed_allreduce_tree(
+                        g, st.worker_error, st.server_error, axis_name)
+                else:
+                    w_err, s_err = st.worker_error, st.server_error
+                mu = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x,
+                                  st.mu, g)
+                return (mu, st.nu, w_err, s_err, st.var_counter,
+                        st.var_interval)
+
+            mu, nu, w_err, s_err, vc, vi = jax.lax.cond(
+                var_step, refresh, between, (g, st))
+
+            def upd(m, v, p):
+                u = m / (jnp.sqrt(v) + eps)
+                if weight_decay > 0.0:
+                    u = u + weight_decay * p.astype(jnp.float32)
+                return (-lr * u).astype(p.dtype)
+            deltas = jax.tree.map(upd, mu, nu, params)
+            return (deltas, mu, nu, st.accum, jnp.float32(0.0), vi, vc,
+                    st.local_interval, st.local_counter, w_err, s_err)
+
+        # ---- phase 2: frozen variance, local steps --------------------
+        def local_phase(op):
+            g, st = op
+            # re-zero the error feedback at the phase boundary (reference
+            # reinitial_error_buffer): phase-1 errors are gradient-scale,
+            # phase-2 compresses the ~lr-times-smaller update accumulator —
+            # stale errors would swamp it
+            first_local = count == (var_freeze_step + 1)
+            st = st.replace(
+                worker_error=jax.tree.map(
+                    lambda e: jnp.where(first_local, 0.0, e),
+                    st.worker_error),
+                server_error=jax.tree.map(
+                    lambda e: jnp.where(first_local, 0.0, e),
+                    st.server_error))
+            mu = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x, st.mu, g)
+            lrs = st.lrs + lr
+
+            def upd(m, v, p):
+                u = m / (jnp.sqrt(v) + eps)
+                if weight_decay > 0.0:
+                    u = u + weight_decay * p.astype(jnp.float32)
+                return -lr * u
+            delta_local = jax.tree.map(upd, mu, st.nu, params)
+            accum = jax.tree.map(jnp.add, st.accum, delta_local)
+            sync = (count % st.local_interval) == 0
+
+            def do_sync(op2):
+                mu, accum, st, delta_local = op2
+                # roll the whole window back, exchange the accumulated
+                # update in momentum units, re-apply the synced average
+                in_momentum_units = jax.tree.map(
+                    lambda a, v: a * (jnp.sqrt(v) + eps), accum, st.nu)
+                if axis_name is not None:
+                    synced, w_err, s_err = compressed_allreduce_tree(
+                        in_momentum_units, st.worker_error,
+                        st.server_error, axis_name)
+                else:
+                    synced = in_momentum_units
+                    w_err, s_err = st.worker_error, st.server_error
+                applied = jax.tree.map(
+                    lambda s_, v: s_ / (jnp.sqrt(v) + eps), synced, st.nu)
+                deltas = jax.tree.map(
+                    lambda d, a, ap: (d - a + ap),
+                    delta_local, accum, applied)
+                new_mu = jax.tree.map(lambda s_: -s_ / lrs, synced)
+                lc = st.local_counter + 1
+                grow = lc >= local_step_scaler
+                li = jnp.where(
+                    grow, jnp.minimum(st.local_interval * 2,
+                                      local_step_clipper),
+                    st.local_interval)
+                return (deltas, new_mu,
+                        jax.tree.map(jnp.zeros_like, accum),
+                        jnp.float32(0.0), li, jnp.where(grow, 0, lc),
+                        w_err, s_err)
+
+            def no_sync(op2):
+                mu, accum, st, delta_local = op2
+                return (delta_local, mu, accum, lrs, st.local_interval,
+                        st.local_counter, st.worker_error, st.server_error)
+
+            deltas, mu, accum, lrs, li, lc, w_err, s_err = jax.lax.cond(
+                sync, do_sync, no_sync, (mu, accum, st, delta_local))
+            deltas = jax.tree.map(lambda d, p: d.astype(p.dtype), deltas,
+                                  params)
+            return (deltas, mu, st.nu, accum, lrs, st.var_interval,
+                    st.var_counter, li, lc, w_err, s_err)
+
+        (deltas, mu, nu, accum, lrs, vi, vc, li, lc, w_err, s_err) = \
+            jax.lax.cond(frozen, local_phase, warmup, (grads, state))
+        return deltas, ZeroOneAdamState(
+            count=count, mu=mu, nu=nu, accum=accum, lrs=lrs,
+            var_interval=vi, var_counter=vc, local_interval=li,
+            local_counter=lc, worker_error=w_err, server_error=s_err)
+
+    return Optimizer(init=init, update=update)
+
+
+@struct.dataclass
+class OnebitLambState:
+    count: jnp.ndarray
+    mu: any
+    nu: any                 # frozen-at-warmup-end second moment
+    nu_fresh: any           # kept fresh from reconstructed gradients
+    coeff_freeze: any       # per-tensor EMA of the warmup trust ratio
+    last_factor: any        # per-tensor rate-limited variance factor
+    scaling_coeff: any      # per-tensor momentum pre-scaling for compression
+    worker_error: any
+    server_error: any
+
+
+def onebit_lamb(betas=(0.9, 0.999), eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100000,
+                max_coeff: float = 10.0, min_coeff: float = 0.01,
+                coeff_beta: float = 0.9, factor_max: float = 4.0,
+                factor_min: float = 0.5, factor_threshold: float = 0.1,
+                axis_name: Optional[str] = None,
+                cuda_aware: bool = False,
+                comm_backend_name: str = "xla") -> Optimizer:
+    """1-bit LAMB (reference runtime/fp16/onebit/lamb.py).
+
+    Warmup (< ``freeze_step``): baseline LAMB — per-tensor trust ratio
+    ``clamp(||p|| / ||m/(sqrt(v)+eps) + wd p||, min_coeff, max_coeff)``,
+    while an EMA (``coeff_beta``) of the ratio is recorded per tensor.
+
+    Compression stage: the second moment freezes; the momentum advances
+    with the LOCAL gradient, is pre-scaled by ``scaling_coeff`` (computed
+    once at the freeze boundary so all tensors compress at a comparable
+    RMS), 1-bit-allreduced, and unscaled. The trust ratio is no longer
+    recomputed from unstable compressed updates — instead the frozen EMA
+    is modulated by ``factor = max(frozen_denom / fresh_denom)``, where
+    the fresh variance tracks gradients reconstructed from consecutive
+    momenta; the factor is clamped to [factor_min, factor_max] and rate-
+    limited to ±factor_threshold per step. No bias correction, matching
+    the reference update rule.
+    """
+    b1, b2 = betas
+
+    def _tensor_scalar_tree(params, val):
+        return jax.tree.map(lambda _: jnp.asarray(val, jnp.float32), params)
+
+    def init(params):
+        w_err, s_err = init_error_feedback(
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+        return OnebitLambState(
+            count=jnp.zeros((), jnp.int32), mu=_tree_zeros_like(params),
+            nu=_tree_zeros_like(params), nu_fresh=_tree_zeros_like(params),
+            coeff_freeze=_tensor_scalar_tree(params, 0.0),
+            last_factor=_tensor_scalar_tree(params, 1.0),
+            scaling_coeff=_tensor_scalar_tree(params, 1.0),
+            worker_error=w_err, server_error=s_err)
+
+    def update(grads, state, params, lr):
+        count = state.count + 1
+        lr = jnp.asarray(lr, jnp.float32)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        frozen = count > freeze_step
+
+        def warmup(op):
+            g, st = op
+            if axis_name is not None:
+                g = jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), g)
+            mu = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x, st.mu, g)
+            nu = jax.tree.map(lambda v, x: b2 * v + (1 - b2) * x * x,
+                              st.nu, g)
+
+            def per_tensor(m, v, p, cf):
+                upd = m / (jnp.sqrt(v) + eps)
+                if weight_decay > 0.0:
+                    upd = upd + weight_decay * p.astype(jnp.float32)
+                wnorm = jnp.linalg.norm(p.astype(jnp.float32))
+                unorm = jnp.linalg.norm(upd)
+                raw = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm,
+                                1.0)
+                coeff = jnp.clip(raw, min_coeff, max_coeff)
+                new_cf = jnp.where(
+                    coeff != 1.0,
+                    coeff_beta * cf + (1 - coeff_beta) * coeff, cf)
+                return (-lr * coeff * upd).astype(p.dtype), new_cf
+            out = jax.tree.map(per_tensor, mu, nu, params, st.coeff_freeze)
+            deltas = jax.tree.map(lambda o: o[0], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+            coeff_freeze = jax.tree.map(
+                lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+
+            # boundary bookkeeping, branchless: at count == freeze_step,
+            # snapshot nu into nu_fresh and derive scaling coefficients
+            at_freeze = count == freeze_step
+            nu_fresh = jax.tree.map(
+                lambda vf, v: jnp.where(at_freeze, v, vf), st.nu_fresh, nu)
+            rms = jax.tree.map(
+                lambda m: jnp.linalg.norm(m) / jnp.sqrt(jnp.float32(m.size)),
+                mu)
+            rms_leaves = jax.tree.leaves(rms)
+            united = sum(rms_leaves) / len(rms_leaves)
+            scaling = jax.tree.map(
+                lambda sc, r: jnp.where(at_freeze,
+                                        united / jnp.maximum(r, 1e-30), sc),
+                st.scaling_coeff, rms)
+            return (deltas, mu, nu, nu_fresh, coeff_freeze, st.last_factor,
+                    scaling, st.worker_error, st.server_error)
+
+        def compressed(op):
+            g, st = op
+            mu_last = st.mu
+            mu = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x, st.mu, g)
+            scaled = jax.tree.map(jnp.multiply, mu, st.scaling_coeff)
+            if axis_name is not None:
+                scaled, w_err, s_err = compressed_allreduce_tree(
+                    scaled, st.worker_error, st.server_error, axis_name)
+            else:
+                w_err, s_err = st.worker_error, st.server_error
+            mu = jax.tree.map(jnp.divide, scaled, st.scaling_coeff)
+            g_rec = jax.tree.map(
+                lambda m, ml: (m - ml * b1) / (1 - b1), mu, mu_last)
+            nu_fresh = jax.tree.map(
+                lambda vf, x: b2 * vf + (1 - b2) * x * x, st.nu_fresh,
+                g_rec)
+
+            def per_tensor(m, v, vf, p, cf, lf):
+                denom = jnp.sqrt(v) + eps
+                denom_real = jnp.sqrt(vf) + eps
+                prelim = m / denom
+                upd = prelim
+                factor = jnp.max(denom / denom_real)
+                if weight_decay > 0.0:
+                    upd = prelim + weight_decay * p.astype(jnp.float32)
+                    ratio = jnp.minimum(
+                        1.0, jnp.linalg.norm(prelim) /
+                        jnp.maximum(jnp.linalg.norm(upd), 1e-30))
+                    factor = factor * ratio + (1.0 - ratio)
+                factor = jnp.clip(factor, factor_min, factor_max)
+                factor = jnp.clip(factor, lf * (1.0 - factor_threshold),
+                                  lf * (1.0 + factor_threshold))
+                coeff = cf * factor
+                return (-lr * coeff * upd).astype(p.dtype), factor
+            out = jax.tree.map(per_tensor, mu, st.nu, nu_fresh, params,
+                               st.coeff_freeze, st.last_factor)
+            deltas = jax.tree.map(lambda o: o[0], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+            last_factor = jax.tree.map(
+                lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+            return (deltas, mu, st.nu, nu_fresh, st.coeff_freeze,
+                    last_factor, st.scaling_coeff, w_err, s_err)
+
+        (deltas, mu, nu, nu_fresh, coeff_freeze, last_factor, scaling,
+         w_err, s_err) = jax.lax.cond(frozen, compressed, warmup,
+                                      (grads, state))
+        return deltas, OnebitLambState(
+            count=count, mu=mu, nu=nu, nu_fresh=nu_fresh,
+            coeff_freeze=coeff_freeze, last_factor=last_factor,
+            scaling_coeff=scaling, worker_error=w_err, server_error=s_err)
 
     return Optimizer(init=init, update=update)

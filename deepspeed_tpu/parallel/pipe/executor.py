@@ -221,7 +221,6 @@ class PipelineEngine:
         act_mail: dict = {}                           # (stage, mb) -> act
         grad_mail: dict = {}                          # (stage, mb) -> ct
         grads = [None] * S
-        fwd_count = [0] * S
         load_count = [0] * S
         sent_act = [0] * S
         recv_act = [0] * S
@@ -264,7 +263,6 @@ class PipelineEngine:
                         self._act_sh[s])
             elif isinstance(cmd, ForwardPass):
                 buf = cmd.buffer_id
-                fwd_count[s] += 1
                 h = in_act[s][buf]
                 if s == S - 1:
                     loss, vjp = self._fwd[s](self.stage_params[s], h,

@@ -145,10 +145,14 @@ def checkpoint(function, *args):
             def spec(x):
                 # keep the partition_activations sharding in host memory
                 # too — replicating the stash would multiply host RAM by
-                # the device count
-                s = (_partition_spec(x)
-                     if cfg.partition_activations else None) or P()
-                return s
+                # the device count. Same mesh-axis guard as
+                # _constrain_saved: a mesh without the named axes must
+                # fall back to replicated, not crash at trace time.
+                if (not cfg.partition_activations or
+                        "seq" not in mesh.axis_names or
+                        "data" not in mesh.axis_names):
+                    return P()
+                return _partition_spec(x) or P()
 
             def to_kind(x, kind):
                 if not hasattr(x, "ndim"):

@@ -89,9 +89,40 @@ class DeepSpeedEngine:
         self.train_batch_size = config.train_batch_size
 
         opt_cfg = config.optimizer
+        # 1-bit optimizer family: when the mesh has a real data-parallel
+        # extent, the whole train step drops into shard_map over the DP
+        # axes so the optimizer's error-feedback sign compression runs on
+        # the actual gradient exchange (reference runtime/comm/nccl.py:51
+        # over DCN) — not just in unit tests. GSPMD would otherwise insert
+        # an exact allreduce before the optimizer ever saw the grads.
+        self._onebit_axes: tuple = ()
         if optimizer is None:
-            optimizer = build_optimizer(opt_cfg.type if opt_cfg else "AdamW",
-                                        opt_cfg.params if opt_cfg else {})
+            opt_type = (opt_cfg.type if opt_cfg else "AdamW")
+            opt_params = dict(opt_cfg.params) if opt_cfg else {}
+            key = opt_type.lower().replace("_", "").replace("deepspeed", "")
+            if key in ("onebitadam", "zerooneadam", "onebitlamb"):
+                axes = tuple(a for a in ("data", "fsdp")
+                             if self.mesh.shape[a] > 1)
+                if axes:
+                    if config.zero_config.stage != 0:
+                        raise ValueError(
+                            "1-bit optimizers need replicated parameters "
+                            "(zero_optimization.stage=0) for the "
+                            "compressed DP exchange — the reference has "
+                            "the same restriction")
+                    if config.fp16.enabled:
+                        raise NotImplementedError(
+                            "fp16 dynamic loss scaling is not wired into "
+                            "the compressed-DP step; use bf16")
+                    for ax in ("tensor", "seq", "pipe"):
+                        if self.mesh.shape[ax] > 1:
+                            raise NotImplementedError(
+                                f"compressed-DP step composes only with "
+                                f"pure data parallelism (mesh {ax}="
+                                f"{self.mesh.shape[ax]})")
+                    opt_params["axis_name"] = axes
+                    self._onebit_axes = axes
+            optimizer = build_optimizer(opt_type, opt_params)
         self.optimizer = optimizer
         self.lr_scheduler = lr_scheduler or build_schedule(
             config.scheduler, opt_cfg.params if opt_cfg else None)
@@ -423,7 +454,98 @@ class DeepSpeedEngine:
 
         return step_fn
 
+    def _make_compressed_step_fn(self, batch):
+        """Whole-step shard_map over the DP axes for the 1-bit optimizer
+        family: each worker computes LOCAL gradients from its batch shard
+        (no GSPMD allreduce — the batch never crosses workers), and the
+        optimizer's own pmean / error-feedback sign-compressed exchange is
+        the only gradient communication (reference onebit design: engine
+        backward-allreduce disabled, optimizer owns comm).
+
+        Semantics notes vs the exact path: gradient clipping acts on the
+        per-worker local gradient (a global norm cannot be formed without
+        the exact exchange the algorithm exists to avoid) and the reported
+        grad_norm is the worker mean. Model code must not place sharding
+        constraints over the DP axes (they are manual inside this region).
+        """
+        gas = self.gas
+        loss_fn = self.loss_fn
+        clip = self.config.gradient_clipping
+        optimizer = self.optimizer
+        schedule = self.lr_scheduler
+        mixed = self.mixed_precision
+        dtype = self.compute_dtype
+        axes = self._onebit_axes
+
+        def local_step(state: TrainState, batch, rng):
+            params = state.params
+
+            def micro(mb, r):
+                loss, grads = jax.value_and_grad(
+                    lambda p: loss_fn(p, mb, r).astype(jnp.float32))(params)
+                return loss, cast_tree(grads, jnp.float32)
+
+            if gas > 1:
+                mbs = jax.tree.map(
+                    lambda x: x.reshape((gas, x.shape[0] // gas)
+                                        + x.shape[1:]), batch)
+                rngs = jax.random.split(rng, gas)
+
+                def body(carry, mb_r):
+                    acc, lsum = carry
+                    loss, grads = micro(*mb_r)
+                    return (jax.tree.map(jnp.add, acc, grads),
+                            lsum + loss), None
+                zero = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (grads, lsum), _ = jax.lax.scan(
+                    body, (zero, jnp.float32(0.0)), (mbs, rngs))
+                grads = jax.tree.map(lambda g: g / gas, grads)
+                mean_loss = lsum / gas
+            else:
+                mean_loss, grads = micro(batch, rng)
+
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                 for g in jax.tree.leaves(grads)))
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+
+            lr = schedule(state.step)
+            master = state.master if mixed else state.params
+            updates, new_opt = optimizer.update(
+                grads, state.opt_state, master, lr)
+            new_master = jax.tree.map(jnp.add, master, updates)
+            new_params = (cast_tree(new_master, dtype) if mixed
+                          else new_master)
+            new_state = state.replace(
+                step=state.step + 1, params=new_params,
+                master=new_master if mixed else None,
+                opt_state=new_opt, loss_scale=state.loss_scale)
+            metrics = {"loss": jax.lax.pmean(mean_loss, axes),
+                       "grad_norm": jax.lax.pmean(gnorm, axes),
+                       "lr": lr,
+                       "loss_scale": jnp.float32(1.0),
+                       "skipped": jnp.bool_(False)}
+            return new_state, metrics
+
+        state_specs = jax.tree.map(lambda _: P(), self.state)
+        batch_specs = jax.tree.map(lambda _: P(DATA_AXES), batch)
+        metric_specs = {k: P() for k in ("loss", "grad_norm", "lr",
+                                         "loss_scale", "skipped")}
+        return jax.shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(state_specs, batch_specs, P()),
+            out_specs=(state_specs, metric_specs),
+            check_vma=False)
+
     def _compile_step(self, batch):
+        if self._onebit_axes:
+            self._eager_param_staging = False
+            self._step_fn = jax.jit(
+                self._make_compressed_step_fn(batch),
+                donate_argnums=(0,))
+            return
         batch_sh = self._batch_sharding(batch)
         in_sh = self._state_shardings
         out_sh = self._state_shardings
@@ -784,8 +906,28 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         from deepspeed_tpu.runtime.checkpointing import save_checkpoint
         self._ensure_params_resident()
-        return save_checkpoint(self, save_dir, tag=tag,
-                               client_state=client_state or {})
+        prev_state = None
+        opt = self.state.opt_state
+        if self._onebit_axes and hasattr(opt, "worker_error"):
+            # Under the compressed-DP shard_map step the error-feedback
+            # buffers are physically PER-WORKER even though their out_spec
+            # claims replication (check_vma=False) — host materialization
+            # would silently persist only worker 0's residuals and feed
+            # them to every worker on restore. They are transient
+            # compensation, so checkpoint zeros instead: the cost is one
+            # uncompensated exchange after resume.
+            prev_state = self.state
+            self.state = self.state.replace(opt_state=opt.replace(
+                worker_error=jax.tree.map(jnp.zeros_like,
+                                          opt.worker_error),
+                server_error=jax.tree.map(jnp.zeros_like,
+                                          opt.server_error)))
+        try:
+            return save_checkpoint(self, save_dir, tag=tag,
+                                   client_state=client_state or {})
+        finally:
+            if prev_state is not None:
+                self.state = prev_state
 
     def load_checkpoint(self, load_dir, tag=None, **kwargs):
         from deepspeed_tpu.runtime.checkpointing import load_checkpoint
