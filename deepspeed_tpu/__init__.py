@@ -27,7 +27,14 @@ def init_distributed(dist_backend="xla", **kwargs):
 
 
 def init_inference(model=None, config=None, **kwargs):
-    """deepspeed.init_inference analog (deepspeed/__init__.py:233)."""
+    """deepspeed.init_inference analog (deepspeed/__init__.py:233).
+
+    ``model`` may be a live HF torch model, an
+    ``(InferenceTransformerConfig, params)`` pair, or a **path to an HF
+    checkpoint directory** — the file-based route loads safetensors /
+    sharded / torch-pickle weights straight into the fused tree without
+    instantiating a torch model (reference ``state_dict_factory.py`` /
+    ``module_inject/load_checkpoint.py``)."""
     try:
         from deepspeed_tpu.inference.engine import InferenceEngine
         from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
@@ -40,6 +47,10 @@ def init_inference(model=None, config=None, **kwargs):
         merged = dict(config)
         merged.update(kwargs)
         config = DeepSpeedInferenceConfig(**merged)
+    if isinstance(model, str):
+        from deepspeed_tpu.module_inject.state_dict_loader import (
+            load_inference_checkpoint)
+        model = load_inference_checkpoint(model, dtype=config.jnp_dtype)
     return InferenceEngine(model, config)
 
 

@@ -3,12 +3,26 @@
 Analog of ``deepspeed/inference/`` (engine.py, config.py); the kernel side
 lives in ``deepspeed_tpu/model_implementations`` and
 ``deepspeed_tpu/ops/pallas``.
+
+Exports resolve lazily (PEP 562): ``model_implementations.transformer``
+imports ``inference.kv_cache``, and an eager ``engine`` import here would
+close an import cycle for any caller that touches the policy table before
+the inference package.
 """
 from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
                                             DeepSpeedMoEConfig,
                                             DeepSpeedTPConfig)
-from deepspeed_tpu.inference.engine import InferenceEngine
-from deepspeed_tpu.inference.kv_cache import KVCache, init_cache
 
 __all__ = ["DeepSpeedInferenceConfig", "DeepSpeedTPConfig",
            "DeepSpeedMoEConfig", "InferenceEngine", "KVCache", "init_cache"]
+
+_LAZY = {"InferenceEngine": "deepspeed_tpu.inference.engine",
+         "KVCache": "deepspeed_tpu.inference.kv_cache",
+         "init_cache": "deepspeed_tpu.inference.kv_cache"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

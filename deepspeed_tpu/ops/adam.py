@@ -214,10 +214,20 @@ def _onebit(which: str, p):
     return getattr(onebit, which)(**p)
 
 
+def normalize_optimizer_key(name: str) -> str:
+    """Canonical registry key for a JSON optimizer type (shared with the
+    engine's 1-bit-family detection so the two cannot desync)."""
+    return name.lower().replace("_", "").replace("deepspeed", "")
+
+
+ONEBIT_OPTIMIZER_KEYS = frozenset(
+    {"onebitadam", "zerooneadam", "onebitlamb"})
+
+
 def build_optimizer(name: str, params: Optional[dict] = None) -> Optimizer:
     """Build from the JSON optimizer section (engine._configure_basic_optimizer
     analog, runtime/engine.py:1314)."""
-    key = name.lower().replace("_", "").replace("deepspeed", "")
+    key = normalize_optimizer_key(name)
     if key not in OPTIMIZER_REGISTRY:
         raise ValueError(f"unknown optimizer {name!r}; "
                          f"supported: {sorted(OPTIMIZER_REGISTRY)}")

@@ -39,12 +39,32 @@ class OnebitAdamState:
     server_error: any
 
 
+def _check_reference_extras(amsgrad=False, max_grad_norm=0.0,
+                            eps_inside_sqrt=False):
+    """Reference-JSON compatibility: these keys are legal in upstream
+    onebit configs; accept the supported values, refuse the rest loudly
+    (the reference itself rejects amsgrad)."""
+    if amsgrad:
+        raise ValueError("amsgrad is not supported by the 1-bit optimizer "
+                         "family (same restriction as the reference)")
+    if max_grad_norm:
+        raise NotImplementedError(
+            "max_grad_norm inside the optimizer is not supported; use the "
+            "engine's gradient_clipping config instead")
+    if eps_inside_sqrt:
+        raise NotImplementedError("eps_inside_sqrt=True is not supported")
+
+
 def onebit_adam(betas=(0.9, 0.999), eps: float = 1e-8,
                 weight_decay: float = 0.0, freeze_step: int = 100,
                 axis_name: Optional[str] = None,
+                bias_correction: bool = True,
+                amsgrad: bool = False, max_grad_norm: float = 0.0,
+                eps_inside_sqrt: bool = False,
                 cuda_aware: bool = False,
                 comm_backend_name: str = "xla") -> Optimizer:
     b1, b2 = betas
+    _check_reference_extras(amsgrad, max_grad_norm, eps_inside_sqrt)
 
     def init(params):
         w_err, s_err = init_error_feedback(
@@ -123,6 +143,9 @@ def zero_one_adam(betas=(0.9, 0.999), eps: float = 1e-8,
                   local_step_scaler: int = 32678,
                   local_step_clipper: int = 16,
                   axis_name: Optional[str] = None,
+                  bias_correction: bool = True,
+                  amsgrad: bool = False, max_grad_norm: float = 0.0,
+                  eps_inside_sqrt: bool = False,
                   cuda_aware: bool = False,
                   comm_backend_name: str = "xla") -> Optimizer:
     """0/1 Adam (arXiv:2202.06009; reference runtime/fp16/onebit/zoadam.py).
@@ -149,6 +172,7 @@ def zero_one_adam(betas=(0.9, 0.999), eps: float = 1e-8,
     behavior is exact.
     """
     b1, b2 = betas
+    _check_reference_extras(amsgrad, max_grad_norm, eps_inside_sqrt)
 
     def init(params):
         zeros = _tree_zeros_like(params)
@@ -261,7 +285,11 @@ def zero_one_adam(betas=(0.9, 0.999), eps: float = 1e-8,
                 deltas = jax.tree.map(
                     lambda d, a, ap: (d - a + ap),
                     delta_local, accum, applied)
-                new_mu = jax.tree.map(lambda s_: -s_ / lrs, synced)
+                # lrs == 0 (schedule decayed to zero across the window)
+                # means nothing was applied and synced == 0: re-seed the
+                # momentum to 0 rather than 0/0 = NaN
+                safe_lrs = jnp.where(lrs > 0, lrs, 1.0)
+                new_mu = jax.tree.map(lambda s_: -s_ / safe_lrs, synced)
                 lc = st.local_counter + 1
                 grow = lc >= local_step_scaler
                 li = jnp.where(
@@ -314,6 +342,9 @@ def onebit_lamb(betas=(0.9, 0.999), eps: float = 1e-8,
                 coeff_beta: float = 0.9, factor_max: float = 4.0,
                 factor_min: float = 0.5, factor_threshold: float = 0.1,
                 axis_name: Optional[str] = None,
+                bias_correction: bool = True,
+                amsgrad: bool = False, max_grad_norm: float = 0.0,
+                eps_inside_sqrt: bool = False,
                 cuda_aware: bool = False,
                 comm_backend_name: str = "xla") -> Optimizer:
     """1-bit LAMB (reference runtime/fp16/onebit/lamb.py).
@@ -334,6 +365,7 @@ def onebit_lamb(betas=(0.9, 0.999), eps: float = 1e-8,
     the reference update rule.
     """
     b1, b2 = betas
+    _check_reference_extras(amsgrad, max_grad_norm, eps_inside_sqrt)
 
     def _tensor_scalar_tree(params, val):
         return jax.tree.map(lambda _: jnp.asarray(val, jnp.float32), params)

@@ -97,10 +97,11 @@ class DeepSpeedEngine:
         # an exact allreduce before the optimizer ever saw the grads.
         self._onebit_axes: tuple = ()
         if optimizer is None:
+            from deepspeed_tpu.ops.adam import (ONEBIT_OPTIMIZER_KEYS,
+                                                normalize_optimizer_key)
             opt_type = (opt_cfg.type if opt_cfg else "AdamW")
             opt_params = dict(opt_cfg.params) if opt_cfg else {}
-            key = opt_type.lower().replace("_", "").replace("deepspeed", "")
-            if key in ("onebitadam", "zerooneadam", "onebitlamb"):
+            if normalize_optimizer_key(opt_type) in ONEBIT_OPTIMIZER_KEYS:
                 axes = tuple(a for a in ("data", "fsdp")
                              if self.mesh.shape[a] > 1)
                 if axes:
@@ -477,8 +478,17 @@ class DeepSpeedEngine:
         dtype = self.compute_dtype
         axes = self._onebit_axes
 
+        axis_sizes = {a: self.mesh.shape[a] for a in axes}
+
         def local_step(state: TrainState, batch, rng):
             params = state.params
+            # distinct dropout/randomness per worker: the exact GSPMD path
+            # draws one mask over the global batch, so the local shard must
+            # not repeat the same rng stream on every worker
+            widx = jnp.int32(0)
+            for a in axes:
+                widx = widx * axis_sizes[a] + jax.lax.axis_index(a)
+            rng = jax.random.fold_in(rng, widx)
 
             def micro(mb, r):
                 loss, grads = jax.value_and_grad(
