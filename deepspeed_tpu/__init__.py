@@ -50,7 +50,10 @@ def init_inference(model=None, config=None, **kwargs):
     if isinstance(model, str):
         from deepspeed_tpu.module_inject.state_dict_loader import (
             load_inference_checkpoint)
-        model = load_inference_checkpoint(model, dtype=config.jnp_dtype)
+        import jax.numpy as _jnp
+        load_dtype = (_jnp.bfloat16 if config.jnp_dtype == _jnp.int8
+                      else config.jnp_dtype)
+        model = load_inference_checkpoint(model, dtype=load_dtype)
     return InferenceEngine(model, config)
 
 

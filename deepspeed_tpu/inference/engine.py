@@ -47,6 +47,14 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
                  mesh: Optional[Mesh] = None):
         self.config = config or DeepSpeedInferenceConfig()
+        # dtype="int8" means WEIGHT STORAGE (reference GroupQuantizer):
+        # activations run bf16, weights quantize to int8+scales at
+        # placement time — resolved before conversion so the policy table
+        # never casts weights to an integer dtype
+        int8 = self.config.jnp_dtype == jnp.int8  # "int8"/"torch.int8"
+        self._weight_quant = int8 or self.config.quant.enabled
+        self._act_dtype = (jnp.bfloat16 if int8
+                           else self.config.jnp_dtype)
         if isinstance(model, tuple):
             self.model_config, params = model
         elif isinstance(model, InferenceTransformerConfig):
@@ -62,11 +70,11 @@ class InferenceEngine:
                     " (policy table); pass (InferenceTransformerConfig, "
                     "params) instead") from e
             self.model_config, params = convert_hf_model(
-                model, dtype=self.config.jnp_dtype)
+                model, dtype=self._act_dtype)
         # engine dtype wins over the model config's (one source of truth):
         # activations are cast to model_config.dtype inside the forward
         self.model_config = dataclasses.replace(self.model_config,
-                                                dtype=self.config.jnp_dtype)
+                                                dtype=self._act_dtype)
         self.mesh = mesh or self._build_mesh()
         if self.mesh is not None:
             tp = self.config.tp_size
@@ -78,46 +86,78 @@ class InferenceEngine:
                     f"{self.model_config.kv_heads}")
         self.params = self._place_params(params)
         self._prefill_jit = jax.jit(
-            functools.partial(prefill, cfg=self.model_config),
+            functools.partial(prefill, cfg=self.model_config,
+                              mesh=self.mesh),
             donate_argnames=("cache",))
         self._decode_jit = jax.jit(
-            functools.partial(decode_step, cfg=self.model_config),
+            functools.partial(decode_step, cfg=self.model_config,
+                              mesh=self.mesh),
             donate_argnames=("cache",))
         self._encoder_jit = jax.jit(
-            functools.partial(encoder_forward, cfg=self.model_config))
+            functools.partial(encoder_forward, cfg=self.model_config,
+                              mesh=self.mesh))
         self._causal_fwd_jit = jax.jit(
-            functools.partial(causal_forward, cfg=self.model_config))
+            functools.partial(causal_forward, cfg=self.model_config,
+                              mesh=self.mesh))
         self._gen_loops: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------ setup
 
     def _build_mesh(self) -> Optional[Mesh]:
         tp = self.config.tp_size
-        if tp <= 1:
+        ep = (self.config.moe.ep_size
+              if self.model_config.num_experts > 0 else 1)
+        if tp <= 1 and ep <= 1:
             return None
         devs = jax.devices()
-        if len(devs) < tp:
-            raise ValueError(f"tp_size={tp} but only {len(devs)} devices")
-        return Mesh(np.asarray(devs[:tp]).reshape(tp), ("tensor",))
+        if len(devs) < tp * ep:
+            raise ValueError(f"tp_size={tp} * ep_size={ep} but only "
+                             f"{len(devs)} devices")
+        # expert outermost (EP all-to-alls are per-MoE-layer; TP
+        # allreduces are per-GEMM and want the innermost ICI)
+        return Mesh(np.asarray(devs[:ep * tp]).reshape(ep, tp),
+                    ("expert", "tensor"))
 
     def _place_params(self, params):
-        dtype = self.config.jnp_dtype
+        dtype = self._act_dtype
+
+        def cast(x):
+            # pre-quantized {"q","scale"} nodes pass through untouched —
+            # their f32 scales must not downcast to the activation dtype
+            if isinstance(x, dict) and "q" in x:
+                return x
+            x = jnp.asarray(x)
+            return x.astype(dtype) if jnp.issubdtype(
+                x.dtype, jnp.floating) else x
         params = jax.tree.map(
-            lambda x: x.astype(dtype) if jnp.issubdtype(
-                jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x),
-            params)
+            cast, params,
+            is_leaf=lambda x: isinstance(x, dict) and "q" in x)
+        if self._weight_quant:
+            # AFTER the activation-dtype cast so scales stay f32
+            from deepspeed_tpu.module_inject.quantize import GroupQuantizer
+            wq = self.config.quant.weight
+            params = GroupQuantizer(
+                num_bits=wq.num_bits,
+                group_size=wq.group_size).quantize_tree(params)
         if self.mesh is None:
             return params
         specs = tp_param_specs(params)
+        axes = set(self.mesh.axis_names)
+
+        def filter_spec(sp):
+            # drop mesh axes this engine's mesh does not have (e.g. expert
+            # specs on a TP-only mesh)
+            return P(*((a if a in axes else None) for a in sp))
         return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            lambda x, sp: jax.device_put(
+                x, NamedSharding(self.mesh, filter_spec(sp))),
             params, specs)
 
     def _make_cache(self, batch: int, max_seq: int) -> KVCache:
         cache = init_cache(self.model_config.n_layer, batch, max_seq,
                            self.model_config.kv_heads,
                            self.model_config.head_dim,
-                           dtype=self.config.jnp_dtype)
+                           dtype=self._act_dtype)
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, None, None, "tensor", None))
             cache = cache.replace(

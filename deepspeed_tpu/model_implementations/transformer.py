@@ -62,6 +62,12 @@ class InferenceTransformerConfig:
     # per-layer sliding-window size (None = global) — GPT-Neo alternates
     # global/local(256); length n_layer when set
     local_windows: Optional[tuple] = None
+    # MoE FFN (reference ops/transformer/inference/moe_inference.py):
+    # layers in ``moe_layers`` replace their MLP with num_experts experts
+    # behind a top-k gate; experts shard over the ``expert`` mesh axis
+    num_experts: int = 0
+    moe_layers: Optional[tuple] = None       # None + num_experts>0 → all
+    moe_top_k: int = 1                       # inference default: top-1
     dtype: Any = jnp.bfloat16
 
     @property
@@ -75,6 +81,11 @@ class InferenceTransformerConfig:
     @property
     def ffn(self) -> int:
         return self.intermediate_size or 4 * self.n_embd
+
+    def is_moe_layer(self, idx: int) -> bool:
+        if self.num_experts <= 0:
+            return False
+        return self.moe_layers is None or idx in self.moe_layers
 
     @property
     def scale(self) -> float:
@@ -130,6 +141,22 @@ def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
             layer["ln2"] = {"scale": jnp.ones((E,), dt),
                             "bias": jnp.zeros((E,), dt)}
         params["layers"].append(layer)
+    # MoE layers replace their MLP with a gate + stacked experts
+    for i, layer in enumerate(params["layers"]):
+        if cfg.is_moe_layer(i):
+            X = cfg.num_experts
+            k = jax.random.fold_in(rng, 1000 + i)
+            ks = jax.random.split(k, 3)
+            del layer["mlp"]
+            layer["moe"] = {
+                "gate": dense(ks[0], (E, X), E),
+                "experts": {
+                    "wi": dense(ks[1], (X, E, F), E),
+                    "bi": jnp.zeros((X, F), dt),
+                    "wo": dense(ks[2], (X, F, E), F),
+                    "bo": jnp.zeros((X, E), dt),
+                },
+            }
     return params
 
 
@@ -141,6 +168,17 @@ def tp_param_specs(params: Dict) -> Dict:
     reference's LinearAllreduce does by hand. Embeddings/LN replicated
     (matches reference AutoTP scope)."""
     def spec_for(path: str) -> P:
+        # int8 leaves: the q payload shards like the weight it replaces;
+        # the per-dim0-group scale [d0, 1, ...] follows the weight's dim-0
+        # sharding (so a row-parallel weight keeps its scales local)
+        if path.endswith(".q"):
+            return spec_for(path[:-2])
+        if path.endswith(".scale"):
+            # quant scales are [*leading dims, 1]: follow the weight's
+            # leading-dim sharding. LayerNorm .scale paths recurse to P()
+            # and come out replicated, which is already correct for them.
+            base = tuple(spec_for(path[:-len(".scale")]))
+            return P(*base[:-1], None) if base else P()
         if path.endswith(("attn.wq", "attn.wk", "attn.wv")):
             return P(None, "tensor", None)
         if path.endswith(("attn.bq", "attn.bk", "attn.bv")):
@@ -153,6 +191,16 @@ def tp_param_specs(params: Dict) -> Dict:
             return P("tensor")
         if path.endswith("mlp.wo"):
             return P("tensor", None)
+        # MoE experts: expert-parallel over dim 0, Megatron TP within
+        # (reference moe_inference.py EP groups + per-expert TP slicing)
+        if path.endswith("experts.wi"):
+            return P("expert", None, "tensor")
+        if path.endswith("experts.bi"):
+            return P("expert", "tensor")
+        if path.endswith("experts.wo"):
+            return P("expert", "tensor", None)
+        if path.endswith("experts.bo"):
+            return P("expert", None)
         return P()
 
     def walk(tree, path=""):
@@ -167,6 +215,18 @@ def tp_param_specs(params: Dict) -> Dict:
 
 
 # ---------------------------------------------------------------- math
+
+def _w(w, dtype):
+    """Resolve a weight leaf that may be stored as TRUE int8: a dict
+    ``{"q": int8 [orig shape], "scale": f32 [d0, 1, ...]}`` with per-group
+    scales along dim 0 (module_inject/quantize.py GroupQuantizer). The
+    dequant multiply fuses into the consuming matmul under XLA, so HBM
+    holds int8 + scales only (the reference stores int8 + per-group scales
+    the same way, replace_module.py:140-199)."""
+    if isinstance(w, dict) and "q" in w:
+        return (w["q"].astype(dtype) * w["scale"].astype(dtype))
+    return w.astype(dtype) if w.dtype != dtype else w
+
 
 def _layer_norm(x, p, eps):
     xf = x.astype(jnp.float32)
@@ -315,9 +375,10 @@ def _decode_attention(q, k_cache, v_cache, live,
 
 def _qkv(x, a, cfg, positions):
     """x [..., E] → q [..., H, D], k/v [..., KH, D] with rotary applied."""
-    q = jnp.einsum("...e,ehd->...hd", x, a["wq"]) + a["bq"]
-    k = jnp.einsum("...e,ehd->...hd", x, a["wk"]) + a["bk"]
-    v = jnp.einsum("...e,ehd->...hd", x, a["wv"]) + a["bv"]
+    dt = x.dtype
+    q = jnp.einsum("...e,ehd->...hd", x, _w(a["wq"], dt)) + a["bq"]
+    k = jnp.einsum("...e,ehd->...hd", x, _w(a["wk"], dt)) + a["bk"]
+    v = jnp.einsum("...e,ehd->...hd", x, _w(a["wv"], dt)) + a["bv"]
     if cfg.positional == "rotary":
         q = apply_rotary(q, positions, cfg.rotary_dim, cfg.rotary_base,
                          cfg.rotary_interleaved)
@@ -327,12 +388,71 @@ def _qkv(x, a, cfg, positions):
 
 
 def _mlp(x, m, cfg):
-    h = _act((x @ m["wi"] + m["bi"]).astype(jnp.float32), cfg.activation)
-    return h.astype(x.dtype) @ m["wo"] + m["bo"]
+    h = _act((x @ _w(m["wi"], x.dtype) + m["bi"]).astype(jnp.float32),
+             cfg.activation)
+    return h.astype(x.dtype) @ _w(m["wo"], x.dtype) + m["bo"]
+
+
+def _moe_mlp(x, moe, cfg, mesh=None):
+    """MoE FFN (reference moe_inference.py: gate → einsum dispatch →
+    all-to-all → expert FFN → all-to-all → combine). Dense dispatch over
+    ``[X, S, ...]`` with a sharding constraint on the expert dim: when the
+    mesh has an ``expert`` axis, XLA lowers the dispatch/combine einsums to
+    the all-to-all pair the reference issues by hand
+    (``einsum_sec_sm_ecm`` + ``_AllToAll``, moe_inference.py:1-466).
+    Inference gating is exact top-k (no capacity drop: serving must not
+    silently zero tokens the way capacity-bound training may)."""
+    dt = x.dtype
+    shape = x.shape
+    t = x.reshape(-1, shape[-1])                         # [S, E]
+    logits = (t @ _w(moe["gate"], dt)).astype(jnp.float32)   # [S, X]
+    probs = jax.nn.softmax(logits, axis=-1)
+    k = min(cfg.moe_top_k, cfg.num_experts)
+    top_p, top_i = jax.lax.top_k(probs, k)               # [S, k]
+    # renormalized combine weights over the selected experts (top-2 norm
+    # matches sharded_moe.py's second-place renormalization)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    dispatch = jnp.sum(jax.nn.one_hot(top_i, cfg.num_experts, dtype=dt) *
+                       top_p[..., None].astype(dt), axis=1)   # [S, X]
+    sel = jnp.sum(jax.nn.one_hot(top_i, cfg.num_experts, dtype=dt),
+                  axis=1)                                 # 0/1 [S, X]
+    ex = moe["experts"]
+    xin = jnp.einsum("sx,se->xse", sel, t)                # [X, S, E]
+    xin = _maybe_expert_constrain(xin, mesh)
+    h = _act(jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt)) +
+             ex["bi"][:, None, :], cfg.activation).astype(dt)
+    out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt)) +         ex["bo"][:, None, :]
+    out = _maybe_expert_constrain(out, mesh)
+    combined = jnp.einsum("sx,xse->se", dispatch, out)    # combine
+    return combined.reshape(shape)
+
+
+def _maybe_expert_constrain(t, mesh):
+    """Pin the leading expert dim to the ``expert`` mesh axis when one is
+    live — this is what turns dispatch/combine into EP all-to-alls. The
+    mesh is the CALLER's (the inference engine's own EP×TP mesh, threaded
+    through the forward entry points; falls back to the training global
+    mesh so shard_map-free training setups compose)."""
+    if mesh is None:
+        from deepspeed_tpu.comm.mesh import get_global_mesh, has_global_mesh
+        mesh = get_global_mesh() if has_global_mesh() else None
+    if (mesh is not None and "expert" in mesh.axis_names and
+            mesh.shape["expert"] > 1):
+        return jax.lax.with_sharding_constraint(
+            t, jax.sharding.NamedSharding(
+                mesh, P("expert", *([None] * (t.ndim - 1)))))
+    return t
+
+
+def _ffn(x, layer, cfg, mesh=None):
+    """MLP or MoE, by layer schema."""
+    if "moe" in layer:
+        return _moe_mlp(x, layer["moe"], cfg, mesh)
+    return _mlp(x, layer["mlp"], cfg)
 
 
 def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
-               causal=True, key_mask=None):
+               causal=True, key_mask=None, mesh=None):
     """Full-sequence block (prefill / encoder). x [B, T, E]."""
     a = layer["attn"]
     ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
@@ -343,26 +463,27 @@ def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
     window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
     attn = _prefill_attention(q, k, v, cfg, causal=causal, key_mask=key_mask,
                               window=window)
-    attn_out = jnp.einsum("...hd,hde->...e", attn, a["wo"]) + a["bo"]
+    attn_out = jnp.einsum("...hd,hde->...e", attn,
+                          _w(a["wo"], x.dtype)) + a["bo"]
     if cfg.parallel_attn_mlp:
         # GPT-J/NeoX: x + attn(ln1(x)) + mlp(ln(x)); GPT-J shares ln1
         ln2 = layer.get("ln2")
         mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)
                   if ln2 is not None else ln1_out)
-        out = x + attn_out + _mlp(mlp_in, layer["mlp"], cfg)
+        out = x + attn_out + _ffn(mlp_in, layer, cfg, mesh)
         return out, cache
     if cfg.pre_layer_norm:
         x = x + attn_out
-        out = x + _mlp(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
-                       layer["mlp"], cfg)
+        out = x + _ffn(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
+                       layer, cfg, mesh)
     else:  # BERT post-LN
         x = _layer_norm(x + attn_out, layer["ln1"], cfg.layer_norm_eps)
-        out = _layer_norm(x + _mlp(x, layer["mlp"], cfg),
+        out = _layer_norm(x + _ffn(x, layer, cfg, mesh),
                           layer["ln2"], cfg.layer_norm_eps)
     return out, cache
 
 
-def _block_decode(x, layer, cfg, cache, layer_idx):
+def _block_decode(x, layer, cfg, cache, layer_idx, mesh=None):
     """Single-token block. x [B, E]; appends to cache."""
     a = layer["attn"]
     ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
@@ -373,18 +494,19 @@ def _block_decode(x, layer, cfg, cache, layer_idx):
     window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
     attn = _decode_attention(q, cache.k[layer_idx], cache.v[layer_idx],
                              cache.lengths + 1, cfg, window=window)
-    attn_out = jnp.einsum("bhd,hde->be", attn, a["wo"]) + a["bo"]
+    attn_out = jnp.einsum("bhd,hde->be", attn,
+                          _w(a["wo"], x.dtype)) + a["bo"]
     if cfg.parallel_attn_mlp:
         ln2 = layer.get("ln2")
         mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)
                   if ln2 is not None else ln1_out)
-        return x + attn_out + _mlp(mlp_in, layer["mlp"], cfg), cache
+        return x + attn_out + _ffn(mlp_in, layer, cfg, mesh), cache
     if cfg.pre_layer_norm:
         x = x + attn_out
-        return x + _mlp(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
-                        layer["mlp"], cfg), cache
+        return x + _ffn(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
+                        layer, cfg, mesh), cache
     x = _layer_norm(x + attn_out, layer["ln1"], cfg.layer_norm_eps)
-    return _layer_norm(x + _mlp(x, layer["mlp"], cfg), layer["ln2"],
+    return _layer_norm(x + _ffn(x, layer, cfg, mesh), layer["ln2"],
                        cfg.layer_norm_eps), cache
 
 
@@ -411,7 +533,8 @@ def _logits(params, cfg, x):
     return out
 
 
-def _causal_trunk(params, cfg, input_ids, lengths, cache, key_mask=None):
+def _causal_trunk(params, cfg, input_ids, lengths, cache, key_mask=None,
+                  mesh=None):
     """Shared causal forward trunk: embed → blocks → final LN. ``prefill``
     and ``causal_forward`` both run through here so full-sequence scoring
     can never diverge from generation."""
@@ -420,33 +543,34 @@ def _causal_trunk(params, cfg, input_ids, lengths, cache, key_mask=None):
     x = _embed(params, cfg, input_ids, positions)
     for i, layer in enumerate(params["layers"]):
         x, cache = _block_seq(x, layer, cfg, positions, lengths, cache, i,
-                              causal=True, key_mask=key_mask)
+                              causal=True, key_mask=key_mask, mesh=mesh)
     return _layer_norm(x, params["ln_f"], cfg.layer_norm_eps), cache
 
 
 def prefill(params, cfg: InferenceTransformerConfig, input_ids, lengths,
-            cache: KVCache):
+            cache: KVCache, mesh=None):
     """Run the right-padded prompt ``[B, T]`` through the model, populating
     the cache. Returns (next-token logits ``[B, V]``, cache)."""
-    x, cache = _causal_trunk(params, cfg, input_ids, lengths, cache)
+    x, cache = _causal_trunk(params, cfg, input_ids, lengths, cache,
+                             mesh=mesh)
     # logits at the last live token of each row
     last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)[:, 0]
     return _logits(params, cfg, last), cache
 
 
 def decode_step(params, cfg: InferenceTransformerConfig, tokens,
-                cache: KVCache):
+                cache: KVCache, mesh=None):
     """One generation step: ``tokens [B]`` int32 → (logits [B, V], cache).
     Appends k/v for the new token and advances lengths."""
     x = _embed(params, cfg, tokens[:, None], cache.lengths[:, None])[:, 0]
     for i, layer in enumerate(params["layers"]):
-        x, cache = _block_decode(x, layer, cfg, cache, i)
+        x, cache = _block_decode(x, layer, cfg, cache, i, mesh)
     x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
     return _logits(params, cfg, x), advance(cache)
 
 
 def causal_forward(params, cfg: InferenceTransformerConfig, input_ids,
-                   attention_mask=None):
+                   attention_mask=None, mesh=None):
     """Full-sequence logits ``[B, T, V]`` for causal models — the shape the
     reference ``InferenceEngine.forward`` returns (inference/engine.py:495),
     so scoring/perplexity loops indexing ``logits[:, i]`` port unchanged.
@@ -454,12 +578,12 @@ def causal_forward(params, cfg: InferenceTransformerConfig, input_ids,
     are not scored against pad context. No cache; ``generate`` keeps the
     last-token fast path."""
     x, _ = _causal_trunk(params, cfg, input_ids, None, None,
-                         key_mask=attention_mask)
+                         key_mask=attention_mask, mesh=mesh)
     return _logits(params, cfg, x)
 
 
 def encoder_forward(params, cfg: InferenceTransformerConfig, input_ids,
-                    attention_mask=None, token_type_ids=None):
+                    attention_mask=None, token_type_ids=None, mesh=None):
     """Bidirectional encoder forward (BERT/DistilBERT policies). Returns
     final hidden states ``[B, T, E]``."""
     B, T = input_ids.shape
@@ -470,7 +594,7 @@ def encoder_forward(params, cfg: InferenceTransformerConfig, input_ids,
     lengths = jnp.sum(mask, -1).astype(jnp.int32)
     for i, layer in enumerate(params["layers"]):
         x, _ = _block_seq(x, layer, cfg, positions, lengths, None, i,
-                          causal=False, key_mask=mask)
+                          causal=False, key_mask=mask, mesh=mesh)
     if cfg.pre_layer_norm:
         x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
     return x

@@ -65,6 +65,10 @@ def onebit_adam(betas=(0.9, 0.999), eps: float = 1e-8,
                 comm_backend_name: str = "xla") -> Optimizer:
     b1, b2 = betas
     _check_reference_extras(amsgrad, max_grad_norm, eps_inside_sqrt)
+    if not bias_correction:
+        raise NotImplementedError(
+            "onebit_adam always applies bias correction (pinned at the "
+            "freeze boundary); bias_correction=False is not supported")
 
     def init(params):
         w_err, s_err = init_error_feedback(
@@ -166,7 +170,8 @@ def zero_one_adam(betas=(0.9, 0.999), eps: float = 1e-8,
       syncs up to ``local_step_clipper``. This is the 0/1 in the name:
       most steps exchange 0 bits.
 
-    No bias correction, matching the reference update rule. In engine mode
+    No bias correction regardless of ``bias_correction`` (the reference
+    zoadam update rule applies none either). In engine mode
     (``axis_name=None``) the exchanges are identity (gradients arrive
     pre-reduced); under ``shard_map`` with per-worker grads the wire
     behavior is exact.
@@ -361,8 +366,8 @@ def onebit_lamb(betas=(0.9, 0.999), eps: float = 1e-8,
     is modulated by ``factor = max(frozen_denom / fresh_denom)``, where
     the fresh variance tracks gradients reconstructed from consecutive
     momenta; the factor is clamped to [factor_min, factor_max] and rate-
-    limited to ±factor_threshold per step. No bias correction, matching
-    the reference update rule.
+    limited to ±factor_threshold per step. No bias correction regardless
+    of ``bias_correction``, matching the reference update rule.
     """
     b1, b2 = betas
     _check_reference_extras(amsgrad, max_grad_norm, eps_inside_sqrt)

@@ -1,0 +1,162 @@
+"""MoE inference + true int8 weight storage (VERDICT r1 item 6; reference
+ops/transformer/inference/moe_inference.py + replace_module.py:140-199)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, set_global_mesh
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, _moe_mlp, causal_forward, init_params)
+from deepspeed_tpu.module_inject.quantize import (GroupQuantizer,
+                                                  dequantize_weight,
+                                                  quantize_weight,
+                                                  tree_weight_bytes)
+
+V, E, L, H, X = 128, 32, 2, 4, 4
+
+
+def _cfg(**kw):
+    return InferenceTransformerConfig(
+        vocab_size=V, n_positions=64, n_embd=E, n_layer=L, n_head=H,
+        dtype=jnp.float32, **kw)
+
+
+class TestMoEInference:
+    def test_moe_mlp_matches_per_token_oracle(self):
+        """Dense-dispatch MoE == looping each token through its argmax
+        expert (top-1, no capacity drops — serving must be exact)."""
+        cfg = _cfg(num_experts=X, moe_layers=(0,))
+        rng = jax.random.PRNGKey(0)
+        p = init_params(rng, cfg)
+        moe = p["layers"][0]["moe"]
+        x = jax.random.normal(jax.random.fold_in(rng, 9), (3, 5, E),
+                              jnp.float32)
+        out = _moe_mlp(x, moe, cfg)
+
+        t = np.asarray(x).reshape(-1, E)
+        gate = np.asarray(moe["gate"], np.float32)
+        probs = jax.nn.softmax(jnp.asarray(t @ gate), axis=-1)
+        oracle = np.zeros_like(t)
+        for s in range(t.shape[0]):
+            xi = int(np.argmax(np.asarray(probs[s])))
+            wi = np.asarray(moe["experts"]["wi"][xi], np.float32)
+            bi = np.asarray(moe["experts"]["bi"][xi], np.float32)
+            wo = np.asarray(moe["experts"]["wo"][xi], np.float32)
+            bo = np.asarray(moe["experts"]["bo"][xi], np.float32)
+            h = t[s] @ wi + bi
+            h = np.asarray(jax.nn.gelu(jnp.asarray(h), approximate=True))
+            oracle[s] = h @ wo + bo   # top-1: combine weight renorms to 1
+        np.testing.assert_allclose(np.asarray(out).reshape(-1, E), oracle,
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_moe_generate_and_forward(self):
+        cfg = _cfg(num_experts=X, moe_layers=(1,))
+        eng = InferenceEngine((cfg, init_params(jax.random.PRNGKey(1), cfg)),
+                              DeepSpeedInferenceConfig(dtype="float32"))
+        logits = eng.forward(jnp.asarray([[1, 2, 3, 4]], jnp.int32))
+        assert logits.shape == (1, 4, V)
+        assert np.isfinite(np.asarray(logits)).all()
+        out = eng.generate([[5, 6, 7]], max_new_tokens=4)
+        assert len(out[0]) == 7
+
+    def test_moe_decode_matches_forward(self):
+        """Decode-path MoE must agree with the full-sequence forward (the
+        KV-cache oracle, per the project verify recipe)."""
+        cfg = _cfg(num_experts=X, moe_layers=(0, 1))
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        eng = InferenceEngine((cfg, params),
+                              DeepSpeedInferenceConfig(dtype="float32"))
+        prompt = list(range(1, 9))
+        out = eng.generate([prompt], max_new_tokens=3)
+        full = causal_forward(params, cfg,
+                              jnp.asarray([out[0]], jnp.int32))
+        for i in range(len(prompt), len(out[0])):
+            assert out[0][i] == int(jnp.argmax(full[0, i - 1])), i
+
+    def test_moe_ep_mesh_runs(self):
+        """EP×TP mesh: experts shard over 'expert', heads over 'tensor';
+        the program compiles and matches the single-device result."""
+        cfg = _cfg(num_experts=X, moe_layers=(0, 1))
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        ids = jnp.asarray([[1, 2, 3, 4, 5]], jnp.int32)
+        ref = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+            dtype="float32")).forward(ids)
+        eng = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+            dtype="float32", tp={"tp_size": 2},
+            moe={"ep_size": 2}))
+        assert eng.mesh is not None and \
+            dict(zip(eng.mesh.axis_names, eng.mesh.devices.shape)) == \
+            {"expert": 2, "tensor": 2}
+        got = eng.forward(ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestInt8Storage:
+    def test_quantize_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 48), jnp.float32)
+        qw = quantize_weight(w, group_size=16)
+        assert qw["q"].dtype == jnp.int8 and qw["q"].shape == w.shape
+        assert qw["scale"].dtype == jnp.float32
+        back = dequantize_weight(qw)
+        err = float(jnp.abs(back - w).max())
+        # symmetric int8: max error ~ scale/2 = absmax/254
+        assert err <= float(jnp.abs(w).max()) / 127.0
+
+    def test_true_memory_drop(self):
+        """VERDICT r1: fake-quant had no memory win. True int8 must store
+        ~half the bytes of the bf16 tree."""
+        cfg = _cfg()
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(
+                x.dtype, jnp.floating) else x,
+            init_params(jax.random.PRNGKey(0), cfg))
+        qparams = GroupQuantizer().quantize_tree(params)
+        q_leaves = [l for l in jax.tree_util.tree_leaves(qparams)
+                    if l.dtype == jnp.int8]
+        assert q_leaves, "no int8 leaves stored"
+        # count only the quantized weight matrices: int8 payload + f32
+        # per-row scales vs the original bf16 bytes
+        orig = sum(l.size * 2 for l in q_leaves)
+        quant = sum(l.size * 1 for l in q_leaves) + sum(
+            l.size * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(qparams)
+            if l.dtype == jnp.float32 and l.ndim > 1)
+        assert quant < 0.62 * orig, (quant, orig)
+        assert tree_weight_bytes(qparams) < tree_weight_bytes(params)
+
+    def test_int8_engine_close_to_exact_and_generates(self):
+        cfg = _cfg()
+        params = init_params(jax.random.PRNGKey(4), cfg)
+        exact = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+            dtype="float32"))
+        q = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+            dtype="int8"))
+        assert q.model_config.dtype == jnp.bfloat16  # activations bf16
+        n_int8 = sum(l.dtype == jnp.int8
+                     for l in jax.tree_util.tree_leaves(q.params))
+        assert n_int8 == 6 * L  # wq wk wv wo wi wo per layer
+        ids = jnp.asarray([[1, 2, 3, 4, 5, 6]], jnp.int32)
+        le = np.asarray(exact.forward(ids), np.float32)
+        lq = np.asarray(q.forward(ids), np.float32)
+        # int8 grid + bf16 activations: loose agreement, same top-1 mostly
+        agree = (le.argmax(-1) == lq.argmax(-1)).mean()
+        assert agree >= 0.5, agree
+        out = q.generate([[1, 2, 3]], max_new_tokens=3)
+        assert len(out[0]) == 6
+
+    def test_int8_moe_tree(self):
+        cfg = _cfg(num_experts=X, moe_layers=(0,))
+        params = init_params(jax.random.PRNGKey(5), cfg)
+        qt = GroupQuantizer().quantize_tree(params)
+        assert qt["layers"][0]["moe"]["experts"]["wi"]["q"].dtype == jnp.int8
+        assert qt["layers"][1]["mlp"]["wi"]["q"].dtype == jnp.int8
+        eng = InferenceEngine((cfg, params),
+                              DeepSpeedInferenceConfig(dtype="int8"))
+        logits = eng.forward(jnp.asarray([[1, 2, 3]], jnp.int32))
+        assert np.isfinite(np.asarray(logits, np.float32)).all()
