@@ -1,0 +1,100 @@
+"""Pluggable accelerator abstraction.
+
+Analog of ``accelerator/abstract_accelerator.py`` (``DeepSpeedAccelerator``
+ABC :5) — the seam the reference routes every ``torch.cuda.*`` touch
+through so a non-CUDA backend can be swapped in (``real_accelerator.py:41``
+XPU hook). The JAX translation drops the CUDA-era surface that has no
+meaning under XLA (streams/events — the runtime schedules asynchronously;
+typed Tensor constructors — dtypes are jnp dtypes; empty_cache — XLA owns
+the arena) and keeps the queries the runtime actually consults: device
+identity/count, memory stats, dtype support, RNG seeding, the collectives
+backend name, and the op-builder hook for the native (C++) extensions.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    _name: str = "abstract"
+    _communication_backend_name: str = "xla"
+
+    # -- device identity --------------------------------------------------
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str: ...
+
+    @abc.abstractmethod
+    def device(self, device_index: int = 0) -> Any: ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int: ...
+
+    @abc.abstractmethod
+    def current_device(self) -> int: ...
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def is_available(self) -> bool: ...
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Block until pending work on the device drains (torch.cuda
+        .synchronize analog; XLA: wait on a trivial computation)."""
+
+    # -- rng --------------------------------------------------------------
+    @abc.abstractmethod
+    def manual_seed(self, seed: int) -> Any:
+        """Return a fresh PRNG key (functional JAX replaces device RNG
+        state mutation)."""
+
+    def manual_seed_all(self, seed: int) -> Any:
+        return self.manual_seed(seed)
+
+    # -- memory -----------------------------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> dict: ...
+
+    def memory_allocated(self, device_index=None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    def total_memory(self, device_index=None) -> int:
+        return int(self.memory_stats(device_index).get(
+            "bytes_limit", 0))
+
+    def available_memory(self, device_index=None) -> int:
+        return self.total_memory(device_index) - \
+            self.memory_allocated(device_index)
+
+    # -- dtype support ----------------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    # -- comm / build -----------------------------------------------------
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.op_builder"
+
+    def create_op_builder(self, class_name: str):
+        import importlib
+        mod = importlib.import_module(self.op_builder_dir())
+        return getattr(mod, class_name)()
+
+    def pin_memory(self, array):
+        """Place a host array in pinned/staging memory when the backend
+        distinguishes one (TPU pinned_host); identity elsewhere."""
+        return array
+
+    def on_accelerator(self, array) -> bool:
+        import jax
+        return isinstance(array, jax.Array) and \
+            array.device.platform == self.device(0).platform
+
+    def name(self) -> str:
+        return self._name

@@ -1,0 +1,31 @@
+"""Accelerator selection (analog of accelerator/real_accelerator.py:37,55):
+``get_accelerator()`` resolves lazily from the live JAX backend;
+``set_accelerator()`` installs a custom implementation (the reference's
+pluggable XPU hook, :41)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import (
+    DeepSpeedAccelerator)
+
+_ACCELERATOR: Optional[DeepSpeedAccelerator] = None
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _ACCELERATOR
+    if not isinstance(accel, DeepSpeedAccelerator):
+        raise TypeError("set_accelerator expects a DeepSpeedAccelerator")
+    _ACCELERATOR = accel
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        import jax
+        from deepspeed_tpu.accelerator.tpu_accelerator import (
+            CPU_Accelerator, TPU_Accelerator)
+        _ACCELERATOR = (TPU_Accelerator()
+                        if jax.default_backend() == "tpu"
+                        else CPU_Accelerator())
+    return _ACCELERATOR

@@ -190,11 +190,14 @@ def broadcast(x, src_index: int = 0, axis_name: str = "data"):
     """Broadcast from one index of the named axis to all (reference:
     comm/comm.py broadcast; engine._broadcast_model engine.py:1087)."""
     _log(f"broadcast[{axis_name}]", x)
-    # select the src slice on every member: gather then index is wasteful;
-    # use ppermute-free formulation via psum of masked value.
-    idx = lax.axis_index(axis_name)
-    mask = (idx == src_index).astype(x.dtype)
-    return lax.psum(x * mask, axis_name)
+    # one ring rotation: every member receives from the previous member;
+    # after |axis| applications of `select src's value` semantics, a single
+    # all_gather-free way to do this is to gather ONLY the src shard.
+    # all_gather + static index lowers to a collective-broadcast on TPU
+    # (XLA recognizes the single-slice use), unlike the old masked psum
+    # which paid a full multiply+allreduce per call.
+    gathered = lax.all_gather(x, axis_name)  # [axis, ...]
+    return gathered[src_index]
 
 
 def ppermute(x, perm, axis_name: str = "pipe"):

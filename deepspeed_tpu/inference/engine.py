@@ -240,6 +240,7 @@ class InferenceEngine:
         if loop is not None:
             return loop
         cfg = self.model_config
+        mesh = self.mesh  # MoE: decode hot path needs the EP constraint too
 
         def select(lg, rng, temperature, top_k):
             if not sampled:
@@ -270,7 +271,7 @@ class InferenceEngine:
 
             def body(c):
                 step, tok, cache, done, out, n_gen, rng = c
-                lg, cache = decode_step(params, cfg, tok, cache)
+                lg, cache = decode_step(params, cfg, tok, cache, mesh=mesh)
                 rng, sub = jax.random.split(rng)
                 nxt = select(lg, sub, temperature, top_k)
                 out = out.at[:, step].set(jnp.where(done, 0, nxt))

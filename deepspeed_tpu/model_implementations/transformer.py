@@ -224,7 +224,10 @@ def _w(w, dtype):
     holds int8 + scales only (the reference stores int8 + per-group scales
     the same way, replace_module.py:140-199)."""
     if isinstance(w, dict) and "q" in w:
-        return (w["q"].astype(dtype) * w["scale"].astype(dtype))
+        # lazy import: module_inject's package init reaches back into this
+        # module via the policy table, so a top-level import would cycle
+        from deepspeed_tpu.module_inject.quantize import dequantize_weight
+        return dequantize_weight(w, dtype)
     return w.astype(dtype) if w.dtype != dtype else w
 
 

@@ -5,8 +5,14 @@ from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
     CurriculumScheduler)
 from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
     DeepSpeedDataSampler)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer, load_difficulties, samples_up_to)
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    IndexedDatasetBuilder, MMapIndexedDataset, build_from_sequences)
 from deepspeed_tpu.runtime.data_pipeline.random_ltd_scheduler import (
     RandomLTDScheduler)
 
 __all__ = ["CurriculumScheduler", "DeepSpeedDataSampler",
-           "RandomLTDScheduler"]
+           "RandomLTDScheduler", "DataAnalyzer", "load_difficulties",
+           "samples_up_to", "IndexedDatasetBuilder", "MMapIndexedDataset",
+           "build_from_sequences"]

@@ -1,0 +1,139 @@
+"""Memory-mapped indexed dataset: variable-length samples on disk.
+
+Analog of ``runtime/data_pipeline/data_sampling/indexed_dataset.py`` (the
+Megatron-style mmap ``.bin``/``.idx`` pair, 645 LoC in the reference). The
+on-disk layout here is this project's own (documented below, not the
+Megatron binary format): the capability contract is the same — O(1) random
+access to millions of variable-length token sequences with host memory
+bounded by the OS page cache, the storage substrate for the offline
+``DataAnalyzer`` and the curriculum sampler.
+
+Layout::
+
+    <prefix>.idx   magic  b"DSTPIDX1"
+                   dtype  u8 code (numpy kind, table below)
+                   count  u64 N
+                   offsets u64[N+1]   element offsets into .bin
+    <prefix>.bin   sample elements, concatenated, native byte order
+
+Both files are written once by :class:`IndexedDatasetBuilder` and read via
+``np.memmap`` by :class:`MMapIndexedDataset`.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPIDX1"
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class IndexedDatasetBuilder:
+    """Streams samples to ``<prefix>.bin`` and finalizes the index."""
+
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+        self._bin = open(data_file_path(path_prefix), "wb")
+        self._offsets = [0]
+
+    def add_item(self, sample) -> None:
+        arr = np.ascontiguousarray(sample, dtype=self.dtype)
+        self._bin.write(arr.tobytes())
+        self._offsets.append(self._offsets[-1] + arr.size)
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another indexed dataset (the reduce step of sharded
+        dataset builds — reference builder ``merge_file_``)."""
+        other = MMapIndexedDataset(other_prefix)
+        if other.dtype != self.dtype:
+            raise ValueError("dtype mismatch in merge")
+        with open(data_file_path(other_prefix), "rb") as f:
+            while True:
+                chunk = f.read(1 << 22)
+                if not chunk:
+                    break
+                self._bin.write(chunk)
+        base = self._offsets[-1]
+        self._offsets.extend(base + o for o in other._offsets[1:])
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<B", _CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self._offsets) - 1))
+            f.write(np.asarray(self._offsets, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Random-access reader over the ``.bin``/``.idx`` pair."""
+
+    def __init__(self, path_prefix: str):
+        self.prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"{index_file_path(path_prefix)}: bad magic {magic!r}")
+            (code,) = struct.unpack("<B", f.read(1))
+            (count,) = struct.unpack("<Q", f.read(8))
+            self.dtype = np.dtype(_DTYPES[code])
+            self._offsets = np.frombuffer(
+                f.read(8 * (count + 1)), np.uint64)
+        self._data = np.memmap(data_file_path(path_prefix), mode="r",
+                               dtype=self.dtype)
+
+    def __len__(self) -> int:
+        return len(self._offsets) - 1
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        lo, hi = int(self._offsets[i]), int(self._offsets[i + 1])
+        return self._data[lo:hi]
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return np.diff(self._offsets).astype(np.int64)
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(index_file_path(path_prefix)) and
+                os.path.exists(data_file_path(path_prefix)))
+
+
+def make_builder(path_prefix: str, dtype=np.int32) -> IndexedDatasetBuilder:
+    return IndexedDatasetBuilder(path_prefix, dtype)
+
+
+def make_dataset(path_prefix: str) -> MMapIndexedDataset:
+    return MMapIndexedDataset(path_prefix)
+
+
+def build_from_sequences(seqs: Sequence, path_prefix: str,
+                         dtype=np.int32) -> MMapIndexedDataset:
+    """Convenience: materialize an in-memory corpus to disk."""
+    b = IndexedDatasetBuilder(path_prefix, dtype)
+    for s in seqs:
+        b.add_item(s)
+    b.finalize()
+    return MMapIndexedDataset(path_prefix)

@@ -691,13 +691,26 @@ class DeepSpeedEngine:
         profiling = (self.flops_profiler is not None and
                      self.global_steps + 1 ==
                      self.flops_profiler.profile_step)
-        if profiling:
-            self.flops_profiler.start_profile()
         self.tput_timer.start()
         self._rng, rng = jax.random.split(self._rng)
         if self._eager_param_staging:
             self.state = self.state.replace(params=jax.device_put(
                 self.state.params, self._device_param_shardings))
+        if profiling:
+            if self.global_steps == 0:
+                # the timed region would include the XLA compile of the
+                # first dispatch — latency/FLOPS would be compile-dominated
+                # and wildly misleading. Pre-compile (AOT, no execution,
+                # same avals/shardings as the dispatch below — hence after
+                # staging — and no extra rng split: lowering only reads
+                # avals, and splitting would perturb the training
+                # trajectory of profiled vs unprofiled runs).
+                logger.warning(
+                    "flops_profiler.profile_step coincides with the first "
+                    "(compiling) step; pre-compiling so reported latency "
+                    "excludes compilation")
+                self._step_fn.lower(self.state, batch, rng).compile()
+            self.flops_profiler.start_profile()
         self.state, metrics = self._step_fn(self.state, batch, rng)
         if self._eager_param_staging:
             self.state = self.state.replace(params=jax.device_put(
@@ -963,10 +976,18 @@ class DeepSpeedEngine:
             return None
 
     def _write_monitor_events(self, metrics):
-        events = [(f"Train/Samples/train_loss", float(metrics["loss"]),
-                   self.global_steps * self.train_batch_size),
-                  (f"Train/Samples/lr", float(metrics["lr"]),
-                   self.global_steps * self.train_batch_size)]
+        """Reference event parity (runtime/engine.py:1946-1954): loss, lr,
+        and — when present — the dynamic loss scale and global grad norm."""
+        samples = self.global_steps * self.train_batch_size
+        events = [("Train/Samples/train_loss", float(metrics["loss"]),
+                   samples),
+                  ("Train/Samples/lr", float(metrics["lr"]), samples)]
+        if self.config.fp16.enabled and "loss_scale" in metrics:
+            events.append(("Train/Samples/loss_scale",
+                           float(metrics["loss_scale"]), samples))
+        if "grad_norm" in metrics and metrics["grad_norm"] is not None:
+            events.append(("Train/Samples/grad_norm",
+                           float(metrics["grad_norm"]), samples))
         self.monitor.write_events(events)
 
 

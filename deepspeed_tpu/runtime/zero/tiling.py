@@ -1,0 +1,153 @@
+"""Tiled linear layers: huge projections as grids of independent tiles.
+
+Analog of ``runtime/zero/tiling.py`` (``TiledLinear``): the reference
+splits a Linear's input/output dimensions into tiles processed in
+sequence, so ZeRO-3 can partition and offload every inactive tile — the
+way to fit a projection larger than device memory. The TPU formulation is
+functional: the weight is a grid of separate param leaves
+``w_i_j [in_tile_i, out_tile_j]``; each leaf gets its own ZeRO-3 sharding
+(sharded-by-construction in the engine) or offload_param host placement,
+and the forward `lax`-scans over input tiles inside a remat region so at
+most one tile's gather is live at a time.
+
+The reference's companion ``contiguous_memory_allocator.py`` (defragments
+the partition cache) has no analog by design: XLA owns allocation and its
+arena allocator packs live buffers — there is no fragmentation knob to
+turn on TPU.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.pipe.module import partition_uniform
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Even split along the last dim (Megatron helper parity)."""
+    bounds = partition_uniform(tensor.shape[-1], num_partitions)
+    return tuple(tensor[..., lo:hi]
+                 for lo, hi in zip(bounds[:-1], bounds[1:]))
+
+
+class TiledLinear:
+    """``y = x @ W + b`` over an ``in_splits × out_splits`` tile grid.
+
+    ``init(rng)`` builds the tiled param tree; ``apply(params, x)`` runs
+    the tiled matmul. ``combine_out_splits=False`` returns the per-out-tile
+    list (reference flag, for consumers that keep going tile-wise);
+    ``input_is_already_split=True`` accepts a tuple of input tiles.
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 bias: bool = True, in_splits: int = 1, out_splits: int = 1,
+                 input_is_already_split: bool = False,
+                 combine_out_splits: bool = True,
+                 dtype: Any = jnp.float32):
+        if in_splits < 1 or out_splits < 1:
+            raise ValueError("splits must be >= 1")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.input_is_already_split = input_is_already_split
+        self.combine_out_splits = combine_out_splits
+        self.dtype = dtype
+        self.in_bounds = partition_uniform(in_features, in_splits)
+        self.out_bounds = partition_uniform(out_features, out_splits)
+
+    # -- params ----------------------------------------------------------
+    def init(self, rng) -> Dict[str, Any]:
+        params: Dict[str, Any] = {}
+        scale = 1.0 / jnp.sqrt(jnp.float32(self.in_features))
+        for i in range(self.in_splits):
+            for j in range(self.out_splits):
+                k = jax.random.fold_in(rng, i * self.out_splits + j)
+                shape = (self.in_bounds[i + 1] - self.in_bounds[i],
+                         self.out_bounds[j + 1] - self.out_bounds[j])
+                params[f"w_{i}_{j}"] = (
+                    jax.random.normal(k, shape, jnp.float32) * scale
+                ).astype(self.dtype)
+        if self.use_bias:
+            for j in range(self.out_splits):
+                params[f"b_{j}"] = jnp.zeros(
+                    (self.out_bounds[j + 1] - self.out_bounds[j],),
+                    self.dtype)
+        return params
+
+    def from_dense(self, kernel, bias=None) -> Dict[str, Any]:
+        """Tile an existing dense ``[in, out]`` kernel (reference
+        ``copy_params_from``)."""
+        if kernel.shape != (self.in_features, self.out_features):
+            raise ValueError(f"kernel {kernel.shape} != "
+                             f"({self.in_features}, {self.out_features})")
+        params: Dict[str, Any] = {}
+        for i in range(self.in_splits):
+            for j in range(self.out_splits):
+                params[f"w_{i}_{j}"] = jnp.asarray(
+                    kernel[self.in_bounds[i]:self.in_bounds[i + 1],
+                           self.out_bounds[j]:self.out_bounds[j + 1]],
+                    self.dtype)
+        if self.use_bias:
+            if bias is None:
+                raise ValueError("layer has bias=True but none given")
+            for j in range(self.out_splits):
+                params[f"b_{j}"] = jnp.asarray(
+                    bias[self.out_bounds[j]:self.out_bounds[j + 1]],
+                    self.dtype)
+        return params
+
+    # -- forward ---------------------------------------------------------
+    def apply(self, params: Dict[str, Any], x):
+        if self.input_is_already_split:
+            xs: Tuple = tuple(x)
+            if len(xs) != self.in_splits:
+                raise ValueError(f"expected {self.in_splits} input tiles, "
+                                 f"got {len(xs)}")
+        else:
+            xs = tuple(x[..., self.in_bounds[i]:self.in_bounds[i + 1]]
+                       for i in range(self.in_splits))
+        outs = []
+        for j in range(self.out_splits):
+            def out_tile(j=j):
+                # remat: the backward re-gathers tile weights instead of
+                # keeping every tile's activations+weights live
+                def f(*tiles):
+                    acc = xs[0] @ tiles[0]
+                    for i in range(1, self.in_splits):
+                        acc = acc + xs[i] @ tiles[i]
+                    return acc
+                tiles = tuple(params[f"w_{i}_{j}"]
+                              for i in range(self.in_splits))
+                return jax.checkpoint(f)(*tiles)
+            o = out_tile()
+            if self.use_bias:
+                o = o + params[f"b_{j}"]
+            outs.append(o)
+        if self.combine_out_splits:
+            return jnp.concatenate(outs, axis=-1)
+        return outs
+
+    __call__ = apply
+
+
+class TiledLinearReturnBias(TiledLinear):
+    """Reference variant: returns ``(y_without_bias, bias)`` so a Megatron
+    row-parallel consumer can defer the bias add until after its reduce."""
+
+    def apply(self, params, x):
+        use_bias, self.use_bias = self.use_bias, False
+        try:
+            y = super().apply(params, x)
+        finally:
+            self.use_bias = use_bias
+        if not self.use_bias:
+            return y, None
+        bias = jnp.concatenate([params[f"b_{j}"]
+                                for j in range(self.out_splits)], -1)
+        return y, bias
+
+    __call__ = apply

@@ -1,0 +1,66 @@
+"""Accelerator abstraction seam (reference accelerator/abstract_accelerator
+.py:5, real_accelerator.py:37-55 — SURVEY row 35)."""
+import jax
+import pytest
+
+from deepspeed_tpu.accelerator import (DeepSpeedAccelerator,
+                                       get_accelerator, set_accelerator)
+
+
+def test_get_accelerator_resolves_backend():
+    acc = get_accelerator()
+    assert isinstance(acc, DeepSpeedAccelerator)
+    assert acc.name() in ("cpu", "tpu")
+    assert acc.device_count() == jax.device_count()
+    assert acc.device(0) is jax.devices()[0]
+    assert acc.communication_backend_name() == "xla"
+    assert acc.is_bf16_supported()
+    assert isinstance(acc.memory_stats(), dict)
+    key = acc.manual_seed(0)
+    assert key.shape in ((2,), ())  # PRNG key forms
+
+
+def test_set_accelerator_plugs_in():
+    class Custom(DeepSpeedAccelerator):
+        _name = "custom"
+        _communication_backend_name = "dcn"
+
+        def device_name(self, i=None):
+            return "custom"
+
+        def device(self, i=0):
+            return jax.devices()[i]
+
+        def device_count(self):
+            return 1
+
+        def current_device(self):
+            return 0
+
+        def is_available(self):
+            return True
+
+        def manual_seed(self, seed):
+            return jax.random.PRNGKey(seed)
+
+        def memory_stats(self, i=None):
+            return {"bytes_in_use": 7, "bytes_limit": 10}
+
+    prev = get_accelerator()
+    try:
+        set_accelerator(Custom())
+        acc = get_accelerator()
+        assert acc.name() == "custom"
+        assert acc.communication_backend_name() == "dcn"
+        assert acc.memory_allocated() == 7
+        assert acc.available_memory() == 3
+        with pytest.raises(TypeError):
+            set_accelerator(object())
+    finally:
+        set_accelerator(prev)
+
+
+def test_op_builder_hook():
+    acc = get_accelerator()
+    b = acc.create_op_builder("AsyncIOBuilder")
+    assert hasattr(b, "is_compatible")

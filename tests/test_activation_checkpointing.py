@@ -11,6 +11,9 @@ from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, set_global_mesh
 from deepspeed_tpu.config.config import ActivationCheckpointingConfig
 from deepspeed_tpu.runtime import activation_checkpointing as ckpt
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 
 @pytest.fixture(autouse=True)
 def _reset_ckpt():

@@ -7,6 +7,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 # ------------------------------------------------------------ profiler
 
 def test_flops_profiler_matmul():

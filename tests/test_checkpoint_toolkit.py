@@ -9,6 +9,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 import deepspeed_tpu
 from deepspeed_tpu.checkpoint import (AsyncCheckpointEngine,
                                       DeepSpeedCheckpoint,

@@ -13,6 +13,9 @@ import deepspeed_tpu
 from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 VOCAB = 256
 
 

@@ -9,6 +9,9 @@ from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, set_global_mesh
 from deepspeed_tpu.moe import (MoE, capacity, moe_param_count,
                                split_moe_params, top1_gating, top2_gating)
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 
 class TestCapacity:
     def test_formula(self):

@@ -13,6 +13,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 from deepspeed_tpu.ops.cpu_adam import (DeepSpeedCPUAdam,
                                         DeepSpeedCPUAdagrad,
                                         _f32_to_bf16_np)

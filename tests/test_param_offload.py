@@ -9,6 +9,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 import deepspeed_tpu
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
 

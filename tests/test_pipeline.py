@@ -22,6 +22,9 @@ from deepspeed_tpu.parallel.pipe import (InferenceSchedule, LayerSpec,
 from deepspeed_tpu.parallel.pipe.schedule import (BackwardPass, ForwardPass,
                                                   OptimizerStep)
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 
 # ---------------------------------------------------------------------------
 # topology (reference tests/unit/runtime/pipe/test_topology.py)

@@ -12,6 +12,9 @@ from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, set_global_mesh
 from deepspeed_tpu.ops.attention import causal_attention_reference
 from deepspeed_tpu.ops.ring_attention import ring_self_attention
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 
 def _qkv(B=2, T=64, H=2, D=16, seed=0):
     key = jax.random.PRNGKey(seed)

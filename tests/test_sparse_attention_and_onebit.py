@@ -21,6 +21,9 @@ from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
                                                 sparse_attention_reference)
 from deepspeed_tpu.ops.pallas.block_sparse_attention import build_lut
 
+pytestmark = pytest.mark.slow  # compile-heavy
+
+
 B, T, H, D = 2, 64, 4, 16
 BLOCK = 8
 
