@@ -93,8 +93,13 @@ class DeepSpeedAccelerator(abc.ABC):
 
     def on_accelerator(self, array) -> bool:
         import jax
-        return isinstance(array, jax.Array) and \
-            array.device.platform == self.device(0).platform
+        if not isinstance(array, jax.Array):
+            return False
+        # .devices() covers sharded arrays too (.device returns a Sharding
+        # for multi-device arrays)
+        devs = array.devices()
+        return bool(devs) and next(iter(devs)).platform == \
+            self.device(0).platform
 
     def name(self) -> str:
         return self._name

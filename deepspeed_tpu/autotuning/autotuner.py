@@ -1,19 +1,27 @@
-"""Autotuner: search ZeRO stage × micro-batch for best throughput.
+"""Autotuner: search mesh shape × ZeRO stage × micro-batch for throughput.
 
-Analog of ``deepspeed/autotuning/autotuner.py:38``: the reference profiles
-model memory, generates a ZeRO-stage × micro-batch experiment grid from
-config templates, schedules trial runs, and picks the fastest. The TPU
-version runs trials *in process* (each trial jit-compiles a fresh engine —
-no launcher round-trip needed on a single controller) and prunes the grid
-by the same memory model the reference uses (activation+param+optimizer
-bytes vs HBM).
+Analog of ``deepspeed/autotuning/autotuner.py:38`` plus its tuners
+(``tuner/model_based_tuner.py``, ``cost_model.py``, ``index_based_tuner.py``)
+and config templates. The reference profiles model memory, generates a
+ZeRO-stage × micro-batch grid from templates, schedules launcher runs, and
+picks the fastest. The TPU version:
 
-Metric: ``throughput`` (samples/s, default) or ``latency``.
+* runs trials *in process* (each trial jit-compiles a fresh engine — no
+  launcher round-trip on a single controller);
+* searches the **mesh shape** too — dp × tensor × seq factorizations of
+  the device count. On TPU this is the knob that actually matters: the
+  same model at the same batch can differ multiples in throughput between
+  a pure-DP and a TP-heavy layout;
+* prunes by a memory model before compiling (params/dp_shard + optimizer
+  + activation bytes vs per-device HBM — the reference's
+  ``model_info``-based pruning), and
+* early-stops the micro-batch sweep per (mesh, stage) arm when throughput
+  stops improving (the model-based tuner's monotone assumption: larger
+  micro helps until the memory/latency knee, then it only hurts).
 """
 from __future__ import annotations
 
 import gc
-import itertools
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -24,6 +32,65 @@ from deepspeed_tpu.utils.logging import logger
 DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16)
 DEFAULT_STAGES = (0, 1, 2, 3)
 
+# config templates (reference autotuning/config_templates/template_zeroN.json)
+TUNING_TEMPLATES: Dict[int, Dict] = {
+    0: {"zero_optimization": {"stage": 0}},
+    1: {"zero_optimization": {"stage": 1}},
+    2: {"zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True}},
+    3: {"zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True}},
+}
+
+
+def mesh_shape_candidates(n_devices: int,
+                          axes: Tuple[str, ...] = ("data", "tensor"),
+                          max_tensor: int = 8,
+                          max_seq: int = 8) -> List[Dict[str, int]]:
+    """All factorizations of ``n_devices`` over the given mesh axes
+    (data absorbs the remainder). The search space the reference's
+    launcher-level tuner cannot reach — it tunes within a fixed world."""
+    caps = {"tensor": max_tensor, "seq": max_seq}
+    shapes: List[Dict[str, int]] = []
+
+    def divisors(n):
+        return [d for d in range(1, n + 1) if n % d == 0]
+
+    non_data = [a for a in axes if a != "data"]
+
+    def rec(i, left, cur):
+        if i == len(non_data):
+            shapes.append({**cur, "data": left})
+            return
+        ax = non_data[i]
+        for d in divisors(left):
+            if d <= caps.get(ax, left):
+                rec(i + 1, left // d, {**cur, ax: d})
+    rec(0, n_devices, {})
+    return shapes
+
+
+def estimate_trial_bytes(param_count: int, stage: int, micro: int,
+                         seq_len: int, hidden: int, n_layers: int,
+                         mesh: Dict[str, int],
+                         param_bytes: int = 2,
+                         remat: bool = True) -> int:
+    """Per-device memory model (reference cost_model.py + the activation
+    memory the engine's ``autotuning_profile_model_info`` hook measures).
+    Deliberately coarse — it exists to prune compile-time-expensive trials
+    that cannot fit, not to rank the survivors."""
+    dp = mesh.get("data", 1) * mesh.get("fsdp", 1)
+    tp = mesh.get("tensor", 1)
+    sp = mesh.get("seq", 1)
+    shard = dp if stage >= 3 else 1
+    weights = param_count * param_bytes // (shard * tp)
+    master_opt = (param_count * (4 + 8) //
+                  ((dp if stage >= 1 else 1) * tp))
+    grads = param_count * 4 // ((dp if stage >= 2 else 1) * tp)
+    act_per_layer = micro * seq_len * hidden * param_bytes // (tp * sp)
+    acts = act_per_layer * (2 if remat else n_layers)
+    return weights + master_opt + grads + acts
+
 
 class Autotuner:
     def __init__(self, engine_builder: Callable[[Dict], Any],
@@ -31,29 +98,64 @@ class Autotuner:
                  base_config: Dict,
                  micro_batches: Tuple[int, ...] = DEFAULT_MICRO_BATCHES,
                  zero_stages: Tuple[int, ...] = DEFAULT_STAGES,
+                 mesh_shapes: Optional[List[Dict[str, int]]] = None,
                  num_steps: int = 3, warmup_steps: int = 1,
-                 metric: str = "throughput"):
+                 metric: str = "throughput",
+                 model_info: Optional[Dict] = None,
+                 hbm_bytes: Optional[int] = None,
+                 early_stop_threshold: float = 0.97):
         """``engine_builder(config_dict) -> engine`` builds a fresh engine;
         ``batch_builder(global_batch_size) -> batch`` builds a matching
-        input batch."""
+        input batch. ``mesh_shapes``: list of mesh-section dicts to search
+        (None → micro/stage-only, the r1 behavior). ``model_info``:
+        {param_count, seq_len, hidden, n_layers} enables memory pruning
+        against ``hbm_bytes`` per device."""
         self.engine_builder = engine_builder
         self.batch_builder = batch_builder
         self.base_config = base_config
-        self.micro_batches = micro_batches
+        self.micro_batches = tuple(sorted(micro_batches))
         self.zero_stages = zero_stages
+        self.mesh_shapes = mesh_shapes
         self.num_steps = num_steps
         self.warmup_steps = warmup_steps
         self.metric = metric
+        self.model_info = model_info
+        self.hbm_bytes = hbm_bytes
+        self.early_stop_threshold = early_stop_threshold
         self.results: List[Dict] = []
+        self.pruned: List[Dict] = []
 
-    def _trial_config(self, stage: int, micro: int) -> Dict:
+    # ------------------------------------------------------------------
+    def _trial_config(self, stage: int, micro: int,
+                      mesh: Optional[Dict[str, int]]) -> Dict:
         cfg = dict(self.base_config)
         cfg.pop("train_batch_size", None)
         cfg["train_micro_batch_size_per_gpu"] = micro
+        template = TUNING_TEMPLATES.get(stage, {})
+        for k, v in template.items():
+            if k in cfg:
+                continue
+            if k == "bf16" and cfg.get("fp16", {}).get("enabled"):
+                continue  # an fp16 base config must keep stages 2/3 viable
+            cfg[k] = dict(v)
         zero = dict(cfg.get("zero_optimization", {}))
         zero["stage"] = stage
         cfg["zero_optimization"] = zero
+        if mesh is not None:
+            cfg["mesh"] = dict(mesh)
         return cfg
+
+    def _predict_fits(self, stage: int, micro: int,
+                      mesh: Optional[Dict[str, int]]) -> bool:
+        if self.model_info is None or self.hbm_bytes is None:
+            return True
+        need = estimate_trial_bytes(
+            self.model_info["param_count"], stage, micro,
+            self.model_info.get("seq_len", 1024),
+            self.model_info.get("hidden", 1024),
+            self.model_info.get("n_layers", 12),
+            mesh or {"data": 1})
+        return need <= self.hbm_bytes
 
     def _run_trial(self, cfg: Dict) -> Optional[Dict]:
         try:
@@ -76,32 +178,54 @@ class Autotuner:
         finally:
             gc.collect()
 
+    # ------------------------------------------------------------------
     def tune(self) -> Dict:
-        """Run the grid; return {'best_config', 'best_metrics', 'results'}
-        (the reference's summary + exps dir rolled into one dict)."""
+        """Run the search; return {'best_config', 'best_metrics',
+        'results', 'pruned'} (the reference's summary + exps dir rolled
+        into one dict)."""
+        meshes = self.mesh_shapes if self.mesh_shapes is not None else [None]
         best = None
-        for stage, micro in itertools.product(self.zero_stages,
-                                              self.micro_batches):
-            cfg = self._trial_config(stage, micro)
-            metrics = self._run_trial(cfg)
-            rec = {"zero_stage": stage, "micro_batch": micro,
-                   "metrics": metrics}
-            self.results.append(rec)
-            if metrics is None:
-                continue
-            logger.info(
-                f"autotune trial z{stage} mbs{micro}: "
-                f"{metrics['throughput']:.1f} samples/s")
-            better = (best is None or
-                      (metrics["throughput"] > best[2]["throughput"]
-                       if self.metric == "throughput"
-                       else metrics["latency_s"] < best[2]["latency_s"]))
-            if better:
-                best = (stage, micro, metrics, cfg)
+        for mesh in meshes:
+            for stage in self.zero_stages:
+                arm_best = None
+                for micro in self.micro_batches:
+                    label = {"mesh": mesh, "zero_stage": stage,
+                             "micro_batch": micro}
+                    if not self._predict_fits(stage, micro, mesh):
+                        self.pruned.append(label)
+                        logger.info(f"autotune pruned (memory model): "
+                                    f"{label}")
+                        continue
+                    cfg = self._trial_config(stage, micro, mesh)
+                    metrics = self._run_trial(cfg)
+                    self.results.append({**label, "metrics": metrics})
+                    if metrics is None:
+                        break  # bigger micro will not come back from OOM
+                    logger.info(
+                        f"autotune trial mesh={mesh} z{stage} mbs{micro}: "
+                        f"{metrics['throughput']:.1f} samples/s")
+                    if best is None or self._better(metrics, best[1]):
+                        best = (cfg, metrics, label)
+                    # early-stop this arm once bigger micro stops paying
+                    if arm_best is not None and (
+                            metrics["throughput"] <
+                            self.early_stop_threshold *
+                            arm_best["throughput"]):
+                        logger.info(f"autotune early-stop arm at "
+                                    f"mbs{micro}")
+                        break
+                    if (arm_best is None or metrics["throughput"] >
+                            arm_best["throughput"]):
+                        arm_best = metrics
         if best is None:
             raise RuntimeError("no autotuning trial succeeded")
-        stage, micro, metrics, cfg = best
-        logger.info(f"autotune best: z{stage} mbs{micro} "
+        cfg, metrics, label = best
+        logger.info(f"autotune best: {label} "
                     f"{metrics['throughput']:.1f} samples/s")
         return {"best_config": cfg, "best_metrics": metrics,
-                "results": self.results}
+                "results": self.results, "pruned": self.pruned}
+
+    def _better(self, a: Dict, b: Dict) -> bool:
+        if self.metric == "throughput":
+            return a["throughput"] > b["throughput"]
+        return a["latency_s"] < b["latency_s"]

@@ -1,9 +1,9 @@
 """Model compression (analog of ``deepspeed/compression/``)."""
-from deepspeed_tpu.compression.compress import (apply_compression,
+from deepspeed_tpu.compression.compress import (apply_compression, student_initialization,
                                                 init_compression,
                                                 redundancy_clean,
                                                 seed_masks)
 from deepspeed_tpu.compression.scheduler import CompressionScheduler
 
-__all__ = ["init_compression", "apply_compression", "redundancy_clean",
+__all__ = ["init_compression", "apply_compression", "redundancy_clean", "student_initialization",
            "seed_masks", "CompressionScheduler"]

@@ -223,3 +223,75 @@ def redundancy_clean(params, spec: CompressionSpec):
     order = list(flat)
     return jax.tree_util.tree_unflatten(
         treedef, [new_flat[k] for k in order]), report
+
+
+def student_initialization(student_params, teacher_params,
+                           compression_config: Dict):
+    """Layer-reduction knowledge-distillation init (reference
+    ``compress.py:182`` ``student_initialization``): seed a shallow
+    student from selected teacher layers before distillation.
+
+    ``compression_config["layer_reduction"]``:
+      module_name_prefix: path of the layer container in the param tree
+          (e.g. ``"layers"`` for the fused inference tree, ``"blocks"``
+          for GPT2LMModel's stacked tree)
+      teacher_layer: teacher layer index per student layer, in order
+      other_module_name: additional top-level subtrees copied verbatim
+          (embeddings, final LN, lm head)
+
+    Functional: returns a NEW student tree; handles both list-of-layers
+    containers and stacked arrays with a leading layer dim.
+    """
+    cfg = compression_config
+    if "compression_training" in cfg:      # full ds-config form
+        cfg = cfg["compression_training"]
+    cfg = cfg.get("layer_reduction", cfg)
+    if not cfg or cfg.get("enabled") is False:
+        return student_params
+    if "module_name_prefix" not in cfg or "teacher_layer" not in cfg:
+        raise ValueError(
+            "layer_reduction config needs module_name_prefix and "
+            "teacher_layer (reference compress.py:182)")
+    prefix = cfg["module_name_prefix"]
+    teacher_layer = list(cfg["teacher_layer"])
+    other = list(cfg.get("other_module_name", []))
+
+    def get_path(tree, path):
+        node = tree
+        for part in path.split("."):
+            node = node[int(part)] if part.isdigit() else node[part]
+        return node
+
+    def set_path(tree, path, value):
+        parts = path.split(".")
+        node = tree
+        for part in parts[:-1]:
+            node = node[int(part)] if part.isdigit() else node[part]
+        last = parts[-1]
+        node[int(last) if last.isdigit() else last] = value
+
+    out = jax.tree_util.tree_map(lambda x: x, student_params)  # deep-ish copy
+    s_container = get_path(out, prefix)
+    t_container = get_path(teacher_params, prefix)
+
+    if isinstance(s_container, list):
+        if len(teacher_layer) != len(s_container):
+            raise ValueError(
+                f"teacher_layer maps {len(teacher_layer)} layers but the "
+                f"student has {len(s_container)}")
+        for s_idx, t_idx in enumerate(teacher_layer):
+            s_container[s_idx] = jax.tree_util.tree_map(
+                lambda x: x, t_container[t_idx])
+    else:
+        # stacked arrays: leading dim = layer
+        n_student = jax.tree_util.tree_leaves(s_container)[0].shape[0]
+        if len(teacher_layer) != n_student:
+            raise ValueError(
+                f"teacher_layer maps {len(teacher_layer)} layers but the "
+                f"student has {n_student}")
+        idx = jnp.asarray(teacher_layer, jnp.int32)
+        set_path(out, prefix, jax.tree_util.tree_map(
+            lambda t: jnp.take(t, idx, axis=0), t_container))
+    for name in other:
+        set_path(out, name, get_path(teacher_params, name))
+    return out

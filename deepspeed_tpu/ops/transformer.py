@@ -1,0 +1,216 @@
+"""Fused BERT-style TRAINING transformer layer.
+
+Analog of the reference's flagship training kernel
+(``ops/transformer/transformer.py:459`` ``DeepSpeedTransformerLayer`` +
+``DeepSpeedTransformerConfig`` :38, backed by ~6k LoC of CUDA in
+``csrc/transformer/`` — the "64 TFLOPS BERT layer"). On TPU the fusion the
+CUDA code does by hand (bias+gelu into the FFN GEMM, bias+dropout+residual
+into the projection, fp32 LayerNorm accumulation) is XLA's job; what
+remains worth owning is the layer *contract*: the exact parameter set,
+pre/post-LN orderings, dropout placement, and a Pallas flash-attention
+core for the unmasked case.
+
+Differences by design:
+* ``stochastic_mode`` is accepted and ignored: it trades determinism for
+  ~2% speed in the CUDA kernels; XLA programs are deterministic and the
+  trade does not exist.
+* weights use TPU-friendly ``[in, out]`` layout (the reference stores
+  torch's ``[out, in]``); ``from_torch_layout`` converts.
+
+Parameter schema (names mirror the reference's attributes)::
+
+    attn_qkvw [E, 3E]  attn_qkvb [3E]
+    attn_ow   [E, E]   attn_ob   [E]
+    attn_nw/attn_nb    [E]           attention LayerNorm
+    inter_w   [E, F]   inter_b   [F]
+    output_w  [F, E]   output_b  [E]
+    norm_w/norm_b      [E]           FFN LayerNorm
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepSpeedTransformerConfig:
+    """Reference config surface (transformer.py:38) minus CUDA-isms."""
+    batch_size: int = -1                  # API parity; shapes come from x
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1                  # API parity
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False    # memory trick subsumed by remat
+    gelu_checkpoint: bool = False         # ditto
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False         # no-op: XLA is deterministic
+    return_tuple: bool = False
+    training: bool = True
+
+    @property
+    def ffn(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.fp16 else jnp.float32
+
+
+class DeepSpeedTransformerLayer:
+    """Functional encoder layer: ``init(rng) -> params``;
+    ``apply(params, x, attention_mask=None, rng=None) -> y``."""
+
+    layer_id = 0
+
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+        self.layer_id = DeepSpeedTransformerLayer.layer_id
+        DeepSpeedTransformerLayer.layer_id += 1
+
+    # -- params -----------------------------------------------------------
+    def init(self, rng) -> Dict[str, Any]:
+        cfg = self.config
+        E, F = cfg.hidden_size, cfg.ffn
+        std = cfg.initializer_range
+        if cfg.adjust_init_range and cfg.num_hidden_layers > 0:
+            # output-projection init shrinks with depth (reference
+            # init_transformer_weights output_std = std / sqrt(2L))
+            out_std = std / math.sqrt(2.0 * cfg.num_hidden_layers)
+        else:
+            out_std = std
+        k = iter(jax.random.split(rng, 4))
+
+        def normal(key, shape, s):
+            return (jax.random.normal(key, shape, jnp.float32) * s
+                    ).astype(cfg.dtype)
+        return {
+            "attn_qkvw": normal(next(k), (E, 3 * E), std),
+            "attn_qkvb": jnp.zeros((3 * E,), cfg.dtype),
+            "attn_ow": normal(next(k), (E, E), out_std),
+            "attn_ob": jnp.zeros((E,), cfg.dtype),
+            "attn_nw": jnp.ones((E,), cfg.dtype),
+            "attn_nb": jnp.zeros((E,), cfg.dtype),
+            "inter_w": normal(next(k), (E, F), std),
+            "inter_b": jnp.zeros((F,), cfg.dtype),
+            "output_w": normal(next(k), (F, E), out_std),
+            "output_b": jnp.zeros((E,), cfg.dtype),
+            "norm_w": jnp.ones((E,), cfg.dtype),
+            "norm_b": jnp.zeros((E,), cfg.dtype),
+        }
+
+    @staticmethod
+    def from_torch_layout(qkvw, qkvb, ow, ob, attn_nw, attn_nb, inter_w,
+                          inter_b, output_w, output_b, norm_w, norm_b,
+                          dtype=jnp.float32) -> Dict[str, Any]:
+        """Reference/torch ``[out, in]`` tensors → this layer's params."""
+        import numpy as np
+        t = lambda a: jnp.asarray(np.asarray(a), dtype)  # noqa: E731
+        return {"attn_qkvw": t(qkvw).T, "attn_qkvb": t(qkvb),
+                "attn_ow": t(ow).T, "attn_ob": t(ob),
+                "attn_nw": t(attn_nw), "attn_nb": t(attn_nb),
+                "inter_w": t(inter_w).T, "inter_b": t(inter_b),
+                "output_w": t(output_w).T, "output_b": t(output_b),
+                "norm_w": t(norm_w), "norm_b": t(norm_b)}
+
+    # -- forward ----------------------------------------------------------
+    def _ln(self, x, w, b):
+        m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
+        v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+        y = (x.astype(jnp.float32) - m) * jax.lax.rsqrt(
+            v + self.config.layer_norm_eps)
+        return (y * w.astype(jnp.float32) +
+                b.astype(jnp.float32)).astype(x.dtype)
+
+    def _dropout(self, x, rate, rng, deterministic):
+        if deterministic or rate <= 0.0 or rng is None:
+            return x, rng
+        rng, sub = jax.random.split(rng)
+        keep = jax.random.bernoulli(sub, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype), rng
+
+    def _attention(self, x, params, attention_mask, rng, deterministic):
+        cfg = self.config
+        B, T, E = x.shape
+        H, D = cfg.heads, E // cfg.heads
+        qkv = x @ params["attn_qkvw"] + params["attn_qkvb"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        need_mask = attention_mask is not None
+        drop_attn = (not deterministic and cfg.attn_dropout_ratio > 0.0
+                     and rng is not None)
+        # the Pallas kernel tiles at 128: lengths above one block must be
+        # multiples of it (callers pad); otherwise use the einsum path
+        flash_ok = T <= 128 or T % 128 == 0
+        if not need_mask and not drop_attn and flash_ok:
+            # Pallas flash core (bidirectional)
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                flash_attention)
+            y = flash_attention(q, k, v, causal=False)
+        else:
+            scale = 1.0 / math.sqrt(D)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            if need_mask:
+                m = attention_mask
+                if m.ndim == 2:          # [B, T] HF key mask
+                    m = m[:, None, None, :]
+                att = jnp.where(m > 0, att, jnp.float32(-1e30))
+            att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(x.dtype)
+            if drop_attn:
+                att, rng = self._dropout(att, cfg.attn_dropout_ratio, rng,
+                                         deterministic)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        y = y.reshape(B, T, E) @ params["attn_ow"] + params["attn_ob"]
+        return y, rng
+
+    def apply(self, params: Dict[str, Any], x,
+              attention_mask=None, rng=None,
+              deterministic: Optional[bool] = None):
+        """x [B, T, E] → [B, T, E]; BERT orderings per pre_layer_norm
+        (reference DeepSpeedTransformerFunction :152)."""
+        cfg = self.config
+        det = (not cfg.training) if deterministic is None else deterministic
+        x = x.astype(cfg.dtype)
+        if cfg.pre_layer_norm:
+            h = self._ln(x, params["attn_nw"], params["attn_nb"])
+            attn, rng = self._attention(h, params, attention_mask, rng, det)
+            attn, rng = self._dropout(attn, cfg.hidden_dropout_ratio, rng,
+                                      det)
+            x = x + attn
+            h = self._ln(x, params["norm_w"], params["norm_b"])
+            ffn = jax.nn.gelu(
+                (h @ params["inter_w"] + params["inter_b"]
+                 ).astype(jnp.float32), approximate=False).astype(cfg.dtype)
+            ffn = ffn @ params["output_w"] + params["output_b"]
+            ffn, rng = self._dropout(ffn, cfg.hidden_dropout_ratio, rng, det)
+            out = x + ffn
+        else:  # post-LN (original BERT)
+            attn, rng = self._attention(x, params, attention_mask, rng, det)
+            attn, rng = self._dropout(attn, cfg.hidden_dropout_ratio, rng,
+                                      det)
+            x = self._ln(x + attn, params["attn_nw"], params["attn_nb"])
+            ffn = jax.nn.gelu(
+                (x @ params["inter_w"] + params["inter_b"]
+                 ).astype(jnp.float32), approximate=False).astype(cfg.dtype)
+            ffn = ffn @ params["output_w"] + params["output_b"]
+            ffn, rng = self._dropout(ffn, cfg.hidden_dropout_ratio, rng, det)
+            out = self._ln(x + ffn, params["norm_w"], params["norm_b"])
+        if cfg.return_tuple:
+            return (out,)
+        return out
+
+    __call__ = apply

@@ -121,9 +121,10 @@ class DataAnalyzer:
             i2m = IndexedDatasetBuilder(
                 os.path.join(d, "index_to_metric"),
                 dtype=self.metric_dtype)
+            sorted_vals = values[order]
             pos = 0
             for v in uniq:
-                cnt = int(np.searchsorted(values[order], v, "right") - pos)
+                cnt = int(np.searchsorted(sorted_vals, v, "right") - pos)
                 i2s.add_item(order[pos:pos + cnt])
                 i2m.add_item(np.asarray([v]))
                 pos += cnt

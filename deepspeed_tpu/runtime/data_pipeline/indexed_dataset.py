@@ -97,8 +97,13 @@ class MMapIndexedDataset:
             self.dtype = np.dtype(_DTYPES[code])
             self._offsets = np.frombuffer(
                 f.read(8 * (count + 1)), np.uint64)
-        self._data = np.memmap(data_file_path(path_prefix), mode="r",
-                               dtype=self.dtype)
+        if os.path.getsize(data_file_path(path_prefix)) == 0:
+            # np.memmap refuses zero-length files; an empty dataset (e.g.
+            # an empty analyzer worker shard) is legal
+            self._data = np.empty((0,), self.dtype)
+        else:
+            self._data = np.memmap(data_file_path(path_prefix), mode="r",
+                                   dtype=self.dtype)
 
     def __len__(self) -> int:
         return len(self._offsets) - 1

@@ -350,3 +350,153 @@ def test_autotuner_picks_best():
     assert out["best_config"]["zero_optimization"]["stage"] in (1, 3)
     assert out["best_metrics"]["throughput"] > 0
     assert len(out["results"]) == 4
+
+
+def test_autotuner_mesh_shape_search():
+    """r2: the mesh factorization (dp×tp) is part of the search space —
+    the knob that matters on TPU (reference tunes only within a fixed
+    world size)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.autotuning.autotuner import mesh_shape_candidates
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+
+    shapes = mesh_shape_candidates(8, axes=("data", "tensor"))
+    assert {"data": 8, "tensor": 1} in shapes
+    assert {"data": 4, "tensor": 2} in shapes
+    assert {"data": 1, "tensor": 8} in shapes
+    assert all(s["data"] * s["tensor"] == 8 for s in shapes)
+    shapes3 = mesh_shape_candidates(8, axes=("data", "tensor", "seq"),
+                                    max_tensor=2, max_seq=2)
+    assert all(s["tensor"] <= 2 and s["seq"] <= 2 for s in shapes3)
+
+    cfg = GPT2Config(n_embd=32, n_layer=1, n_head=2, n_positions=64,
+                     vocab_size=128, dtype=jnp.bfloat16, remat=False)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+
+    def engine_builder(ds_cfg):
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=ds_cfg)
+        return eng
+
+    def batch_builder(global_bs):
+        return {"input_ids": jnp.zeros((global_bs, 16), jnp.int32)}
+
+    base = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True}}
+    tuner = Autotuner(engine_builder, batch_builder, base,
+                      micro_batches=(1,), zero_stages=(3,),
+                      mesh_shapes=[{"data": 8, "tensor": 1},
+                                   {"data": 4, "tensor": 2}],
+                      num_steps=1, warmup_steps=1)
+    out = tuner.tune()
+    assert out["best_config"]["mesh"] in ({"data": 8, "tensor": 1},
+                                          {"data": 4, "tensor": 2})
+    assert len(out["results"]) == 2
+
+
+def test_autotuner_memory_pruning():
+    """Trials the memory model says cannot fit are skipped WITHOUT
+    compiling (reference model_info pruning)."""
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.autotuning.autotuner import estimate_trial_bytes
+
+    calls = []
+
+    def engine_builder(cfg):
+        calls.append(cfg)
+        raise AssertionError("should never build: everything pruned")
+
+    tuner = Autotuner(engine_builder, lambda b: None, {},
+                      micro_batches=(4, 8), zero_stages=(0,),
+                      model_info={"param_count": 10_000_000_000,
+                                  "seq_len": 2048, "hidden": 8192,
+                                  "n_layers": 48},
+                      hbm_bytes=16 * 2 ** 30)
+    with pytest.raises(RuntimeError, match="no autotuning trial"):
+        tuner.tune()
+    assert not calls
+    assert len(tuner.pruned) == 2
+    # sanity of the estimator's direction: stage 3 over dp=8 needs less
+    # per-device than stage 0
+    big = estimate_trial_bytes(1_000_000_000, 0, 4, 1024, 4096, 24,
+                               {"data": 8})
+    small = estimate_trial_bytes(1_000_000_000, 3, 4, 1024, 4096, 24,
+                                 {"data": 8})
+    assert small < big
+
+
+def test_student_initialization_layer_reduction():
+    """KD layer-reduction init (reference compress.py:182): student layers
+    seeded from selected teacher layers; embeddings copied verbatim."""
+    from deepspeed_tpu.compression.compress import student_initialization
+    rng = np.random.RandomState(0)
+
+    def layer(seed):
+        r = np.random.RandomState(seed)
+        return {"w": jnp.asarray(r.randn(4, 4), jnp.float32)}
+
+    teacher = {"wte": jnp.asarray(rng.randn(10, 4), jnp.float32),
+               "layers": [layer(i) for i in range(6)]}
+    student = {"wte": jnp.asarray(np.zeros((10, 4)), jnp.float32),
+               "layers": [layer(100 + i) for i in range(3)]}
+    cfg = {"layer_reduction": {"enabled": True,
+                               "module_name_prefix": "layers",
+                               "teacher_layer": [1, 3, 5],
+                               "other_module_name": ["wte"]}}
+    out = student_initialization(student, teacher, cfg)
+    for s_idx, t_idx in enumerate([1, 3, 5]):
+        np.testing.assert_array_equal(np.asarray(out["layers"][s_idx]["w"]),
+                                      np.asarray(teacher["layers"][t_idx]["w"]))
+    np.testing.assert_array_equal(np.asarray(out["wte"]),
+                                  np.asarray(teacher["wte"]))
+    # stacked-array container form (GPT2LMModel "blocks" layout)
+    teacher_s = {"blocks": {"w": jnp.arange(24, dtype=jnp.float32
+                                            ).reshape(6, 4)}}
+    student_s = {"blocks": {"w": jnp.zeros((2, 4), jnp.float32)}}
+    out2 = student_initialization(student_s, teacher_s, {
+        "layer_reduction": {"module_name_prefix": "blocks",
+                            "teacher_layer": [0, 5]}})
+    np.testing.assert_array_equal(np.asarray(out2["blocks"]["w"][1]),
+                                  np.asarray(teacher_s["blocks"]["w"][5]))
+    with pytest.raises(ValueError, match="maps"):
+        student_initialization(student, teacher, {
+            "layer_reduction": {"module_name_prefix": "layers",
+                                "teacher_layer": [0]}})
+
+
+def test_compression_composes_with_tensor_sharding():
+    """The reference needs bespoke ColumnParallelLinear_Compress /
+    RowParallelLinear_Compress classes (basic_layer.py:834-887) because
+    masks must align with each rank's weight slice. Under GSPMD the mask
+    is a global array sharded like the weight, so the SAME compression
+    path serves TP — asserted by parity between a sharded and an
+    unsharded application."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, \
+        set_global_mesh
+    from deepspeed_tpu.compression.compress import (apply_compression,
+                                                    init_compression,
+                                                    seed_masks)
+    mesh = build_mesh(MeshConfig(data=2, tensor=4))
+    set_global_mesh(mesh)
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(16, 32), jnp.float32)
+    params = {"mlp": {"wi": w}}
+    ds = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"sp1": {"params": {"dense_ratio": 0.25},
+                                     "modules": ["*"]}}}}}
+    spec_a = init_compression(params, ds)
+    seed_masks(params, spec_a, step=10)
+    ref = apply_compression(params, spec_a, step=10)
+
+    # column-parallel placement: wi sharded over its out dim
+    sharded = {"mlp": {"wi": jax.device_put(
+        w, NamedSharding(mesh, P(None, "tensor")))}}
+    spec_b = init_compression(sharded, ds)
+    seed_masks(sharded, spec_b, step=10)
+    got = apply_compression(sharded, spec_b, step=10)
+    np.testing.assert_array_equal(np.asarray(got["mlp"]["wi"]),
+                                  np.asarray(ref["mlp"]["wi"]))

@@ -1,0 +1,137 @@
+"""Fused BERT-style training layer (reference ops/transformer/
+transformer.py:459 DeepSpeedTransformerLayer — SURVEY row 27, the
+reference's flagship training kernel). Numerical parity against HF BERT's
+own layer, both LN orderings, grads, mask handling."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+
+pytestmark = pytest.mark.slow  # compile-heavy
+
+E, H, F, B, T = 32, 4, 64, 2, 16
+
+
+def _cfg(**kw):
+    kw.setdefault("hidden_size", E)
+    kw.setdefault("heads", H)
+    kw.setdefault("intermediate_size", F)
+    kw.setdefault("attn_dropout_ratio", 0.0)
+    kw.setdefault("hidden_dropout_ratio", 0.0)
+    kw.setdefault("training", False)
+    return DeepSpeedTransformerConfig(**kw)
+
+
+def test_matches_hf_bert_layer_post_ln():
+    """Post-LN ordering == transformers.BertLayer bit-for-bit-ish."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    hf_cfg = transformers.BertConfig(
+        hidden_size=E, num_attention_heads=H, intermediate_size=F,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12, hidden_act="gelu")
+    hf_cfg._attn_implementation = "eager"  # standalone-module construction
+    torch.manual_seed(0)
+    bl = transformers.models.bert.modeling_bert.BertLayer(hf_cfg).eval()
+
+    at = bl.attention.self
+    qkvw = torch.cat([at.query.weight, at.key.weight, at.value.weight])
+    qkvb = torch.cat([at.query.bias, at.key.bias, at.value.bias])
+    params = DeepSpeedTransformerLayer.from_torch_layout(
+        qkvw.detach(), qkvb.detach(),
+        bl.attention.output.dense.weight.detach(),
+        bl.attention.output.dense.bias.detach(),
+        bl.attention.output.LayerNorm.weight.detach(),
+        bl.attention.output.LayerNorm.bias.detach(),
+        bl.intermediate.dense.weight.detach(),
+        bl.intermediate.dense.bias.detach(),
+        bl.output.dense.weight.detach(),
+        bl.output.dense.bias.detach(),
+        bl.output.LayerNorm.weight.detach(),
+        bl.output.LayerNorm.bias.detach())
+    layer = DeepSpeedTransformerLayer(_cfg(pre_layer_norm=False))
+    x = np.random.RandomState(0).randn(B, T, E).astype(np.float32)
+    ours = np.asarray(layer.apply(params, jnp.asarray(x)))
+    with torch.no_grad():
+        theirs = bl(torch.tensor(x))[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_key_padding_mask():
+    layer = DeepSpeedTransformerLayer(_cfg(pre_layer_norm=True))
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, T, E))
+    mask = np.ones((B, T), np.int32)
+    mask[:, T // 2:] = 0
+    y_masked = layer.apply(params, x, attention_mask=jnp.asarray(mask))
+    # padded keys must not influence live positions: perturb a padded slot
+    x2 = x.at[:, -1].add(100.0)
+    y2 = layer.apply(params, x2, attention_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(
+        np.asarray(y_masked[:, : T // 2]), np.asarray(y2[:, : T // 2]),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_grads_flow_and_flash_path_matches_einsum():
+    """No-mask inference path (Pallas flash, interpret-mode on CPU) agrees
+    with the masked einsum path under an all-ones mask; grads finite."""
+    layer = DeepSpeedTransformerLayer(_cfg(pre_layer_norm=True,
+                                           training=True))
+    params = layer.init(jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (B, T, E))
+    y_flash = layer.apply(params, x, deterministic=True)
+    ones = jnp.ones((B, T), jnp.int32)
+    y_einsum = layer.apply(params, x, attention_mask=ones,
+                           deterministic=True)
+    np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_einsum),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(p):
+        return jnp.sum(layer.apply(p, x, deterministic=True) ** 2)
+    g = jax.grad(loss)(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+    # dropout changes outputs under training rng, deterministically per key
+    layer_d = DeepSpeedTransformerLayer(_cfg(
+        hidden_dropout_ratio=0.3, training=True))
+    p2 = layer_d.init(jax.random.PRNGKey(4))
+    a = layer_d.apply(p2, x, rng=jax.random.PRNGKey(7))
+    b = layer_d.apply(p2, x, rng=jax.random.PRNGKey(7))
+    c = layer_d.apply(p2, x, rng=jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+
+def test_stack_trains():
+    """A 4-layer stack under value_and_grad: loss falls with SGD."""
+    cfgs = _cfg(pre_layer_norm=True, training=True, num_hidden_layers=4)
+    layers = [DeepSpeedTransformerLayer(cfgs) for _ in range(4)]
+    params = [l.init(jax.random.PRNGKey(10 + i))
+              for i, l in enumerate(layers)]
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, T, E))
+    target = jax.random.normal(jax.random.PRNGKey(1), (B, T, E))
+
+    @jax.jit
+    def step(ps):
+        def loss(ps):
+            h = x
+            for l, p in zip(layers, ps):
+                h = l.apply(p, h, deterministic=True)
+            return jnp.mean((h - target) ** 2)
+        v, g = jax.value_and_grad(loss)(ps)
+        return v, jax.tree.map(lambda p, gg: p - 0.3 * gg, ps, g)
+
+    losses = []
+    for _ in range(40):
+        v, params = step(params)
+        losses.append(float(v))
+    # random targets have a high irreducible floor; the property under
+    # test is that gradients flow through the 4-layer stack and descent
+    # makes steady progress toward it
+    assert losses[-1] < 0.92 * losses[0], losses[::8]
+    assert all(b < a + 1e-3 for a, b in zip(losses, losses[1:])), \
+        "loss must decrease monotonically under full-batch SGD"
