@@ -180,11 +180,41 @@ PHASES = {
 }
 
 
+def chip_responsive(timeout_s: float = 60.0) -> bool:
+    """Probe device init in a subprocess. The axon relay can wedge for
+    hours if any client was killed mid-compile (server keeps compiling;
+    every new client blocks silently in device init) — burning phase
+    budgets against a wedged relay records nothing."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def wait_for_chip(budget_left: float) -> bool:
+    """Poll until the relay answers or the budget is nearly gone."""
+    t0 = time.time()
+    while budget_left - (time.time() - t0) > 180:
+        if chip_responsive(60):
+            return True
+        log("relay unresponsive — waiting 60s before re-probing "
+            "(killed-mid-compile wedge; see verify SKILL.md)")
+        time.sleep(60)
+    return chip_responsive(30)
+
+
 def run_phase(name: str, budget_left: float):
     extra, cap = PHASES[name]
     timeout = min(cap, budget_left - 30)
     if timeout < 120:
         log(f"phase {name}: SKIPPED (only {budget_left:.0f}s budget left)")
+        return None
+    if not wait_for_chip(budget_left - timeout):
+        log(f"phase {name}: SKIPPED (relay still wedged)")
         return None
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", name] + extra
     log(f"phase {name}: start (timeout {timeout:.0f}s)")

@@ -196,6 +196,11 @@ class InferenceEngine:
         Mirrors ``InferenceEngine._generate`` (inference/engine.py:523); the
         per-token hot path is the jitted decode step with a donated cache.
         """
+        if self.model_config.head == "none":
+            raise ValueError(
+                "this model has no LM head (CLIP-style encoder) — use "
+                "forward() for hidden states; generate() needs vocabulary "
+                "logits")
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
         if max_new_tokens <= 0:   # no-op budget: prompts unchanged

@@ -68,6 +68,9 @@ class InferenceTransformerConfig:
     num_experts: int = 0
     moe_layers: Optional[tuple] = None       # None + num_experts>0 → all
     moe_top_k: int = 1                       # inference default: top-1
+    # "lm" → project to vocab logits; "none" → return final hidden states
+    # (CLIP text encoder: causal pre-LN trunk with no LM head)
+    head: str = "lm"
     dtype: Any = jnp.bfloat16
 
     @property
@@ -245,6 +248,8 @@ def _act(x, kind):
         return jax.nn.relu(x)
     if kind == "gelu":
         return jax.nn.gelu(x, approximate=False)
+    if kind == "quick_gelu":                 # CLIP: x * sigmoid(1.702 x)
+        return x * jax.nn.sigmoid(1.702 * x)
     return jax.nn.gelu(x, approximate=True)  # gelu_new / gelu_fast
 
 
@@ -582,6 +587,8 @@ def causal_forward(params, cfg: InferenceTransformerConfig, input_ids,
     last-token fast path."""
     x, _ = _causal_trunk(params, cfg, input_ids, None, None,
                          key_mask=attention_mask, mesh=mesh)
+    if cfg.head == "none":
+        return x
     return _logits(params, cfg, x)
 
 

@@ -424,3 +424,117 @@ class DistilBertPolicy(HFPolicy):
                         "wo": _linear_w(b.ffn.lin2, dtype),
                         "bo": _t2j(b.ffn.lin2.bias, dtype)}})
         return cfg, params
+
+
+@register_policy
+class CLIPTextPolicy(HFPolicy):
+    """CLIP text encoder (reference HFCLIPLayerPolicy,
+    replace_policy.py:237): causal pre-LN trunk, quick_gelu, learned
+    positions, no LM head — forward returns final hidden states."""
+    model_types = ("clip", "clip_text_model")
+
+    def convert(self, model, dtype):
+        hf = model.config
+        if getattr(hf, "model_type", None) == "clip":
+            # full CLIPModel: take the text tower (vision/diffusers towers
+            # are out of the text-serving scope, tracked in README)
+            tc = hf.text_config
+            if isinstance(tc, dict):
+                from types import SimpleNamespace
+                tc = SimpleNamespace(**tc)
+            hf = tc
+        E = hf.hidden_size
+        H = hf.num_attention_heads
+        L = hf.num_hidden_layers
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=hf.max_position_embeddings, n_embd=E, n_layer=L,
+            n_head=H, intermediate_size=hf.intermediate_size,
+            activation=getattr(hf, "hidden_act", "quick_gelu"),
+            layer_norm_eps=getattr(hf, "layer_norm_eps", 1e-5),
+            head="none", tied_lm_head=True, dtype=dtype)
+        base = model.text_model if hasattr(model, "text_model") else model
+        emb = base.embeddings
+        params = {"wte": _t2j(emb.token_embedding.weight, dtype),
+                  "wpe": _t2j(emb.position_embedding.weight, dtype),
+                  "ln_f": _ln(base.final_layer_norm, dtype),
+                  "layers": []}
+        for b in base.encoder.layers:
+            at = b.self_attn
+            params["layers"].append({
+                "ln1": _ln(b.layer_norm1, dtype),
+                "ln2": _ln(b.layer_norm2, dtype),
+                "attn": _attn_params(
+                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.k_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.v_proj, dtype).reshape(E, H, D),
+                    _t2j(at.q_proj.bias, dtype).reshape(H, D),
+                    _t2j(at.k_proj.bias, dtype).reshape(H, D),
+                    _t2j(at.v_proj.bias, dtype).reshape(H, D),
+                    _linear_w(at.out_proj, dtype).reshape(H, D, E),
+                    _t2j(at.out_proj.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.fc1, dtype),
+                        "bi": _t2j(b.mlp.fc1.bias, dtype),
+                        "wo": _linear_w(b.mlp.fc2, dtype),
+                        "bo": _t2j(b.mlp.fc2.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
+class MegatronGPT2Policy(HFPolicy):
+    """Megatron-LM GPT-2 (reference MegatronLayerPolicy,
+    replace_policy.py:405): pre-LN, per-head fused QKV, learned positions.
+    Megatron release checkpoints carry no config.json — serve them through
+    the state-dict loader with a config dict
+    ``{"model_type": "megatron-gpt2", "hidden_size": ..., "num_layers":
+    ..., "num_attention_heads": ..., "vocab_size": ...,
+    "max_position_embeddings": ...}``."""
+    model_types = ("megatron-gpt2", "megatron_gpt2")
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E = hf.hidden_size
+        H = hf.num_attention_heads
+        L = getattr(hf, "num_layers", None) or hf.num_hidden_layers
+        D = E // H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=hf.max_position_embeddings, n_embd=E, n_layer=L,
+            n_head=H,
+            intermediate_size=getattr(hf, "ffn_hidden_size", None) or 4 * E,
+            activation="gelu", layer_norm_eps=getattr(
+                hf, "layernorm_epsilon", 1e-5),
+            tied_lm_head=True, dtype=dtype)
+        base = (model.language_model if hasattr(model, "language_model")
+                else model)
+        emb = base.embedding
+        trunk = (base.transformer if hasattr(base, "transformer")
+                 else base.encoder)
+        params = {"wte": _t2j(emb.word_embeddings.weight, dtype),
+                  "wpe": _t2j(emb.position_embeddings.weight, dtype),
+                  "ln_f": _ln(trunk.final_layernorm, dtype),
+                  "layers": []}
+        # fused-QKV layout changed at Megatron checkpoint_version 2.0:
+        # older checkpoints stack [3, H, D] on the out dim (q block, k
+        # block, v block), newer interleave per head [H, 3, D] — the
+        # reference's megatron_v2/version knob (replace_policy.py:409)
+        v2 = float(getattr(hf, "checkpoint_version", 2.0)) >= 2.0
+        split = _split_fused_per_head if v2 else _split_fused_stacked
+        for b in trunk.layers:
+            at = b.attention if hasattr(b, "attention") else b.self_attention
+            W = _linear_w(at.query_key_value, dtype)      # [E, 3E]
+            bias = _t2j(at.query_key_value.bias, dtype)
+            wq, wk, wv, bq, bk, bv = split(W, bias, E, H, D)
+            params["layers"].append({
+                "ln1": _ln(b.input_layernorm, dtype),
+                "ln2": _ln(b.post_attention_layernorm, dtype),
+                "attn": _attn_params(
+                    wq, wk, wv, bq, bk, bv,
+                    _linear_w(at.dense, dtype).reshape(H, D, E),
+                    _t2j(at.dense.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.dense_h_to_4h, dtype),
+                        "bi": _t2j(b.mlp.dense_h_to_4h.bias, dtype),
+                        "wo": _linear_w(b.mlp.dense_4h_to_h, dtype),
+                        "bo": _t2j(b.mlp.dense_4h_to_h.bias, dtype)}})
+        return cfg, params

@@ -1,0 +1,44 @@
+"""Spatial (diffusers) inference ops.
+
+Analog of ``csrc/spatial/`` (N9: ``nhwc_bias_add``, ``nhwc_bias_add_add``,
+``nhwc_bias_add_bias_add`` — ``csrc/spatial/csrc/pt_binding.cpp:108-110``).
+The reference hand-fuses these NHWC epilogues because eager torch would
+materialize each intermediate; under XLA they are single fused HLO ops —
+the value here is keeping the op *surface* so diffusers-style UNet blocks
+port against the same names.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _check_nhwc(x, bias):
+    if x.shape[-1] != bias.shape[-1]:
+        raise ValueError(
+            f"channel-last bias: activation C={x.shape[-1]} vs bias "
+            f"C={bias.shape[-1]}")
+
+
+def nhwc_bias_add(activation, bias):
+    """y = x + b (broadcast over N, H, W)."""
+    _check_nhwc(activation, bias)
+    return activation + bias.astype(activation.dtype)
+
+
+def nhwc_bias_add_add(activation, bias, other):
+    """y = (x + b) + other."""
+    _check_nhwc(activation, bias)
+    if other.shape != activation.shape:
+        raise ValueError(f"residual shape {other.shape} != "
+                         f"{activation.shape}")
+    return activation + bias.astype(activation.dtype) + \
+        other.astype(activation.dtype)
+
+
+def nhwc_bias_add_bias_add(activation, bias, other, other_bias):
+    """y = (x + b) + (other + ob)."""
+    _check_nhwc(activation, bias)
+    _check_nhwc(other, other_bias)
+    return (activation + bias.astype(activation.dtype) +
+            other.astype(activation.dtype) +
+            other_bias.astype(activation.dtype))

@@ -17,9 +17,11 @@ as the reference's idle bubble, fraction ``(P-1)/(M+P-1)``.
 
 Memory: autodiff stashes one residual set per tick — the GPipe profile,
 bounded with ``jax.checkpoint`` on the block fn (pass ``remat=True``).
-DeepSpeed's 1F1B depth-bounded variant (schedule.py) is a host-scheduling
-refinement that XLA's static program cannot express; remat achieves the same
-peak-memory bound by recomputation.
+DeepSpeed's 1F1B depth-bounded variant lives in ``executor.py`` (the
+host-driven schedule interpreter): it bounds activation liveness without
+remat's recompute FLOPs, at the cost of per-instruction dispatch. This
+compiled executor is the single-XLA-program throughput path; pick by
+whether M-independent memory or zero dispatch overhead matters more.
 """
 from __future__ import annotations
 

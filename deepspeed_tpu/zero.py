@@ -1,0 +1,109 @@
+"""User-facing ZeRO context APIs: ``zero.Init`` and ``GatheredParameters``.
+
+Analog of ``runtime/zero/partition_parameters.py`` ``Init`` (:537) and
+``GatheredParameters`` (:1512). The reference hijacks ``nn.Module``
+construction so every parameter partitions the moment it is created, and
+gives users a context that temporarily allgathers partitioned params for
+surgery. Under single-controller JAX the engine already shards params by
+construction (``runtime/engine.py _init_state`` — the ``zero.Init``
+*mechanism* is a jit with ``out_shardings``), so these contexts are thin
+and explicit rather than import-time monkeypatches:
+
+* :class:`Init` — a context that provides the target sharding for
+  freshly created params; ``init.shard(tree)`` places a tree with the
+  engine's ZeRO-3 policy without ever materializing it replicated on one
+  device (the reference's memory-at-construction win).
+* :class:`GatheredParameters` — yields full (host numpy) values of the
+  selected engine params for in-place surgery; modified values are
+  re-placed with their original shardings on exit (``modifier_rank``
+  semantics collapse on a single controller: there is one writer).
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.comm.mesh import build_mesh, get_global_mesh
+from deepspeed_tpu.utils.tree import flatten_with_names
+
+
+class Init:
+    """``with zero.Init(config_dict_or_stage) as zinit: params =
+    zinit.shard(make_params())`` — params land sharded-by-construction."""
+
+    def __init__(self, config_dict_or_path: Any = None, mesh=None,
+                 zero_stage: int = 3, **_):
+        from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
+        if isinstance(config_dict_or_path, str):
+            import json
+            with open(config_dict_or_path) as f:
+                config_dict_or_path = json.load(f)
+        if isinstance(config_dict_or_path, dict):
+            zero_stage = config_dict_or_path.get(
+                "zero_optimization", {}).get("stage", zero_stage)
+        self.mesh = mesh or get_global_mesh()
+        self.policy = ZeroShardingPolicy(zero_stage, self.mesh)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def shard(self, params: Any) -> Any:
+        """Place a param tree with the ZeRO policy's shardings."""
+        return jax.device_put(params, self.policy.param_sharding(params))
+
+
+class GatheredParameters:
+    """``with GatheredParameters(engine, ["wte", "h/0/attn"]) as g:``
+    exposes ``g[name]`` as mutable host numpy; writes re-shard on exit.
+    Paths are the '/'-joined leaf names of ``flatten_with_names`` — an
+    entry selects its exact leaf or every leaf under it as a prefix.
+    ``params=None`` gathers every leaf (small models only — the point of
+    the reference context is to gather a FEW params briefly)."""
+
+    def __init__(self, engine, params: Optional[Iterable[str]] = None,
+                 modifier_rank: Optional[int] = 0, fwd_module=None,
+                 enabled: bool = True):
+        self.engine = engine
+        self.enabled = enabled
+        self.paths = list(params) if params is not None else None
+        self._host: dict = {}
+        self._shardings: dict = {}
+
+    def __enter__(self):
+        if not self.enabled:
+            return self
+        leaves = flatten_with_names(self.engine.state.params)
+        sh = flatten_with_names(self.engine._state_shardings.params)
+        for name, leaf in leaves.items():
+            if self.paths is not None and not any(
+                    name == p or name.startswith(p + "/")
+                    for p in self.paths):
+                continue
+            self._host[name] = np.array(jax.device_get(leaf))
+            self._shardings[name] = sh[name]
+        return self
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._host[name]
+
+    def keys(self):
+        return self._host.keys()
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None or not self.enabled:
+            return False
+        leaves = flatten_with_names(self.engine.state.params)
+        updated = dict(leaves)
+        for name, arr in self._host.items():
+            updated[name] = jax.device_put(
+                arr.astype(leaves[name].dtype), self._shardings[name])
+        treedef = jax.tree_util.tree_structure(self.engine.state.params)
+        self.engine.state = self.engine.state.replace(
+            params=jax.tree_util.tree_unflatten(
+                treedef, [updated[k] for k in leaves]))
+        return False

@@ -1,0 +1,99 @@
+"""zero.Init / GatheredParameters user contexts (reference
+partition_parameters.py:537,1512 — SURVEY row 8) and spatial ops (N9)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import zero
+from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, set_global_mesh
+
+
+def _engine():
+    set_global_mesh(build_mesh(MeshConfig()))
+    params = {"w": jnp.ones((16, 16), jnp.float32),
+              "b": jnp.zeros((16,), jnp.float32)}
+
+    def loss_fn(p, batch, rng):
+        return jnp.mean((batch["x"] @ p["w"] + p["b"]) ** 2)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=params, loss_fn=loss_fn,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+                "zero_optimization": {"stage": 3}})
+    return eng
+
+
+class TestZeroInit:
+    def test_shard_by_construction(self):
+        mesh = build_mesh(MeshConfig())
+        set_global_mesh(mesh)
+        with zero.Init({"zero_optimization": {"stage": 3}}) as zinit:
+            p = zinit.shard({"w": jnp.ones((32, 8), jnp.float32)})
+        # stage 3: params sharded over the data axis, not replicated
+        sh = p["w"].sharding
+        assert not sh.is_fully_replicated
+        assert len(p["w"].devices()) == 8
+
+    def test_stage0_replicates(self):
+        set_global_mesh(build_mesh(MeshConfig()))
+        with zero.Init(zero_stage=0) as zinit:
+            p = zinit.shard({"w": jnp.ones((32, 8), jnp.float32)})
+        assert p["w"].sharding.is_fully_replicated
+
+
+class TestGatheredParameters:
+    def test_surgery_writes_back_sharded(self):
+        eng = _engine()
+        with zero.GatheredParameters(eng, ["w"]) as g:
+            assert list(g.keys()) == ["w"]
+            g["w"][:] = 7.0
+        leaf = dict(
+            deepspeed_tpu.utils.tree.flatten_with_names(
+                eng.state.params))["w"]
+        np.testing.assert_allclose(np.asarray(leaf), 7.0)
+        # the engine's recorded sharding for this leaf is preserved
+        want = dict(deepspeed_tpu.utils.tree.flatten_with_names(
+            eng._state_shardings.params))["w"]
+        assert leaf.sharding == want
+        # training still works after surgery
+        m = eng.train_batch({"x": jnp.ones((8, 16), jnp.float32)})
+        assert np.isfinite(m["loss"])
+
+    def test_exception_discards_writes(self):
+        eng = _engine()
+        before = np.asarray(jax.device_get(dict(
+            deepspeed_tpu.utils.tree.flatten_with_names(
+                eng.state.params))["w"]))
+        with pytest.raises(RuntimeError):
+            with zero.GatheredParameters(eng, ["w"]) as g:
+                g["w"][:] = 9.0
+                raise RuntimeError("surgery failed")
+        after = np.asarray(jax.device_get(dict(
+            deepspeed_tpu.utils.tree.flatten_with_names(
+                eng.state.params))["w"]))
+        np.testing.assert_array_equal(before, after)
+
+    def test_disabled_is_noop(self):
+        eng = _engine()
+        with zero.GatheredParameters(eng, ["w"], enabled=False) as g:
+            assert not list(g.keys())
+
+
+class TestSpatialOps:
+    def test_bias_adds(self):
+        from deepspeed_tpu.ops.spatial import (nhwc_bias_add,
+                                               nhwc_bias_add_add,
+                                               nhwc_bias_add_bias_add)
+        x = jnp.ones((2, 4, 4, 8))
+        b = jnp.full((8,), 2.0)
+        o = jnp.full((2, 4, 4, 8), 3.0)
+        ob = jnp.full((8,), 4.0)
+        np.testing.assert_allclose(np.asarray(nhwc_bias_add(x, b)), 3.0)
+        np.testing.assert_allclose(
+            np.asarray(nhwc_bias_add_add(x, b, o)), 6.0)
+        np.testing.assert_allclose(
+            np.asarray(nhwc_bias_add_bias_add(x, b, o, ob)), 10.0)
+        with pytest.raises(ValueError, match="bias"):
+            nhwc_bias_add(x, jnp.ones((4,)))
