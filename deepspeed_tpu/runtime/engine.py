@@ -1016,6 +1016,49 @@ def initialize(args=None,
         comm.init_distributed()
     cfg = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(
         config if config is not None else (config_params or {}))
+    # PipelineModule routes to the 1F1B PipelineEngine, like the reference
+    # (deepspeed/__init__.py:124-148 chooses PipelineEngine by model type)
+    from deepspeed_tpu.parallel.pipe.module import PipelineModule
+    if isinstance(model, PipelineModule):
+        from deepspeed_tpu.parallel.pipe.executor import PipelineEngine
+        if model_parameters is None:
+            raise ValueError("model_parameters: one param tree per layer")
+        if training_data is not None or collate_fn is not None:
+            raise NotImplementedError(
+                "training_data/collate_fn are not wired into the pipeline "
+                "path yet — iterate your dataloader and call "
+                "engine.train_batch(inputs, labels) directly")
+        mesh = mesh or build_mesh(cfg.mesh)
+        set_global_mesh(mesh)
+        # the batch triad holds on this path too: the number of pipeline
+        # microbatches IS the gradient-accumulation factor
+        cfg.resolve_batch_config(get_data_parallel_world_size(mesh))
+        micro = cfg.gradient_accumulation_steps
+        if optimizer is None:
+            import optax
+            oc = cfg.optimizer
+            otype = (oc.type if oc else "AdamW").lower()
+            p = dict(oc.params) if oc else {}
+            lr = (lr_scheduler if callable(lr_scheduler)
+                  else build_schedule(cfg.scheduler, p)
+                  if cfg.scheduler else p.get("lr", 1e-3))
+            if otype in ("adam", "adamw", "fusedadam"):
+                b1, b2 = p.get("betas", (0.9, 0.999))
+                optimizer = optax.adamw(
+                    lr, b1=b1, b2=b2, eps=p.get("eps", 1e-8),
+                    weight_decay=p.get("weight_decay",
+                                       0.0 if otype == "adam" else 0.01))
+            elif otype == "sgd":
+                optimizer = optax.sgd(lr, momentum=p.get("momentum", 0.0))
+            else:
+                raise NotImplementedError(
+                    f"pipeline path supports Adam/AdamW/SGD configs (got "
+                    f"{otype!r}); pass an optax GradientTransformation as "
+                    f"optimizer= for anything else")
+        engine = PipelineEngine(model, list(model_parameters), optimizer,
+                                micro_batches=micro, loss_fn=loss_fn,
+                                mesh=mesh)
+        return engine, optimizer, None, lr_scheduler
     if loss_fn is None:
         if model is None or not hasattr(model, "loss_fn"):
             raise ValueError(

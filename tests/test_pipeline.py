@@ -440,3 +440,41 @@ class Test1F1BExecutor:
         assert got == pytest.approx(ref, rel=1e-4)
         out = eng.eval_batch(x)
         assert out.shape == (B, self.C)
+
+
+class TestInitializePipelineRouting:
+    def test_initialize_returns_pipeline_engine(self):
+        """deepspeed.initialize(model=PipelineModule) routes to the 1F1B
+        PipelineEngine (reference __init__.py:124-148 model-type switch)."""
+        import deepspeed_tpu
+        from deepspeed_tpu.parallel.pipe import LayerSpec, PipelineEngine, \
+            PipelineModule
+        mesh = build_mesh(MeshConfig(data=2, pipe=4))
+        set_global_mesh(mesh)
+
+        def layer(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        def loss(y, labels):
+            return jnp.mean((y - labels) ** 2)
+
+        k = jax.random.PRNGKey(0)
+        params = [{"w": jax.random.normal(jax.random.fold_in(k, i),
+                                          (8, 8)) * 0.3} for i in range(8)]
+        pm = PipelineModule([LayerSpec(lambda: layer) for _ in range(8)],
+                            num_stages=4, partition_method="uniform",
+                            loss_fn=loss)
+        eng, opt, _, _ = deepspeed_tpu.initialize(
+            model=pm, model_parameters=params, mesh=mesh,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "train_batch_size": 8,
+                    "optimizer": {"type": "AdamW",
+                                  "params": {"lr": 1e-2}}})
+        assert isinstance(eng, PipelineEngine)
+        # triad: 8 global = 2 micro * M gas * 2 dp → M = 2 microbatches
+        assert eng.micro_batches == 2
+        x = jax.random.normal(jax.random.fold_in(k, 9), (8, 8))
+        y = jax.random.normal(jax.random.fold_in(k, 10), (8, 8))
+        m1 = eng.train_batch(x, y)
+        m2 = eng.train_batch(x, y)
+        assert m2["loss"] < m1["loss"]
