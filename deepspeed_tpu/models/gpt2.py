@@ -60,9 +60,12 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
-    # ring attention over the seq mesh axis (capability beyond the reference
-    # — SURVEY §5.7); requires dropout == 0 in the attention core
+    # sequence/context parallelism over the seq mesh axis (capability
+    # beyond the reference — SURVEY §5.7); requires dropout == 0 in the
+    # attention core. sp_mode: "ring" (ppermute K/V ring, O(T/sp) memory)
+    # or "ulysses" (all-to-all head scatter, needs n_head % sp == 0)
     sequence_parallel: bool = False
+    sp_mode: str = "ring"
     # pad vocab to a multiple of 128 (lane width) for MXU efficiency;
     # Megatron does the same for TP divisibility.
     vocab_pad_multiple: int = 128
@@ -71,6 +74,12 @@ class GPT2Config:
     # weights into HBM *inside* its remat region — backward re-fetches, so
     # HBM holds only a few layers of weights at a time.
     offload_params: bool = False
+
+    def __post_init__(self):
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mode must be 'ring' or 'ulysses', got "
+                f"{self.sp_mode!r}")
 
     @property
     def padded_vocab_size(self) -> int:
@@ -109,9 +118,17 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, H, C // H)
 
         if cfg.sequence_parallel and _seq_axis_active():
-            from deepspeed_tpu.ops.ring_attention import ring_self_attention
             from deepspeed_tpu.comm.mesh import get_global_mesh
-            y = ring_self_attention(q, k, v, get_global_mesh())
+            if cfg.sp_mode == "ulysses":
+                # all-to-all SP (DeepSpeed-Ulysses): full-seq attention
+                # over head subsets; needs n_head % sp == 0
+                from deepspeed_tpu.ops.ulysses_attention import (
+                    ulysses_self_attention)
+                y = ulysses_self_attention(q, k, v, get_global_mesh())
+            else:
+                from deepspeed_tpu.ops.ring_attention import (
+                    ring_self_attention)
+                y = ring_self_attention(q, k, v, get_global_mesh())
         elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.attention import causal_attention
             y = causal_attention(q, k, v)

@@ -24,16 +24,20 @@ def _on_tpu() -> bool:
         return False
 
 
-def causal_attention_reference(q, k, v):
+def causal_attention_reference(q, k, v, scale=None, causal=True):
     """Numerics oracle: plain softmax attention, fp32 accumulation.
 
-    Shapes: q/k/v ``[B, T, H, D]`` → ``[B, T, H, D]``.
+    Shapes: q/k/v ``[B, T, H, D]`` → ``[B, T, H, D]``. Also serves the
+    sequence-parallel modes' dense core and degenerate-mesh fallbacks, so
+    scale/causal overrides live HERE, once.
     """
     B, T, H, D = q.shape
-    scale = 1.0 / (D ** 0.5)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
     att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    mask = jnp.tril(jnp.ones((T, T), bool))
-    att = jnp.where(mask[None, None], att, -1e30)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        att = jnp.where(mask[None, None], att, -1e30)
     att = jax.nn.softmax(att, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", att.astype(v.dtype), v)
 

@@ -168,9 +168,8 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     mesh = mesh or get_global_mesh()
     if SEQ_AXIS not in mesh.axis_names or mesh.shape[SEQ_AXIS] == 1:
         from deepspeed_tpu.ops.attention import causal_attention_reference
-        if not causal:
-            raise ValueError("non-causal path requires seq axis > 1")
-        return causal_attention_reference(q, k, v)
+        return causal_attention_reference(q, k, v, scale=scale,
+                                          causal=causal)
     sp = mesh.shape[SEQ_AXIS]
     if q.shape[1] % sp:
         raise ValueError(f"seq len {q.shape[1]} not divisible by seq "

@@ -1,0 +1,87 @@
+"""DeepSpeed-Ulysses-style all-to-all sequence parallelism.
+
+The second of the two first-class long-context modes (SURVEY §5.7 — the
+reference snapshot has neither; ring attention lives in
+``ops/ring_attention.py``). Ulysses (arXiv:2309.14509) keeps activations
+sharded over the sequence axis everywhere EXCEPT inside attention: an
+all-to-all re-partitions [B, T/sp, H, D] → [B, T, H/sp, D] (full sequence,
+head subset), runs ordinary dense attention per local head group, and a
+second all-to-all restores sequence sharding. Communication volume is
+O(T·H·D/sp) per device — constant in sequence-parallel degree — versus the
+ring's sp-1 neighbour hops; Ulysses wins when heads are plentiful and the
+interconnect favours all-to-all (TPU ICI does), the ring wins when
+sp > heads or memory must stay strictly O(T/sp) inside attention too.
+
+Both entry points mirror ring_attention's: a shard_map-internal form and a
+global-array wrapper. Requires ``n_head % sp == 0``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import get_global_mesh
+
+SEQ_AXIS = "seq"
+
+
+def _dense_attention(q, k, v, causal, scale):
+    """[B, T, h, D] full-sequence attention — the shared numerics oracle
+    (one implementation to keep in agreement, ops/attention.py)."""
+    from deepspeed_tpu.ops.attention import causal_attention_reference
+    return causal_attention_reference(q, k, v, scale=scale, causal=causal)
+
+
+def ulysses_attention_sharded(q, k, v, axis_name: str = SEQ_AXIS,
+                              causal: bool = True,
+                              scale: Optional[float] = None):
+    """Call INSIDE a shard_map manual over ``axis_name``.
+
+    q/k/v: per-device sequence shards ``[B, T/sp, H, D]`` with
+    ``H %%SP == 0``. Returns the same layout.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    sp = jax.lax.axis_size(axis_name)
+
+    def seq_to_head(x):
+        # [B, T/sp, H, D] → [B, T, H/sp, D]: scatter heads, gather seq
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    if q.shape[2] % sp:
+        raise ValueError(f"n_head {q.shape[2]} not divisible by seq "
+                         f"axis {sp} (use ring attention instead)")
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    out = _dense_attention(qh, kh, vh, causal, float(scale))
+    return head_to_seq(out)
+
+
+def ulysses_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                           causal: bool = True,
+                           scale: Optional[float] = None):
+    """Global-array entry point: shards [B, T, H, D] over the ``seq`` axis
+    and runs the all-to-all pair. Works inside jit (other mesh axes stay
+    automatic)."""
+    mesh = mesh or get_global_mesh()
+    if SEQ_AXIS not in mesh.axis_names or mesh.shape[SEQ_AXIS] == 1:
+        from deepspeed_tpu.ops.attention import causal_attention_reference
+        return causal_attention_reference(q, k, v, scale=scale,
+                                          causal=causal)
+    sp = mesh.shape[SEQ_AXIS]
+    if q.shape[1] % sp:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by seq "
+                         f"axis {sp}")
+    fn = functools.partial(ulysses_attention_sharded, causal=causal,
+                           scale=scale)
+    spec = P(None, SEQ_AXIS, None, None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={SEQ_AXIS})(q, k, v)

@@ -1028,6 +1028,10 @@ def initialize(args=None,
                 "training_data/collate_fn are not wired into the pipeline "
                 "path yet — iterate your dataloader and call "
                 "engine.train_batch(inputs, labels) directly")
+        if tp_specs is not None:
+            raise NotImplementedError(
+                "tp_specs are not applied on the pipeline path yet (stage "
+                "params are replicated within each stage sub-mesh)")
         mesh = mesh or build_mesh(cfg.mesh)
         set_global_mesh(mesh)
         # the batch triad holds on this path too: the number of pipeline
