@@ -30,9 +30,17 @@ SEQ_AXIS = "seq"
 
 
 def _dense_attention(q, k, v, causal, scale):
-    """[B, T, h, D] full-sequence attention — the shared numerics oracle
-    (one implementation to keep in agreement, ops/attention.py)."""
-    from deepspeed_tpu.ops.attention import causal_attention_reference
+    """[B, T, h, D] full-sequence attention over the local head subset.
+
+    The long-context point of Ulysses dies with an O(T²) score matrix, so
+    the causal/default-scale case (what the gpt2 integration produces)
+    routes through ``causal_attention`` — the Pallas flash kernel on TPU.
+    Other cases fall back to the shared dense oracle."""
+    from deepspeed_tpu.ops.attention import (causal_attention,
+                                             causal_attention_reference)
+    default_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if causal and abs(scale - default_scale) < 1e-12:
+        return causal_attention(q, k, v)
     return causal_attention_reference(q, k, v, scale=scale, causal=causal)
 
 
