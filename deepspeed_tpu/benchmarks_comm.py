@@ -1,0 +1,100 @@
+"""Collective micro-benchmarks — the ``ds_bench`` /
+``benchmarks/communication/*`` analog: sweep message sizes over
+all_reduce / all_gather / reduce_scatter / all_to_all / ppermute on the
+live device set and report algorithmic bandwidth. On the virtual CPU mesh
+the numbers are meaningless but the sweep validates every collective
+lowers and runs; on real slices it measures ICI.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+               "ppermute")
+
+
+def _op(name: str, axis: str, n: int):
+    if name == "all_reduce":
+        return lambda x: jax.lax.psum(x, axis)
+    if name == "all_gather":
+        return lambda x: jax.lax.all_gather(x, axis)
+    if name == "reduce_scatter":
+        return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
+    if name == "all_to_all":
+        return lambda x: jax.lax.all_to_all(
+            x.reshape(n, -1), axis, split_axis=0, concat_axis=0,
+            tiled=False).reshape(-1)
+    if name == "ppermute":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return lambda x: jax.lax.ppermute(x, axis, perm)
+    raise ValueError(name)
+
+
+def _bus_bytes(name: str, nbytes: int, n: int) -> float:
+    """Algorithmic bus bytes per device (ring conventions, as the
+    reference's bandwidth formulas)."""
+    if name == "all_reduce":
+        return 2 * nbytes * (n - 1) / n
+    if name in ("all_gather", "reduce_scatter"):
+        return nbytes * (n - 1) / n
+    if name == "all_to_all":
+        return nbytes * (n - 1) / n
+    return nbytes  # ppermute: one hop
+
+
+def run_sweep(sizes_mb=(1, 4, 16), trials: int = 5,
+              collectives=COLLECTIVES, axis: str = "data",
+              mesh: Mesh = None) -> List[Dict]:
+    devs = jax.devices()
+    n = len(devs)
+    mesh = mesh or Mesh(np.asarray(devs), (axis,))
+    results = []
+    for name in collectives:
+        for mb in sizes_mb:
+            elems = int(mb * (1 << 20)) // 4
+            per_dev = max(n, elems // n * n)  # divisible local chunks
+            x = jnp.ones((per_dev,), jnp.float32)
+            fn = jax.jit(jax.shard_map(
+                _op(name, axis, n), mesh=mesh, in_specs=P(axis),
+                out_specs=P(axis) if name != "all_gather" else P(),
+                check_vma=False))
+            y = fn(x)
+            jax.block_until_ready(y)
+            t0 = time.perf_counter()
+            for _ in range(trials):
+                y = fn(x)
+            jax.block_until_ready(y)
+            float(jnp.sum(y.reshape(-1)[:1]))  # relay-safe sync
+            dt = (time.perf_counter() - t0) / trials
+            nbytes = per_dev // n * 4  # per-device payload
+            busbw = _bus_bytes(name, nbytes * n, n) / max(dt, 1e-9)
+            results.append({
+                "collective": name, "size_mb": mb, "devices": n,
+                "latency_ms": round(dt * 1e3, 3),
+                "busbw_gbps": round(busbw / (1 << 30), 3)})
+    return results
+
+
+def main() -> None:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(description="collective bandwidth sweep")
+    ap.add_argument("--sizes-mb", default="1,4,16")
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--collectives", default=",".join(COLLECTIVES))
+    args = ap.parse_args()
+    out = run_sweep(tuple(float(s) for s in args.sizes_mb.split(",")),
+                    args.trials, tuple(args.collectives.split(",")))
+    for r in out:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

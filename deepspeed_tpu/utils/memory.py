@@ -1,0 +1,38 @@
+"""Memory introspection — ``see_memory_usage`` analog (reference
+``runtime/utils.py``: prints torch.cuda allocated/cached plus host
+memory at checkpoints the engine chooses). TPU version reads the device
+allocator stats through the accelerator seam and host RSS via psutil."""
+from __future__ import annotations
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def see_memory_usage(message: str, force: bool = False,
+                     ranks=(0,)) -> dict:
+    """Log device + host memory. Returns the numbers for programmatic use
+    (the engine's memory_breakdown config calls this around steps)."""
+    import jax
+    if not force:
+        return {}
+    from deepspeed_tpu.accelerator import get_accelerator
+    acc = get_accelerator()
+    stats = acc.memory_stats()
+    dev_used = stats.get("bytes_in_use", 0)
+    dev_peak = stats.get("peak_bytes_in_use", dev_used)
+    dev_limit = stats.get("bytes_limit", 0)
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        host_used, host_total = vm.used, vm.total
+    except ImportError:
+        host_used = host_total = 0
+    gb = 1 << 30
+    if jax.process_index() in ranks or ranks is None:
+        logger.info(
+            f"{message} | device MA {dev_used / gb:.2f} GB "
+            f"peak {dev_peak / gb:.2f} GB limit {dev_limit / gb:.2f} GB | "
+            f"host {host_used / gb:.2f}/{host_total / gb:.2f} GB")
+    return {"device_bytes_in_use": dev_used,
+            "device_peak_bytes": dev_peak,
+            "device_bytes_limit": dev_limit,
+            "host_used_bytes": host_used, "host_total_bytes": host_total}

@@ -500,3 +500,17 @@ def test_compression_composes_with_tensor_sharding():
     got = apply_compression(sharded, spec_b, step=10)
     np.testing.assert_array_equal(np.asarray(got["mlp"]["wi"]),
                                   np.asarray(ref["mlp"]["wi"]))
+
+
+def test_comm_bench_sweep_and_memory_usage():
+    """ds_bench analog: every collective lowers and runs on the virtual
+    mesh with positive bandwidth numbers; see_memory_usage reports."""
+    from deepspeed_tpu.benchmarks_comm import COLLECTIVES, run_sweep
+    from deepspeed_tpu.utils.memory import see_memory_usage
+    out = run_sweep(sizes_mb=(0.25,), trials=1)
+    assert {r["collective"] for r in out} == set(COLLECTIVES)
+    assert all(r["latency_ms"] > 0 and r["busbw_gbps"] >= 0 for r in out)
+    assert all(r["devices"] == 8 for r in out)
+    mem = see_memory_usage("test", force=True)
+    assert mem["host_total_bytes"] > 0
+    assert see_memory_usage("quiet") == {}  # force=False is free
