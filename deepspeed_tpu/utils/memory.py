@@ -27,7 +27,7 @@ def see_memory_usage(message: str, force: bool = False,
     except ImportError:
         host_used = host_total = 0
     gb = 1 << 30
-    if jax.process_index() in ranks or ranks is None:
+    if ranks is None or jax.process_index() in ranks:
         logger.info(
             f"{message} | device MA {dev_used / gb:.2f} GB "
             f"peak {dev_peak / gb:.2f} GB limit {dev_limit / gb:.2f} GB | "

@@ -509,7 +509,7 @@ def test_comm_bench_sweep_and_memory_usage():
     from deepspeed_tpu.utils.memory import see_memory_usage
     out = run_sweep(sizes_mb=(0.25,), trials=1)
     assert {r["collective"] for r in out} == set(COLLECTIVES)
-    assert all(r["latency_ms"] > 0 and r["busbw_gbps"] >= 0 for r in out)
+    assert all(r["latency_ms"] > 0 and r["busbw_GiBps"] >= 0 for r in out)
     assert all(r["devices"] == 8 for r in out)
     mem = see_memory_usage("test", force=True)
     assert mem["host_total_bytes"] > 0
