@@ -113,6 +113,60 @@ def phase_train(args) -> dict:
     }
 
 
+def phase_train_bert(args) -> dict:
+    """BERT-large MLM pre-training throughput — the reference's flagship
+    training-kernel headline (64 TFLOPS/GPU BERT-large, SURVEY §6)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    log(f"backend={jax.default_backend()} devices={jax.device_count()}")
+    import deepspeed_tpu
+    from deepspeed_tpu.models.bert import BertPreTrainingModel, config_for
+
+    n_chips = jax.device_count()
+    cfg = config_for("bert-large", dtype=jnp.bfloat16,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0,
+                     max_position_embeddings=args.seq)
+    model = BertPreTrainingModel(cfg)
+    log(f"init bert-large seq={args.seq}")
+    params = model.init(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config={
+            "train_micro_batch_size_per_gpu": args.micro,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1}})
+    del params
+    log("engine ready")
+    bs = engine.train_batch_size
+    rs = np.random.default_rng(0)
+    ids = rs.integers(0, cfg.vocab_size, (bs, args.seq)).astype(np.int32)
+    labels = np.where(rs.random((bs, args.seq)) < 0.15, ids, -100)
+    batch = {"input_ids": jnp.asarray(ids),
+             "mlm_labels": jnp.asarray(labels, jnp.int32),
+             "nsp_labels": jnp.asarray(rs.integers(0, 2, (bs,)),
+                                       jnp.int32)}
+    t = time.time()
+    float(engine.train_batch(batch)["loss"])
+    log(f"step 1 (compile) done in {time.time() - t:.1f}s")
+    t0 = time.time()
+    for _ in range(args.steps):
+        m = engine.train_batch(batch)
+    float(m["loss"])
+    dt = time.time() - t0
+    log(f"{args.steps} steps in {dt:.2f}s")
+    tps = bs * args.seq * args.steps / dt / n_chips
+    fpt = model.flops_per_token()
+    return {"phase": "train-bert-large", "preset": "bert-large",
+            "tokens_per_sec_per_chip": round(tps, 2),
+            "tflops_per_chip": round(tps * fpt / 1e12, 2),
+            "flops_per_token": fpt, "seq": args.seq,
+            "global_batch": bs, "chips": n_chips,
+            "ms_per_step": round(dt / args.steps * 1e3, 1),
+            "vs_bert_baseline_64tflops": round(tps * fpt / 64e12, 3)}
+
+
 def phase_infer(args) -> dict:
     import jax
     import jax.numpy as jnp
@@ -184,6 +238,8 @@ PHASES = {
     # After inference so a tight budget never loses the p50 metric.
     "train-350m-noremat": (["--preset", "gpt2-350m", "--no-flash",
                             "--no-remat"], 480),
+    # the reference's training-kernel headline: BERT-large (64 TFLOPS/GPU)
+    "train-bert-large": (["--seq", "512", "--micro", "16"], 480),
     "train-350m-flash": (["--preset", "gpt2-350m"], 480),
 }
 
@@ -266,7 +322,9 @@ def main() -> None:
         if plat:  # testing hook — the axon sitecustomize pins JAX_PLATFORMS
             import jax
             jax.config.update("jax_platforms", plat)
-        fn = phase_infer if args.phase == "inference" else phase_train
+        fn = (phase_infer if args.phase == "inference" else
+              phase_train_bert if args.phase == "train-bert-large" else
+              phase_train)
         print(json.dumps(fn(args)), flush=True)
         return
 

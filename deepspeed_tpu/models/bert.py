@@ -1,0 +1,181 @@
+"""BERT pre-training model family — the reference's flagship training bench.
+
+The reference's headline training kernel is the BERT encoder layer
+(``ops/transformer/transformer.py:459``; benchmarked via BingBertSquad and
+bert-bench, SURVEY §4/§6). This module assembles that layer
+(:mod:`deepspeed_tpu.ops.transformer`) into an engine-ready masked-LM (+
+optional NSP) pre-training model: ``init`` → param pytree, ``loss_fn(params,
+batch, rng)`` → scalar, so ``deepspeed_tpu.initialize`` drives it like any
+other model, composing with ZeRO/offload/precision untouched.
+
+Batch schema (BingBertSquad-style pre-training):
+    input_ids      [B, T] int32
+    attention_mask [B, T] int32 (1 = live)           optional
+    token_type_ids [B, T] int32                      optional
+    mlm_labels     [B, T] int32, -100 = not masked   (MLM loss)
+    nsp_labels     [B] int32 in {0, 1}               optional (NSP loss)
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True      # reference default (preln modeling)
+    with_nsp: bool = True
+    dtype: Any = jnp.bfloat16
+
+
+PRESETS: Dict[str, dict] = {
+    "bert-base": dict(hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072),
+    "bert-large": dict(hidden_size=1024, num_hidden_layers=24,
+                       num_attention_heads=16, intermediate_size=4096),
+}
+
+
+def config_for(name: str, **overrides) -> BertConfig:
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r}: {sorted(PRESETS)}")
+    return BertConfig(**{**PRESETS[name], **overrides})
+
+
+class BertPreTrainingModel:
+    """Engine-facing BERT MLM(+NSP) model over the fused training layer."""
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        layer_cfg = DeepSpeedTransformerConfig(
+            hidden_size=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+            heads=config.num_attention_heads,
+            attn_dropout_ratio=config.attention_probs_dropout_prob,
+            hidden_dropout_ratio=config.hidden_dropout_prob,
+            num_hidden_layers=config.num_hidden_layers,
+            initializer_range=config.initializer_range,
+            layer_norm_eps=config.layer_norm_eps,
+            pre_layer_norm=config.pre_layer_norm,
+            fp16=config.dtype == jnp.bfloat16,
+            training=True)
+        self.layers = [DeepSpeedTransformerLayer(layer_cfg)
+                       for _ in range(config.num_hidden_layers)]
+
+    # -- init --------------------------------------------------------------
+    def init(self, rng, **_) -> Dict[str, Any]:
+        cfg = self.config
+        E = cfg.hidden_size
+        k = iter(jax.random.split(rng, 6 + cfg.num_hidden_layers))
+        std = cfg.initializer_range
+        dt = cfg.dtype
+
+        def emb(key, shape):
+            return (jax.random.normal(key, shape, jnp.float32) * std
+                    ).astype(dt)
+
+        params: Dict[str, Any] = {
+            "wte": emb(next(k), (cfg.vocab_size, E)),
+            "wpe": emb(next(k), (cfg.max_position_embeddings, E)),
+            "wtte": emb(next(k), (cfg.type_vocab_size, E)),
+            "emb_ln": {"scale": jnp.ones((E,), dt),
+                       "bias": jnp.zeros((E,), dt)},
+            "layers": [l.init(next(k)) for l in self.layers],
+            # MLM head: dense + LN, unembedding tied to wte + output bias
+            "mlm_dense": {"w": emb(next(k), (E, E)),
+                          "b": jnp.zeros((E,), dt)},
+            "mlm_ln": {"scale": jnp.ones((E,), dt),
+                       "bias": jnp.zeros((E,), dt)},
+            "mlm_bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+        }
+        if cfg.with_nsp:
+            params["pooler"] = {"w": emb(next(k), (E, E)),
+                                "b": jnp.zeros((E,), dt)}
+            params["nsp"] = {"w": emb(jax.random.fold_in(rng, 99), (E, 2)),
+                             "b": jnp.zeros((2,), jnp.float32)}
+        return params
+
+    # -- forward -----------------------------------------------------------
+    def _ln(self, x, p):
+        eps = self.config.layer_norm_eps
+        m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
+        v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+        return ((x.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps) *
+                p["scale"].astype(jnp.float32) +
+                p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+    def encode(self, params, input_ids, attention_mask=None,
+               token_type_ids=None, rng=None, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(input_ids))
+        x = (params["wte"][input_ids] +
+             params["wpe"][jnp.arange(T)][None] +
+             params["wtte"][tt]).astype(cfg.dtype)
+        x = self._ln(x, params["emb_ln"])
+        for layer, lp in zip(self.layers, params["layers"]):
+            if rng is not None:
+                rng = jax.random.fold_in(rng, 1)
+            x = layer.apply(lp, x, attention_mask=attention_mask, rng=rng,
+                            deterministic=deterministic)
+        return x
+
+    # -- losses ------------------------------------------------------------
+    def loss_fn(self, params, batch, rng=None):
+        cfg = self.config
+        x = self.encode(params, batch["input_ids"],
+                        batch.get("attention_mask"),
+                        batch.get("token_type_ids"), rng=rng,
+                        deterministic=rng is None)
+        # MLM head over masked positions
+        h = x @ params["mlm_dense"]["w"] + params["mlm_dense"]["b"]
+        h = jax.nn.gelu(h.astype(jnp.float32),
+                        approximate=False).astype(x.dtype)
+        h = self._ln(h, params["mlm_ln"])
+        logits = (h @ params["wte"].astype(h.dtype).T
+                  ).astype(jnp.float32) + params["mlm_bias"]
+        labels = batch["mlm_labels"]
+        live = labels != -100
+        safe = jnp.where(live, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_ll = jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+        denom = jnp.maximum(jnp.sum(live), 1)
+        loss = -jnp.sum(jnp.where(live, tok_ll, 0.0)) / denom
+        if cfg.with_nsp and "nsp_labels" in batch:
+            pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"] +
+                              params["pooler"]["b"])
+            nsp_logits = (pooled @ params["nsp"]["w"].astype(pooled.dtype)
+                          ).astype(jnp.float32) + params["nsp"]["b"]
+            nsp_lp = jax.nn.log_softmax(nsp_logits, -1)
+            nsp_ll = jnp.take_along_axis(
+                nsp_lp, batch["nsp_labels"][:, None], -1)[:, 0]
+            loss = loss - jnp.mean(nsp_ll)
+        return loss
+
+    def flops_per_token(self) -> float:
+        """6N per token (training fwd+bwd), N = encoder+head params."""
+        cfg = self.config
+        E, F, L = cfg.hidden_size, cfg.intermediate_size, \
+            cfg.num_hidden_layers
+        per_layer = 4 * E * E + 2 * E * F
+        n = L * per_layer + cfg.vocab_size * E
+        return 6.0 * n
