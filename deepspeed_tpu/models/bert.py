@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
-                                           DeepSpeedTransformerLayer)
+                                           DeepSpeedTransformerLayer,
+                                           layer_norm_fp32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,8 +64,12 @@ def config_for(name: str, **overrides) -> BertConfig:
 class BertPreTrainingModel:
     """Engine-facing BERT MLM(+NSP) model over the fused training layer."""
 
-    def __init__(self, config: BertConfig):
+    def __init__(self, config: BertConfig, train: bool = True):
+        """``train=False`` disables dropout regardless of rng — the engine
+        threads an rng into every loss call (including no-grad forward),
+        so rng presence alone must not mean "apply dropout"."""
         self.config = config
+        self.train = train
         layer_cfg = DeepSpeedTransformerConfig(
             hidden_size=config.hidden_size,
             intermediate_size=config.intermediate_size,
@@ -115,12 +120,8 @@ class BertPreTrainingModel:
 
     # -- forward -----------------------------------------------------------
     def _ln(self, x, p):
-        eps = self.config.layer_norm_eps
-        m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
-        v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
-        return ((x.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps) *
-                p["scale"].astype(jnp.float32) +
-                p["bias"].astype(jnp.float32)).astype(x.dtype)
+        return layer_norm_fp32(x, p["scale"], p["bias"],
+                               self.config.layer_norm_eps)
 
     def encode(self, params, input_ids, attention_mask=None,
                token_type_ids=None, rng=None, deterministic=True):
@@ -145,7 +146,7 @@ class BertPreTrainingModel:
         x = self.encode(params, batch["input_ids"],
                         batch.get("attention_mask"),
                         batch.get("token_type_ids"), rng=rng,
-                        deterministic=rng is None)
+                        deterministic=(not self.train) or rng is None)
         # MLM head over masked positions
         h = x @ params["mlm_dense"]["w"] + params["mlm_dense"]["b"]
         h = jax.nn.gelu(h.astype(jnp.float32),

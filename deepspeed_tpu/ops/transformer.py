@@ -36,6 +36,16 @@ import jax
 import jax.numpy as jnp
 
 
+def layer_norm_fp32(x, scale, bias, eps):
+    """fp32-accumulation LayerNorm (the reference's normalize_kernels.cu
+    semantics) — THE shared implementation for the training stack."""
+    m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
+    v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+    y = (x.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps)
+    return (y * scale.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
 @dataclasses.dataclass(frozen=True)
 class DeepSpeedTransformerConfig:
     """Reference config surface (transformer.py:38) minus CUDA-isms."""
@@ -127,12 +137,7 @@ class DeepSpeedTransformerLayer:
 
     # -- forward ----------------------------------------------------------
     def _ln(self, x, w, b):
-        m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
-        v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
-        y = (x.astype(jnp.float32) - m) * jax.lax.rsqrt(
-            v + self.config.layer_norm_eps)
-        return (y * w.astype(jnp.float32) +
-                b.astype(jnp.float32)).astype(x.dtype)
+        return layer_norm_fp32(x, w, b, self.config.layer_norm_eps)
 
     def _dropout(self, x, rate, rng, deterministic):
         if deterministic or rate <= 0.0 or rng is None:

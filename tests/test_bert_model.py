@@ -98,9 +98,14 @@ def test_masked_positions_only():
     # (changing the INPUT at that position does change the loss)
     b2["input_ids"] = jnp.asarray(ids2)
     assert float(model.loss_fn(params, b2)) != base
-    lab2 = lab.copy()
-    lab2[lab2 == -100] = 5  # pretend-labels at unmasked spots... but keep
-    # the live mask: -100 semantics are what exclude them
+    # exclusion: with EVERY position masked out the MLM loss is exactly 0
     b3 = dict(b)
-    b3["mlm_labels"] = jnp.asarray(np.where(lab == -100, -100, lab))
-    assert float(model.loss_fn(params, b3)) == pytest.approx(base)
+    b3["mlm_labels"] = jnp.full_like(b["mlm_labels"], -100)
+    assert float(model.loss_fn(params, b3)) == 0.0
+    # inclusion: changing the label VALUE at a live position moves the loss
+    live_pos = np.argwhere(lab != -100)[0]
+    lab2 = lab.copy()
+    lab2[live_pos[0], live_pos[1]] = (lab2[live_pos[0], live_pos[1]] + 1) % V
+    b4 = dict(b)
+    b4["mlm_labels"] = jnp.asarray(lab2)
+    assert float(model.loss_fn(params, b4)) != base
