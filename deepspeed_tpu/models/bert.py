@@ -180,3 +180,32 @@ class BertPreTrainingModel:
         per_layer = 4 * E * E + 2 * E * F
         n = L * per_layer + cfg.vocab_size * E
         return 6.0 * n
+
+    # -- TP ----------------------------------------------------------------
+    def tp_specs(self):
+        """Megatron column/row-parallel PartitionSpecs for the engine's
+        sharding policy: QKV + FFN-in column-parallel over 'tensor',
+        attn-out + FFN-out row-parallel; embeddings/norms replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        def layer_spec():
+            return {
+                "attn_qkvw": P(None, "tensor"), "attn_qkvb": P("tensor"),
+                "attn_ow": P("tensor", None), "attn_ob": P(),
+                "attn_nw": P(), "attn_nb": P(),
+                "inter_w": P(None, "tensor"), "inter_b": P("tensor"),
+                "output_w": P("tensor", None), "output_b": P(),
+                "norm_w": P(), "norm_b": P(),
+            }
+        specs = {
+            "wte": P(), "wpe": P(), "wtte": P(),
+            "emb_ln": {"scale": P(), "bias": P()},
+            "layers": [layer_spec() for _ in self.layers],
+            "mlm_dense": {"w": P(), "b": P()},
+            "mlm_ln": {"scale": P(), "bias": P()},
+            "mlm_bias": P(),
+        }
+        if self.config.with_nsp:
+            specs["pooler"] = {"w": P(), "b": P()}
+            specs["nsp"] = {"w": P(), "b": P()}
+        return specs
