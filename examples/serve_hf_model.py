@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Serve an HF checkpoint directory with TP / int8 / MoE knobs.
+
+  python examples/serve_hf_model.py /path/to/gpt2-checkpoint \
+      --dtype int8 --prompt "1 2 3 4"
+"""
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="HF checkpoint dir (config.json + "
+                                 "safetensors/bin) or nothing to demo "
+                                 "with a random tiny model")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--prompt-ids", default="1,2,3,4",
+                    help="comma-separated token ids (no tokenizer dep)")
+    args = ap.parse_args()
+
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        args.path, dtype=args.dtype, tp={"tp_size": args.tp})
+    prompt = [int(t) for t in args.prompt_ids.split(",")]
+    out = eng.generate([prompt], max_new_tokens=args.max_new_tokens)
+    print("generated ids:", out[0])
+
+
+if __name__ == "__main__":
+    main()
