@@ -186,6 +186,7 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0,
                  eos_token_id: Optional[int] = None,
                  attention_mask=None, seed: int = 0) -> list:
         """Greedy/sampled generation. ``input_ids``: a list of token lists
@@ -219,10 +220,11 @@ class InferenceEngine:
             lengths=jnp.asarray(lengths), cache=cache)
 
         loop = self._generate_loop(max_new_tokens, float(temperature) > 0.0,
-                                   int(top_k) > 0)
+                                   int(top_k) > 0, float(top_p) > 0.0)
         out_buf, n_gen, _ = loop(
             self.params, logits, cache, jax.random.PRNGKey(seed),
             jnp.float32(temperature), jnp.int32(top_k),
+            jnp.float32(top_p),
             jnp.int32(-1 if eos_token_id is None else eos_token_id))
         # ONE host sync per generation (the reference built CUDA graphs to
         # kill per-token launch overhead, inference/engine.py:454-473; the
@@ -233,21 +235,21 @@ class InferenceEngine:
                 + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
 
     def _generate_loop(self, max_new_tokens: int, sampled: bool,
-                       top_k_on: bool):
+                       top_k_on: bool, top_p_on: bool = False):
         """Compile (and cache) the whole decode loop as ONE program: a
         ``lax.while_loop`` over the donated KV cache with on-device
         sampling and EOS bookkeeping. Early-exits when every row is done.
         Only structure is baked into the compile key (length, greedy vs
         sampled, top-k on/off); temperature/top_k/eos ride as traced
         scalars so sweeps over them don't recompile."""
-        key = (max_new_tokens, sampled, top_k_on)
+        key = (max_new_tokens, sampled, top_k_on, top_p_on)
         loop = self._gen_loops.get(key)
         if loop is not None:
             return loop
         cfg = self.model_config
         mesh = self.mesh  # MoE: decode hot path needs the EP constraint too
 
-        def select(lg, rng, temperature, top_k):
+        def select(lg, rng, temperature, top_k, top_p):
             if not sampled:
                 return jnp.argmax(lg, -1).astype(jnp.int32)
             lg = lg / temperature
@@ -256,16 +258,27 @@ class InferenceEngine:
                     jnp.sort(lg, -1), lg.shape[-1] - top_k[None, None],
                     axis=-1)
                 lg = jnp.where(lg < kth, -1e30, lg)
+            if top_p_on:
+                # nucleus sampling: keep the smallest prefix of the
+                # descending-probability ordering whose mass >= top_p
+                srt = jnp.sort(lg, -1)[..., ::-1]
+                probs = jax.nn.softmax(srt, -1)
+                cum = jnp.cumsum(probs, -1)
+                keep = cum - probs < top_p[None, None]  # always keep top-1
+                cutoff = jnp.max(jnp.where(keep, srt, -jnp.inf), -1,
+                                 keepdims=True)
+                lg = jnp.where(lg < cutoff, -1e30, lg)
             return jax.random.categorical(rng, lg, -1).astype(jnp.int32)
 
-        def run(params, logits, cache, rng, temperature, top_k, eos):
+        def run(params, logits, cache, rng, temperature, top_k, top_p,
+                eos):
             B = logits.shape[0]
             # token 0 comes from the prefill logits; each loop iteration
             # decodes the previous token first, so the final token never
             # pays a wasted trailing decode_step. eos == -1 disables EOS
             # stopping (token ids are non-negative).
             rng, sub = jax.random.split(rng)
-            tok = select(logits, sub, temperature, top_k)
+            tok = select(logits, sub, temperature, top_k, top_p)
             out = jnp.zeros((B, max_new_tokens), jnp.int32).at[:, 0].set(tok)
             done = tok == eos
             n_gen = jnp.ones((B,), jnp.int32)
@@ -278,7 +291,7 @@ class InferenceEngine:
                 step, tok, cache, done, out, n_gen, rng = c
                 lg, cache = decode_step(params, cfg, tok, cache, mesh=mesh)
                 rng, sub = jax.random.split(rng)
-                nxt = select(lg, sub, temperature, top_k)
+                nxt = select(lg, sub, temperature, top_k, top_p)
                 out = out.at[:, step].set(jnp.where(done, 0, nxt))
                 n_gen = n_gen + jnp.where(done, 0, 1)
                 done = done | (nxt == eos)

@@ -186,3 +186,34 @@ def test_config_aliases():
     assert c.tp_size == 4
     c2 = DeepSpeedInferenceConfig(dtype="half")
     assert c2.jnp_dtype == jnp.float16
+
+
+def test_top_p_sampling():
+    """Nucleus sampling: tokens outside the top-p mass are never drawn;
+    tiny top_p degenerates to greedy."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, init_params)
+    cfg = InferenceTransformerConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine((cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32"))
+    prompt = [[1, 2, 3, 4]]
+    greedy = eng.generate(prompt, max_new_tokens=4)
+    # top_p → 0 keeps only the argmax token: identical to greedy
+    nucleus0 = eng.generate(prompt, max_new_tokens=4, temperature=1.0,
+                            top_p=1e-6, seed=3)
+    assert nucleus0 == greedy
+    # moderate top_p still generates, and varies with the seed
+    a = eng.generate(prompt, max_new_tokens=8, temperature=1.0,
+                     top_p=0.9, seed=1)
+    b = eng.generate(prompt, max_new_tokens=8, temperature=1.0,
+                     top_p=0.9, seed=2)
+    assert len(a[0]) == len(b[0]) == 12
+    # composition with top_k compiles as its own loop variant
+    c = eng.generate(prompt, max_new_tokens=4, temperature=1.0,
+                     top_k=5, top_p=0.9, seed=1)
+    assert len(c[0]) == 8
