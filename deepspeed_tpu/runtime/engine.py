@@ -903,6 +903,20 @@ class DeepSpeedEngine:
     def get_lr(self):
         return [float(self.lr_scheduler(self.state.step))]
 
+    def get_loss_scale(self) -> float:
+        """Current dynamic loss scale (fp16) or 1.0 (reference
+        engine.cur_scale property)."""
+        if self.host_opt is not None and self.config.fp16.enabled:
+            return float(self._host_loss_scale.scale)
+        if self.config.fp16.enabled:
+            return float(self.state.loss_scale.scale)
+        return 1.0
+
+    @property
+    def global_samples(self) -> int:
+        """Samples consumed so far (reference engine.global_samples)."""
+        return self.global_steps * self.train_batch_size
+
     def get_global_grad_norm(self):
         return None  # populated from metrics by callers
 

@@ -215,3 +215,28 @@ class TestCheckpoint:
         w3 = jax.device_get(e3.state.params["wte"])
         w1 = jax.device_get(e1.state.params["wte"])
         np.testing.assert_array_equal(w3, w1)
+
+
+def test_ds_api_accessors():
+    """Reference engine accessor parity: cur-scale, global_samples, lr."""
+    eng = _tiny_engine() if "_tiny_engine" in dir() else None
+    if eng is None:
+        import deepspeed_tpu
+        from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, \
+            set_global_mesh
+        set_global_mesh(build_mesh(MeshConfig()))
+        params = {"w": jnp.ones((8, 8), jnp.float32)}
+
+        def loss_fn(p, batch, rng):
+            return jnp.mean((batch["x"] @ p["w"]) ** 2)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model_parameters=params, loss_fn=loss_fn,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "sgd", "params": {"lr": 0.1}},
+                    "fp16": {"enabled": True,
+                             "initial_scale_power": 8}})
+    assert eng.get_loss_scale() == 2.0 ** 8
+    assert eng.global_samples == 0
+    eng.train_batch({"x": jnp.ones((8, 8), jnp.float32)})
+    assert eng.global_samples == eng.train_batch_size
+    assert isinstance(eng.get_lr()[0], float)
