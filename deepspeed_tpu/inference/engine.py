@@ -327,3 +327,73 @@ def _pad_batch(input_ids, attention_mask=None):
         padded[:, :ids.shape[1]] = ids
         ids = padded
     return ids, lengths
+
+
+def save_serving_checkpoint(engine: InferenceEngine, path: str) -> None:
+    """Write the CONVERTED (and possibly int8-quantized) serving state to
+    disk — the reference's ``save_mp_checkpoint_path`` (init_inference can
+    persist the injected/re-sharded model so later servers skip policy
+    conversion and quantization). Layout:
+
+        <path>/serving_config.json   InferenceTransformerConfig fields
+        <path>/serving.safetensors   flat '/'-joined param leaves
+    """
+    import json
+    import os
+
+    import dataclasses as dc
+    from safetensors.numpy import save_file
+
+    from deepspeed_tpu.utils.tree import flatten_with_names
+
+    os.makedirs(path, exist_ok=True)
+    cfg = dc.asdict(engine.model_config)
+    cfg["dtype"] = str(jnp.dtype(engine.model_config.dtype))
+    for k, v in list(cfg.items()):
+        if isinstance(v, tuple):
+            cfg[k] = list(v)
+    with open(os.path.join(path, "serving_config.json"), "w") as f:
+        json.dump(cfg, f, indent=1)
+    flat = {k: np.asarray(jax.device_get(v))
+            for k, v in flatten_with_names(engine.params).items()}
+    save_file(flat, os.path.join(path, "serving.safetensors"))
+
+
+def load_serving_checkpoint(path: str,
+                            config: Optional[DeepSpeedInferenceConfig]
+                            = None) -> InferenceEngine:
+    """Rebuild an :class:`InferenceEngine` from ``save_serving_checkpoint``
+    output — no policy conversion, no re-quantization (int8 q/scale leaves
+    reload as stored)."""
+    import json
+    import os
+
+    from safetensors import safe_open
+
+    with open(os.path.join(path, "serving_config.json")) as f:
+        raw = json.load(f)
+    raw["dtype"] = jnp.dtype(raw["dtype"]).type
+    for k in ("local_windows", "moe_layers"):
+        if raw.get(k) is not None:
+            raw[k] = tuple(raw[k])
+    model_cfg = InferenceTransformerConfig(**raw)
+
+    # rebuild the nested tree from '/'-joined names
+    tree: Dict[str, Any] = {}
+    with safe_open(os.path.join(path, "serving.safetensors"),
+                   framework="numpy") as h:
+        for name in h.keys():
+            parts = name.split("/")
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = h.get_tensor(name)
+
+    def listify(node):
+        if isinstance(node, dict):
+            if node and all(k.isdigit() for k in node):
+                return [listify(node[str(i)]) for i in range(len(node))]
+            return {k: listify(v) for k, v in node.items()}
+        return jnp.asarray(node)
+    params = listify(tree)
+    return InferenceEngine((model_cfg, params), config)

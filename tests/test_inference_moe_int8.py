@@ -160,3 +160,31 @@ class TestInt8Storage:
                               DeepSpeedInferenceConfig(dtype="int8"))
         logits = eng.forward(jnp.asarray([[1, 2, 3]], jnp.int32))
         assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+class TestServingCheckpoint:
+    """save_mp_checkpoint_path analog: persist the converted/quantized
+    serving state; reload skips conversion and re-quantization."""
+
+    def test_roundtrip_int8_moe(self, tmp_path):
+        from deepspeed_tpu.inference.engine import (load_serving_checkpoint,
+                                                    save_serving_checkpoint)
+        cfg = _cfg(num_experts=X, moe_layers=(0,))
+        params = init_params(jax.random.PRNGKey(7), cfg)
+        eng = InferenceEngine((cfg, params),
+                              DeepSpeedInferenceConfig(dtype="int8"))
+        ids = jnp.asarray([[1, 2, 3, 4, 5]], jnp.int32)
+        ref = np.asarray(eng.forward(ids), np.float32)
+
+        save_serving_checkpoint(eng, str(tmp_path / "srv"))
+        eng2 = load_serving_checkpoint(str(tmp_path / "srv"),
+                                       DeepSpeedInferenceConfig(
+                                           dtype="int8"))
+        # quantized leaves reload as stored int8 (no double quantization)
+        q = eng2.params["layers"][1]["mlp"]["wi"]
+        assert isinstance(q, dict) and q["q"].dtype == jnp.int8
+        assert q["scale"].dtype == jnp.float32
+        got = np.asarray(eng2.forward(ids), np.float32)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+        out = eng2.generate([[1, 2, 3]], max_new_tokens=3)
+        assert len(out[0]) == 6
