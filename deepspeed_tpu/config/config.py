@@ -12,7 +12,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Literal, Optional, Union
 
-from pydantic import Field, model_validator
+from pydantic import ConfigDict, Field, model_validator
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.comm.mesh import MeshConfig
@@ -173,8 +173,37 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
-class GradientAccumulationPluginConfig(DeepSpeedConfigModel):
-    pass
+class AMPConfig(DeepSpeedConfigModel):
+    """``amp`` section (reference runtime/constants.py:177-192: Apex AMP
+    pass-through kwargs). Apex is CUDA-only; on TPU ``amp.enabled`` maps to
+    native bf16 mixed precision (fp32 master + bf16 compute) — the same
+    contract O1/O2 provide. Unknown passthrough kwargs are surfaced, not
+    silently swallowed."""
+    enabled: bool = False
+    opt_level: Literal["O0", "O1", "O2", "O3"] = "O1"
+
+    model_config = ConfigDict(extra="allow", validate_assignment=True,
+                              populate_by_name=True)
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    """``eigenvalue`` section (reference runtime/config.py:540
+    get_eigenvalue_config) — drives MoQ precision switching. The reference
+    asserts this off at v0.8.0 ("temporarily disabled"); here it works."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = Field(100, ge=1)
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = Field(1, ge=1)
+    layer_name: str = ""
+    layer_num: int = Field(0, ge=0)
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    """``data_types`` section (reference runtime/constants.py:389-394):
+    dtype used for the gradient-accumulation buffer under GAS."""
+    grad_accum_dtype: Optional[Literal["fp32", "fp16", "bf16"]] = None
 
 
 class CheckpointConfig(DeepSpeedConfigModel):
@@ -244,8 +273,37 @@ class DeepSpeedConfig:
             "curriculum_learning",
             de.get("data_sampling", {}).get("curriculum_learning", {}))
 
+        self.amp = AMPConfig(**pd.get("amp", {}))
+        self.eigenvalue = EigenvalueConfig(**pd.get("eigenvalue", {}))
+        self.data_types = DataTypesConfig(**pd.get("data_types", {}))
+        self.sparse_gradients: bool = pd.get("sparse_gradients", False)
+        # parsed-section parity with reference DeepSpeedConfig.
+        # compression_config: consumed by the engine's MoQ setup
+        # (MoQConfig.from_compression_config) and by user-driven
+        # compression.init_compression
+        self.compression_config: dict = pd.get("compression_training", {})
+
         if self.fp16.enabled and self.bf16.enabled:
             raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.amp.enabled:
+            if self.fp16.enabled or self.bf16.enabled:
+                raise ValueError(
+                    "amp is mutually exclusive with fp16/bf16 (the "
+                    "reference engine has the same restriction)")
+            if self.amp.opt_level == "O3":
+                raise ValueError(
+                    "amp opt_level O3 (pure half, no master weights) is "
+                    "numerically unsafe and unsupported; use O1/O2")
+            extra = {k: v for k, v in pd.get("amp", {}).items()
+                     if k not in ("enabled", "opt_level")}
+            if extra:
+                logger.warning(
+                    "amp passthrough kwargs %s are Apex-specific and have "
+                    "no TPU meaning; amp maps to native bf16 mixed "
+                    "precision here", sorted(extra))
+        if self.eigenvalue.enabled and not self.eigenvalue.layer_name:
+            raise ValueError("eigenvalue.enabled requires layer_name "
+                             "(reference eigenvalue.py asserts the same)")
 
         self.zero_enabled = self.zero_config.stage > 0
         self.zero_optimization_stage = self.zero_config.stage
@@ -266,8 +324,7 @@ class DeepSpeedConfig:
         "curriculum_learning", "aio", "sparse_attention",
         "zero_allow_untested_optimizer", "communication_data_type",
         "sparse_gradients", "amp", "pipeline", "inference", "data_types",
-        "eigenvalue", "progressive_layer_drop", "quantize_training",
-        "gradient_accumulation_plugin", "timers", "nebula", "hybrid_engine",
+        "eigenvalue", "progressive_layer_drop", "nebula",
     })
 
     @classmethod
@@ -334,6 +391,12 @@ class DeepSpeedConfig:
         if self.fp16.enabled:
             return "float16"
         if self.bf16.enabled:
+            return "bfloat16"
+        if self.amp.enabled and self.amp.opt_level in ("O1", "O2"):
+            # Apex O1/O2 ≈ fp32 master + half compute; TPU-native half is
+            # bf16 (no loss scaling needed — amp's dynamic scaler is an
+            # fp16 artifact). O0 is Apex's fp32-passthrough baseline mode
+            # and stays fp32.
             return "bfloat16"
         return "float32"
 

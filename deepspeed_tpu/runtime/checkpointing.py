@@ -95,6 +95,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
         "ds_version": _version(),
     }
+    if getattr(engine, "quantizer", None) is not None:
+        # MoQ schedule must survive resume — restarting at start_bits
+        # would re-widen already-quantized weights
+        meta["moq"] = engine.quantizer.state_dict()
+        meta["gas_boundary_ctr"] = engine._gas_boundary_ctr
     if getattr(engine, "host_opt", None) is not None:
         ls = engine._host_loss_scale
         meta["host_loss_scale"] = {
@@ -217,6 +222,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.skipped_steps = int(meta.get("skipped_steps", 0))
         engine._micro_steps = int(meta.get("micro_steps", 0))
         client_state = meta.get("client_state", {})
+        if "moq" in meta and getattr(engine, "quantizer", None) is not None:
+            engine.quantizer.load_state_dict(meta["moq"])
+            engine._gas_boundary_ctr = int(meta.get("gas_boundary_ctr", 0))
         hls = meta.get("host_loss_scale")
         if hls and getattr(engine, "host_opt", None) is not None:
             import jax.numpy as jnp

@@ -8,17 +8,20 @@ directly (forward-over-reverse), so each iteration is one ``jvp`` of
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
 class Eigenvalue:
+    """Power-iteration driver. Layer selection (layer_name/layer_num) and
+    the recompute cadence (gas_boundary_resolution) are the *engine's*
+    concern — it slices the param tree (runtime/quantize.layer_blocks) and
+    decides when to call; this class only estimates one block."""
+
     def __init__(self, verbose: bool = False, max_iter: int = 100,
-                 tol: float = 1e-2, stability: float = 1e-6,
-                 gas_boundary_resolution: int = 1,
-                 layer_name: str = "", layer_num: int = 0):
+                 tol: float = 1e-2, stability: float = 1e-6):
         self.max_iter = max_iter
         self.tol = tol
         self.stability = stability
@@ -30,15 +33,24 @@ class Eigenvalue:
         norm = jnp.maximum(norm, self.stability)
         return jax.tree.map(lambda x: x / norm, v), norm
 
-    def compute_eigenvalue(self, loss_fn: Callable[[Any], jnp.ndarray],
-                           params: Any, rng: jax.Array) -> float:
-        """Dominant |eigenvalue| of the loss Hessian at ``params``."""
-        grad_fn = jax.grad(lambda p: loss_fn(p).astype(jnp.float32))
+    def compute_eigenvalue(self, loss_fn: Optional[Callable[[Any],
+                                                            jnp.ndarray]],
+                           params: Any, rng: jax.Array,
+                           hvp: Optional[Callable[[Any], Any]] = None
+                           ) -> float:
+        """Dominant |eigenvalue| of the loss Hessian at ``params``.
 
-        def hvp(v):
-            return jax.jvp(grad_fn, (params,), (v,))[1]
-
-        hvp = jax.jit(hvp)
+        The iteration runs in fp32 regardless of the training dtype:
+        bf16 tangents lose the small Rayleigh-quotient differences the
+        convergence test depends on. Callers that re-estimate repeatedly
+        (the engine's per-boundary MoQ recompute) pass a pre-jitted
+        ``hvp`` so the Hessian-vector product compiles once, not per call.
+        """
+        params = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+        if hvp is None:
+            grad_fn = jax.grad(lambda p: loss_fn(p).astype(jnp.float32))
+            hvp = jax.jit(
+                lambda v: jax.jvp(grad_fn, (params,), (v,))[1])
         leaves, treedef = jax.tree_util.tree_flatten(params)
         keys = jax.random.split(rng, len(leaves))
         v = jax.tree_util.tree_unflatten(treedef, [

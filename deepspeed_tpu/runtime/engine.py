@@ -201,6 +201,7 @@ class DeepSpeedEngine:
         self._grad_fn = None
         self._pending_grads = None
         self._pending_losses = []
+        self._last_micro_batch = None
         self._micro_steps = 0
         self.global_steps = 0
         self.skipped_steps = 0
@@ -221,6 +222,35 @@ class DeepSpeedEngine:
             self.flops_profiler = FlopsProfiler(
                 self, profile_step=config.flops_profiler.profile_step,
                 output_file=config.flops_profiler.output_file)
+        # MoQ: quantize-in-step (reference engine.py:1400 _configure_
+        # quantization + :2078 quantizer.quantize in _take_model_step)
+        self.quantizer = None
+        self.eigenvalue = None
+        from deepspeed_tpu.runtime.quantize import MoQConfig, MoQuantizer
+        moq_cfg = MoQConfig.from_compression_config(config.compression_config)
+        if moq_cfg.enabled:
+            if not self.mixed_precision:
+                raise ValueError(
+                    "MoQ (quantize in optimizer step) requires fp16 or "
+                    "bf16 master-weight training — the quantized compute "
+                    "params are re-derived from the unquantized fp32 "
+                    "master each step (reference engine.py:1412 asserts "
+                    "fp16)")
+            if self.host_opt is not None:
+                raise NotImplementedError(
+                    "MoQ is not wired into the ZeRO-Offload host step; "
+                    "disable offload_optimizer or in-forward quantize "
+                    "via compression instead")
+            self.quantizer = MoQuantizer(moq_cfg, self.state.params,
+                                         self.compute_dtype)
+        if config.eigenvalue.enabled:
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+            ev = config.eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ev.verbose, max_iter=ev.max_iter, tol=ev.tol,
+                stability=ev.stability)
+        self._gas_boundary_ctr = 0
+        self.block_eigenvalue: Optional[Dict[str, float]] = None
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} "
             f"dtype={config.precision_dtype} mesh="
@@ -324,6 +354,12 @@ class DeepSpeedEngine:
         loss_fn = self.loss_fn
         fp16 = self.config.fp16.enabled
         clip = self.config.gradient_clipping
+        # data_types.grad_accum_dtype (constants.py:389-394): dtype of the
+        # GAS accumulation buffer. Default fp32 (the reference's safe
+        # default); bf16/fp16 halve accumulator HBM at a precision cost.
+        acc_dtype = {"fp32": jnp.float32, "fp16": jnp.float16,
+                     "bf16": jnp.bfloat16, None: jnp.float32}[
+                         self.config.data_types.grad_accum_dtype]
         grad_spec = self.policy.spec_of(
             self.policy.grad_sharding(self.state.params))
         mesh = self.mesh
@@ -370,18 +406,19 @@ class DeepSpeedEngine:
                     acc, loss_sum = carry
                     mb, r = mb_rng
                     loss, grads = micro_grads(params, scale, mb, r)
-                    grads = cast_tree(grads, jnp.float32)
+                    grads = cast_tree(grads, acc_dtype)
                     acc = constrain(jax.tree.map(jnp.add, acc, grads))
                     return (acc, loss_sum + loss), None
 
                 zero_grads = constrain(jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params))
+                    lambda p: jnp.zeros(p.shape, acc_dtype), params))
                 mbs = jax.tree.map(
                     lambda x: x.reshape((gas, x.shape[0] // gas)
                                         + x.shape[1:]), batch)
                 rngs = jax.random.split(rng, gas)
                 (grads, loss_sum), _ = jax.lax.scan(
                     mb_body, (zero_grads, jnp.float32(0.0)), (mbs, rngs))
+                grads = cast_tree(grads, jnp.float32)
                 mean_loss = loss_sum / gas
             else:
                 mean_loss, grads = micro_grads(params, scale, batch, rng)
@@ -691,6 +728,10 @@ class DeepSpeedEngine:
         profiling = (self.flops_profiler is not None and
                      self.global_steps + 1 ==
                      self.flops_profiler.profile_step)
+        if self.quantizer is not None and self.global_steps == 0:
+            # "quantization happens at step 0" (reference engine.py:1786):
+            # the initial weights are quantized before the first update
+            self._moq_boundary(batch, overflow=False, step_zero=True)
         self.tput_timer.start()
         self._rng, rng = jax.random.split(self._rng)
         if self._eager_param_staging:
@@ -715,6 +756,13 @@ class DeepSpeedEngine:
         if self._eager_param_staging:
             self.state = self.state.replace(params=jax.device_put(
                 self.state.params, self._state_shardings.params))
+        if self.quantizer is not None:
+            # GAS boundary: every train_batch is one (the gas scan is
+            # inside the step). NOTE: the fp16 overflow gate reads
+            # metrics["skipped"] — a host sync per step, same cadence the
+            # reference pays reading optimizer.overflow.
+            overflow = self.config.fp16.enabled and bool(metrics["skipped"])
+            self._moq_boundary(batch, overflow=overflow)
         self._maybe_swap_params_out()
         if profiling:
             jax.block_until_ready(metrics["loss"])
@@ -739,6 +787,77 @@ class DeepSpeedEngine:
             if self.global_steps % self.config.steps_per_print == 0:
                 self._write_monitor_events(metrics)
         return metrics
+
+    # ------------------------------------------------------------------
+    # MoQ (runtime/quantize.py; reference _take_model_step engine.py:2078)
+    # ------------------------------------------------------------------
+    def _moq_boundary(self, batch, overflow: bool,
+                      step_zero: bool = False) -> None:
+        """Advance the MoQ schedule and quantize the compute params.
+        Mirrors the reference boundary block (engine.py:2146-2166):
+        eigenvalue recompute every ``gas_boundary_resolution`` boundaries
+        while a precision switch is still pending, then quantize."""
+        if self.global_steps < self.quantizer.cfg.schedule_offset:
+            # full-precision warmup (shared_parameters.schedule_offset —
+            # the compression scheduler gates the reference the same way)
+            return
+        self._gas_boundary_ctr += 1
+        factors = None
+        ev_enabled = self.eigenvalue is not None
+        if (ev_enabled and not step_zero and
+                self._gas_boundary_ctr %
+                self.config.eigenvalue.gas_boundary_resolution == 0 and
+                self.quantizer.any_precision_switch()):
+            self.block_eigenvalue = self._compute_block_eigenvalues(batch)
+            from deepspeed_tpu.runtime.quantize import (
+                eigen_factors_from_blocks)
+            factors = eigen_factors_from_blocks(self.block_eigenvalue,
+                                                self.quantizer.paths)
+        self.quantizer.on_boundary(overflow, factors, ev_enabled)
+        # Quantize even when the schedule skipped (fp16 overflow): the
+        # step re-derived the compute params from the UNQUANTIZED master,
+        # so declining to re-apply would leak full-precision weights into
+        # the next forward. (The reference gets this for free: its
+        # overflow path skips the master->fp16 copy, leaving the fp16
+        # groups quantized from the previous boundary.)
+        self._rng, qrng = jax.random.split(self._rng)
+        self.state = self.state.replace(
+            params=self.quantizer.apply(self.state.params, qrng))
+
+    def _compute_block_eigenvalues(self, batch) -> Dict[str, float]:
+        """Dominant |Hessian eigenvalue| per layer block via jvp power
+        iteration on one micro-batch (reference Eigenvalue.compute_
+        eigenvalue walks layer_name-matched modules). The per-block HVP is
+        jitted ONCE (params/batch/tangent are arguments, not closure
+        constants) — recomputes at later boundaries reuse the executable."""
+        from deepspeed_tpu.runtime.quantize import layer_blocks, merge_block
+        ev_cfg = self.config.eigenvalue
+        params = self.state.params
+        blocks = layer_blocks(params, ev_cfg.layer_name, ev_cfg.layer_num)
+        micro = jax.tree.map(lambda x: x[:self.micro_batch_size], batch)
+        rng = jax.random.PRNGKey(0)
+        if not hasattr(self, "_eigen_hvp_cache"):
+            self._eigen_hvp_cache = {}
+        out: Dict[str, float] = {}
+        loss_fn = self.loss_fn
+        for i, (prefix, sub) in enumerate(blocks.items()):
+            if prefix not in self._eigen_hvp_cache:
+                def hvp_fn(full, s32, mb, v, _prefix=prefix):
+                    def sub_loss(s):
+                        merged = merge_block(full, _prefix, s)
+                        return loss_fn(merged, mb,
+                                       jax.random.PRNGKey(0)
+                                       ).astype(jnp.float32)
+                    return jax.jvp(jax.grad(sub_loss), (s32,), (v,))[1]
+                self._eigen_hvp_cache[prefix] = jax.jit(hvp_fn)
+            hvp_jit = self._eigen_hvp_cache[prefix]
+            sub32 = jax.tree.map(lambda x: x.astype(jnp.float32), sub)
+            out[prefix] = self.eigenvalue.compute_eigenvalue(
+                None, sub, jax.random.fold_in(rng, i),
+                hvp=lambda v, _h=hvp_jit, _s=sub32: _h(params, _s, micro, v))
+        if self.config.eigenvalue.verbose:
+            log_dist(f"block eigenvalues: {out}", ranks=[0])
+        return out
 
     def _maybe_swap_params_out(self):
         """NVMe param tier: after the step, spill the host-resident params
@@ -780,6 +899,11 @@ class DeepSpeedEngine:
         if self._grad_fn is None:
             self._build_grad_fn()
         self._ensure_params_resident()
+        if self.quantizer is not None and self.global_steps == 0 and \
+                self._micro_steps == 0:
+            # step-0 quantization on this path too (engine.py:1786)
+            self._moq_boundary(batch, overflow=False, step_zero=True)
+        self._last_micro_batch = batch  # eigenvalue probe batch for step()
         self._rng, rng = jax.random.split(self._rng)
         loss, grads = self._grad_fn(self.state.params,
                                     self.state.loss_scale.scale, batch, rng)
@@ -809,6 +933,11 @@ class DeepSpeedEngine:
             / max(len(self._pending_losses), 1)
         self._pending_grads = None
         self._pending_losses = []
+        if self.quantizer is not None:
+            # same boundary semantics as train_batch (_take_model_step
+            # quantizes on the forward/backward/step path too)
+            overflow = self.config.fp16.enabled and bool(metrics["skipped"])
+            self._moq_boundary(self._last_micro_batch, overflow=overflow)
         self.global_steps += 1
         if self.config.fp16.enabled and bool(metrics["skipped"]):
             self.skipped_steps += 1
