@@ -27,18 +27,7 @@ from jax.sharding import PartitionSpec as P
 DATA_AXES = ("data", "fsdp")
 
 
-def _maybe_constrain(x, spec: P):
-    """Apply a sharding constraint when a mesh with the named axes is in
-    scope (bare-jit unit tests run without one)."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or mesh.empty:
-        return x
-    names = set(mesh.axis_names)
-    for entry in spec:
-        for ax in (entry if isinstance(entry, tuple) else (entry,)):
-            if ax is not None and ax not in names:
-                return x
-    return jax.lax.with_sharding_constraint(x, spec)
+from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
 
 def _seq_axis_active() -> bool:

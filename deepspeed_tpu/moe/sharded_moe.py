@@ -28,16 +28,7 @@ from jax.sharding import PartitionSpec as P
 EP_AXES = ("data", "fsdp")
 
 
-def _constrain(x, spec: P):
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or mesh.empty:
-        return x
-    names = set(mesh.axis_names)
-    for entry in spec:
-        for ax in (entry if isinstance(entry, tuple) else (entry,)):
-            if ax is not None and ax not in names:
-                return x
-    return jax.lax.with_sharding_constraint(x, spec)
+from deepspeed_tpu.utils.sharding import maybe_constrain as _constrain
 
 
 def capacity(num_tokens: int, num_experts: int, capacity_factor: float,

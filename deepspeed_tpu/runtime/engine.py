@@ -74,7 +74,8 @@ class DeepSpeedEngine:
                  training_data=None,
                  collate_fn=None,
                  rng: Optional[jax.Array] = None,
-                 model_handles_param_offload: bool = False):
+                 model_handles_param_offload: bool = False,
+                 sparse_grad_paths: Optional[Any] = None):
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         set_global_mesh(self.mesh)
         self.config = config
@@ -125,6 +126,54 @@ class DeepSpeedEngine:
                     self._onebit_axes = axes
             optimizer = build_optimizer(opt_type, opt_params)
         self.optimizer = optimizer
+        # sparse_gradients (reference constants.py:107, engine sparse
+        # allreduce :2459-2541): embedding-shaped leaves exchange (ids,
+        # rows) instead of the dense [vocab, dim] gradient. Engages in the
+        # explicit shard_map DP step; needs replicated params like the
+        # reference (ZeRO rejects sparse grads, stage_1_and_2 asserts).
+        self._sparse_grad_axes: tuple = ()
+        if config.sparse_gradients:
+            if self._onebit_axes:
+                raise NotImplementedError(
+                    "sparse_gradients cannot combine with the 1-bit "
+                    "optimizer family (its error-feedback compression "
+                    "assumes dense tensors — same as the reference)")
+            if config.fp16.enabled:
+                raise NotImplementedError(
+                    "sparse_gradients + fp16 loss scaling is not wired "
+                    "into the explicit-exchange step; use bf16")
+            axes = tuple(a for a in ("data", "fsdp")
+                         if self.mesh.shape[a] > 1)
+            if not sparse_grad_paths:
+                # like the reference, only *declared* sparse embeddings
+                # ride the sparse exchange (torch needs Embedding(
+                # sparse=True); name-guessing would silently corrupt
+                # tied embeddings, whose grads are dense through the
+                # softmax). No declaration → nothing to do.
+                logger.warning(
+                    "sparse_gradients enabled but no sparse_grad_paths "
+                    "declared (model attribute or initialize kwarg) — "
+                    "falling back to the dense exchange. NOTE: tied "
+                    "input/output embeddings must NOT be declared (their "
+                    "gradient is dense through the logits)")
+            elif axes:
+                if config.zero_config.stage != 0:
+                    raise ValueError(
+                        "sparse_gradients requires replicated parameters "
+                        "(zero_optimization.stage=0); the reference ZeRO "
+                        "optimizer rejects sparse gradients too")
+                for ax in ("tensor", "seq", "pipe"):
+                    if self.mesh.shape[ax] > 1:
+                        raise NotImplementedError(
+                            "sparse_gradients composes only with pure "
+                            f"data parallelism (mesh {ax}="
+                            f"{self.mesh.shape[ax]})")
+                self._sparse_grad_axes = axes
+            else:
+                log_dist("sparse_gradients: no data-parallel extent, "
+                         "nothing to exchange — using the fused step",
+                         ranks=[0])
+        self._sparse_grad_patterns = tuple(sparse_grad_paths or ())
         self.lr_scheduler = lr_scheduler or build_schedule(
             config.scheduler, opt_cfg.params if opt_cfg else None)
 
@@ -506,19 +555,44 @@ class DeepSpeedEngine:
         grad_norm is the worker mean. Model code must not place sharding
         constraints over the DP axes (they are manual inside this region).
         """
-        gas = self.gas
-        loss_fn = self.loss_fn
-        clip = self.config.gradient_clipping
-        optimizer = self.optimizer
-        schedule = self.lr_scheduler
-        mixed = self.mixed_precision
-        dtype = self.compute_dtype
         axes = self._onebit_axes
-
-        axis_sizes = {a: self.mesh.shape[a] for a in axes}
+        local_grads = self._make_local_grads_fn(axes)
+        clip = self.config.gradient_clipping
+        apply_update = self._make_replicated_update()
 
         def local_step(state: TrainState, batch, rng):
-            params = state.params
+            grads, mean_loss = local_grads(state.params, batch, rng)
+            # clip acts on the per-worker LOCAL gradient: a global norm
+            # cannot be formed without the exact exchange this algorithm
+            # exists to avoid; reported grad_norm is the worker mean
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                 for g in jax.tree.leaves(grads)))
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+            new_state, lr = apply_update(state, grads)
+            metrics = {"loss": jax.lax.pmean(mean_loss, axes),
+                       "grad_norm": jax.lax.pmean(gnorm, axes),
+                       "lr": lr,
+                       "loss_scale": jnp.float32(1.0),
+                       "skipped": jnp.bool_(False)}
+            return new_state, metrics
+
+        return self._wrap_explicit_dp(local_step, batch)
+
+    def _make_local_grads_fn(self, axes):
+        """Per-worker gradient producer shared by the explicit-exchange
+        shard_map steps (1-bit compressed, sparse): distinct rng per
+        worker, GAS scan accumulation in ``data_types.grad_accum_dtype``,
+        mean over micro-batches. Returns fp32 grads + local mean loss."""
+        gas = self.gas
+        loss_fn = self.loss_fn
+        axis_sizes = {a: self.mesh.shape[a] for a in axes}
+        acc_dtype = {"fp32": jnp.float32, "fp16": jnp.float16,
+                     "bf16": jnp.bfloat16, None: jnp.float32}[
+                         self.config.data_types.grad_accum_dtype]
+
+        def local_grads(params, batch, rng):
             # distinct dropout/randomness per worker: the exact GSPMD path
             # draws one mask over the global batch, so the local shard must
             # not repeat the same rng stream on every worker
@@ -530,7 +604,7 @@ class DeepSpeedEngine:
             def micro(mb, r):
                 loss, grads = jax.value_and_grad(
                     lambda p: loss_fn(p, mb, r).astype(jnp.float32))(params)
-                return loss, cast_tree(grads, jnp.float32)
+                return loss, grads
 
             if gas > 1:
                 mbs = jax.tree.map(
@@ -541,23 +615,32 @@ class DeepSpeedEngine:
                 def body(carry, mb_r):
                     acc, lsum = carry
                     loss, grads = micro(*mb_r)
+                    grads = cast_tree(grads, acc_dtype)
                     return (jax.tree.map(jnp.add, acc, grads),
                             lsum + loss), None
                 zero = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, acc_dtype), params)
                 (grads, lsum), _ = jax.lax.scan(
                     body, (zero, jnp.float32(0.0)), (mbs, rngs))
-                grads = jax.tree.map(lambda g: g / gas, grads)
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) / gas, grads)
                 mean_loss = lsum / gas
             else:
                 mean_loss, grads = micro(batch, rng)
+                grads = cast_tree(grads, jnp.float32)
+            return grads, mean_loss
 
-            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                 for g in jax.tree.leaves(grads)))
-            if clip > 0.0:
-                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * coef, grads)
+        return local_grads
 
+    def _make_replicated_update(self):
+        """Optimizer/master update on replicated (post-exchange) grads —
+        the tail both explicit-DP steps share."""
+        optimizer = self.optimizer
+        schedule = self.lr_scheduler
+        mixed = self.mixed_precision
+        dtype = self.compute_dtype
+
+        def apply_update(state: TrainState, grads):
             lr = schedule(state.step)
             master = state.master if mixed else state.params
             updates, new_opt = optimizer.update(
@@ -569,13 +652,11 @@ class DeepSpeedEngine:
                 step=state.step + 1, params=new_params,
                 master=new_master if mixed else None,
                 opt_state=new_opt, loss_scale=state.loss_scale)
-            metrics = {"loss": jax.lax.pmean(mean_loss, axes),
-                       "grad_norm": jax.lax.pmean(gnorm, axes),
-                       "lr": lr,
-                       "loss_scale": jnp.float32(1.0),
-                       "skipped": jnp.bool_(False)}
-            return new_state, metrics
+            return new_state, lr
 
+        return apply_update
+
+    def _wrap_explicit_dp(self, local_step, batch):
         state_specs = jax.tree.map(lambda _: P(), self.state)
         batch_specs = jax.tree.map(lambda _: P(DATA_AXES), batch)
         metric_specs = {k: P() for k in ("loss", "grad_norm", "lr",
@@ -586,11 +667,92 @@ class DeepSpeedEngine:
             out_specs=(state_specs, metric_specs),
             check_vma=False)
 
+    def _make_sparse_step_fn(self, batch):
+        """Whole-step shard_map over the DP axes with a row-sparse
+        exchange for embedding-shaped leaves (reference sparse allreduce,
+        engine.py:2459: all_gather indices+values instead of dense
+        allreduce). Numerically identical to the GSPMD fused step: local
+        grads are mean-exchanged (pmean for dense leaves, (ids,rows)
+        gather-scatter for sparse ones), then clip/optimizer run
+        replicated."""
+        from deepspeed_tpu.runtime.quantize import _leaf_paths
+        from deepspeed_tpu.runtime.sparse_tensor import (sparse_all_mean,
+                                                         sparse_capacity)
+        import fnmatch
+        clip = self.config.gradient_clipping
+        axes = self._sparse_grad_axes
+        dp = 1
+        for a in axes:
+            dp *= self.mesh.shape[a]
+
+        # leaf selection + per-leaf capacity, resolved at trace time
+        paths = _leaf_paths(self.state.params)
+        caps = []
+        n_sparse = 0
+        for path, leaf in zip(paths, jax.tree.leaves(self.state.params)):
+            cap = None
+            if leaf.ndim == 2 and any(fnmatch.fnmatch(path, p)
+                                      for p in self._sparse_grad_patterns):
+                c = sparse_capacity(batch, dp, leaf.shape[0])
+                # only exchange sparsely when it actually saves bandwidth
+                # (ids+rows from every worker vs one dense reduce)
+                if 2 * c * dp < leaf.shape[0]:
+                    cap = c
+                    n_sparse += 1
+            caps.append(cap)
+        log_dist(f"sparse_gradients: {n_sparse} leaf(s) on the sparse "
+                 f"exchange, dp={dp}", ranks=[0])
+        cap_by_path = dict(zip(paths, caps))
+
+        def exchange(grads):
+            flat, treedef = jax.tree_util.tree_flatten(grads)
+            out = []
+            for cap, g in zip(caps, flat):
+                if cap is None:
+                    out.append(jax.lax.pmean(g, axes))
+                else:
+                    out.append(sparse_all_mean(g, cap, axes))
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        local_grads = self._make_local_grads_fn(axes)
+        apply_update = self._make_replicated_update()
+
+        def local_step(state: TrainState, batch, rng):
+            grads, mean_loss = local_grads(state.params, batch, rng)
+            # the DP exchange — the one piece that differs from pmean;
+            # clip/update then run on replicated (global) grads, exactly
+            # like the fused GSPMD step
+            grads = exchange(grads)
+            mean_loss = jax.lax.pmean(mean_loss, axes)
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                 for g in jax.tree.leaves(grads)))
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+            new_state, lr = apply_update(state, grads)
+            metrics = {"loss": mean_loss, "grad_norm": gnorm, "lr": lr,
+                       "loss_scale": jnp.float32(1.0),
+                       "skipped": jnp.bool_(False)}
+            return new_state, metrics
+
+        self._sparse_grad_caps = cap_by_path  # introspection + tests
+        # capacities are baked into this executable from THIS batch's
+        # shapes; train_batch rebuilds the step when batch shapes change
+        self._sparse_batch_shapes = tuple(
+            tuple(x.shape) for x in jax.tree.leaves(batch))
+        return self._wrap_explicit_dp(local_step, batch)
+
     def _compile_step(self, batch):
         if self._onebit_axes:
             self._eager_param_staging = False
             self._step_fn = jax.jit(
                 self._make_compressed_step_fn(batch),
+                donate_argnums=(0,))
+            return
+        if self._sparse_grad_axes:
+            self._eager_param_staging = False
+            self._step_fn = jax.jit(
+                self._make_sparse_step_fn(batch),
                 donate_argnums=(0,))
             return
         batch_sh = self._batch_sharding(batch)
@@ -723,6 +885,14 @@ class DeepSpeedEngine:
             out = self._offload_train_batch(batch)
             self._maybe_swap_params_out()
             return out
+        if (self._sparse_grad_axes and self._step_fn is not None and
+                tuple(tuple(x.shape) for x in jax.tree.leaves(batch))
+                != self._sparse_batch_shapes):
+            # sparse-exchange capacities are shape-derived compile-time
+            # constants — a different batch shape would retrace with STALE
+            # capacities and silently drop embedding-grad rows. Rebuild
+            # (the retrace was unavoidable anyway).
+            self._step_fn = None
         if self._step_fn is None:
             self._compile_step(batch)
         profiling = (self.flops_profiler is not None and
@@ -1147,7 +1317,8 @@ def initialize(args=None,
                tp_specs=None,
                dist_init_required: Optional[bool] = None,
                collate_fn=None,
-               rng=None):
+               rng=None,
+               sparse_grad_paths=None):
     """``deepspeed.initialize`` analog (deepspeed/__init__.py:52).
 
     Returns ``(engine, optimizer, training_dataloader, lr_scheduler)`` like
@@ -1225,7 +1396,11 @@ def initialize(args=None,
                              training_data=training_data, rng=rng,
                              model_handles_param_offload=bool(
                                  getattr(model, "handles_param_offload",
-                                         False)))
+                                         False)),
+                             sparse_grad_paths=(
+                                 sparse_grad_paths if sparse_grad_paths
+                                 is not None else getattr(
+                                     model, "sparse_grad_paths", None)))
     if engine._param_offload_cfg is not None and \
             engine._model_fetches_params:
         setter = getattr(model, "set_param_fetch_shardings", None)

@@ -255,15 +255,12 @@ class MoQuantizer:
                 factor = (eigen_factors or {}).get(path, 1)
                 self.real_ratio = 1.0
                 self.period[i] = (self.period[i] << 1) * factor
-                self.bits[i] -= 1
+                self.bits[i] -= 1   # loop guard keeps bits >= target
                 if self.cfg.verbose:
                     log_dist(
                         f"MoQ: {path} -> {self.bits[i]} bits at qstep "
                         f"{self.qsteps}, next period {self.period[i]}",
                         ranks=[0])
-            if self.bits[i] < self.target[i]:
-                raise AssertionError(
-                    f"quantization bit below target for {path}")
         return True
 
     # -- device pass -------------------------------------------------------
