@@ -1,0 +1,25 @@
+"""Diffusers (Stable-Diffusion) model family — TPU-native.
+
+Reference surface: ``ops/transformer/inference/diffusers_attention.py``
+(DeepSpeedDiffusersAttention), ``diffusers_transformer_block.py``
+(DeepSpeedDiffusersTransformerBlock), ``model_implementations/diffusers/
+{unet,vae}.py`` (DSUNet/DSVAE CUDA-graph wrappers) and the diffusers
+branch of ``module_inject/replace_module.py:201``.
+"""
+from deepspeed_tpu.model_implementations.diffusers.attention import (
+    DiffusersAttentionConfig, attention, convert_attention)
+from deepspeed_tpu.model_implementations.diffusers.transformer_block import (
+    Diffusers2DTransformerConfig, convert_transformer_block,
+    transformer_block)
+from deepspeed_tpu.model_implementations.diffusers.unet import (
+    DSUNet, UNetConfig, convert_unet, timestep_embedding, unet_apply)
+from deepspeed_tpu.model_implementations.diffusers.vae import (
+    DSVAE, VAEConfig, convert_vae, vae_decode, vae_encode)
+
+__all__ = [
+    "DiffusersAttentionConfig", "attention", "convert_attention",
+    "Diffusers2DTransformerConfig", "transformer_block",
+    "convert_transformer_block", "DSUNet", "UNetConfig", "convert_unet",
+    "timestep_embedding", "unet_apply", "DSVAE", "VAEConfig",
+    "convert_vae", "vae_decode", "vae_encode",
+]

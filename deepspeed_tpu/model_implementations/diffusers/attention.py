@@ -1,0 +1,138 @@
+"""Fused diffusers attention (Stable-Diffusion UNet attention).
+
+Analog of ``DeepSpeedDiffusersAttention``
+(``/root/reference/deepspeed/ops/transformer/inference/diffusers_attention.py``):
+self- or cross-attention over flattened spatial tokens ``[B, HW, C]`` with
+the reference's scaling convention ``scale = (1/norm_factor)**2`` where
+``norm_factor = sqrt(sqrt(head_dim))`` — i.e. the standard
+``1/sqrt(head_dim)`` applied as two pre-softmax multiplies to keep the
+intermediates in half-precision range. The reference dispatches a Triton
+flash kernel for the self-attention path; here long self-attention routes
+through the Pallas flash kernel on TPU and a fused XLA softmax elsewhere
+(GEMMs ride the MXU either way).
+
+Weights may be TRUE int8 ({"q", "scale"} leaves — module_inject/quantize):
+the dequant fuses into the consuming matmul.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class DiffusersAttentionConfig:
+    """Mirrors ``Diffusers2DTransformerConfig`` + the attention shape args
+    (heads, head_dim implied)."""
+    hidden_size: int
+    heads: int
+    dtype: Any = jnp.bfloat16
+    int8_quantization: bool = False
+    # route the self-attention core through the Pallas flash kernel when
+    # the token count crosses this bound (TPU only; the reference's
+    # triton_flash_attn analog)
+    flash_min_tokens: int = 1024
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.heads
+
+
+# one definition of the int8-aware weight resolver for the whole repo
+from deepspeed_tpu.model_implementations.transformer import _w  # noqa: E402
+
+
+def _to_np(t) -> np.ndarray:
+    """Extract a numpy array from a torch tensor / safetensors view /
+    ndarray, upcasting torch bf16 (which numpy cannot represent) the same
+    way module_inject/policies.py:41 does."""
+    if hasattr(t, "detach"):
+        t = t.detach()
+    if hasattr(t, "float"):
+        t = t.float()
+    if hasattr(t, "numpy"):
+        t = t.numpy()
+    return np.asarray(t)
+
+
+def _split_heads(x, heads):
+    b, t, c = x.shape
+    return x.reshape(b, t, heads, c // heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+def attention(params: Dict[str, Any], hidden: jax.Array,
+              cfg: DiffusersAttentionConfig,
+              context: Optional[jax.Array] = None,
+              do_out_bias: bool = True) -> jax.Array:
+    """Apply diffusers attention. ``params``:
+
+    ``{"q_w": [C, C], "k_w": [Ctx, C], "v_w": [Ctx, C],
+       "out_w": [C, C], "out_b": [C]}``
+
+    (already transposed to jnp ``x @ w`` layout; use
+    :func:`convert_attention` for HF diffusers checkpoints).
+    ``do_out_bias=False`` defers the output bias to the caller — the
+    transformer block folds it into the residual LayerNorm epilogue
+    exactly like the reference (``do_out_bias`` attribute)."""
+    dtype = cfg.dtype
+    kv_src = hidden if context is None else context
+    q = hidden.astype(dtype) @ _w(params["q_w"], dtype)
+    k = kv_src.astype(dtype) @ _w(params["k_w"], dtype)
+    v = kv_src.astype(dtype) @ _w(params["v_w"], dtype)
+
+    b, t, c = q.shape
+    d = cfg.head_dim
+    use_flash = (context is None and
+                 jax.default_backend() == "tpu" and
+                 t >= cfg.flash_min_tokens and t % 128 == 0 and
+                 d in (64, 128, 256))
+    if use_flash:
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        ctx_layer = flash_attention(          # [B, T, H, D] layout
+            q.reshape(b, t, cfg.heads, d), k.reshape(b, t, cfg.heads, d),
+            v.reshape(b, t, cfg.heads, d), causal=False,
+            scale=1.0 / float(np.sqrt(d)))
+        merged = ctx_layer.reshape(b, t, c)
+    else:
+        qh, kh, vh = (_split_heads(x, cfg.heads) for x in (q, k, v))
+        # reference convention: norm_factor = head_dim ** 0.25, q and k
+        # each pre-scaled by 1/norm_factor so q@k^T carries 1/sqrt(d)
+        inv_nf = 1.0 / float(np.power(d, 0.25))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh * inv_nf, kh * inv_nf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        merged = _merge_heads(
+            jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), vh))
+    out = merged @ _w(params["out_w"], dtype)
+    if do_out_bias:
+        out = out + params["out_b"].astype(dtype)
+    return out
+
+
+def convert_attention(sd: Dict[str, Any], prefix: str,
+                      int8: bool = False) -> Dict[str, Any]:
+    """Build the param tree from an HF diffusers state dict (keys
+    ``{prefix}.to_q.weight``, ``to_k``, ``to_v``, ``to_out.0.{weight,bias}``
+    — torch Linear layout [out, in], transposed here to [in, out])."""
+    def get(name):
+        return _to_np(sd[f"{prefix}.{name}"])
+
+    def maybe_q(w):
+        if int8:
+            from deepspeed_tpu.module_inject.quantize import quantize_weight
+            return quantize_weight(w)
+        return jnp.asarray(w)
+
+    return {"q_w": maybe_q(get("to_q.weight").T),
+            "k_w": maybe_q(get("to_k.weight").T),
+            "v_w": maybe_q(get("to_v.weight").T),
+            "out_w": maybe_q(get("to_out.0.weight").T),
+            "out_b": jnp.asarray(get("to_out.0.bias"))}

@@ -1,0 +1,82 @@
+"""Load a Stable-Diffusion checkpoint directory into TPU-native modules.
+
+The reference's diffusers path (``deepspeed/__init__.py init_inference``
+with a live ``StableDiffusionPipeline`` → ``replace_module.py:201``
+generic_injection + DSUNet/DSVAE/DSClipEncoder wrappers) requires the
+torch pipeline in host memory. Here the converters read the on-disk
+layout of a diffusers save directory directly (the same no-torch-model
+design as ``module_inject/state_dict_loader.py``):
+
+    <path>/unet/config.json + diffusion_pytorch_model.safetensors
+    <path>/vae/config.json  + diffusion_pytorch_model.safetensors
+
+and return jit-cached :class:`DSUNet` / :class:`DSVAE` servables.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.model_implementations.diffusers.unet import (
+    DSUNet, UNetConfig, convert_unet)
+from deepspeed_tpu.model_implementations.diffusers.vae import (
+    DSVAE, VAEConfig, convert_vae)
+from deepspeed_tpu.module_inject.state_dict_loader import load_state_dict
+
+
+def _component_sd(path: str, name: str):
+    comp = os.path.join(path, name)
+    if not os.path.isdir(comp):
+        raise FileNotFoundError(
+            f"{path} has no {name}/ sub-directory — expected a diffusers "
+            "save layout (StableDiffusionPipeline.save_pretrained)")
+    with open(os.path.join(comp, "config.json")) as f:
+        cfg = json.load(f)
+    return load_state_dict(comp), cfg
+
+
+def load_unet(path: str, dtype=jnp.bfloat16,
+              int8: bool = False) -> DSUNet:
+    sd, raw = _component_sd(path, "unet")
+    cfg = UNetConfig(
+        in_channels=raw.get("in_channels", 4),
+        out_channels=raw.get("out_channels", 4),
+        block_out_channels=tuple(raw.get("block_out_channels",
+                                         (320, 640, 1280, 1280))),
+        layers_per_block=raw.get("layers_per_block", 2),
+        cross_attention_dim=raw.get("cross_attention_dim", 768),
+        attention_head_dim=raw.get("attention_head_dim", 8),
+        transformer_layers=raw.get("transformer_layers_per_block", 1),
+        down_block_types=tuple(raw.get("down_block_types", ())) or
+        UNetConfig.down_block_types,
+        up_block_types=tuple(raw.get("up_block_types", ())) or
+        UNetConfig.up_block_types,
+        norm_num_groups=raw.get("norm_num_groups", 32),
+        flip_sin_to_cos=raw.get("flip_sin_to_cos", True),
+        freq_shift=raw.get("freq_shift", 0),
+        dtype=dtype, int8_quantization=int8)
+    return DSUNet(convert_unet(sd, cfg), cfg)
+
+
+def load_vae(path: str, dtype=jnp.bfloat16) -> DSVAE:
+    sd, raw = _component_sd(path, "vae")
+    cfg = VAEConfig(
+        in_channels=raw.get("in_channels", 3),
+        latent_channels=raw.get("latent_channels", 4),
+        block_out_channels=tuple(raw.get("block_out_channels",
+                                         (128, 256, 512, 512))),
+        layers_per_block=raw.get("layers_per_block", 2),
+        norm_num_groups=raw.get("norm_num_groups", 32),
+        scaling_factor=raw.get("scaling_factor", 0.18215),
+        dtype=dtype)
+    return DSVAE(convert_vae(sd, cfg), cfg)
+
+
+def load_stable_diffusion(path: str, dtype=jnp.bfloat16,
+                          int8: bool = False) -> Tuple[DSUNet, DSVAE]:
+    """Load unet + vae from a diffusers save directory."""
+    return load_unet(path, dtype=dtype, int8=int8), load_vae(path,
+                                                             dtype=dtype)

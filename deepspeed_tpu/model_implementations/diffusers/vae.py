@@ -1,0 +1,195 @@
+"""TPU-native AutoencoderKL (Stable-Diffusion VAE).
+
+Analog of ``/root/reference/deepspeed/model_implementations/diffusers/
+vae.py`` (``DSVAE`` — CUDA-graphed encode/decode wrappers). As with the
+UNet, there is no torch module to wrap on TPU, so the decoder/encoder are
+implemented functionally in NHWC: ResnetBlocks (no time embedding),
+a single mid self-attention block over spatial tokens, nearest-neighbor
+upsampling. GroupNorm fp32, convs bf16, ``jax.jit`` shape-keyed caching
+standing in for CUDA-graph replay.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.model_implementations.diffusers.attention import (
+    DiffusersAttentionConfig, attention)
+from deepspeed_tpu.model_implementations.diffusers.unet import (
+    _conv, _group_norm, _t, _conv_w, _norm_w, _lin_w, _upsample)
+
+
+@dataclasses.dataclass
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.18215
+    norm_eps: float = 1e-6   # diffusers AutoencoderKL resnet/norm eps
+    dtype: Any = jnp.bfloat16
+
+
+def _vae_resnet(p, x, cfg: VAEConfig):
+    dtype = cfg.dtype
+    h = _group_norm(x, p["norm1"]["scale"], p["norm1"]["bias"],
+                    cfg.norm_num_groups, eps=cfg.norm_eps)
+    h = _conv(jax.nn.silu(h), p["conv1"]["w"], p["conv1"]["b"], dtype=dtype)
+    h = _group_norm(h, p["norm2"]["scale"], p["norm2"]["bias"],
+                    cfg.norm_num_groups, eps=cfg.norm_eps)
+    h = _conv(jax.nn.silu(h), p["conv2"]["w"], p["conv2"]["b"], dtype=dtype)
+    if "conv_shortcut" in p:
+        x = _conv(x, p["conv_shortcut"]["w"], p["conv_shortcut"]["b"],
+                  dtype=dtype)
+    return x.astype(dtype) + h
+
+
+def _vae_attention(p, x, cfg: VAEConfig):
+    """Single-head (diffusers VAE default) self-attention over HW tokens."""
+    b, h, w, c = x.shape
+    y = _group_norm(x, p["group_norm"]["scale"], p["group_norm"]["bias"],
+                    cfg.norm_num_groups,
+                    eps=cfg.norm_eps).astype(cfg.dtype)
+    acfg = DiffusersAttentionConfig(hidden_size=c, heads=1, dtype=cfg.dtype)
+    y = attention(p, y.reshape(b, h * w, c), acfg)
+    return x.astype(cfg.dtype) + y.reshape(b, h, w, c)
+
+
+def _mid(p, x, cfg: VAEConfig):
+    x = _vae_resnet(p["resnets"][0], x, cfg)
+    x = _vae_attention(p["attentions"][0], x, cfg)
+    return _vae_resnet(p["resnets"][1], x, cfg)
+
+
+def vae_decode(params: Dict[str, Any], latents: jax.Array,
+               cfg: VAEConfig) -> jax.Array:
+    """latents [B, h, w, latent_channels] (already divided by
+    scaling_factor by the caller, diffusers convention) → image NHWC in
+    [-1, 1]."""
+    dtype = cfg.dtype
+    p = params["decoder"]
+    x = _conv(latents.astype(dtype), params["post_quant_conv"]["w"],
+              params["post_quant_conv"]["b"], dtype=dtype)
+    x = _conv(x, p["conv_in"]["w"], p["conv_in"]["b"], dtype=dtype)
+    x = _mid(p["mid_block"], x, cfg)
+    n_blocks = len(cfg.block_out_channels)
+    for bi in range(n_blocks):
+        bp = p["up_blocks"][bi]
+        for li in range(cfg.layers_per_block + 1):
+            x = _vae_resnet(bp["resnets"][li], x, cfg)
+        if "upsampler" in bp:
+            x = _upsample(bp["upsampler"], x, cfg)
+    x = _group_norm(x, p["conv_norm_out"]["scale"],
+                    p["conv_norm_out"]["bias"], cfg.norm_num_groups, eps=cfg.norm_eps)
+    return _conv(jax.nn.silu(x), p["conv_out"]["w"], p["conv_out"]["b"],
+                 dtype=dtype)
+
+
+def vae_encode(params: Dict[str, Any], image: jax.Array,
+               cfg: VAEConfig) -> jax.Array:
+    """image NHWC [-1,1] → (mean, logvar) latent moments, each
+    [B, h, w, latent_channels]."""
+    dtype = cfg.dtype
+    p = params["encoder"]
+    x = _conv(image.astype(dtype), p["conv_in"]["w"], p["conv_in"]["b"],
+              dtype=dtype)
+    n_blocks = len(cfg.block_out_channels)
+    for bi in range(n_blocks):
+        bp = p["down_blocks"][bi]
+        for li in range(cfg.layers_per_block):
+            x = _vae_resnet(bp["resnets"][li], x, cfg)
+        if "downsampler" in bp:
+            # VAE Downsample2D uses the asymmetric F.pad(0,1,0,1) layout
+            x = _conv(x, bp["downsampler"]["w"], bp["downsampler"]["b"],
+                      stride=2, dtype=dtype, asym_pad=True)
+    x = _mid(p["mid_block"], x, cfg)
+    x = _group_norm(x, p["conv_norm_out"]["scale"],
+                    p["conv_norm_out"]["bias"], cfg.norm_num_groups, eps=cfg.norm_eps)
+    x = _conv(jax.nn.silu(x), p["conv_out"]["w"], p["conv_out"]["b"],
+              dtype=dtype)
+    moments = _conv(x, params["quant_conv"]["w"], params["quant_conv"]["b"],
+                    dtype=dtype)
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    return mean, logvar
+
+
+class DSVAE:
+    """Serving wrapper (reference DSVAE): jit-cached encode/decode."""
+
+    def __init__(self, params: Dict[str, Any], cfg: VAEConfig):
+        self.params = params
+        self.config = cfg
+        self._dec = jax.jit(lambda p, z: vae_decode(p, z, cfg))
+        self._enc = jax.jit(lambda p, x: vae_encode(p, x, cfg))
+
+    def decode(self, latents):
+        return self._dec(self.params, latents)
+
+    def encode(self, image):
+        return self._enc(self.params, image)
+
+
+# ------------------------------------------------------------------ convert
+def _convert_vae_resnet(sd, prefix):
+    out = {"norm1": _norm_w(sd, f"{prefix}.norm1"),
+           "conv1": _conv_w(sd, f"{prefix}.conv1"),
+           "norm2": _norm_w(sd, f"{prefix}.norm2"),
+           "conv2": _conv_w(sd, f"{prefix}.conv2")}
+    if f"{prefix}.conv_shortcut.weight" in sd:
+        out["conv_shortcut"] = _conv_w(sd, f"{prefix}.conv_shortcut")
+    return out
+
+
+def _convert_vae_attn(sd, prefix):
+    return {"group_norm": _norm_w(sd, f"{prefix}.group_norm"),
+            "q_w": jnp.asarray(_t(sd, f"{prefix}.to_q.weight").T),
+            "k_w": jnp.asarray(_t(sd, f"{prefix}.to_k.weight").T),
+            "v_w": jnp.asarray(_t(sd, f"{prefix}.to_v.weight").T),
+            "out_w": jnp.asarray(_t(sd, f"{prefix}.to_out.0.weight").T),
+            "out_b": jnp.asarray(_t(sd, f"{prefix}.to_out.0.bias"))}
+
+
+def _convert_vae_mid(sd, prefix):
+    return {"resnets": [_convert_vae_resnet(sd, f"{prefix}.resnets.0"),
+                        _convert_vae_resnet(sd, f"{prefix}.resnets.1")],
+            "attentions": [_convert_vae_attn(sd, f"{prefix}.attentions.0")]}
+
+
+def convert_vae(sd: Dict[str, Any], cfg: VAEConfig) -> Dict[str, Any]:
+    """Param tree from an HF diffusers AutoencoderKL state dict
+    (``vae/diffusion_pytorch_model.safetensors`` naming)."""
+    n = len(cfg.block_out_channels)
+    dec: Dict[str, Any] = {
+        "conv_in": _conv_w(sd, "decoder.conv_in"),
+        "mid_block": _convert_vae_mid(sd, "decoder.mid_block"),
+        "conv_norm_out": _norm_w(sd, "decoder.conv_norm_out"),
+        "conv_out": _conv_w(sd, "decoder.conv_out"),
+        "up_blocks": []}
+    for bi in range(n):
+        p = f"decoder.up_blocks.{bi}"
+        bp = {"resnets": [_convert_vae_resnet(sd, f"{p}.resnets.{li}")
+                          for li in range(cfg.layers_per_block + 1)]}
+        if f"{p}.upsamplers.0.conv.weight" in sd:
+            bp["upsampler"] = {"conv": _conv_w(sd, f"{p}.upsamplers.0.conv")}
+        dec["up_blocks"].append(bp)
+    enc: Dict[str, Any] = {
+        "conv_in": _conv_w(sd, "encoder.conv_in"),
+        "mid_block": _convert_vae_mid(sd, "encoder.mid_block"),
+        "conv_norm_out": _norm_w(sd, "encoder.conv_norm_out"),
+        "conv_out": _conv_w(sd, "encoder.conv_out"),
+        "down_blocks": []}
+    for bi in range(n):
+        p = f"encoder.down_blocks.{bi}"
+        bp = {"resnets": [_convert_vae_resnet(sd, f"{p}.resnets.{li}")
+                          for li in range(cfg.layers_per_block)]}
+        if f"{p}.downsamplers.0.conv.weight" in sd:
+            bp["downsampler"] = _conv_w(sd, f"{p}.downsamplers.0.conv")
+        enc["down_blocks"].append(bp)
+    return {"decoder": dec, "encoder": enc,
+            "post_quant_conv": _conv_w(sd, "post_quant_conv"),
+            "quant_conv": _conv_w(sd, "quant_conv")}

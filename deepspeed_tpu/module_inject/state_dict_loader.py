@@ -163,11 +163,22 @@ def load_hf_config(path: str) -> SimpleNamespace:
 
 def load_state_dict(path: str):
     """Resolve the checkpoint files under ``path`` into a (possibly lazy)
-    flat name→tensor mapping."""
-    st = os.path.join(path, "model.safetensors")
-    st_index = st + ".index.json"
-    bin_ = os.path.join(path, "pytorch_model.bin")
-    bin_index = bin_ + ".index.json"
+    flat name→tensor mapping. Knows both the transformers layout
+    (``model.safetensors`` / ``pytorch_model.bin``) and the diffusers
+    component layout (``diffusion_pytorch_model.*``)."""
+    def first(*names):
+        for n in names:
+            p = os.path.join(path, n)
+            if os.path.exists(p):
+                return p
+        return os.path.join(path, names[0])
+
+    st = first("model.safetensors", "diffusion_pytorch_model.safetensors")
+    st_index = first("model.safetensors.index.json",
+                     "diffusion_pytorch_model.safetensors.index.json")
+    bin_ = first("pytorch_model.bin", "diffusion_pytorch_model.bin")
+    bin_index = first("pytorch_model.bin.index.json",
+                      "diffusion_pytorch_model.bin.index.json")
 
     if os.path.exists(st_index):
         with open(st_index) as f:

@@ -1,0 +1,507 @@
+"""Diffusers (Stable-Diffusion) family tests.
+
+Component parity is checked against torch (CPU) implementations of the
+same math — GroupNorm/conv padding conventions, the diffusers attention
+scaling, the BasicTransformerBlock dataflow, ResnetBlock2D — using
+identical weights routed through the converters, so the NCHW→NHWC /
+[out,in]→[in,out] conversion conventions are what is actually under test.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.model_implementations.diffusers import (
+    DSUNet, DSVAE, DiffusersAttentionConfig, Diffusers2DTransformerConfig,
+    UNetConfig, VAEConfig, attention, convert_attention,
+    convert_transformer_block, convert_unet, convert_vae,
+    timestep_embedding, transformer_block, unet_apply, vae_decode,
+    vae_encode)
+from deepspeed_tpu.model_implementations.diffusers.unet import (
+    _conv, _group_norm, _resnet_block)
+
+RNG = np.random.default_rng(0)
+
+
+def _nchw(x_nhwc):
+    return torch.tensor(np.asarray(x_nhwc, np.float32)).permute(0, 3, 1, 2)
+
+
+def _nhwc(x_torch):
+    return x_torch.detach().numpy().transpose(0, 2, 3, 1)
+
+
+# ------------------------------------------------------------- primitives
+def test_group_norm_matches_torch():
+    x = RNG.normal(size=(2, 6, 6, 8)).astype(np.float32)
+    scale = RNG.normal(size=(8,)).astype(np.float32)
+    bias = RNG.normal(size=(8,)).astype(np.float32)
+    got = np.asarray(_group_norm(jnp.asarray(x), jnp.asarray(scale),
+                                 jnp.asarray(bias), groups=4))
+    want = _nhwc(F.group_norm(_nchw(x), 4, torch.tensor(scale),
+                              torch.tensor(bias), eps=1e-5))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+@pytest.mark.parametrize("stride,asym", [(1, False), (2, False), (2, True)])
+def test_conv_matches_torch(stride, asym):
+    x = RNG.normal(size=(2, 8, 8, 4)).astype(np.float32)
+    w = RNG.normal(size=(3, 3, 4, 6)).astype(np.float32) * 0.1
+    b = RNG.normal(size=(6,)).astype(np.float32)
+    got = np.asarray(_conv(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                           stride=stride, dtype=jnp.float32,
+                           asym_pad=asym))
+    tw = torch.tensor(w.transpose(3, 2, 0, 1))      # HWIO -> OIHW
+    tx = _nchw(x)
+    if asym:
+        tx = F.pad(tx, (0, 1, 0, 1))                # VAE Downsample2D
+        want = F.conv2d(tx, tw, torch.tensor(b), stride=2)
+    else:
+        want = F.conv2d(tx, tw, torch.tensor(b), stride=stride, padding=1)
+    np.testing.assert_allclose(got, _nhwc(want), atol=2e-4)
+
+
+def test_timestep_embedding_matches_diffusers_formula():
+    t = jnp.asarray([0.0, 10.0, 999.0])
+    dim = 32
+    got = np.asarray(timestep_embedding(t, dim, flip_sin_to_cos=True))
+    half = dim // 2
+    freqs = np.exp(-np.log(10000) * np.arange(half) / half)
+    emb = np.asarray(t)[:, None] * freqs[None]
+    want = np.concatenate([np.cos(emb), np.sin(emb)], axis=-1)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    assert abs(float(got[0].sum()) - half) < 1e-5   # t=0: cos=1, sin=0
+
+
+# ------------------------------------------------------------- attention
+def _torch_diffusers_attention(sd, prefix, hidden, context, heads):
+    q = F.linear(hidden, sd[f"{prefix}.to_q.weight"])
+    src = hidden if context is None else context
+    k = F.linear(src, sd[f"{prefix}.to_k.weight"])
+    v = F.linear(src, sd[f"{prefix}.to_v.weight"])
+    b, t, c = q.shape
+    d = c // heads
+
+    def split(x):
+        return x.reshape(b, -1, heads, d).permute(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+    att = torch.softmax(q @ k.transpose(-1, -2) / np.sqrt(d), dim=-1)
+    out = (att @ v).permute(0, 2, 1, 3).reshape(b, t, c)
+    return F.linear(out, sd[f"{prefix}.to_out.0.weight"],
+                    sd[f"{prefix}.to_out.0.bias"])
+
+
+def _make_attn_sd(prefix, c, ctx_dim=None):
+    ctx_dim = ctx_dim or c
+    return {
+        f"{prefix}.to_q.weight": torch.randn(c, c) * 0.1,
+        f"{prefix}.to_k.weight": torch.randn(c, ctx_dim) * 0.1,
+        f"{prefix}.to_v.weight": torch.randn(c, ctx_dim) * 0.1,
+        f"{prefix}.to_out.0.weight": torch.randn(c, c) * 0.1,
+        f"{prefix}.to_out.0.bias": torch.randn(c) * 0.1,
+    }
+
+
+@pytest.mark.parametrize("cross", [False, True])
+def test_attention_matches_torch(cross):
+    torch.manual_seed(0)
+    c, heads, ctx_dim = 32, 4, 16
+    sd = _make_attn_sd("attn", c, ctx_dim if cross else None)
+    hidden = torch.randn(2, 9, c)
+    context = torch.randn(2, 5, ctx_dim) if cross else None
+    want = _torch_diffusers_attention(sd, "attn", hidden, context, heads)
+    params = convert_attention(sd, "attn")
+    cfg = DiffusersAttentionConfig(hidden_size=c, heads=heads,
+                                   dtype=jnp.float32)
+    got = attention(params, jnp.asarray(hidden.numpy()), cfg,
+                    context=None if context is None
+                    else jnp.asarray(context.numpy()))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=2e-5)
+
+
+# ------------------------------------------------------------ tx block
+def _make_block_sd(prefix, c, ctx_dim, inner=None):
+    inner = inner or 4 * c
+    sd = {}
+    for n in ("norm1", "norm2", "norm3"):
+        sd[f"{prefix}.{n}.weight"] = torch.randn(c) * 0.1 + 1
+        sd[f"{prefix}.{n}.bias"] = torch.randn(c) * 0.1
+    sd.update(_make_attn_sd(f"{prefix}.attn1", c))
+    sd.update(_make_attn_sd(f"{prefix}.attn2", c, ctx_dim))
+    sd[f"{prefix}.ff.net.0.proj.weight"] = torch.randn(2 * inner, c) * 0.05
+    sd[f"{prefix}.ff.net.0.proj.bias"] = torch.randn(2 * inner) * 0.05
+    sd[f"{prefix}.ff.net.2.weight"] = torch.randn(c, inner) * 0.05
+    sd[f"{prefix}.ff.net.2.bias"] = torch.randn(c) * 0.05
+    return sd
+
+
+def _torch_basic_block(sd, p, x, context, heads):
+    def ln(n, y):
+        return F.layer_norm(y, (y.shape[-1],), sd[f"{p}.{n}.weight"],
+                            sd[f"{p}.{n}.bias"], eps=1e-5)
+    x = x + _torch_diffusers_attention(sd, f"{p}.attn1", ln("norm1", x),
+                                       None, heads)
+    x = x + _torch_diffusers_attention(sd, f"{p}.attn2", ln("norm2", x),
+                                       context, heads)
+    h = F.linear(ln("norm3", x), sd[f"{p}.ff.net.0.proj.weight"],
+                 sd[f"{p}.ff.net.0.proj.bias"])
+    value, gate = h.chunk(2, dim=-1)
+    h = value * F.gelu(gate)
+    return x + F.linear(h, sd[f"{p}.ff.net.2.weight"],
+                        sd[f"{p}.ff.net.2.bias"])
+
+
+def test_transformer_block_matches_torch():
+    torch.manual_seed(1)
+    c, heads, ctx_dim = 32, 4, 16
+    sd = _make_block_sd("blk", c, ctx_dim)
+    hidden = torch.randn(2, 9, c)
+    context = torch.randn(2, 5, ctx_dim)
+    want = _torch_basic_block(sd, "blk", hidden, context, heads)
+    params = convert_transformer_block(sd, "blk")
+    cfg = Diffusers2DTransformerConfig(hidden_size=c, heads=heads,
+                                       context_dim=ctx_dim,
+                                       dtype=jnp.float32)
+    got = transformer_block(params, jnp.asarray(hidden.numpy()), cfg,
+                            context=jnp.asarray(context.numpy()))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-4)
+
+
+# -------------------------------------------------------------- resnet
+def test_resnet_block_matches_torch():
+    torch.manual_seed(2)
+    cin, cout, temb_dim, groups = 8, 16, 12, 4
+    sd = {
+        "r.norm1.weight": torch.randn(cin) * 0.1 + 1,
+        "r.norm1.bias": torch.randn(cin) * 0.1,
+        "r.conv1.weight": torch.randn(cout, cin, 3, 3) * 0.1,
+        "r.conv1.bias": torch.randn(cout) * 0.1,
+        "r.time_emb_proj.weight": torch.randn(cout, temb_dim) * 0.1,
+        "r.time_emb_proj.bias": torch.randn(cout) * 0.1,
+        "r.norm2.weight": torch.randn(cout) * 0.1 + 1,
+        "r.norm2.bias": torch.randn(cout) * 0.1,
+        "r.conv2.weight": torch.randn(cout, cout, 3, 3) * 0.1,
+        "r.conv2.bias": torch.randn(cout) * 0.1,
+        "r.conv_shortcut.weight": torch.randn(cout, cin, 1, 1) * 0.1,
+        "r.conv_shortcut.bias": torch.randn(cout) * 0.1,
+    }
+    x = torch.randn(2, cin, 6, 6)
+    temb = torch.randn(2, temb_dim)
+
+    h = F.group_norm(x, groups, sd["r.norm1.weight"], sd["r.norm1.bias"],
+                     eps=1e-5)
+    h = F.conv2d(F.silu(h), sd["r.conv1.weight"], sd["r.conv1.bias"],
+                 padding=1)
+    t = F.linear(F.silu(temb), sd["r.time_emb_proj.weight"],
+                 sd["r.time_emb_proj.bias"])
+    h = h + t[:, :, None, None]
+    h = F.group_norm(h, groups, sd["r.norm2.weight"], sd["r.norm2.bias"],
+                     eps=1e-5)
+    h = F.conv2d(F.silu(h), sd["r.conv2.weight"], sd["r.conv2.bias"],
+                 padding=1)
+    want = F.conv2d(x, sd["r.conv_shortcut.weight"],
+                    sd["r.conv_shortcut.bias"]) + h
+
+    from deepspeed_tpu.model_implementations.diffusers.unet import (
+        _convert_resnet)
+    params = _convert_resnet(sd, "r")
+    cfg = UNetConfig(norm_num_groups=groups, dtype=jnp.float32)
+    got = _resnet_block(params, jnp.asarray(_nhwc(x)),
+                        jnp.asarray(temb.numpy()), cfg)
+    np.testing.assert_allclose(np.asarray(got), _nhwc(want), atol=5e-4)
+
+
+# ------------------------------------------------------------ full unet
+def tiny_unet_cfg(**kw):
+    return UNetConfig(
+        in_channels=4, out_channels=4, block_out_channels=(16, 32),
+        layers_per_block=1, cross_attention_dim=8, attention_head_dim=2,
+        down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"),
+        up_block_types=("UpBlock2D", "CrossAttnUpBlock2D"),
+        norm_num_groups=8, dtype=jnp.float32, **kw)
+
+
+def tiny_unet_sd(cfg: UNetConfig, seed=3):
+    """Random state dict in HF diffusers naming with diffusers' channel
+    bookkeeping (UNet2DConditionModel __init__)."""
+    torch.manual_seed(seed)
+    sd = {}
+    chs = cfg.block_out_channels
+    temb_dim = chs[0] * 4
+
+    def lin(p, i, o):
+        sd[f"{p}.weight"] = torch.randn(o, i) * 0.05
+        sd[f"{p}.bias"] = torch.randn(o) * 0.05
+
+    def conv(p, i, o, k=3):
+        sd[f"{p}.weight"] = torch.randn(o, i, k, k) * 0.05
+        sd[f"{p}.bias"] = torch.randn(o) * 0.05
+
+    def norm(p, c):
+        sd[f"{p}.weight"] = torch.randn(c) * 0.1 + 1
+        sd[f"{p}.bias"] = torch.randn(c) * 0.1
+
+    def resnet(p, cin, cout):
+        norm(f"{p}.norm1", cin)
+        conv(f"{p}.conv1", cin, cout)
+        lin(f"{p}.time_emb_proj", temb_dim, cout)
+        norm(f"{p}.norm2", cout)
+        conv(f"{p}.conv2", cout, cout)
+        if cin != cout:
+            conv(f"{p}.conv_shortcut", cin, cout, k=1)
+
+    def attn(p, c, ctx):
+        for n, i in (("to_q", c), ("to_k", ctx), ("to_v", ctx)):
+            sd[f"{p}.{n}.weight"] = torch.randn(c, i) * 0.05
+        sd[f"{p}.to_out.0.weight"] = torch.randn(c, c) * 0.05
+        sd[f"{p}.to_out.0.bias"] = torch.randn(c) * 0.05
+
+    def spatial(p, c, n_blocks=None):
+        norm(f"{p}.norm", c)
+        conv(f"{p}.proj_in", c, c, k=1)
+        if n_blocks is None:
+            n_blocks = (cfg.transformer_layers
+                        if isinstance(cfg.transformer_layers, int) else
+                        max(cfg.transformer_layers))
+        for i in range(n_blocks):
+            b = f"{p}.transformer_blocks.{i}"
+            for n in ("norm1", "norm2", "norm3"):
+                norm(f"{b}.{n}", c)
+            attn(f"{b}.attn1", c, c)
+            attn(f"{b}.attn2", c, cfg.cross_attention_dim)
+            inner = 4 * c
+            lin(f"{b}.ff.net.0.proj", c, 2 * inner)
+            lin(f"{b}.ff.net.2", inner, c)
+        conv(f"{p}.proj_out", c, c, k=1)
+
+    lin("time_embedding.linear_1", chs[0], temb_dim)
+    lin("time_embedding.linear_2", temb_dim, temb_dim)
+    conv("conv_in", cfg.in_channels, chs[0])
+    norm("conv_norm_out", chs[0])
+    conv("conv_out", chs[0], cfg.out_channels)
+
+    out_ch = chs[0]
+    for bi, btype in enumerate(cfg.down_block_types):
+        in_ch, out_ch = out_ch, chs[bi]
+        for li in range(cfg.layers_per_block):
+            resnet(f"down_blocks.{bi}.resnets.{li}",
+                   in_ch if li == 0 else out_ch, out_ch)
+            if btype.startswith("CrossAttn"):
+                spatial(f"down_blocks.{bi}.attentions.{li}", out_ch)
+        if bi < len(chs) - 1:
+            conv(f"down_blocks.{bi}.downsamplers.0.conv", out_ch, out_ch)
+
+    resnet("mid_block.resnets.0", chs[-1], chs[-1])
+    spatial("mid_block.attentions.0", chs[-1])
+    resnet("mid_block.resnets.1", chs[-1], chs[-1])
+
+    rev = list(reversed(chs))
+    prev = chs[-1]
+    for bi, btype in enumerate(cfg.up_block_types):
+        out_c = rev[bi]
+        in_c = rev[min(bi + 1, len(chs) - 1)]
+        for li in range(cfg.layers_per_block + 1):
+            skip = in_c if li == cfg.layers_per_block else out_c
+            rin = prev if li == 0 else out_c
+            resnet(f"up_blocks.{bi}.resnets.{li}", rin + skip, out_c)
+            if btype.startswith("CrossAttn"):
+                spatial(f"up_blocks.{bi}.attentions.{li}", out_c)
+        prev = out_c
+        if bi < len(chs) - 1:
+            conv(f"up_blocks.{bi}.upsamplers.0.conv", out_c, out_c)
+    return sd
+
+
+def test_unet_forward_shapes_and_determinism():
+    cfg = tiny_unet_cfg()
+    params = convert_unet(tiny_unet_sd(cfg), cfg)
+    sample = jnp.asarray(RNG.normal(size=(2, 8, 8, 4)), jnp.float32)
+    ctx = jnp.asarray(RNG.normal(size=(2, 7, 8)), jnp.float32)
+    t = jnp.asarray([5, 900], jnp.float32)
+    out = unet_apply(params, sample, t, ctx, cfg)
+    assert out.shape == (2, 8, 8, 4)
+    assert np.all(np.isfinite(np.asarray(out)))
+    out2 = unet_apply(params, sample, t, ctx, cfg)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # timestep conditioning actually conditions
+    out3 = unet_apply(params, sample, jnp.asarray([5, 5], jnp.float32),
+                      ctx, cfg)
+    assert not np.allclose(np.asarray(out)[1], np.asarray(out3)[1])
+
+
+def test_unet_multi_transformer_layers():
+    """transformer_layers_per_block > 1 (SDXL-style) converts and runs
+    every block, not just block 0."""
+    cfg = tiny_unet_cfg(transformer_layers=2)
+    params = convert_unet(tiny_unet_sd(cfg), cfg)
+    assert len(params["mid_block"]["attentions"][0]["blocks"]) == 2
+    assert len(params["down_blocks"][0]["attentions"][0]["blocks"]) == 2
+    out = unet_apply(params, jnp.zeros((1, 8, 8, 4), jnp.float32),
+                     jnp.asarray([1.0]), jnp.zeros((1, 7, 8), jnp.float32),
+                     cfg)
+    assert out.shape == (1, 8, 8, 4)
+    # the second block's weights matter
+    cfg1 = tiny_unet_cfg(transformer_layers=1)
+    p1 = convert_unet(tiny_unet_sd(cfg), cfg1)
+    out1 = unet_apply(p1, jnp.zeros((1, 8, 8, 4), jnp.float32),
+                      jnp.asarray([1.0]), jnp.zeros((1, 7, 8), jnp.float32),
+                      cfg1)
+    assert not np.allclose(np.asarray(out), np.asarray(out1))
+
+
+def test_ds_unet_wrapper_jit_cache():
+    cfg = tiny_unet_cfg()
+    unet = DSUNet(convert_unet(tiny_unet_sd(cfg), cfg), cfg)
+    sample = jnp.zeros((1, 8, 8, 4), jnp.float32)
+    ctx = jnp.zeros((1, 7, 8), jnp.float32)
+    t = jnp.asarray([1.0])
+    o1 = unet(sample, t, ctx)
+    o2 = unet(sample, t, ctx)      # second call hits the executable cache
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert unet._fn._cache_size() == 1
+
+
+def test_unet_int8_memory_drop():
+    from deepspeed_tpu.module_inject.quantize import tree_weight_bytes
+    cfg = tiny_unet_cfg()
+    sd = tiny_unet_sd(cfg)
+    dense = convert_unet(sd, cfg)
+    q = convert_unet(sd, tiny_unet_cfg(int8_quantization=True))
+    # int8 targets the spatial-transformer GEMM weights (the reference
+    # quantizes exactly these via GroupQuantizer in the diffusers block)
+    d_blk = dense["mid_block"]["attentions"][0]["blocks"][0]
+    q_blk = q["mid_block"]["attentions"][0]["blocks"][0]
+    assert tree_weight_bytes(q_blk) < 0.45 * tree_weight_bytes(d_blk)
+    sample = jnp.asarray(RNG.normal(size=(1, 8, 8, 4)), jnp.float32)
+    ctx = jnp.asarray(RNG.normal(size=(1, 7, 8)), jnp.float32)
+    t = jnp.asarray([3.0])
+    od = np.asarray(unet_apply(dense, sample, t, ctx, cfg))
+    oq = np.asarray(unet_apply(q, sample, t, ctx,
+                               tiny_unet_cfg(int8_quantization=True)))
+    # int8 fake of the attention/ff weights only — outputs stay close
+    assert np.isfinite(oq).all()
+    assert np.corrcoef(od.ravel(), oq.ravel())[0, 1] > 0.98
+
+
+# -------------------------------------------------------------- vae
+def tiny_vae_cfg():
+    return VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                     norm_num_groups=8, dtype=jnp.float32)
+
+
+def tiny_vae_sd(cfg: VAEConfig, seed=4):
+    torch.manual_seed(seed)
+    sd = {}
+
+    def conv(p, i, o, k=3):
+        sd[f"{p}.weight"] = torch.randn(o, i, k, k) * 0.05
+        sd[f"{p}.bias"] = torch.randn(o) * 0.05
+
+    def norm(p, c):
+        sd[f"{p}.weight"] = torch.randn(c) * 0.1 + 1
+        sd[f"{p}.bias"] = torch.randn(c) * 0.1
+
+    def resnet(p, cin, cout):
+        norm(f"{p}.norm1", cin)
+        conv(f"{p}.conv1", cin, cout)
+        norm(f"{p}.norm2", cout)
+        conv(f"{p}.conv2", cout, cout)
+        if cin != cout:
+            conv(f"{p}.conv_shortcut", cin, cout, k=1)
+
+    def attn(p, c):
+        norm(f"{p}.group_norm", c)
+        for n in ("to_q", "to_k", "to_v"):
+            sd[f"{p}.{n}.weight"] = torch.randn(c, c) * 0.05
+        sd[f"{p}.to_out.0.weight"] = torch.randn(c, c) * 0.05
+        sd[f"{p}.to_out.0.bias"] = torch.randn(c) * 0.05
+
+    def mid(p, c):
+        resnet(f"{p}.resnets.0", c, c)
+        attn(f"{p}.attentions.0", c)
+        resnet(f"{p}.resnets.1", c, c)
+
+    chs = cfg.block_out_channels
+    lc = cfg.latent_channels
+    # decoder: conv_in to chs[-1], up blocks in REVERSED channel order
+    conv("decoder.conv_in", lc, chs[-1])
+    mid("decoder.mid_block", chs[-1])
+    prev = chs[-1]
+    for bi, c in enumerate(reversed(chs)):
+        for li in range(cfg.layers_per_block + 1):
+            resnet(f"decoder.up_blocks.{bi}.resnets.{li}",
+                   prev if li == 0 else c, c)
+        prev = c
+        if bi < len(chs) - 1:
+            conv(f"decoder.up_blocks.{bi}.upsamplers.0.conv", c, c)
+    norm("decoder.conv_norm_out", chs[0])
+    conv("decoder.conv_out", chs[0], cfg.in_channels)
+    conv("post_quant_conv", lc, lc, k=1)
+    # encoder
+    conv("encoder.conv_in", cfg.in_channels, chs[0])
+    prev = chs[0]
+    for bi, c in enumerate(chs):
+        for li in range(cfg.layers_per_block):
+            resnet(f"encoder.down_blocks.{bi}.resnets.{li}",
+                   prev if li == 0 else c, c)
+        prev = c
+        if bi < len(chs) - 1:
+            conv(f"encoder.down_blocks.{bi}.downsamplers.0.conv", c, c)
+    mid("encoder.mid_block", chs[-1])
+    norm("encoder.conv_norm_out", chs[-1])
+    conv("encoder.conv_out", chs[-1], 2 * lc)
+    conv("quant_conv", 2 * lc, 2 * lc, k=1)
+    return sd
+
+
+def test_load_stable_diffusion_from_disk(tmp_path):
+    """End-to-end: diffusers save layout on disk → DSUNet/DSVAE with no
+    torch module instantiated (state_dict_factory analog for SD)."""
+    import json as _json
+    from safetensors.numpy import save_file
+    from deepspeed_tpu.model_implementations.diffusers.pipeline import (
+        load_stable_diffusion)
+    ucfg, vcfg = tiny_unet_cfg(), tiny_vae_cfg()
+    for name, sd, raw in (
+            ("unet", tiny_unet_sd(ucfg), {
+                "in_channels": 4, "out_channels": 4,
+                "block_out_channels": [16, 32], "layers_per_block": 1,
+                "cross_attention_dim": 8, "attention_head_dim": 2,
+                "down_block_types": ["CrossAttnDownBlock2D",
+                                     "DownBlock2D"],
+                "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D"],
+                "norm_num_groups": 8}),
+            ("vae", tiny_vae_sd(vcfg), {
+                "in_channels": 3, "latent_channels": 4,
+                "block_out_channels": [16, 32], "layers_per_block": 1,
+                "norm_num_groups": 8})):
+        d = tmp_path / name
+        d.mkdir()
+        save_file({k: v.numpy() for k, v in sd.items()},
+                  str(d / "diffusion_pytorch_model.safetensors"))
+        (d / "config.json").write_text(_json.dumps(raw))
+    unet, vae = load_stable_diffusion(str(tmp_path), dtype=jnp.float32)
+    out = unet(jnp.zeros((1, 8, 8, 4), jnp.float32),
+               jnp.asarray([1.0]), jnp.zeros((1, 7, 8), jnp.float32))
+    assert out.shape == (1, 8, 8, 4)
+    img = vae.decode(jnp.zeros((1, 4, 4, 4), jnp.float32))
+    assert img.shape == (1, 8, 8, 3)
+
+
+def test_vae_decode_encode_shapes():
+    cfg = tiny_vae_cfg()
+    params = convert_vae(tiny_vae_sd(cfg), cfg)
+    vae = DSVAE(params, cfg)
+    latents = jnp.asarray(RNG.normal(size=(1, 4, 4, 4)), jnp.float32)
+    img = vae.decode(latents)
+    # 2 levels -> one 2x upsample
+    assert img.shape == (1, 8, 8, 3)
+    assert np.isfinite(np.asarray(img)).all()
+    mean, logvar = vae.encode(img)
+    assert mean.shape == (1, 4, 4, 4) and logvar.shape == (1, 4, 4, 4)
+    # encode→decode round trip is deterministic
+    img2 = vae.decode(latents)
+    np.testing.assert_array_equal(np.asarray(img), np.asarray(img2))
