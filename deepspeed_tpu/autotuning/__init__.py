@@ -1,4 +1,11 @@
 """Autotuning (analog of ``deepspeed/autotuning/``)."""
 from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.autotuning.scheduler import (ResourceManager,
+                                                write_trial_script)
+from deepspeed_tpu.autotuning.tuner import (GridSearchTuner,
+                                            ModelBasedTuner, RandomTuner,
+                                            RidgeCostModel, build_tuner)
 
-__all__ = ["Autotuner"]
+__all__ = ["Autotuner", "ResourceManager", "write_trial_script",
+           "GridSearchTuner", "RandomTuner", "ModelBasedTuner",
+           "RidgeCostModel", "build_tuner"]

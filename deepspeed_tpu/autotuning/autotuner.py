@@ -103,7 +103,11 @@ class Autotuner:
                  metric: str = "throughput",
                  model_info: Optional[Dict] = None,
                  hbm_bytes: Optional[int] = None,
-                 early_stop_threshold: float = 0.97):
+                 early_stop_threshold: float = 0.97,
+                 tuner_type: str = "gridsearch",
+                 max_trials: Optional[int] = None,
+                 tuner_seed: int = 0,
+                 resource_manager=None):
         """``engine_builder(config_dict) -> engine`` builds a fresh engine;
         ``batch_builder(global_batch_size) -> batch`` builds a matching
         input batch. ``mesh_shapes``: list of mesh-section dicts to search
@@ -122,6 +126,13 @@ class Autotuner:
         self.model_info = model_info
         self.hbm_bytes = hbm_bytes
         self.early_stop_threshold = early_stop_threshold
+        self.tuner_type = tuner_type
+        self.max_trials = max_trials
+        self.tuner_seed = tuner_seed
+        # ResourceManager (autotuning/scheduler.py): run trials out of
+        # process, isolating the tuner from OOM/compile crashes —
+        # reference scheduler.py runs each experiment as a launcher job
+        self.resource_manager = resource_manager
         self.results: List[Dict] = []
         self.pruned: List[Dict] = []
 
@@ -179,15 +190,14 @@ class Autotuner:
             gc.collect()
 
     # ------------------------------------------------------------------
-    def tune(self) -> Dict:
-        """Run the search; return {'best_config', 'best_metrics',
-        'results', 'pruned'} (the reference's summary + exps dir rolled
-        into one dict)."""
+    def _candidates(self):
+        """Enumerate the (mesh, stage, micro) space minus memory-pruned
+        points, arm-ordered (small micro first) so grid search retains
+        the OOM/knee early-stop structure."""
         meshes = self.mesh_shapes if self.mesh_shapes is not None else [None]
-        best = None
+        labels, configs = [], []
         for mesh in meshes:
             for stage in self.zero_stages:
-                arm_best = None
                 for micro in self.micro_batches:
                     label = {"mesh": mesh, "zero_stage": stage,
                              "micro_batch": micro}
@@ -196,36 +206,90 @@ class Autotuner:
                         logger.info(f"autotune pruned (memory model): "
                                     f"{label}")
                         continue
-                    cfg = self._trial_config(stage, micro, mesh)
-                    metrics = self._run_trial(cfg)
-                    self.results.append({**label, "metrics": metrics})
-                    if metrics is None:
-                        break  # bigger micro will not come back from OOM
-                    logger.info(
-                        f"autotune trial mesh={mesh} z{stage} mbs{micro}: "
-                        f"{metrics['throughput']:.1f} samples/s")
-                    if best is None or self._better(metrics, best[1]):
-                        best = (cfg, metrics, label)
-                    # early-stop this arm once bigger micro stops paying
-                    if arm_best is not None and (
-                            metrics["throughput"] <
-                            self.early_stop_threshold *
-                            arm_best["throughput"]):
-                        logger.info(f"autotune early-stop arm at "
-                                    f"mbs{micro}")
-                        break
-                    if (arm_best is None or metrics["throughput"] >
-                            arm_best["throughput"]):
-                        arm_best = metrics
+                    labels.append(label)
+                    configs.append(self._trial_config(stage, micro, mesh))
+        return labels, configs
+
+    def tune(self) -> Dict:
+        """Run the search; return {'best_config', 'best_metrics',
+        'results', 'pruned'} (the reference's summary + exps dir rolled
+        into one dict). ``tuner_type`` picks the strategy (gridsearch /
+        random / model_based — reference tuner/ package); trials run in
+        process or through the ResourceManager subprocess scheduler."""
+        from deepspeed_tpu.autotuning.tuner import build_tuner
+        labels, configs = self._candidates()
+        tuner = build_tuner(self.tuner_type, labels,
+                            max_trials=self.max_trials,
+                            seed=self.tuner_seed)
+        best = None
+        arm_fail: Dict = {}     # arm -> smallest micro that failed (OOM)
+        arm_knee: Dict = {}     # arm -> micro past the throughput knee
+        arm_best: Dict = {}     # arm -> (micro, score)
+        while not tuner.done():
+            i = tuner.next_trial()
+            if i is None:
+                break
+            label = labels[i]
+            arm = (repr(label["mesh"]), label["zero_stage"])
+            micro = label["micro_batch"]
+            if micro >= arm_fail.get(arm, float("inf")):
+                tuner.skip(i)   # budget-free: nothing was measured
+                self.results.append({**label, "metrics": None,
+                                     "skipped": "above failed micro"})
+                continue
+            if micro > arm_knee.get(arm, float("inf")):
+                tuner.skip(i)
+                self.results.append({**label, "metrics": None,
+                                     "skipped": "past throughput knee"})
+                continue
+            if self.resource_manager is not None:
+                metrics = self.resource_manager.run(configs[i], label)
+            else:
+                metrics = self._run_trial(configs[i])
+            score = self._score(metrics)
+            self.results.append({**label, "metrics": metrics})
+            tuner.update(i, score)
+            if score is None:
+                arm_fail[arm] = min(arm_fail.get(arm, float("inf")), micro)
+                continue
+            logger.info(
+                f"autotune trial mesh={label['mesh']} "
+                f"z{label['zero_stage']} mbs{micro}: "
+                f"{self.metric}={abs(score):.4g}")
+            if best is None or score > best[3]:
+                best = (configs[i], metrics, label, score)
+            prev = arm_best.get(arm)
+            # the knee assumption (bigger micro stops paying) is only
+            # evidenced when a LARGER micro underperforms a smaller one —
+            # out-of-order tuners (random/model-based) must not let a
+            # small-micro stumble shadow the untested middle of the arm
+            if prev is not None and micro > prev[0] and (
+                    score < self.early_stop_threshold * prev[1]
+                    if prev[1] > 0 else score < prev[1] /
+                    self.early_stop_threshold):
+                arm_knee[arm] = micro
+                logger.info(f"autotune early-stop arm at mbs{micro}")
+            if prev is None or score > prev[1]:
+                arm_best[arm] = (micro, score)
         if best is None:
             raise RuntimeError("no autotuning trial succeeded")
-        cfg, metrics, label = best
-        logger.info(f"autotune best: {label} "
-                    f"{metrics['throughput']:.1f} samples/s")
-        return {"best_config": cfg, "best_metrics": metrics,
-                "results": self.results, "pruned": self.pruned}
+        cfg, metrics, label, _ = best
+        logger.info(f"autotune best: {label} {metrics}")
+        out = {"best_config": cfg, "best_metrics": metrics,
+               "results": self.results, "pruned": self.pruned}
+        if self.resource_manager is not None:
+            self.resource_manager.write_summary(
+                self.results, {"label": label, "metrics": metrics})
+        return out
 
-    def _better(self, a: Dict, b: Dict) -> bool:
+    def _score(self, metrics: Optional[Dict]) -> Optional[float]:
+        """Signed maximize-me score for the configured metric — the SAME
+        objective feeds the surrogate (tuner.update) and the best-pick,
+        so a model-based search optimizes what the user asked for."""
+        if metrics is None:
+            return None
         if self.metric == "throughput":
-            return a["throughput"] > b["throughput"]
-        return a["latency_s"] < b["latency_s"]
+            v = metrics.get("throughput")
+            return None if v is None else float(v)
+        v = metrics.get("latency_s")
+        return None if v is None else -float(v)

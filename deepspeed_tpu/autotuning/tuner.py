@@ -1,0 +1,201 @@
+"""Tuner strategies + cost model for the autotuner.
+
+Analog of ``deepspeed/autotuning/tuner/`` (``base_tuner.py``,
+``index_based_tuner.py`` — grid/random, ``model_based_tuner.py`` +
+``cost_model.py``). The reference's model-based tuner fits an XGBoost
+ranking model over flattened config features, seeds with INIT_NUM random
+trials, then alternates predict-top-K / evaluate / refit with an 0.2
+random-exploration ratio. xgboost is not in this image, so the cost model
+is a ridge regression over one-hot + log-scale numeric features (numpy
+only) — same contract: ``fit(configs, scores)`` / ``predict(configs)``,
+used purely to *order* candidates, never as the final metric.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+INIT_NUM = 2                      # reference model_based_tuner.py INIT_NUM
+EXPLORATION_RATIO = 0.2           # reference random_exploration_ratio
+
+
+def _features(label: Dict[str, Any]) -> Dict[str, float]:
+    """Flatten a trial label into numeric features (reference
+    ``dict_to_feature``/``flatten``): numbers pass through with a log2
+    companion; mesh dims expand per axis."""
+    out: Dict[str, float] = {}
+    for k, v in label.items():
+        if isinstance(v, dict):
+            for ak, av in v.items():
+                out[f"{k}.{ak}"] = float(av)
+                if av > 0:
+                    out[f"log2.{k}.{ak}"] = float(np.log2(av))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+            if v > 0:
+                out[f"log2.{k}"] = float(np.log2(v))
+        elif v is None:
+            continue
+        else:
+            out[f"{k}={v}"] = 1.0
+    return out
+
+
+class RidgeCostModel:
+    """fit/predict over trial labels — the XGBoostCostModel stand-in."""
+
+    def __init__(self, l2: float = 1e-3):
+        self.l2 = l2
+        self._keys: List[str] = []
+        self._w: Optional[np.ndarray] = None
+        self._mean = 0.0
+
+    def _matrix(self, labels: Sequence[Dict]) -> np.ndarray:
+        rows = [_features(l) for l in labels]
+        if not self._keys:
+            self._keys = sorted({k for r in rows for k in r})
+        X = np.zeros((len(rows), len(self._keys) + 1), np.float64)
+        X[:, -1] = 1.0
+        for i, r in enumerate(rows):
+            for j, k in enumerate(self._keys):
+                X[i, j] = r.get(k, 0.0)
+        return X
+
+    def fit(self, labels: Sequence[Dict], scores: Sequence[float]) -> None:
+        # rebuild the feature set every fit: keys only seen in later
+        # labels (e.g. log2.zero_stage once a stage>0 lands) must enter
+        self._keys = []
+        X = self._matrix(labels)
+        y = np.asarray(scores, np.float64)
+        self._mean = float(y.mean())
+        yc = y - self._mean
+        A = X.T @ X + self.l2 * np.eye(X.shape[1])
+        self._w = np.linalg.solve(A, X.T @ yc)
+
+    def predict(self, labels: Sequence[Dict]) -> np.ndarray:
+        if self._w is None:
+            return np.zeros(len(labels))
+        return self._matrix(labels) @ self._w + self._mean
+
+
+class BaseTuner:
+    """Iterates candidate trials in some order; ``update`` feeds back the
+    measured score so adaptive tuners can reorder (reference
+    ``BaseTuner.tune`` loop)."""
+
+    def __init__(self, candidates: Sequence[Dict],
+                 max_trials: Optional[int] = None, seed: int = 0):
+        self.candidates = list(candidates)
+        self.max_trials = (len(self.candidates) if max_trials is None
+                           else min(max_trials, len(self.candidates)))
+        self.seed = seed
+        self._issued = 0
+
+    def next_trial(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def update(self, index: int, score: Optional[float]) -> None:
+        pass
+
+    def skip(self, index: int) -> None:
+        """Refund the trial budget for a candidate the caller skipped
+        without measuring (OOM shadow / past the knee) — skips must not
+        eat ``max_trials``. The candidate stays consumed (it will not be
+        issued again)."""
+        self._issued -= 1
+
+    def done(self) -> bool:
+        return self._issued >= self.max_trials
+
+
+class _IndexTuner(BaseTuner):
+    """Walks a fixed order; the order pointer is independent of the
+    trial budget so ``skip`` refunds budget without re-issuing."""
+
+    _order: List[int]
+
+    def __init__(self, candidates, max_trials=None, seed: int = 0):
+        super().__init__(candidates, max_trials, seed)
+        self._pointer = 0
+
+    def next_trial(self) -> Optional[int]:
+        if self.done() or self._pointer >= len(self._order):
+            return None
+        i = self._order[self._pointer]
+        self._pointer += 1
+        self._issued += 1
+        return i
+
+
+class GridSearchTuner(_IndexTuner):
+    """Exhaustive in declaration order (index_based_tuner.GridSearchTuner)."""
+
+    def __init__(self, candidates, max_trials=None, seed: int = 0):
+        super().__init__(candidates, max_trials, seed)
+        self._order = list(range(len(self.candidates)))
+
+
+class RandomTuner(_IndexTuner):
+    """Uniform random without replacement (index_based_tuner.RandomTuner)."""
+
+    def __init__(self, candidates, max_trials=None, seed: int = 0):
+        super().__init__(candidates, max_trials, seed)
+        self._order = list(range(len(self.candidates)))
+        random.Random(seed).shuffle(self._order)
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model-guided search (model_based_tuner.ModelBasedTuner):
+    INIT_NUM random seeds, then argmax of the surrogate's prediction over
+    unvisited candidates, with EXPLORATION_RATIO random picks."""
+
+    def __init__(self, candidates, max_trials=None, seed: int = 0,
+                 cost_model: Optional[RidgeCostModel] = None):
+        super().__init__(candidates, max_trials, seed)
+        self.model = cost_model or RidgeCostModel()
+        self._rng = random.Random(seed)
+        self._visited: set = set()
+        self._evaluated: List[Tuple[int, float]] = []
+
+    def next_trial(self) -> Optional[int]:
+        if self.done() or len(self._visited) >= len(self.candidates):
+            return None
+        unvisited = [i for i in range(len(self.candidates))
+                     if i not in self._visited]
+        if (len(self._evaluated) < INIT_NUM or
+                self._rng.random() < EXPLORATION_RATIO):
+            i = self._rng.choice(unvisited)
+        else:
+            labels = [self.candidates[i] for i in unvisited]
+            pred = self.model.predict(labels)
+            i = unvisited[int(np.argmax(pred))]
+        self._visited.add(i)
+        self._issued += 1
+        return i
+
+    def update(self, index: int, score: Optional[float]) -> None:
+        # failures feed back as score 0 — the surrogate learns to avoid
+        # the region instead of ignoring it
+        self._evaluated.append((index, 0.0 if score is None else score))
+        if len(self._evaluated) >= INIT_NUM:
+            idx, ys = zip(*self._evaluated)
+            self.model.fit([self.candidates[i] for i in idx], ys)
+
+
+TUNERS: Dict[str, Any] = {
+    "gridsearch": GridSearchTuner,
+    "random": RandomTuner,
+    "model_based": ModelBasedTuner,
+}
+
+
+def build_tuner(name: str, candidates, max_trials=None,
+                seed: int = 0) -> BaseTuner:
+    if name not in TUNERS:
+        raise ValueError(f"unknown tuner_type {name!r}; supported: "
+                         f"{sorted(TUNERS)}")
+    return TUNERS[name](candidates, max_trials=max_trials, seed=seed)

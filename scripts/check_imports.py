@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Architectural import lint (reference ``scripts/check-torchdist.py``,
+which forbids raw torch.distributed outside deepspeed/comm).
+
+TPU-native invariants enforced here:
+
+1. ``torch`` may only be imported in checkpoint-interop modules
+   (``module_inject/``: policy conversion + state-dict loading). torch in
+   the compute/runtime path means host tensors leaking into what must be
+   jax-native code.
+2. ``jax.distributed`` (multi-host runtime init) may only be touched under
+   ``comm/`` — everything else reaches distribution through the mesh/comm
+   facade.
+
+Exit code 1 with a listing on violation; importable for tests.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "deepspeed_tpu")
+
+TORCH_ALLOWED = (
+    "module_inject/",          # HF/diffusers checkpoint conversion
+)
+# writer/IO utilities that happen to live in the torch package but move
+# no tensors into the compute path
+TORCH_MODULE_EXCEPTIONS = (
+    "torch.utils.tensorboard",
+)
+JAX_DISTRIBUTED_ALLOWED = (
+    "comm/",
+)
+
+
+def _imports(path: str) -> List[Tuple[int, str]]:
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), path)
+        except SyntaxError as e:
+            return [(e.lineno or 0, f"<syntax error: {e}>")]
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            out.extend((node.lineno, a.name) for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            out.append((node.lineno, node.module))
+        elif isinstance(node, ast.Attribute):
+            # jax.distributed.<x> attribute access without import
+            parts = []
+            n = node
+            while isinstance(n, ast.Attribute):
+                parts.append(n.attr)
+                n = n.value
+            if isinstance(n, ast.Name):
+                parts.append(n.id)
+                dotted = ".".join(reversed(parts))
+                if dotted.startswith("jax.distributed"):
+                    out.append((node.lineno, "jax.distributed"))
+    return out
+
+
+def check(pkg_root: str = PKG) -> List[str]:
+    violations = []
+    for dirpath, _, files in os.walk(pkg_root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+            for lineno, mod in _imports(path):
+                if (mod == "torch" or mod.startswith("torch.")) and \
+                        not rel.startswith(TORCH_ALLOWED) and \
+                        not mod.startswith(TORCH_MODULE_EXCEPTIONS):
+                    violations.append(
+                        f"{rel}:{lineno}: torch import outside "
+                        f"module_inject ({mod})")
+                if mod.startswith("jax.distributed") and \
+                        not rel.startswith(JAX_DISTRIBUTED_ALLOWED):
+                    violations.append(
+                        f"{rel}:{lineno}: jax.distributed outside comm/ "
+                        f"({mod})")
+    return violations
+
+
+if __name__ == "__main__":
+    bad = check()
+    for v in bad:
+        print(v, file=sys.stderr)
+    sys.exit(1 if bad else 0)

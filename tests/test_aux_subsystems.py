@@ -514,3 +514,23 @@ def test_comm_bench_sweep_and_memory_usage():
     mem = see_memory_usage("test", force=True)
     assert mem["host_total_bytes"] > 0
     assert see_memory_usage("quiet") == {}  # force=False is free
+
+
+# ------------------------------------------------- import lint (check-torchdist analog)
+def test_import_lint_clean_and_detects():
+    import importlib.util, os
+    spec = importlib.util.spec_from_file_location(
+        "check_imports", os.path.join(os.path.dirname(__file__), "..",
+                                      "scripts", "check_imports.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    assert lint.check() == []          # the tree is clean
+    # and it actually detects: a temp package with a stray torch import
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        os.makedirs(os.path.join(d, "runtime"))
+        with open(os.path.join(d, "runtime", "bad.py"), "w") as f:
+            f.write("import torch\nimport jax.distributed\n")
+        bad = lint.check(d)
+        assert len(bad) == 2
+        assert "torch import" in bad[0]
