@@ -84,7 +84,22 @@ def phase_train(args) -> dict:
     t = time.time()
     m = engine.train_batch(batch)
     float(m["loss"])
-    log(f"step 2 (warm) done in {time.time() - t:.1f}s")
+    warm_s = time.time() - t
+    log(f"step 2 (warm) done in {warm_s:.1f}s")
+    # partial record NOW: if the orchestrator must kill this phase during
+    # the measurement loop, the warm-step estimate survives on stdout
+    # (run_phase takes the LAST parseable JSON line)
+    tokens_per_step = global_bs * args.seq
+    fpt = model.flops_per_token()
+    print(json.dumps({
+        "phase": f"train-{args.preset}-partial", "preset": args.preset,
+        "tokens_per_sec_per_chip": round(tokens_per_step / warm_s /
+                                         n_chips, 2),
+        "tflops_per_chip": round(tokens_per_step / warm_s / n_chips *
+                                 fpt / 1e12, 2),
+        "flops_per_token": fpt, "seq": args.seq, "global_batch": global_bs,
+        "chips": n_chips, "ms_per_step": round(warm_s * 1e3, 1),
+        "partial": True, "loss": round(loss0, 4)}), flush=True)
 
     steps = args.steps
     t0 = time.time()
@@ -94,9 +109,7 @@ def phase_train(args) -> dict:
     dt = time.time() - t0
     log(f"{steps} steps in {dt:.2f}s ({dt / steps * 1e3:.0f} ms/step)")
 
-    tokens_per_step = global_bs * args.seq
     tps_chip = tokens_per_step * steps / dt / n_chips
-    fpt = model.flops_per_token()
     return {
         "phase": (f"train-{args.preset}" +
                   ("-noflash" if args.no_flash else "") +
@@ -275,8 +288,14 @@ def wait_for_chip(budget_left: float) -> bool:
     return chip_responsive(30)
 
 
-def run_phase(name: str, budget_left: float):
+def run_phase(name: str, budget_left: float, adaptive: bool = False):
     extra, cap = PHASES[name]
+    if adaptive:
+        # the first training phase carries the round's headline number:
+        # give it up to ~45% of the whole budget rather than killing a
+        # slow-relay compile at the fixed cap (killing mid-compile wedges
+        # the relay for every later phase — see PHASES note)
+        cap = max(cap, budget_left * 0.45)
     timeout = min(cap, budget_left - 30)
     if timeout < 120:
         log(f"phase {name}: SKIPPED (only {budget_left:.0f}s budget left)")
@@ -286,22 +305,34 @@ def run_phase(name: str, budget_left: float):
         return None
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", name] + extra
     log(f"phase {name}: start (timeout {timeout:.0f}s)")
+
+    def last_json(raw: bytes):
+        for line in reversed((raw or b"").decode().strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict):
+                return parsed
+        return None
+
     try:
         proc = subprocess.run(cmd, stdout=subprocess.PIPE, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        log(f"phase {name}: TIMEOUT after {timeout:.0f}s — killed; "
-            "continuing with remaining phases")
-        return None
+    except subprocess.TimeoutExpired as e:
+        # the phase may have printed a '-partial' warm-step record before
+        # the measurement loop was killed — salvage it
+        partial = last_json(e.stdout)
+        log(f"phase {name}: TIMEOUT after {timeout:.0f}s — killed"
+            + ("; salvaged partial record" if partial else "")
+            + "; continuing with remaining phases")
+        return partial
     if proc.returncode != 0:
         log(f"phase {name}: FAILED rc={proc.returncode}")
         return None
-    for line in reversed(proc.stdout.decode().strip().splitlines()):
-        try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
-    log(f"phase {name}: no JSON in output")
-    return None
+    result = last_json(proc.stdout)
+    if result is None:
+        log(f"phase {name}: no JSON in output")
+    return result
 
 
 def main() -> None:
@@ -334,10 +365,11 @@ def main() -> None:
 
     results: dict = {}
     order = (args.phases.split(",") if args.phases else list(PHASES))
+    first_train = next((n for n in order if n.startswith("train")), None)
     for name in order:
         try:
             left = args.budget - (time.time() - T0)
-            r = run_phase(name, left)
+            r = run_phase(name, left, adaptive=(name == first_train))
             if r is not None:
                 results[name] = r
         except Exception as e:  # noqa: BLE001 — one phase's failure must

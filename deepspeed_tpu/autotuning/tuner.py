@@ -178,11 +178,21 @@ class ModelBasedTuner(BaseTuner):
         return i
 
     def update(self, index: int, score: Optional[float]) -> None:
-        # failures feed back as score 0 — the surrogate learns to avoid
-        # the region instead of ignoring it
-        self._evaluated.append((index, 0.0 if score is None else score))
+        # failures are recorded and mapped to BELOW the worst measured
+        # score at fit time — an absolute 0.0 would be the *best* score
+        # under negative objectives (metric=latency), steering the
+        # surrogate toward the failing region
+        self._evaluated.append((index, score))
         if len(self._evaluated) >= INIT_NUM:
-            idx, ys = zip(*self._evaluated)
+            real = [s for _, s in self._evaluated if s is not None]
+            if real:
+                span = max(real) - min(real)
+                penalty = min(real) - max(span, 1.0)
+            else:
+                penalty = -1.0
+            idx = [i for i, _ in self._evaluated]
+            ys = [penalty if s is None else s
+                  for _, s in self._evaluated]
             self.model.fit([self.candidates[i] for i in idx], ys)
 
 

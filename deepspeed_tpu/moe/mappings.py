@@ -1,0 +1,118 @@
+"""Token gather/drop across the tensor-parallel axis.
+
+Analog of ``deepspeed/moe/mappings.py`` (``gather_tokens``/``drop_tokens``
+with ``_GatherTokens``/``_DropTokens`` autograd pairs, ``:27-110``): when
+an MoE layer sits inside a TP region whose activations are
+sequence-sharded across TP ranks, tokens must be gathered before expert
+dispatch and re-dropped after, with the transposed collective as the
+gradient.
+
+Two execution contexts, same API:
+
+* **GSPMD (default)** — axes are Auto: "gather" and "drop" are sharding
+  constraints (replicated vs sharded along ``tensor``); XLA inserts the
+  all-gather/slice and their transposes. This is the TPU-idiomatic form.
+* **shard_map** — axes Manual: explicit ``lax.all_gather(tiled=True)``
+  and the local slice. JAX differentiates both with the correct
+  transpose pair, matching the reference's autograd functions.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.utils.sharding import maybe_constrain
+
+TENSOR_AXIS = "tensor"
+
+
+def _axis_mode() -> str:
+    """'manual' inside shard_map over tensor, 'auto' under GSPMD with a
+    tensor axis, 'none' without a mesh."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or TENSOR_AXIS not in mesh.axis_names:
+        return "none"
+    types = dict(zip(mesh.axis_names, mesh.axis_types))
+    if types[TENSOR_AXIS] == jax.sharding.AxisType.Manual:
+        return "manual"
+    return "auto"
+
+
+def _spec(x, dim: int, sharded: bool) -> P:
+    entries = [None] * x.ndim
+    if sharded:
+        entries[dim] = TENSOR_AXIS
+    return P(*entries)
+
+
+def _local_slice(x: jax.Array, dim: int) -> jax.Array:
+    n = jax.lax.axis_size(TENSOR_AXIS)
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"drop_tokens: dim {dim} size {x.shape[dim]} not "
+            f"divisible by tensor={n} (reference asserts the same)")
+    idx = jax.lax.axis_index(TENSOR_AXIS)
+    chunk = x.shape[dim] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, dim)
+
+
+# The autograd pairing matters: downstream TP compute is REPLICATED, so
+# the backward of gather takes this rank's cotangent slice — NOT the
+# psum-scatter jax's native all_gather transpose would insert (that
+# convention is for sharded-sum losses and over-counts by tp_size here).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _gather_manual(x, dim):
+    return jax.lax.all_gather(x, TENSOR_AXIS, axis=dim, tiled=True)
+
+
+def _gather_fwd(x, dim):
+    return _gather_manual(x, dim), None
+
+
+def _gather_bwd(dim, _, ct):
+    return (_local_slice(ct, dim),)
+
+
+_gather_manual.defvjp(_gather_fwd, _gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _drop_manual(x, dim):
+    return _local_slice(x, dim)
+
+
+def _drop_fwd(x, dim):
+    return _drop_manual(x, dim), None
+
+
+def _drop_bwd(dim, _, ct):
+    return (jax.lax.all_gather(ct, TENSOR_AXIS, axis=dim, tiled=True),)
+
+
+_drop_manual.defvjp(_drop_fwd, _drop_bwd)
+
+
+def gather_tokens(x: jax.Array, dim: int = 0) -> jax.Array:
+    """All-gather ``x`` along ``dim`` across TP ranks (reference
+    ``gather_tokens``; backward drops to the local chunk)."""
+    mode = _axis_mode()
+    if mode == "none":
+        return x
+    if mode == "manual":
+        return _gather_manual(x, dim)
+    # GSPMD: constrain replicated along dim — XLA materializes the gather
+    return maybe_constrain(x, _spec(x, dim, sharded=False))
+
+
+def drop_tokens(x: jax.Array, dim: int = 0) -> jax.Array:
+    """Keep this rank's chunk of ``x`` along ``dim`` (reference
+    ``drop_tokens``; backward all-gathers)."""
+    mode = _axis_mode()
+    if mode == "none":
+        return x
+    if mode == "manual":
+        return _drop_manual(x, dim)
+    return maybe_constrain(x, _spec(x, dim, sharded=True))

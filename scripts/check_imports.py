@@ -49,6 +49,10 @@ def _imports(path: str) -> List[Tuple[int, str]]:
             out.extend((node.lineno, a.name) for a in node.names)
         elif isinstance(node, ast.ImportFrom) and node.module:
             out.append((node.lineno, node.module))
+            # 'from jax import distributed [as d]' must resolve to the
+            # dotted module, not just 'jax'
+            out.extend((node.lineno, f"{node.module}.{a.name}")
+                       for a in node.names)
         elif isinstance(node, ast.Attribute):
             # jax.distributed.<x> attribute access without import
             parts = []

@@ -79,6 +79,20 @@ def test_model_based_tuner_converges_to_best():
     assert best_seen == true_best
 
 
+def test_failure_penalty_below_worst_negative_score():
+    """OOM feedback must rank BELOW measured scores even when the
+    objective is negative (metric=latency) — an absolute 0.0 would be
+    the best score and steer the surrogate into the failing region."""
+    labels = labels_grid()
+    t = ModelBasedTuner(labels, max_trials=6, seed=2)
+    t.update(0, -0.5)
+    t.update(1, -0.2)
+    t.update(2, None)            # failure
+    # the model was fit with the failure below the worst real score
+    pred = t.model.predict([labels[2]])
+    assert pred[0] < -0.2        # not pulled up to 0
+
+
 def test_model_based_tuner_handles_failures():
     labels = labels_grid()
     t = ModelBasedTuner(labels, max_trials=6, seed=0)

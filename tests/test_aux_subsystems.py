@@ -534,3 +534,21 @@ def test_import_lint_clean_and_detects():
         bad = lint.check(d)
         assert len(bad) == 2
         assert "torch import" in bad[0]
+
+
+# ------------------------------------------------- runtime/utils.py surface
+def test_runtime_utils_surface():
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.utils import (CheckOverflow, clip_grad_norm_,
+                                             global_norm, partition_uniform)
+    tree = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.zeros((2, 2))}
+    assert float(global_norm(tree)) == 5.0
+    clipped, norm = clip_grad_norm_(tree, max_norm=1.0)
+    assert float(norm) == 5.0
+    assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+    assert float(global_norm(tree, float("inf"))) == 4.0
+    assert not CheckOverflow().check(tree)
+    assert CheckOverflow().check({"a": jnp.asarray([jnp.inf])})
+    assert partition_uniform(10, 3) == [0, 4, 7, 10] or \
+        len(partition_uniform(10, 3)) == 4

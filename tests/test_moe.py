@@ -174,3 +174,58 @@ class TestMoELayer:
         dense_mask, expert_mask = split_moe_params(params)
         assert dense_mask["layer0"]["dense"] is True
         assert expert_mask["layer0"]["experts"]["wi"] is True
+
+
+# ----------------------------------------------- mappings (moe/mappings.py)
+def test_gather_drop_tokens_shard_map_round_trip():
+    """gather then drop is the identity, and grads flow with the
+    transposed collectives (reference _GatherTokens/_DropTokens pairs)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("tensor",))
+    x = jnp.arange(32.0, dtype=jnp.float32).reshape(8, 4)
+
+    def body(xs):
+        full = gather_tokens(xs, dim=0)          # [8, 4] on every rank
+        assert full.shape == (8, 4)
+        return drop_tokens(full, dim=0)          # back to [2, 4]
+
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("tensor"),
+                                out_specs=P("tensor"),
+                                check_vma=False))(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def loss(xs):
+        return jnp.sum(gather_tokens(xs, dim=0) ** 2)
+
+    def gbody(xs):
+        return jax.grad(loss)(xs)
+
+    g = jax.jit(jax.shard_map(gbody, mesh=mesh, in_specs=P("tensor"),
+                              out_specs=P("tensor"), check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x), atol=1e-6)
+
+
+def test_drop_tokens_divisibility_error():
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.moe.mappings import drop_tokens
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("tensor",))
+    x = jnp.zeros((6, 2))
+
+    def body(xs):
+        return drop_tokens(xs, dim=0)
+
+    with pytest.raises(ValueError, match="not\\s+divisible"):
+        jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                              out_specs=P(), check_vma=False))(x)
+
+
+def test_gather_drop_tokens_no_mesh_noop():
+    from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
+    x = jnp.ones((4, 2))
+    assert gather_tokens(x).shape == (4, 2)
+    assert drop_tokens(x).shape == (4, 2)
