@@ -1,0 +1,227 @@
+"""Megatron TP-sharded checkpoint merge/split.
+
+Analog of ``deepspeed/runtime/state_dict_factory.py`` (``MegatronSDLoader``
+``:200-377`` + ``get_merge/split_state_dicts``): Megatron-LM saves one
+checkpoint file per tensor-parallel rank (``mp_rank_00/``, ``mp_rank_01/``
+…); serving at a different TP degree requires merging or re-splitting the
+shards along each parameter's partition axis:
+
+* axis 0 (column-parallel): ``mlp.dense_h_to_4h.{weight,bias}``,
+  ``word_embeddings.weight``, and the fused
+  ``attention.query_key_value.{weight,bias}`` (with the interleaved
+  pre-2.0 layout handled per ``merge_query_key_value``)
+* axis 1 (row-parallel): ``attention.dense.weight``,
+  ``mlp.dense_4h_to_h.weight``
+* everything else is replicated — shards must agree and the first wins.
+
+The TPU framework only needs the *merge* direction at load time (GSPMD
+re-shards the merged tree onto any mesh via NamedShardings), but split is
+provided for writing reference-compatible sharded checkpoints.
+All math is numpy; torch is only touched to read ``.pt`` files.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+ROW_PARALLEL = ("attention.dense.weight", "self_attention.dense.weight",
+                "mlp.dense_4h_to_h.weight")
+COL_PARALLEL = ("mlp.dense_h_to_4h.weight", "mlp.dense_h_to_4h.bias",
+                "word_embeddings.weight")
+QKV = ("attention.query_key_value.weight", "attention.query_key_value.bias",
+       "self_attention.query_key_value.weight",
+       "self_attention.query_key_value.bias")
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach()
+    if hasattr(t, "float"):
+        t = t.float()
+    if hasattr(t, "numpy"):
+        t = t.numpy()
+    return np.asarray(t)
+
+
+def _kind(key: str) -> str:
+    if any(key.endswith(p) for p in QKV):
+        return "qkv"
+    if any(key.endswith(p) for p in ROW_PARALLEL):
+        return "row"
+    if any(key.endswith(p) for p in COL_PARALLEL):
+        return "col"
+    return "replicated"
+
+
+def merge_qkv(parts: Sequence[np.ndarray],
+              checkpoint_version: float) -> np.ndarray:
+    """reference ``merge_query_key_value`` (:243): pre-2.0 checkpoints
+    interleave [q_1..q_n, k_1.., v_1..] per shard — each shard splits
+    into its q/k/v thirds and same-role thirds concatenate; 2.0+ fuses
+    per-head and a plain axis-0 cat is correct."""
+    if checkpoint_version >= 2.0:
+        return np.concatenate(parts, axis=0)
+    thirds = [np.split(p, 3, axis=0) for p in parts]
+    return np.concatenate(
+        [np.concatenate([t[i] for t in thirds], axis=0)
+         for i in range(3)], axis=0)
+
+
+def split_qkv(param: np.ndarray, n: int, offset: int,
+              checkpoint_version: float) -> np.ndarray:
+    """reference ``split_query_key_value`` (:281)."""
+    if checkpoint_version >= 2.0:
+        return np.split(param, n, axis=0)[offset]
+    q, k, v = np.split(param, 3, axis=0)
+    return np.concatenate([np.split(x, n, axis=0)[offset]
+                           for x in (q, k, v)], axis=0)
+
+
+def merge_megatron_shards(shards: Sequence[Dict[str, Any]],
+                          checkpoint_version: float = 2.0
+                          ) -> Dict[str, np.ndarray]:
+    """Merge per-TP-rank flat state dicts into the full model
+    (reference ``merge_state_dict`` :330-377)."""
+    if not shards:
+        raise ValueError("no shards to merge")
+    keys = list(shards[0].keys())
+    for i, sd in enumerate(shards[1:], 1):
+        if list(sd.keys()) != keys:
+            raise ValueError(f"shard {i} key set differs from shard 0")
+    out: Dict[str, np.ndarray] = {}
+    for key in keys:
+        parts = [_np(sd[key]) for sd in shards]
+        kind = _kind(key)
+        if kind == "row":
+            out[key] = np.concatenate(parts, axis=1)
+        elif kind == "col":
+            out[key] = np.concatenate(parts, axis=0)
+        elif kind == "qkv":
+            out[key] = merge_qkv(parts, checkpoint_version)
+        else:
+            for i, p in enumerate(parts[1:], 1):
+                if p.shape != parts[0].shape or not np.allclose(
+                        p, parts[0], atol=1e-5):
+                    raise ValueError(
+                        f"replicated param {key!r} differs between "
+                        f"shard 0 and shard {i} — partition rule missing?")
+            out[key] = parts[0]
+    return out
+
+
+def split_megatron_state_dict(sd: Dict[str, Any], world: int, rank: int,
+                              checkpoint_version: float = 2.0
+                              ) -> Dict[str, np.ndarray]:
+    """One TP rank's shard of a full state dict (reference
+    ``split_state_dict`` :200-241)."""
+    if not 0 <= rank < world:
+        raise ValueError(f"rank {rank} out of range for world {world}")
+    out: Dict[str, np.ndarray] = {}
+    for key, value in sd.items():
+        v = _np(value)
+        kind = _kind(key)
+        if kind == "row":
+            if v.shape[1] % world:
+                raise ValueError(f"{key}: dim1 {v.shape[1]} not divisible "
+                                 f"by {world}")
+            out[key] = np.split(v, world, axis=1)[rank]
+        elif kind == "col":
+            if v.shape[0] % world:
+                raise ValueError(f"{key}: dim0 {v.shape[0]} not divisible "
+                                 f"by {world}")
+            out[key] = np.split(v, world, axis=0)[rank]
+        elif kind == "qkv":
+            out[key] = split_qkv(v, world, rank, checkpoint_version)
+        else:
+            out[key] = v
+    return out
+
+
+# ---------------------------------------------------------------- loading
+_MP_DIR = re.compile(r"mp_rank_(\d+)$")
+_MP_FILE = re.compile(r"mp_rank_(\d+)_model_states\.pt$")
+
+
+def find_megatron_shards(path: str) -> List[str]:
+    """Resolve a Megatron checkpoint directory to ordered per-rank files:
+    ``mp_rank_XX/model_optim_rng.pt`` (Megatron-LM) or
+    ``mp_rank_XX_model_states.pt`` (DeepSpeed engine saves)."""
+    entries = sorted(os.listdir(path))
+    dirs = [(int(m.group(1)), os.path.join(path, e))
+            for e in entries if (m := _MP_DIR.search(e))
+            and os.path.isdir(os.path.join(path, e))]
+    if dirs:
+        out = []
+        for _, d in sorted(dirs):
+            inner = [f for f in sorted(os.listdir(d)) if f.endswith(".pt")]
+            if not inner:
+                raise FileNotFoundError(f"no .pt file under {d}")
+            out.append(os.path.join(d, inner[0]))
+        return out
+    files = [(int(m.group(1)), os.path.join(path, e))
+             for e in entries if (m := _MP_FILE.search(e))]
+    if files:
+        return [f for _, f in sorted(files)]
+    raise FileNotFoundError(
+        f"no mp_rank_* checkpoint shards under {path!r}")
+
+
+def _flat_model_sd(blob: Any) -> Dict[str, Any]:
+    """Pull the flat parameter dict out of a Megatron checkpoint blob
+    (nested under 'model'/'module'/'language_model' with arbitrary
+    depth); keys get dotted paths."""
+    if isinstance(blob, dict):
+        for k in ("model", "module"):
+            if k in blob and isinstance(blob[k], dict):
+                return _flat_model_sd(blob[k])
+    flat: Dict[str, Any] = {}
+
+    def rec(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                rec(v, f"{prefix}.{k}" if prefix else str(k))
+        elif hasattr(node, "shape"):
+            flat[prefix] = node
+
+    rec(blob, "")
+    return flat
+
+
+class _LenientUnpickler:
+    """pickle module shim for ``torch.load``: checkpoint blobs from
+    Megatron carry argparse Namespaces / megatron.* classes that are not
+    importable here — unknown classes deserialize as inert stubs so the
+    tensors still load."""
+    import pickle as _pickle
+    Unpickler = _pickle.Unpickler          # overridden below
+    loads = staticmethod(_pickle.loads)
+
+    class Unpickler(_pickle.Unpickler):    # noqa: F811
+        def find_class(self, module, name):
+            try:
+                return super().find_class(module, name)
+            except (ImportError, AttributeError):
+                return type(name, (), {"__setstate__": lambda s, _: None,
+                                       "__reduce__": lambda s: (dict, ())})
+
+
+def load_megatron_checkpoint(path: str,
+                             checkpoint_version: float = None
+                             ) -> Dict[str, np.ndarray]:
+    """Load + merge a TP-sharded Megatron checkpoint directory into one
+    flat numpy state dict — the no-live-torch-model analog of
+    ``MegatronSDLoader.load(mp_world_size=1)``."""
+    import torch
+    shards = []
+    ver = checkpoint_version
+    for f in find_megatron_shards(path):
+        blob = torch.load(f, map_location="cpu", weights_only=False,
+                          pickle_module=_LenientUnpickler)
+        if ver is None and isinstance(blob, dict):
+            ver = blob.get("checkpoint_version")
+        shards.append(_flat_model_sd(blob))
+    return merge_megatron_shards(
+        shards, checkpoint_version=2.0 if ver is None else float(ver))

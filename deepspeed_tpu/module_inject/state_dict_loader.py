@@ -21,9 +21,9 @@ mimics the module-tree access the policy table performs
 (``model.transformer.h[3].attn.c_attn.weight`` →
 key ``"transformer.h.3.attn.c_attn.weight"``), so every architecture in
 ``policies.py`` works from files with zero per-policy code. Megatron
-TP-sharded checkpoint merging is out of scope (the reference merges MP
-shards in ``state_dict_factory.py:217``; HF index-sharding covers the
-served-model case here).
+TP-sharded checkpoint directories (``mp_rank_XX``) are detected and
+merged via ``megatron_shards.py`` (the ``state_dict_factory.py:217``
+merge path); HF index-sharding covers the transformers case.
 """
 from __future__ import annotations
 
@@ -172,6 +172,13 @@ def load_state_dict(path: str):
             if os.path.exists(p):
                 return p
         return os.path.join(path, names[0])
+
+    # Megatron TP-sharded layout: merge the mp_rank_* shards
+    if any(_n.startswith("mp_rank_") for _n in
+           (os.listdir(path) if os.path.isdir(path) else ())):
+        from deepspeed_tpu.module_inject.megatron_shards import (
+            load_megatron_checkpoint)
+        return load_megatron_checkpoint(path)
 
     st = first("model.safetensors", "diffusion_pytorch_model.safetensors")
     st_index = first("model.safetensors.index.json",

@@ -42,9 +42,11 @@ def _axis_mode() -> str:
 
 
 def _spec(x, dim: int, sharded: bool) -> P:
-    entries = [None] * x.ndim
-    if sharded:
-        entries[dim] = TENSOR_AXIS
+    # constrain ONLY the tensor placement on `dim`; every other dim stays
+    # UNCONSTRAINED so shardings over other mesh axes (e.g. data on the
+    # batch dim) survive the gather/drop
+    entries: list = [P.UNCONSTRAINED] * x.ndim
+    entries[dim] = TENSOR_AXIS if sharded else None
     return P(*entries)
 
 

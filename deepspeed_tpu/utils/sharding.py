@@ -20,6 +20,8 @@ def maybe_constrain(x, spec: P):
     types = dict(zip(mesh.axis_names, mesh.axis_types))
     names = set(mesh.axis_names)
     for entry in spec:
+        if entry is P.UNCONSTRAINED:
+            continue
         for ax in (entry if isinstance(entry, tuple) else (entry,)):
             if ax is not None and (
                     ax not in names or

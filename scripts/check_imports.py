@@ -70,6 +70,8 @@ def _imports(path: str) -> List[Tuple[int, str]]:
 
 def check(pkg_root: str = PKG) -> List[str]:
     violations = []
+    seen = set()   # one violation per (file, line, rule) even when both
+    #                the bare and dotted module forms of an import match
     for dirpath, _, files in os.walk(pkg_root):
         for fname in files:
             if not fname.endswith(".py"):
@@ -79,12 +81,16 @@ def check(pkg_root: str = PKG) -> List[str]:
             for lineno, mod in _imports(path):
                 if (mod == "torch" or mod.startswith("torch.")) and \
                         not rel.startswith(TORCH_ALLOWED) and \
-                        not mod.startswith(TORCH_MODULE_EXCEPTIONS):
+                        not mod.startswith(TORCH_MODULE_EXCEPTIONS) and \
+                        (rel, lineno, "torch") not in seen:
+                    seen.add((rel, lineno, "torch"))
                     violations.append(
                         f"{rel}:{lineno}: torch import outside "
                         f"module_inject ({mod})")
                 if mod.startswith("jax.distributed") and \
-                        not rel.startswith(JAX_DISTRIBUTED_ALLOWED):
+                        not rel.startswith(JAX_DISTRIBUTED_ALLOWED) and \
+                        (rel, lineno, "jaxdist") not in seen:
+                    seen.add((rel, lineno, "jaxdist"))
                     violations.append(
                         f"{rel}:{lineno}: jax.distributed outside comm/ "
                         f"({mod})")

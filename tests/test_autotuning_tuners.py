@@ -101,7 +101,8 @@ def test_model_based_tuner_handles_failures():
         if i is None:
             break
         t.update(i, None)      # every trial fails
-    assert len(t._evaluated) == 6      # failures recorded as 0-score
+    # failures are recorded (as None, mapped below-worst at fit time)
+    assert len(t._evaluated) == 6
 
 
 # ---------------------------------------------------------------- scheduler

@@ -1,0 +1,148 @@
+"""Megatron TP-shard merge/split tests (state_dict_factory analog)."""
+import os
+
+import numpy as np
+import pytest
+import torch
+
+from deepspeed_tpu.module_inject.megatron_shards import (
+    find_megatron_shards, load_megatron_checkpoint, merge_megatron_shards,
+    merge_qkv, split_megatron_state_dict, split_qkv)
+
+H = 8      # hidden
+RNG = np.random.default_rng(0)
+
+
+def full_sd():
+    pfx = "language_model.transformer.layers.0"
+    return {
+        f"{pfx}.attention.query_key_value.weight":
+            RNG.normal(size=(3 * H, H)).astype(np.float32),
+        f"{pfx}.attention.query_key_value.bias":
+            RNG.normal(size=(3 * H,)).astype(np.float32),
+        f"{pfx}.attention.dense.weight":
+            RNG.normal(size=(H, H)).astype(np.float32),
+        f"{pfx}.attention.dense.bias":
+            RNG.normal(size=(H,)).astype(np.float32),
+        f"{pfx}.mlp.dense_h_to_4h.weight":
+            RNG.normal(size=(4 * H, H)).astype(np.float32),
+        f"{pfx}.mlp.dense_h_to_4h.bias":
+            RNG.normal(size=(4 * H,)).astype(np.float32),
+        f"{pfx}.mlp.dense_4h_to_h.weight":
+            RNG.normal(size=(H, 4 * H)).astype(np.float32),
+        f"{pfx}.mlp.dense_4h_to_h.bias":
+            RNG.normal(size=(H,)).astype(np.float32),
+        f"{pfx}.input_layernorm.weight":
+            RNG.normal(size=(H,)).astype(np.float32),
+        "language_model.embedding.word_embeddings.weight":
+            RNG.normal(size=(32, H)).astype(np.float32),
+    }
+
+
+@pytest.mark.parametrize("world", [2, 4])
+@pytest.mark.parametrize("ver", [1.0, 2.0])
+def test_split_merge_round_trip(world, ver):
+    sd = full_sd()
+    shards = [split_megatron_state_dict(sd, world, r,
+                                        checkpoint_version=ver)
+              for r in range(world)]
+    # column-parallel shards really shrink
+    k = "language_model.transformer.layers.0.mlp.dense_h_to_4h.weight"
+    assert shards[0][k].shape == (4 * H // world, H)
+    merged = merge_megatron_shards(shards, checkpoint_version=ver)
+    for key in sd:
+        np.testing.assert_allclose(merged[key], sd[key], atol=1e-6,
+                                   err_msg=key)
+
+
+def test_qkv_interleave_pre20_differs_from_20():
+    """pre-2.0 shards carry [q_i, k_i, v_i] stacked — a naive axis-0 cat
+    scrambles roles; merge_qkv reorders them."""
+    sd = full_sd()
+    k = "language_model.transformer.layers.0.attention.query_key_value.weight"
+    parts = [split_qkv(sd[k], 2, r, 1.0) for r in range(2)]
+    naive = np.concatenate(parts, axis=0)
+    fixed = merge_qkv(parts, 1.0)
+    assert not np.allclose(naive, sd[k])
+    np.testing.assert_allclose(fixed, sd[k], atol=1e-6)
+
+
+def test_replicated_mismatch_is_loud():
+    sd = full_sd()
+    shards = [split_megatron_state_dict(sd, 2, r) for r in range(2)]
+    shards[1]["language_model.transformer.layers.0.input_layernorm"
+              ".weight"] = shards[1][
+        "language_model.transformer.layers.0.input_layernorm.weight"] + 1
+    with pytest.raises(ValueError, match="replicated param"):
+        merge_megatron_shards(shards)
+
+
+def test_divisibility_and_range_errors():
+    sd = full_sd()
+    with pytest.raises(ValueError, match="not divisible"):
+        split_megatron_state_dict(sd, 3, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        split_megatron_state_dict(sd, 2, 5)
+
+
+def _write_shards(tmp_path, layout, ver=2.0):
+    sd = full_sd()
+    for r in range(2):
+        shard = split_megatron_state_dict(sd, 2, r, checkpoint_version=ver)
+        blob = {"checkpoint_version": ver,
+                "model": {k: torch.tensor(v) for k, v in shard.items()}}
+        if layout == "megatron":
+            d = tmp_path / f"mp_rank_{r:02d}"
+            d.mkdir()
+            torch.save(blob, str(d / "model_optim_rng.pt"))
+        else:
+            torch.save(blob,
+                       str(tmp_path / f"mp_rank_{r:02d}_model_states.pt"))
+    return sd
+
+
+@pytest.mark.parametrize("layout", ["megatron", "deepspeed"])
+def test_load_from_disk_both_layouts(tmp_path, layout):
+    sd = _write_shards(tmp_path, layout)
+    files = find_megatron_shards(str(tmp_path))
+    assert len(files) == 2
+    merged = load_megatron_checkpoint(str(tmp_path))
+    for key in sd:
+        np.testing.assert_allclose(merged[key], sd[key], atol=1e-6)
+
+
+class _Weird:
+    """Stands in for a megatron.* object embedded in a checkpoint."""
+
+
+def test_lenient_unpickling_of_foreign_classes(tmp_path):
+    """Real Megatron blobs embed megatron.* objects (args Namespace);
+    they must deserialize as inert stubs, not ImportError."""
+    import sys
+    import types
+    mod = types.ModuleType("megatron_args_fake")
+    Weird = _Weird
+    orig = (Weird.__module__, Weird.__qualname__)
+    Weird.__module__, Weird.__qualname__ = "megatron_args_fake", "Weird"
+    mod.Weird = Weird
+    sys.modules["megatron_args_fake"] = mod
+    try:
+        blob = {"model": {"w": torch.tensor([1.0, 2.0])}, "args": Weird(),
+                "checkpoint_version": 2.0}
+        d = tmp_path / "mp_rank_00"
+        d.mkdir()
+        torch.save(blob, str(d / "model_optim_rng.pt"))
+    finally:
+        del sys.modules["megatron_args_fake"]
+        Weird.__module__, Weird.__qualname__ = orig
+    merged = load_megatron_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(merged["w"], [1.0, 2.0])
+
+
+def test_load_state_dict_autodetects_megatron_dir(tmp_path):
+    sd = _write_shards(tmp_path, "megatron", ver=1.0)
+    from deepspeed_tpu.module_inject.state_dict_loader import load_state_dict
+    merged = load_state_dict(str(tmp_path))
+    k = ("language_model.transformer.layers.0.attention."
+         "query_key_value.weight")
+    np.testing.assert_allclose(np.asarray(merged[k]), sd[k], atol=1e-6)
