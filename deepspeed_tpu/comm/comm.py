@@ -20,6 +20,7 @@ still make sense:
 from __future__ import annotations
 
 import functools
+import json
 import os
 from typing import Any, Optional
 
@@ -92,6 +93,64 @@ def _log(op_name: str, x) -> None:
 # Initialization (reference: init_distributed, comm/comm.py:599)
 # ---------------------------------------------------------------------------
 
+def in_aml() -> bool:
+    """AzureML job environment (reference comm.py:708)."""
+    return "AZUREML_EXPERIMENT_ID" in os.environ
+
+
+def in_aws_sm() -> bool:
+    """AWS SageMaker job environment (reference comm.py:713)."""
+    return os.environ.get("SM_TRAINING_ENV") is not None or \
+        "SM_CURRENT_HOST" in os.environ
+
+
+def in_dlts() -> bool:
+    """DLTS cluster environment (reference comm.py:718)."""
+    return "DLTS_JOB_ID" in os.environ
+
+
+def mpi_discovery(coordinator_port: int = 29500,
+                  require_addr: bool = True):
+    """Derive (coordinator_address, num_processes, process_id) from an
+    MPI launcher's environment — the analog of the reference's
+    ``mpi_discovery`` (comm.py:664), which uses mpi4py + socket exchange
+    to fill MASTER_ADDR/RANK/WORLD_SIZE. Under ``mpirun`` OpenMPI exports
+    size/rank without an mpi4py dependency; the coordinator host comes
+    from DS_COORDINATOR_ADDR, or the AzureML / SageMaker master-node
+    variables when running there (reference in_aml/in_aws_sm patching,
+    comm.py:708-760)."""
+    env = os.environ
+
+    def master_host():
+        addr = env.get("DS_COORDINATOR_ADDR")
+        if addr is None and in_aml():
+            addr = env.get("AZ_BATCH_MASTER_NODE",
+                           env.get("AZ_BATCHAI_MPI_MASTER_NODE"))
+            addr = addr.split(":")[0] if addr else None
+        if addr is None:
+            hosts = sorted(json.loads(env.get("SM_HOSTS", "[]")))
+            if hosts:
+                addr = hosts[0]
+        return addr
+
+    if "OMPI_COMM_WORLD_SIZE" in env:
+        size = int(env["OMPI_COMM_WORLD_SIZE"])
+        rank = int(env["OMPI_COMM_WORLD_RANK"])
+        addr = master_host()
+        if addr is None and size > 1 and require_addr:
+            raise RuntimeError(
+                "mpi_discovery: set DS_COORDINATOR_ADDR to the rank-0 "
+                "host (OpenMPI exports no hostlist)")
+        return (f"{addr}:{coordinator_port}" if addr else None, size, rank)
+    if in_aws_sm():
+        hosts = sorted(json.loads(env.get("SM_HOSTS", "[]")))
+        cur = env.get("SM_CURRENT_HOST")
+        if hosts and cur in hosts:
+            return (f"{hosts[0]}:{coordinator_port}", len(hosts),
+                    hosts.index(cur))
+    return None, None, None
+
+
 def init_distributed(dist_backend: str = "xla",
                      auto_mpi_discovery: bool = True,
                      coordinator_address: Optional[str] = None,
@@ -104,7 +163,9 @@ def init_distributed(dist_backend: str = "xla",
     rendezvous — jax sees all local devices already. Multi-host TPU pods use
     ``jax.distributed.initialize``, which discovers coordinator/process-count
     from TPU metadata or the env vars below (the analog of the reference's
-    MASTER_ADDR/RANK/WORLD_SIZE discovery, comm/comm.py:664-760).
+    MASTER_ADDR/RANK/WORLD_SIZE discovery, comm/comm.py:664-760), with
+    MPI / AzureML / SageMaker env discovery as the fallback
+    (``mpi_discovery``; reference :664, :708, :713).
     """
     global _INITIALIZED
     if _INITIALIZED:
@@ -114,6 +175,17 @@ def init_distributed(dist_backend: str = "xla",
         num_processes = int(os.environ["DS_NUM_PROCESSES"])
     if process_id is None and "DS_PROCESS_ID" in os.environ:
         process_id = int(os.environ["DS_PROCESS_ID"])
+    if auto_mpi_discovery and num_processes is None and \
+            ("OMPI_COMM_WORLD_SIZE" in os.environ or in_aws_sm()):
+        # an explicitly-supplied coordinator waives the discovery's
+        # address requirement — we only need size/rank from it then
+        addr, size, rank = mpi_discovery(
+            require_addr=coordinator_address is None)
+        if size is not None and size > 1:
+            coordinator_address = coordinator_address or addr
+            num_processes, process_id = size, rank
+            logger.info(f"mpi discovery: process {rank}/{size} "
+                        f"coordinator={coordinator_address}")
     multi_host = coordinator_address is not None or num_processes not in (None, 1)
     if multi_host:
         jax.distributed.initialize(coordinator_address=coordinator_address,
@@ -210,6 +282,59 @@ def axis_index(axis_name: str):
     return lax.axis_index(axis_name)
 
 
+def reduce(x, dst_index: int = 0, op: str = SUM, axis_name: str = "data"):
+    """Reduce to one index of the axis (reference comm.py:492). SPMD has
+    no one-sided result: ``dst_index`` receives the reduction, every
+    other index keeps its input unchanged (the reference's in-place
+    semantics on non-dst ranks)."""
+    _log(f"reduce[{axis_name}]", x)
+    red = all_reduce(x, op=op, axis_name=axis_name)
+    here = lax.axis_index(axis_name) == dst_index
+    return jnp.where(here, red, x)
+
+
+def gather(x, dst_index: int = 0, axis_name: str = "data", axis: int = 0):
+    """Gather onto one index (reference comm.py:428): ``dst_index`` gets
+    the concatenation along ``axis``; others get zeros of that shape
+    (fixed SPMD shapes — the reference's non-dst ranks get nothing)."""
+    _log(f"gather[{axis_name}]", x)
+    gathered = lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    here = lax.axis_index(axis_name) == dst_index
+    return jnp.where(here, gathered, jnp.zeros_like(gathered))
+
+
+def scatter(x, src_index: int = 0, axis_name: str = "data", axis: int = 0):
+    """Each index receives its chunk of ``src_index``'s array along
+    ``axis`` (reference comm.py:445)."""
+    _log(f"scatter[{axis_name}]", x)
+    n = lax.axis_size(axis_name)
+    if x.shape[axis] % n:
+        raise ValueError(f"scatter: dim {axis} size {x.shape[axis]} not "
+                         f"divisible by axis size {n}")
+    src = broadcast(x, src_index=src_index, axis_name=axis_name)
+    chunk = x.shape[axis] // n
+    return lax.dynamic_slice_in_dim(
+        src, lax.axis_index(axis_name) * chunk, chunk, axis)
+
+
+def send_recv(x, pairs, axis_name: str = "pipe"):
+    """Point-to-point transfer expressed as a permutation: ``pairs`` is
+    [(src, dst), ...]; indices not receiving get zeros. The analog of the
+    reference's send/recv/isend/irecv (comm.py:380-427) — under SPMD both
+    sides run one program, so the pair IS the primitive; the pipeline
+    engine's p2p rides this (pipe/p2p.py analog)."""
+    return ppermute(x, pairs, axis_name=axis_name)
+
+
+def all_to_all_single(x, axis_name: str = "expert", split_axis: int = 0,
+                      concat_axis: int = 0):
+    """Alias of :func:`all_to_all` (reference all_to_all_single,
+    comm.py:361 — the single-tensor form is the only one here; list
+    batching is XLA's concern)."""
+    return all_to_all(x, axis_name=axis_name, split_axis=split_axis,
+                      concat_axis=concat_axis)
+
+
 # ---------------------------------------------------------------------------
 # Host-level (outside-jit) helpers.
 # ---------------------------------------------------------------------------
@@ -219,6 +344,17 @@ def barrier() -> None:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("deepspeed_tpu_barrier")
+
+
+def monitored_barrier(timeout=None) -> None:
+    """Barrier that logs who it is waiting on (reference
+    monitored_barrier, comm.py:473). XLA's sync has no per-rank
+    reporting; the logging bracket still localizes a hang to this call
+    site in each process's log."""
+    logger.info(f"monitored_barrier: process {get_rank()}"
+                f"/{get_world_size()} entering")
+    barrier()
+    logger.info(f"monitored_barrier: process {get_rank()} passed")
 
 
 def broadcast_obj(obj: Any, root: int = 0) -> Any:

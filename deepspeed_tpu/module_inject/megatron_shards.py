@@ -159,7 +159,16 @@ def find_megatron_shards(path: str) -> List[str]:
             inner = [f for f in sorted(os.listdir(d)) if f.endswith(".pt")]
             if not inner:
                 raise FileNotFoundError(f"no .pt file under {d}")
-            out.append(os.path.join(d, inner[0]))
+            # prefer the MODEL file: --use-distributed-optimizer also
+            # writes distrib_optim.pt here, which must not be picked up
+            for preferred in ("model_optim_rng.pt", "model_rng.pt"):
+                if preferred in inner:
+                    pick = preferred
+                    break
+            else:
+                non_optim = [f for f in inner if "optim" not in f]
+                pick = (non_optim or inner)[0]
+            out.append(os.path.join(d, pick))
         return out
     files = [(int(m.group(1)), os.path.join(path, e))
              for e in entries if (m := _MP_FILE.search(e))]
@@ -196,16 +205,19 @@ class _LenientUnpickler:
     importable here — unknown classes deserialize as inert stubs so the
     tensors still load."""
     import pickle as _pickle
-    Unpickler = _pickle.Unpickler          # overridden below
-    loads = staticmethod(_pickle.loads)
 
-    class Unpickler(_pickle.Unpickler):    # noqa: F811
+    class Unpickler(_pickle.Unpickler):
         def find_class(self, module, name):
             try:
                 return super().find_class(module, name)
             except (ImportError, AttributeError):
                 return type(name, (), {"__setstate__": lambda s, _: None,
                                        "__reduce__": lambda s: (dict, ())})
+
+    @classmethod
+    def loads(cls, data, **kwargs):
+        import io
+        return cls.Unpickler(io.BytesIO(data), **kwargs).load()
 
 
 def load_megatron_checkpoint(path: str,

@@ -1,0 +1,149 @@
+"""comm facade dispatcher breadth (reference comm/comm.py:224-537:
+one dispatcher per collective) under an 8-device shard_map."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu import comm
+
+
+def run8(fn, x, in_spec=None, out_spec=None):
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=P("data") if in_spec is None else in_spec,
+        out_specs=P("data") if out_spec is None else out_spec,
+        check_vma=False))(x)
+
+
+def test_reduce_only_dst_gets_result():
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def body(xs):
+        return comm.reduce(xs, dst_index=3, axis_name="data")
+
+    out = np.asarray(run8(body, x)).ravel()
+    # dst index 3 holds the sum (28), everyone else keeps their input
+    want = np.arange(8.0)
+    want[3] = 28.0
+    np.testing.assert_array_equal(out, want)
+
+
+def test_gather_onto_dst():
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def body(xs):
+        return comm.gather(xs, dst_index=2, axis_name="data")
+
+    out = np.asarray(run8(body, x, out_spec=P("data")))
+    # out per worker is [8]-gathered or zeros; stacked: row 2 has 0..7
+    out = out.reshape(8, 8)
+    np.testing.assert_array_equal(out[2], np.arange(8.0))
+    assert np.all(out[[0, 1, 3, 4, 5, 6, 7]] == 0)
+
+
+def test_scatter_distributes_src_chunks():
+    # every worker holds a DIFFERENT full array; scatter takes src's
+    x = jnp.stack([jnp.arange(16.0) + 100 * i for i in range(8)])
+
+    def body(xs):
+        return comm.scatter(xs[0], src_index=1, axis_name="data")
+
+    out = np.asarray(run8(body, x, out_spec=P("data"))).reshape(8, 2)
+    want = (np.arange(16.0) + 100).reshape(8, 2)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_scatter_divisibility_error():
+    x = jnp.zeros((8, 3))
+
+    def body(xs):
+        return comm.scatter(xs[0], axis_name="data")
+
+    with pytest.raises(ValueError, match="divisible"):
+        run8(body, x, out_spec=P())
+
+
+def test_send_recv_is_permutation():
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def body(xs):
+        return comm.send_recv(xs, [(0, 1)], axis_name="data")
+
+    out = np.asarray(run8(body, x)).ravel()
+    want = np.zeros(8)
+    want[1] = 0.0   # receives worker 0's value (0.0); others zeros
+    np.testing.assert_array_equal(out, want)
+
+
+def test_all_to_all_single_alias():
+    x = jnp.arange(128.0).reshape(64, 2)   # 8 rows per worker
+
+    def a(xs):
+        return comm.all_to_all_single(xs, axis_name="data")
+
+    def b(xs):
+        return comm.all_to_all(xs, axis_name="data")
+
+    np.testing.assert_array_equal(np.asarray(run8(a, x)),
+                                  np.asarray(run8(b, x)))
+
+
+def test_monitored_barrier_runs():
+    comm.monitored_barrier()     # single process: logs + no-op
+
+
+# ---------------------------------------------------------------- discovery
+def test_mpi_discovery_openmpi(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    monkeypatch.setenv("DS_COORDINATOR_ADDR", "10.0.0.1")
+    addr, size, rank = comm.mpi_discovery()
+    assert (addr, size, rank) == ("10.0.0.1:29500", 4, 2)
+
+
+def test_mpi_discovery_requires_coordinator(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.delenv("DS_COORDINATOR_ADDR", raising=False)
+    with pytest.raises(RuntimeError, match="DS_COORDINATOR_ADDR"):
+        comm.mpi_discovery()
+
+
+def test_sagemaker_discovery(monkeypatch):
+    monkeypatch.setenv("SM_CURRENT_HOST", "algo-2")
+    monkeypatch.setenv("SM_HOSTS", '["algo-1", "algo-2"]')
+    assert comm.in_aws_sm()
+    addr, size, rank = comm.mpi_discovery()
+    assert (addr, size, rank) == ("algo-1:29500", 2, 1)
+
+
+def test_env_detectors(monkeypatch):
+    assert not comm.in_aml() and not comm.in_dlts()
+    monkeypatch.setenv("AZUREML_EXPERIMENT_ID", "x")
+    monkeypatch.setenv("DLTS_JOB_ID", "y")
+    assert comm.in_aml() and comm.in_dlts()
+
+
+def test_ompi_under_sagemaker_uses_sm_hosts(monkeypatch):
+    """SageMaker MPI jobs export BOTH OMPI vars and SM_HOSTS; the OMPI
+    branch must fall through to the SM master, not raise."""
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.delenv("DS_COORDINATOR_ADDR", raising=False)
+    monkeypatch.setenv("SM_CURRENT_HOST", "algo-2")
+    monkeypatch.setenv("SM_HOSTS", '["algo-1", "algo-2"]')
+    addr, size, rank = comm.mpi_discovery()
+    assert (addr, size, rank) == ("algo-1:29500", 2, 1)
+
+
+def test_mpi_discovery_waives_addr_when_supplied(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "0")
+    monkeypatch.delenv("DS_COORDINATOR_ADDR", raising=False)
+    addr, size, rank = comm.mpi_discovery(require_addr=False)
+    assert addr is None and (size, rank) == (2, 0)

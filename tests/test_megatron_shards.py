@@ -146,3 +146,15 @@ def test_load_state_dict_autodetects_megatron_dir(tmp_path):
     k = ("language_model.transformer.layers.0.attention."
          "query_key_value.weight")
     np.testing.assert_allclose(np.asarray(merged[k]), sd[k], atol=1e-6)
+
+
+def test_find_shards_skips_distributed_optimizer_file(tmp_path):
+    sd = full_sd()
+    shard = split_megatron_state_dict(sd, 1, 0)
+    d = tmp_path / "mp_rank_00"
+    d.mkdir()
+    torch.save({"model": {k: torch.tensor(v) for k, v in shard.items()}},
+               str(d / "model_optim_rng.pt"))
+    torch.save({"optimizer": {}}, str(d / "distrib_optim.pt"))
+    files = find_megatron_shards(str(tmp_path))
+    assert files[0].endswith("model_optim_rng.pt")
