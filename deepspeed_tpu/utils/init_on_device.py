@@ -1,0 +1,82 @@
+"""Abstract ("meta-device") model initialization.
+
+Analog of ``deepspeed/utils/init_on_device.py`` (``OnDevice`` — hijacks
+``nn.Module`` construction so params materialize on ``meta`` or a target
+device, used to stand up huge models without host RAM). The functional
+JAX equivalent needs no constructor hijack: ``jax.eval_shape`` traces the
+init function into a ``ShapeDtypeStruct`` tree (zero bytes), and
+``materialize`` instantiates it sharded-by-construction via
+``jax.jit(out_shardings=...)`` so no replica ever exists (SURVEY §7.1:
+"zero.Init __init__ hijack → eval_shape + abstract init").
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """``with OnDevice(dtype=jnp.bfloat16, device="meta"): ...`` —
+    inside the context, :meth:`init` returns abstract (shape/dtype only)
+    trees; with a real device/sharding it materializes directly there."""
+
+    _active: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=None, device: str = "meta",
+                 shardings=None):
+        if device not in ("meta", "device"):
+            raise ValueError(f"device must be 'meta' or 'device', got "
+                             f"{device!r}")
+        self.dtype = dtype
+        self.device = device
+        self.shardings = shardings
+        self._prev: Optional["OnDevice"] = None
+
+    # -- context ---------------------------------------------------------
+    def __enter__(self) -> "OnDevice":
+        self._prev, OnDevice._active = OnDevice._active, self
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = self._prev
+        return False
+
+    # -- init ------------------------------------------------------------
+    def _cast(self, tree):
+        if self.dtype is None:
+            return tree
+        return jax.tree.map(
+            lambda x: (x.update(dtype=self.dtype)
+                       if isinstance(x, jax.ShapeDtypeStruct)
+                       else x.astype(self.dtype))
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def init(self, init_fn: Callable, *args, **kwargs) -> Any:
+        """Run ``init_fn`` abstractly (meta) or materialized (device)."""
+        if self.device == "meta":
+            tree = jax.eval_shape(lambda: init_fn(*args, **kwargs))
+            return self._cast(tree)
+        fn = jax.jit(lambda: self._cast(init_fn(*args, **kwargs)),
+                     out_shardings=self.shardings)
+        return fn()
+
+    @classmethod
+    def current(cls) -> Optional["OnDevice"]:
+        return cls._active
+
+
+def materialize(abstract_tree: Any, init_fn: Callable,
+                shardings=None) -> Any:
+    """Instantiate an abstract tree produced under ``OnDevice('meta')``:
+    params come out directly with ``shardings`` (no full replica is ever
+    built — the memory contract of the reference's device= path)."""
+    out = jax.jit(init_fn, out_shardings=shardings)()
+    chex_shapes = jax.tree.map(lambda a: (a.shape, str(a.dtype)),
+                               abstract_tree)
+    got_shapes = jax.tree.map(lambda a: (a.shape, str(a.dtype)), out)
+    if chex_shapes != got_shapes:
+        raise ValueError("materialize: init_fn disagrees with the "
+                         "abstract tree's shapes/dtypes")
+    return out

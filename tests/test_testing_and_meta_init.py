@@ -1,0 +1,77 @@
+"""Tests for the user test harness (testing.py) and OnDevice meta init."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.testing import (DistributedTest, requires_devices,
+                                   virtual_mesh)
+from deepspeed_tpu.utils.init_on_device import OnDevice, materialize
+
+
+def test_virtual_mesh_shapes():
+    m = virtual_mesh(8)
+    assert m.shape == {"data": 8}
+    m2 = virtual_mesh(8, {"data": 2, "tensor": 4})
+    assert m2.shape == {"data": 2, "tensor": 4}
+    with pytest.raises(ValueError, match="product"):
+        virtual_mesh(8, {"data": 3})
+    with pytest.raises(RuntimeError, match="devices"):
+        virtual_mesh(10_000)
+
+
+class TestAsDistributed(DistributedTest):
+    world_size = 4
+    mesh_axes = {"data": 2, "tensor": 2}
+
+    def test_mesh_available(self):
+        assert self.mesh.shape == {"data": 2, "tensor": 2}
+
+
+@requires_devices(8)
+def test_requires_devices_runs_when_enough():
+    assert jax.device_count() >= 8
+
+
+# -------------------------------------------------------------- OnDevice
+def init_fn():
+    k = jax.random.PRNGKey(0)
+    return {"w": jax.random.normal(k, (16, 8), jnp.float32),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def test_meta_init_is_abstract_and_free():
+    with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+        tree = ctx.init(init_fn)
+    assert isinstance(tree["w"], jax.ShapeDtypeStruct)
+    assert tree["w"].shape == (16, 8)
+    assert tree["w"].dtype == jnp.bfloat16      # float leaves re-typed
+    assert tree["step"].dtype == jnp.int32      # ints untouched
+
+
+def test_device_init_materializes():
+    with OnDevice(device="device") as ctx:
+        tree = ctx.init(init_fn)
+    assert isinstance(tree["w"], jax.Array)
+    assert np.isfinite(np.asarray(tree["w"])).all()
+
+
+def test_materialize_checks_shapes():
+    with OnDevice(device="meta") as ctx:
+        abstract = ctx.init(init_fn)
+    out = materialize(abstract, init_fn)
+    assert out["w"].shape == (16, 8)
+    with pytest.raises(ValueError, match="disagrees"):
+        materialize(abstract, lambda: {"w": jnp.zeros((2, 2)),
+                                       "step": jnp.zeros((), jnp.int32)})
+
+
+def test_ondevice_validates_and_nests():
+    with pytest.raises(ValueError, match="meta"):
+        OnDevice(device="cuda:0")
+    with OnDevice(device="meta") as outer:
+        assert OnDevice.current() is outer
+        with OnDevice(device="device") as inner:
+            assert OnDevice.current() is inner
+        assert OnDevice.current() is outer
+    assert OnDevice.current() is None
