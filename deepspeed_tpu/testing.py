@@ -50,11 +50,22 @@ def virtual_mesh(n: Optional[int] = None,
 
 def requires_devices(n: int):
     """``@requires_devices(8)`` — skip when the backend has fewer
-    devices (the harness analog of the reference's world-size skips)."""
-    import pytest
-    return pytest.mark.skipif(
-        jax.device_count() < n,
-        reason=f"needs {n} devices, have {jax.device_count()}")
+    devices (the harness analog of the reference's world-size skips).
+    The device count is read at CALL time, not decoration time: touching
+    ``jax.device_count()`` during collection would freeze the platform
+    before a fixture/pytest_configure could set the virtual mesh up."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            import pytest
+            if jax.device_count() < n:
+                pytest.skip(f"needs {n} devices, have "
+                            f"{jax.device_count()}")
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
 
 
 class DistributedTest:

@@ -22,7 +22,7 @@ class OnDevice:
     inside the context, :meth:`init` returns abstract (shape/dtype only)
     trees; with a real device/sharding it materializes directly there."""
 
-    _active: Optional["OnDevice"] = None
+    _stack: list = []   # class-level: re-entering one instance is safe
 
     def __init__(self, dtype=None, device: str = "meta",
                  shardings=None):
@@ -32,15 +32,14 @@ class OnDevice:
         self.dtype = dtype
         self.device = device
         self.shardings = shardings
-        self._prev: Optional["OnDevice"] = None
 
     # -- context ---------------------------------------------------------
     def __enter__(self) -> "OnDevice":
-        self._prev, OnDevice._active = OnDevice._active, self
+        OnDevice._stack.append(self)
         return self
 
     def __exit__(self, *exc):
-        OnDevice._active = self._prev
+        OnDevice._stack.pop()
         return False
 
     # -- init ------------------------------------------------------------
@@ -64,19 +63,26 @@ class OnDevice:
 
     @classmethod
     def current(cls) -> Optional["OnDevice"]:
-        return cls._active
+        return cls._stack[-1] if cls._stack else None
 
 
 def materialize(abstract_tree: Any, init_fn: Callable,
-                shardings=None) -> Any:
+                shardings=None, dtype=None) -> Any:
     """Instantiate an abstract tree produced under ``OnDevice('meta')``:
     params come out directly with ``shardings`` (no full replica is ever
-    built — the memory contract of the reference's device= path)."""
-    out = jax.jit(init_fn, out_shardings=shardings)()
-    chex_shapes = jax.tree.map(lambda a: (a.shape, str(a.dtype)),
-                               abstract_tree)
-    got_shapes = jax.tree.map(lambda a: (a.shape, str(a.dtype)), out)
-    if chex_shapes != got_shapes:
+    built — the memory contract of the reference's device= path).
+    ``dtype`` must match the one the abstract tree was built with.
+
+    The shape/dtype agreement is validated ABSTRACTLY first (free) —
+    a mismatched init_fn must not allocate a wrong multi-GB tree before
+    being rejected."""
+    caster = OnDevice(dtype=dtype)
+    expected = jax.tree.map(lambda a: (a.shape, str(a.dtype)),
+                            abstract_tree)
+    probe = caster._cast(jax.eval_shape(init_fn))
+    got = jax.tree.map(lambda a: (a.shape, str(a.dtype)), probe)
+    if expected != got:
         raise ValueError("materialize: init_fn disagrees with the "
                          "abstract tree's shapes/dtypes")
-    return out
+    return jax.jit(lambda: caster._cast(init_fn()),
+                   out_shardings=shardings)()

@@ -75,3 +75,21 @@ def test_ondevice_validates_and_nests():
             assert OnDevice.current() is inner
         assert OnDevice.current() is outer
     assert OnDevice.current() is None
+
+
+def test_materialize_with_dtype_override():
+    with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+        abstract = ctx.init(init_fn)
+    out = materialize(abstract, init_fn, dtype=jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="disagrees"):
+        materialize(abstract, init_fn)   # missing the dtype → mismatch
+
+
+def test_ondevice_reentrant_same_instance():
+    ctx = OnDevice(device="meta")
+    with ctx:
+        with ctx:
+            assert OnDevice.current() is ctx
+        assert OnDevice.current() is ctx
+    assert OnDevice.current() is None
