@@ -357,6 +357,20 @@ def main() -> None:
         if plat:  # testing hook — the axon sitecustomize pins JAX_PLATFORMS
             import jax
             jax.config.update("jax_platforms", plat)
+        cache = os.environ.get(
+            "DSTPU_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_compile_cache"))
+        if cache and cache != "0":
+            # persistent executable cache: a phase compiled in an earlier
+            # bench run (or a pre-warm session) is a disk hit here — the
+            # slow-relay first-compile risk drops out entirely when the
+            # backend supports serialization
+            import jax
+            os.makedirs(cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              2.0)
         fn = (phase_infer if args.phase == "inference" else
               phase_train_bert if args.phase == "train-bert-large" else
               phase_train)

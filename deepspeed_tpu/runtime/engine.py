@@ -76,6 +76,22 @@ class DeepSpeedEngine:
                  rng: Optional[jax.Array] = None,
                  model_handles_param_offload: bool = False,
                  sparse_grad_paths: Optional[Any] = None):
+        if config.compile_cache_dir:
+            # persistent XLA executable cache (the TORCH_EXTENSIONS_DIR
+            # JIT-cache analog, SURVEY §5.6): step recompiles across
+            # process restarts become disk hits. NOTE: jax's cache dir is
+            # PROCESS-GLOBAL — two engines with different dirs cannot both
+            # have their way; the conflict is surfaced, last writer wins.
+            import os as _os
+            _os.makedirs(config.compile_cache_dir, exist_ok=True)
+            current = jax.config.jax_compilation_cache_dir
+            if current not in (None, config.compile_cache_dir):
+                logger.warning(
+                    "compile_cache_dir %s replaces the process-global "
+                    "cache dir %s (jax has one cache per process)",
+                    config.compile_cache_dir, current)
+            jax.config.update("jax_compilation_cache_dir",
+                              config.compile_cache_dir)
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         set_global_mesh(self.mesh)
         self.config = config

@@ -552,3 +552,26 @@ def test_runtime_utils_surface():
     assert CheckOverflow().check({"a": jnp.asarray([jnp.inf])})
     assert partition_uniform(10, 3) == [0, 4, 7, 10] or \
         len(partition_uniform(10, 3)) == 4
+
+
+# ----------------------------------------------------- profiler trace utils
+def test_instrument_and_annotate(tmp_path):
+    import jax.numpy as jnp
+    from deepspeed_tpu.profiling.trace import annotate, instrument, trace
+
+    @instrument
+    def f(x):
+        return x * 2
+
+    @instrument(name="custom")
+    def g(x):
+        with annotate("inner"):
+            return x + 1
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+    assert float(g(jnp.float32(3.0))) == 4.0
+    with trace(str(tmp_path / "tb")):
+        float(jnp.sum(jnp.ones((8, 8))))
+    import os
+    assert any("xplane" in f or "trace" in f.lower()
+               for _, _, fs in os.walk(tmp_path) for f in fs)
