@@ -23,14 +23,16 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class DDIMConfig:
     num_train_timesteps: int = 1000
     beta_start: float = 0.00085
     beta_end: float = 0.012
     beta_schedule: str = "scaled_linear"   # SD default
     eta: float = 0.0                       # 0 = deterministic DDIM
-    scaling_factor: float = 0.18215        # VAE latent scaling
+    # SD scheduler configs ship steps_offset=1: the trajectory ends at
+    # t=1, not t=0 (diffusers DDIMScheduler set_timesteps)
+    steps_offset: int = 1
 
 
 def alphas_cumprod(cfg: DDIMConfig) -> np.ndarray:
@@ -47,9 +49,10 @@ def alphas_cumprod(cfg: DDIMConfig) -> np.ndarray:
 
 def ddim_timesteps(cfg: DDIMConfig, num_inference_steps: int) -> np.ndarray:
     """Descending timestep subsequence (diffusers DDIMScheduler
-    set_timesteps convention: leading spacing)."""
+    set_timesteps convention: leading spacing + steps_offset)."""
     step = cfg.num_train_timesteps // num_inference_steps
-    return (np.arange(num_inference_steps) * step)[::-1].copy()
+    ts = (np.arange(num_inference_steps) * step)[::-1] + cfg.steps_offset
+    return np.clip(ts, 0, cfg.num_train_timesteps - 1)
 
 
 def ddim_step(noise_pred: jax.Array, sample: jax.Array,
@@ -145,7 +148,9 @@ def text_to_image(unet, vae, text_emb, uncond_emb, *,
     cache = getattr(unet, "_sampler_cache", None)
     if cache is None:
         cache = unet._sampler_cache = {}
-    ckey = (num_inference_steps, guidance_scale, ddim.eta, b, h, w, lat_c)
+    # the full (frozen) DDIMConfig is part of the key: alpha tables bake
+    # into the compiled sampler, so a different beta schedule must miss
+    ckey = (num_inference_steps, guidance_scale, ddim, b, h, w, lat_c)
     sampler = cache.get(ckey)
     if sampler is None:
         sampler = cache[ckey] = build_sampler(

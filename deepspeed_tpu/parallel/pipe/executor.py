@@ -96,7 +96,8 @@ class PipelineEngine:
                  *,
                  micro_batches: int,
                  loss_fn: Optional[Callable] = None,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 zero_stage: int = 0):
         mesh = mesh or get_global_mesh()
         if PIPE_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh has no {PIPE_AXIS!r} axis")
@@ -139,9 +140,32 @@ class PipelineEngine:
             self.stage_params.append(
                 jax.device_put(trees, self._param_sh[s]))
 
+        # ZeRO-1 composition (reference engine.py:1533: pipeline engines
+        # compose with stage<=1 — params/grads must stay whole for the
+        # stage-local fwd/bwd, but optimizer moments shard over the DP
+        # axes of each stage's sub-mesh)
+        if zero_stage not in (0, 1):
+            raise ValueError(
+                "the pipeline engine composes with ZeRO stage 0 or 1 "
+                "only (the reference asserts the same: ZeRO-2/3 "
+                "partitioning conflicts with pipelined grad accumulation)")
+        self.zero_stage = zero_stage
+
+        def opt_shardings(s):
+            if zero_stage == 0 or not data_axes:
+                return self._param_sh[s]
+            from deepspeed_tpu.runtime.zero.partition import shard_leaf_spec
+            m = self.stage_meshes[s]
+            shape_tree = jax.eval_shape(self.optimizer.init,
+                                        self.stage_params[s])
+            return jax.tree.map(
+                lambda l: NamedSharding(
+                    m, shard_leaf_spec(l.shape, None, m)), shape_tree)
+
+        self._opt_sh = [opt_shardings(s) for s in range(self.num_stages)]
         self.opt_state = [
             jax.jit(self.optimizer.init,
-                    out_shardings=self._param_sh[s])(self.stage_params[s])
+                    out_shardings=self._opt_sh[s])(self.stage_params[s])
             for s in range(self.num_stages)]
 
         self._fwd = [self._make_fwd(s) for s in range(self.num_stages)]
@@ -155,7 +179,12 @@ class PipelineEngine:
                                                        params)
             import optax
             return optax.apply_updates(params, updates), new_state
-        self._opt_step = jax.jit(opt_step)
+        # per-stage jits: pin output shardings so ZeRO-1 moments STAY
+        # sharded across steps (an unconstrained jit may re-replicate)
+        self._opt_step_fns = [
+            jax.jit(opt_step,
+                    out_shardings=(self._param_sh[s], self._opt_sh[s]))
+            for s in range(self.num_stages)]
 
         # observability: the 1F1B memory bound, per stage
         self.max_live_buffers = [0] * self.num_stages
@@ -300,8 +329,9 @@ class PipelineEngine:
                     return
                 for st in range(S):
                     self.stage_params[st], self.opt_state[st] = \
-                        self._opt_step(self.stage_params[st],
-                                       self.opt_state[st], grads[st])
+                        self._opt_step_fns[st](self.stage_params[st],
+                                               self.opt_state[st],
+                                               grads[st])
                     grads[st] = None
 
         total_ticks = len(streams[0])

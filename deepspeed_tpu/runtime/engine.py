@@ -79,19 +79,22 @@ class DeepSpeedEngine:
         if config.compile_cache_dir:
             # persistent XLA executable cache (the TORCH_EXTENSIONS_DIR
             # JIT-cache analog, SURVEY §5.6): step recompiles across
-            # process restarts become disk hits. NOTE: jax's cache dir is
-            # PROCESS-GLOBAL — two engines with different dirs cannot both
-            # have their way; the conflict is surfaced, last writer wins.
+            # process restarts become disk hits. NOTE: jax initializes
+            # its cache ONCE per process (first compile wins) — a second
+            # engine cannot redirect it, so a conflicting setting is a
+            # warning + no-op rather than a misleading "update".
             import os as _os
             _os.makedirs(config.compile_cache_dir, exist_ok=True)
             current = jax.config.jax_compilation_cache_dir
-            if current not in (None, config.compile_cache_dir):
+            if current in (None, "", config.compile_cache_dir):
+                jax.config.update("jax_compilation_cache_dir",
+                                  config.compile_cache_dir)
+            else:
                 logger.warning(
-                    "compile_cache_dir %s replaces the process-global "
-                    "cache dir %s (jax has one cache per process)",
+                    "compile_cache_dir %s ignored: this process already "
+                    "uses %s (jax initializes one cache per process, "
+                    "first compile wins)",
                     config.compile_cache_dir, current)
-            jax.config.update("jax_compilation_cache_dir",
-                              config.compile_cache_dir)
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         set_global_mesh(self.mesh)
         self.config = config
@@ -1391,7 +1394,8 @@ def initialize(args=None,
                     f"optimizer= for anything else")
         engine = PipelineEngine(model, list(model_parameters), optimizer,
                                 micro_batches=micro, loss_fn=loss_fn,
-                                mesh=mesh)
+                                mesh=mesh,
+                                zero_stage=cfg.zero_config.stage)
         return engine, optimizer, None, lr_scheduler
     if loss_fn is None:
         if model is None or not hasattr(model, "loss_fn"):

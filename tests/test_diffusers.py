@@ -516,8 +516,13 @@ def test_ddim_alpha_schedule():
     assert acp.shape == (1000,)
     assert acp[0] > acp[-1] > 0           # monotone decreasing
     assert acp[0] == pytest.approx(1 - 0.00085, rel=1e-5)
-    ts = ddim_timesteps(cfg, 50)
-    assert len(ts) == 50 and ts[0] == 980 and ts[-1] == 0
+    ts = ddim_timesteps(cfg, 50)                    # steps_offset=1
+    assert len(ts) == 50 and ts[0] == 981 and ts[-1] == 1
+    ts0 = ddim_timesteps(DDIMConfig(steps_offset=0), 50)
+    assert ts0[0] == 980 and ts0[-1] == 0
+    # a different beta schedule must be a different (frozen) config
+    assert DDIMConfig() != DDIMConfig(beta_schedule="linear")
+    assert hash(DDIMConfig()) == hash(DDIMConfig())
 
 
 def test_ddim_step_recovers_x0_at_full_denoise():
