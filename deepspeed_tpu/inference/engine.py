@@ -167,20 +167,48 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ API
 
+    def profile_model_time(self, use_cuda_events: bool = True) -> None:
+        """Enable per-call model-time collection (reference
+        ``profile_model_time``, inference/engine.py:139 — forward hooks +
+        cuda events; here a host-synced wall-clock bracket around the
+        jitted call). ``use_cuda_events`` is accepted for signature
+        parity; the sync is a host transfer either way."""
+        del use_cuda_events
+        self.model_profile_enabled = True
+        if not hasattr(self, "_model_times"):
+            self._model_times = []
+
+    def model_times(self) -> list:
+        """Collected per-call latencies (seconds); clears on read
+        (reference ``model_times``, inference/engine.py:483)."""
+        if not getattr(self, "model_profile_enabled", False):
+            raise AssertionError("model profiling is not enabled — call "
+                                 "profile_model_time() first")
+        out, self._model_times = self._model_times, []
+        return out
+
     def forward(self, input_ids, attention_mask=None):
         """Encoder forward (BERT-family) → hidden states, or full-sequence
         logits ``[B, T, V]`` for causal models — matching the reference
         ``InferenceEngine.forward`` (inference/engine.py:495), so callers
         scoring ``logits[:, i]`` port 1:1. ``generate`` keeps the KV-cache
         fast path internally."""
+        import time as _time
+        t0 = (_time.perf_counter()
+              if getattr(self, "model_profile_enabled", False) else None)
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if not self.model_config.pre_layer_norm:
-            return self._encoder_jit(self.params, input_ids=input_ids,
-                                     attention_mask=attention_mask)
-        if attention_mask is not None:
-            attention_mask = jnp.asarray(attention_mask, jnp.int32)
-        return self._causal_fwd_jit(self.params, input_ids=input_ids,
+            out = self._encoder_jit(self.params, input_ids=input_ids,
                                     attention_mask=attention_mask)
+        else:
+            if attention_mask is not None:
+                attention_mask = jnp.asarray(attention_mask, jnp.int32)
+            out = self._causal_fwd_jit(self.params, input_ids=input_ids,
+                                       attention_mask=attention_mask)
+        if t0 is not None:
+            np.asarray(jax.tree.leaves(out)[0])   # host sync
+            self._model_times.append(_time.perf_counter() - t0)
+        return out
 
     __call__ = forward
 
@@ -202,6 +230,9 @@ class InferenceEngine:
                 "this model has no LM head (CLIP-style encoder) — use "
                 "forward() for hidden states; generate() needs vocabulary "
                 "logits")
+        import time as _time
+        t0 = (_time.perf_counter()
+              if getattr(self, "model_profile_enabled", False) else None)
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
         if max_new_tokens <= 0:   # no-op budget: prompts unchanged
@@ -231,6 +262,8 @@ class InferenceEngine:
         # per-token RTT through a remote relay is the TPU analog).
         out_np = np.asarray(out_buf)
         n_np = np.asarray(n_gen)
+        if t0 is not None:
+            self._model_times.append(_time.perf_counter() - t0)
         return [np.asarray(ids[b, :lengths[b]]).tolist()
                 + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
 

@@ -240,3 +240,38 @@ def test_ds_api_accessors():
     eng.train_batch({"x": jnp.ones((8, 8), jnp.float32)})
     assert eng.global_samples == eng.train_batch_size
     assert isinstance(eng.get_lr()[0], float)
+
+
+@pytest.mark.slow
+def test_ignore_unused_parameters():
+    """reference tests/unit/runtime/zero/test_ignore_unused_parameters:
+    params that receive no gradient signal must not break ZeRO stages —
+    in the functional engine their grads are structural zeros and the
+    step runs; the unused leaf stays (numerically) untouched by Adam's
+    zero-update."""
+    class TwoHead:
+        def init(self, key):
+            k1, k2 = jax.random.split(key)
+            return {"used": jax.random.normal(k1, (8, 8)) * 0.1,
+                    "unused": jax.random.normal(k2, (8, 8)) * 0.1}
+
+        def loss_fn(self, p, batch, rng):
+            return jnp.mean((batch["x"] @ p["used"]) ** 2)
+
+    model = TwoHead()
+    for stage in (0, 2):
+        params = model.init(jax.random.PRNGKey(0))
+        before = np.asarray(params["unused"])
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW",
+                                  "params": {"lr": 1e-2,
+                                             "weight_decay": 0.0}},
+                    "zero_optimization": {"stage": stage}})
+        batch = {"x": jnp.ones((8, 8), jnp.float32)}
+        l0 = float(engine.train_batch(batch)["loss"])
+        l1 = float(engine.train_batch(batch)["loss"])
+        assert l1 < l0                      # used param trains
+        after = np.asarray(engine.state.params["unused"], np.float32)
+        np.testing.assert_allclose(after, before, atol=1e-6)

@@ -217,3 +217,20 @@ def test_top_p_sampling():
     c = eng.generate(prompt, max_new_tokens=4, temperature=1.0,
                      top_k=5, top_p=0.9, seed=1)
     assert len(c[0]) == 8
+
+
+def test_profile_model_time():
+    """reference tests/unit/inference/test_model_profiling.py analog:
+    enabling profiling collects per-call latencies; model_times clears."""
+    cfg = InferenceTransformerConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        dtype=jnp.float32)
+    eng = InferenceEngine(cfg)
+    with pytest.raises(AssertionError, match="not enabled"):
+        eng.model_times()
+    eng.profile_model_time()
+    eng.forward(jnp.asarray([[1, 2, 3, 4]], jnp.int32))
+    eng.generate([[1, 2, 3]], max_new_tokens=2)
+    times = eng.model_times()
+    assert len(times) == 2 and all(t > 0 for t in times)
+    assert eng.model_times() == []   # cleared on read
