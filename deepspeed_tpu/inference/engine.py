@@ -236,6 +236,8 @@ class InferenceEngine:
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
         if max_new_tokens <= 0:   # no-op budget: prompts unchanged
+            if t0 is not None:    # keep model_times 1:1 with calls
+                self._model_times.append(_time.perf_counter() - t0)
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
         max_seq = _round_up(int(lengths.max()) + max_new_tokens, 128)
