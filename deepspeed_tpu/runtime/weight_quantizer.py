@@ -1,0 +1,76 @@
+"""Load-time weight quantization for inference checkpoints.
+
+Analog of ``deepspeed/runtime/weight_quantizer.py`` (``WeightQuantization``
+— quantizes attention/MLP weights while a model-parallel state dict is
+being loaded/merged, so the full-precision tensor never sits in serving
+memory). The storage format and dequant-in-matmul seam are the
+module_inject TRUE-int8 ones ({"q": int8, "scale": f32}); this module is
+the *policy* layer: which leaves quantize (2-D+ GEMM weights above a size
+floor, never norms/biases/embeddings-by-name) at which bit width.
+"""
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+
+from deepspeed_tpu.module_inject.quantize import (dequantize_weight,
+                                                  quantize_weight)
+
+_NEVER = ("*norm*", "*ln_*", "*bias*", "*scale*", "*embed*", "*wte*",
+          "*wpe*", "*position*")
+
+
+class WeightQuantization:
+    """``WeightQuantization(mlp_extra_grouping=...)`` parity surface.
+
+    The reference doubles the group count for MLP weights
+    (``mlp_extra_grouping`` — bigger matrices, finer scales); the same
+    rule applies here via path matching.
+    """
+
+    def __init__(self, mlp_extra_grouping: bool = True,
+                 quantize_groups: int = 64, num_bits: int = 8,
+                 min_size: int = 4096,
+                 skip_patterns: Sequence[str] = _NEVER):
+        self.mlp_extra_grouping = mlp_extra_grouping
+        self.quantize_groups = quantize_groups
+        self.num_bits = num_bits
+        self.min_size = min_size
+        self.skip_patterns = tuple(skip_patterns)
+        self.quantized_paths: list = []
+
+    def _should_quantize(self, path: str, leaf) -> bool:
+        if isinstance(leaf, dict):          # already {"q", "scale"}
+            return False
+        if getattr(leaf, "ndim", 0) < 2 or leaf.size < self.min_size:
+            return False
+        return not any(fnmatch.fnmatch(path, p)
+                       for p in self.skip_patterns)
+
+    def model_quantize(self, params: Any) -> Any:
+        """Quantize the GEMM weights of a converted param tree (the
+        ``model_quantize``/``sd_quantize_megatron`` entry points rolled
+        into one tree transform)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path_parts, leaf in flat:
+            path = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path_parts)
+            if self._should_quantize(path, leaf):
+                groups = self.quantize_groups
+                if self.mlp_extra_grouping and fnmatch.fnmatch(
+                        path, "*mlp*"):
+                    groups *= 2
+                self.quantized_paths.append(path)
+                out.append(quantize_weight(leaf, group_size=groups,
+                                           num_bits=self.num_bits))
+            else:
+                out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    @staticmethod
+    def dequantize(leaf, dtype=None):
+        import jax.numpy as jnp
+        return dequantize_weight(leaf, dtype or jnp.float32)
