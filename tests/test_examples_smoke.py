@@ -1,0 +1,21 @@
+"""Examples stay importable/parseable (rot protection): each script's
+--help must exit 0 without touching the TPU."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = [f for f in os.listdir(
+    os.path.join(os.path.dirname(__file__), "..", "examples"))
+    if f.endswith(".py")]
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_help(script):
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        script)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, path, "--help"],
+                       capture_output=True, timeout=120, env=env)
+    assert p.returncode == 0, p.stderr.decode()[-500:]
