@@ -1,9 +1,12 @@
 """Checkpoint toolkit (analog of ``deepspeed/checkpoint/`` +
 ``runtime/checkpoint_engine/``): engine abstraction (sync/async), universal
-checkpoint inspection/reshaping, ZeRO→fp32 consolidation."""
+checkpoint inspection/reshaping, ZeRO→fp32 consolidation, and IMPORT of
+reference-format DeepSpeed checkpoints (the migration path)."""
 from deepspeed_tpu.checkpoint.checkpoint_engine import (
     AsyncCheckpointEngine, CheckpointEngine, OrbaxCheckpointEngine,
     make_checkpoint_engine)
+from deepspeed_tpu.checkpoint.import_deepspeed import (
+    import_into_engine, load_reference_fp32_state_dict, to_param_tree)
 from deepspeed_tpu.checkpoint.universal import (DeepSpeedCheckpoint,
                                                 reshape_checkpoint)
 from deepspeed_tpu.checkpoint.zero_to_fp32 import (
@@ -16,4 +19,6 @@ __all__ = ["CheckpointEngine", "OrbaxCheckpointEngine",
            "DeepSpeedCheckpoint", "reshape_checkpoint",
            "get_fp32_state_dict_from_zero_checkpoint",
            "convert_zero_checkpoint_to_fp32_state_dict",
-           "load_state_dict_from_zero_checkpoint"]
+           "load_state_dict_from_zero_checkpoint",
+           "load_reference_fp32_state_dict", "to_param_tree",
+           "import_into_engine"]

@@ -1,0 +1,266 @@
+"""Import a REFERENCE DeepSpeed checkpoint directory.
+
+The migration half of the story: a user switching from the reference
+brings their training checkpoint along. This reads the reference's
+on-disk layout directly (no deepspeed package, no live torch model) and
+reconstructs the full fp32 weights:
+
+* ``mp_rank_00_model_states.pt`` / ``zero_pp_rank_0_mp_rank_00_model_
+  states.pt`` — ``param_shapes`` (the flattening order), buffers,
+  ``module`` (for non-ZeRO checkpoints the full weights live here)
+* ``*_optim_states.pt`` per DP rank — the flat fp32 partitions
+  (``single_partition_of_fp32_groups`` for stage 1/2,
+  ``fp32_flat_groups`` for stage 3)
+
+Reconstruction mirrors the reference's own offline consolidation tool
+(``deepspeed/utils/zero_to_fp32.py:160-330``): stage-1/2 partitions
+concatenate per param group and slice sequentially with the
+2*world_size alignment tolerance; stage-3 shards interleave at each
+param boundary with ceil-partition padding. Constants match
+``deepspeed/checkpoint/constants.py``.
+
+The result is a flat ``{dotted_name: np.ndarray}`` — feed it to a
+module_inject policy (HF-style names) or ``to_param_tree`` (generic
+nesting), then install with :func:`import_into_engine`.
+"""
+from __future__ import annotations
+
+import glob
+import math
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+OPTIMIZER_STATE_DICT = "optimizer_state_dict"
+FP32_FLAT_GROUPS = "fp32_flat_groups"
+SINGLE_PARTITION = "single_partition_of_fp32_groups"
+ZERO_STAGE = "zero_stage"
+PARTITION_COUNT = "partition_count"
+PARAM_SHAPES = "param_shapes"
+BUFFER_NAMES = "buffer_names"
+DS_VERSION = "ds_version"
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach()
+    # upcast only the numpy-unrepresentable half dtypes; integer buffers
+    # (position_ids, num_batches_tracked) keep their dtype exactly
+    if hasattr(t, "dtype") and str(t.dtype) in ("torch.bfloat16",
+                                                "torch.float16"):
+        t = t.float()
+    if hasattr(t, "numpy"):
+        t = t.numpy()
+    return np.asarray(t)
+
+
+def _natural(text: str):
+    return [int(c) if c.isdigit() else c for c in re.split(r"(\d+)", text)]
+
+
+def _torch_load(path: str):
+    import torch
+    from deepspeed_tpu.module_inject.megatron_shards import _LenientUnpickler
+    return torch.load(path, map_location="cpu", weights_only=False,
+                      pickle_module=_LenientUnpickler)
+
+
+def resolve_tag_dir(checkpoint_dir: str, tag: Optional[str] = None) -> str:
+    """Follow the reference's ``latest`` tag file when ``checkpoint_dir``
+    is the parent save dir."""
+    latest = os.path.join(checkpoint_dir, "latest")
+    if tag is None and os.path.isfile(latest):
+        tag = open(latest).read().strip()
+    return os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
+
+
+def _model_state_file(d: str) -> str:
+    for name in ("mp_rank_00_model_states.pt",
+                 "zero_pp_rank_0_mp_rank_00_model_states.pt"):
+        p = os.path.join(d, name)
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(f"no *_model_states.pt under {d!r}")
+
+
+def _optim_files(d: str) -> List[str]:
+    files = sorted(glob.glob(os.path.join(d, "*_optim_states.pt")),
+                   key=_natural)
+    return files
+
+
+def load_reference_fp32_state_dict(checkpoint_dir: str,
+                                   tag: Optional[str] = None
+                                   ) -> Dict[str, np.ndarray]:
+    """Full fp32 weights (+ buffers) from a reference checkpoint dir —
+    ZeRO stages 1/2/3 or plain fp16/bf16 saves."""
+    d = resolve_tag_dir(checkpoint_dir, tag)
+    if glob.glob(os.path.join(d, "*mp_rank_01*")):
+        raise NotImplementedError(
+            "TP>1 reference checkpoints (mp_rank_01+ files) are not "
+            "importable directly — merge the model-parallel shards first "
+            "(module_inject.megatron_shards) and retrain-state import "
+            "only the mp_rank_00 slice")
+    model_blob = _torch_load(_model_state_file(d))
+    buffers = {}
+    module_sd = model_blob.get("module") or {}
+    for name in model_blob.get(BUFFER_NAMES, []):
+        if name in module_sd:
+            buffers[name] = _np(module_sd[name])
+
+    optim_files = _optim_files(d)
+    param_shapes = model_blob.get(PARAM_SHAPES)
+    if not optim_files or param_shapes is None:
+        # non-ZeRO save: module holds the real (half) weights
+        if not module_sd:
+            raise ValueError(f"{d!r}: no optim shards and no module "
+                             "weights — not a DeepSpeed checkpoint?")
+        return {k: _np(v) for k, v in module_sd.items()}
+
+    states = [_torch_load(f)[OPTIMIZER_STATE_DICT] for f in optim_files]
+    stage = states[0].get(ZERO_STAGE, 2)
+    world = states[0].get(PARTITION_COUNT, len(states))
+    if isinstance(world, list):
+        world = max(world)
+    if world != len(states):
+        raise ValueError(f"expected {world} optim shards, found "
+                         f"{len(states)} (incomplete checkpoint?)")
+
+    out: Dict[str, np.ndarray] = dict(buffers)
+    if stage in (1, 2):
+        _reconstruct_stage2(states, param_shapes, world, out)
+    elif stage == 3:
+        _reconstruct_stage3(states, param_shapes, world, out)
+    else:
+        raise ValueError(f"unknown zero stage {stage}")
+    # anything in the module blob that the fp32 partitions did not cover
+    # (frozen params — they have no optimizer state — and extra buffers)
+    # comes through at its stored precision
+    for name, value in module_sd.items():
+        if name not in out:
+            out[name] = _np(value)
+    return out
+
+
+def _reconstruct_stage2(states, param_shapes, world, out) -> None:
+    """Concat each group's partitions, slice sequentially, tolerate the
+    2*world alignment padding (zero_to_fp32.py:224-271)."""
+    flat_groups = [s[SINGLE_PARTITION] for s in states]
+    n_groups = len(flat_groups[0])
+    for gi in range(n_groups):
+        full = np.concatenate([_np(flat_groups[r][gi]).reshape(-1)
+                               for r in range(world)])
+        offset = 0
+        for name, shape in param_shapes[gi].items():
+            shape = tuple(shape)
+            n = int(np.prod(shape)) if shape else 1
+            out[name] = full[offset:offset + n].reshape(shape)
+            offset += n
+        align = 2 * world
+        if align * math.ceil(offset / align) != \
+                align * math.ceil(full.size / align):
+            raise ValueError(
+                f"group {gi}: consumed {offset} of {full.size} elements "
+                "— param_shapes do not match the flat partitions")
+
+
+def _reconstruct_stage3(states, param_shapes, world, out) -> None:
+    """Each rank's single flat group holds ceil(n/world) elements of
+    every param in order; zip at param boundaries
+    (zero_to_fp32.py:279-330)."""
+    shards = [_np(s[FP32_FLAT_GROUPS]).reshape(-1)
+              if not isinstance(s[FP32_FLAT_GROUPS], list)
+              else np.concatenate([_np(x).reshape(-1)
+                                   for x in s[FP32_FLAT_GROUPS]])
+              for s in states]
+    merged = {k: tuple(v) for d_ in param_shapes for k, v in d_.items()}
+    # validate BEFORE slicing: a short shard would otherwise surface as a
+    # cryptic numpy reshape error mid-loop
+    need = sum(math.ceil((int(np.prod(s)) if s else 1) / world)
+               for s in merged.values())
+    short = [i for i, s in enumerate(shards) if s.size < need]
+    if short:
+        raise ValueError(
+            f"stage-3 shards {short} hold fewer elements than "
+            f"param_shapes demand ({need}) — truncated checkpoint?")
+    offset = 0
+    for name, shape in merged.items():
+        n = int(np.prod(shape)) if shape else 1
+        part = math.ceil(n / world)
+        pieces = [shards[r][offset:offset + part] for r in range(world)]
+        out[name] = np.concatenate(pieces)[:n].reshape(shape)
+        offset += part
+
+
+def import_into_engine(engine, fp32_tree: Any) -> None:
+    """Install imported fp32 weights into a live engine: the tree
+    structure must match ``engine.state.params`` (use :func:`to_param_tree`
+    plus your own renames to get there). Weights land with the engine's
+    shardings/dtypes; the optimizer state restarts (the reference's
+    consolidation tool also recovers weights only)."""
+    import jax
+
+    from deepspeed_tpu.runtime.precision import cast_tree
+
+    cur = engine.state.params
+    want = jax.tree.map(lambda x: (tuple(x.shape)), cur)
+    got = jax.tree.map(lambda x: (tuple(x.shape)), fp32_tree)
+    if want != got:
+        raise ValueError(
+            "imported tree structure/shapes do not match the engine's "
+            "params — map names (to_param_tree + renames) first")
+    sh = engine._state_shardings
+    import jax.numpy as jnp
+    new_params = jax.device_put(
+        cast_tree(fp32_tree, engine.compute_dtype), sh.params)
+    if engine.host_opt is not None:
+        # ZeRO-Offload: the fp32 master + moments live on the HOST;
+        # refresh them from the imported params (same primitive the
+        # checkpoint loader uses, runtime/checkpointing.py:214). Device
+        # state keeps its offload shape (master=None, opt_state=()).
+        engine.state = engine.state.replace(params=new_params)
+        engine.host_opt.sync_master_from(new_params)
+        return
+    if engine.mixed_precision:
+        new_master = jax.device_put(cast_tree(fp32_tree, jnp.float32),
+                                    sh.master)
+    else:
+        new_master = engine.state.master
+    # re-init moments from the SHARDED master — jitting over the host
+    # tree would materialize a full replica on device first
+    src = new_master if engine.mixed_precision else new_params
+    opt_state = jax.jit(engine.optimizer.init,
+                        out_shardings=sh.opt_state)(src)
+    engine.state = engine.state.replace(
+        params=new_params, master=new_master, opt_state=opt_state)
+
+
+def to_param_tree(flat: Dict[str, np.ndarray],
+                  transpose_linear_keys: Tuple[str, ...] = ()
+                  ) -> Dict[str, Any]:
+    """Nest dotted torch names into a pytree (``a.b.weight`` →
+    ``{"a": {"b": {"weight": ...}}}``); keys matching
+    ``transpose_linear_keys`` patterns transpose [out, in] → [in, out]
+    for jnp ``x @ w`` layout. Match only LINEAR weights — embeddings keep
+    torch's layout, and conv kernels need a real layout permute
+    (OIHW→HWIO), so a >2-D match is rejected loudly."""
+    import fnmatch
+
+    import jax.numpy as jnp
+    tree: Dict[str, Any] = {}
+    for name, arr in flat.items():
+        if any(fnmatch.fnmatch(name, p) for p in transpose_linear_keys):
+            if arr.ndim != 2:
+                raise ValueError(
+                    f"transpose_linear_keys matched {name!r} with ndim="
+                    f"{arr.ndim}; only 2-D Linear weights transpose "
+                    "(conv kernels need OIHW→HWIO, embeddings none)")
+            arr = arr.T
+        node = tree
+        parts = name.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(arr)
+    return tree

@@ -26,6 +26,7 @@ PKG = os.path.join(os.path.dirname(os.path.dirname(
 
 TORCH_ALLOWED = (
     "module_inject/",          # HF/diffusers checkpoint conversion
+    "checkpoint/import_deepspeed.py",   # reference-format .pt import
 )
 # writer/IO utilities that happen to live in the torch package but move
 # no tensors into the compute path

@@ -1,0 +1,223 @@
+"""Reference-checkpoint import tests: synthesize the reference's on-disk
+layout (zero_to_fp32.py protocol) and reconstruct the fp32 weights."""
+import math
+import os
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+import torch
+
+from deepspeed_tpu.checkpoint.import_deepspeed import (
+    load_reference_fp32_state_dict, resolve_tag_dir, to_param_tree)
+
+RNG = np.random.default_rng(0)
+
+
+def make_params():
+    return OrderedDict([
+        ("embed.weight", RNG.normal(size=(33, 8)).astype(np.float32)),
+        ("layer.0.linear.weight", RNG.normal(size=(8, 8)).astype(np.float32)),
+        ("layer.0.linear.bias", RNG.normal(size=(8,)).astype(np.float32)),
+        ("head.weight", RNG.normal(size=(5, 8)).astype(np.float32)),
+    ])
+
+
+def write_model_states(d, params, buffers=None, stage3=False):
+    name = ("zero_pp_rank_0_mp_rank_00_model_states.pt" if stage3
+            else "mp_rank_00_model_states.pt")
+    buffers = buffers or {}
+    blob = {
+        "module": {**{k: torch.tensor(v) for k, v in buffers.items()}},
+        "param_shapes": [OrderedDict(
+            (k, torch.Size(v.shape)) for k, v in params.items())],
+        "buffer_names": list(buffers),
+        "ds_version": "0.8.0",
+    }
+    torch.save(blob, os.path.join(d, name))
+
+
+def write_zero2(d, params, world):
+    flat = np.concatenate([v.reshape(-1) for v in params.values()])
+    align = 2 * world
+    padded = math.ceil(flat.size / align) * align
+    flat = np.pad(flat, (0, padded - flat.size))
+    parts = np.split(flat, world)
+    for r in range(world):
+        blob = {"optimizer_state_dict": {
+            "zero_stage": 2, "partition_count": world,
+            "single_partition_of_fp32_groups": [torch.tensor(parts[r])]}}
+        torch.save(blob, os.path.join(
+            d, f"zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+
+
+def write_zero3(d, params, world):
+    shards = [[] for _ in range(world)]
+    for v in params.values():
+        n = v.size
+        part = math.ceil(n / world)
+        padded = np.pad(v.reshape(-1), (0, part * world - n))
+        for r in range(world):
+            shards[r].append(padded[r * part:(r + 1) * part])
+    for r in range(world):
+        blob = {"optimizer_state_dict": {
+            "zero_stage": 3, "partition_count": world,
+            "fp32_flat_groups": [torch.tensor(np.concatenate(shards[r]))]}}
+        torch.save(blob, os.path.join(
+            d, f"bf16_zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_zero2_reconstruction(tmp_path, world):
+    params = make_params()
+    bufs = {"layer.0.running_stat": RNG.normal(size=(3,)).astype(np.float32)}
+    write_model_states(str(tmp_path), params, bufs)
+    write_zero2(str(tmp_path), params, world)
+    sd = load_reference_fp32_state_dict(str(tmp_path))
+    for k, v in params.items():
+        np.testing.assert_allclose(sd[k], v, atol=1e-6, err_msg=k)
+    np.testing.assert_allclose(sd["layer.0.running_stat"],
+                               bufs["layer.0.running_stat"], atol=1e-6)
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_zero3_reconstruction(tmp_path, world):
+    params = make_params()
+    write_model_states(str(tmp_path), params, stage3=True)
+    write_zero3(str(tmp_path), params, world)
+    sd = load_reference_fp32_state_dict(str(tmp_path))
+    for k, v in params.items():
+        np.testing.assert_allclose(sd[k], v, atol=1e-6, err_msg=k)
+
+
+def test_latest_tag_resolution(tmp_path):
+    step_dir = tmp_path / "global_step42"
+    step_dir.mkdir()
+    (tmp_path / "latest").write_text("global_step42")
+    params = make_params()
+    write_model_states(str(step_dir), params)
+    write_zero2(str(step_dir), params, 2)
+    assert resolve_tag_dir(str(tmp_path)) == str(step_dir)
+    sd = load_reference_fp32_state_dict(str(tmp_path))
+    np.testing.assert_allclose(sd["head.weight"], params["head.weight"],
+                               atol=1e-6)
+
+
+def test_non_zero_checkpoint_uses_module_weights(tmp_path):
+    params = make_params()
+    blob = {"module": {k: torch.tensor(v) for k, v in params.items()}}
+    torch.save(blob, str(tmp_path / "mp_rank_00_model_states.pt"))
+    sd = load_reference_fp32_state_dict(str(tmp_path))
+    np.testing.assert_allclose(sd["embed.weight"], params["embed.weight"],
+                               atol=1e-6)
+
+
+def test_incomplete_shards_is_loud(tmp_path):
+    params = make_params()
+    write_model_states(str(tmp_path), params)
+    write_zero2(str(tmp_path), params, 4)
+    os.remove(str(tmp_path /
+                  "zero_pp_rank_3_mp_rank_00_optim_states.pt"))
+    with pytest.raises(ValueError, match="optim shards"):
+        load_reference_fp32_state_dict(str(tmp_path))
+
+
+def test_mismatched_shapes_is_loud(tmp_path):
+    params = make_params()
+    write_model_states(str(tmp_path), params)
+    wrong = OrderedDict(params)
+    wrong["head.weight"] = RNG.normal(size=(50, 8)).astype(np.float32)
+    write_zero2(str(tmp_path), wrong, 2)   # partitions sized for `wrong`
+    with pytest.raises(ValueError, match="param_shapes"):
+        load_reference_fp32_state_dict(str(tmp_path))
+
+
+def test_to_param_tree_nesting_and_transpose():
+    import jax.numpy as jnp
+    flat = {"a.linear.weight": np.ones((4, 2), np.float32),
+            "a.linear.bias": np.zeros((4,), np.float32)}
+    tree = to_param_tree(flat, transpose_linear_keys=("*.weight",))
+    assert tree["a"]["linear"]["weight"].shape == (2, 4)
+    assert tree["a"]["linear"]["bias"].shape == (4,)
+    assert isinstance(tree["a"]["linear"]["weight"], jnp.ndarray)
+
+
+@pytest.mark.slow
+def test_import_into_engine_end_to_end(tmp_path):
+    """Reference checkpoint dir -> fp32 sd -> param tree -> live engine:
+    the migrated engine serves the imported weights and keeps training."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.checkpoint.import_deepspeed import import_into_engine
+
+    class Tiny:
+        def init(self):
+            return {"w": jnp.zeros((8, 8), jnp.float32),
+                    "b": jnp.zeros((8,), jnp.float32)}
+
+        def loss_fn(self, p, batch, rng):
+            return jnp.mean((batch["x"] @ p["w"] + p["b"]) ** 2)
+
+    # reference-side "training result"
+    ref = OrderedDict([("w", RNG.normal(size=(8, 8)).astype(np.float32)),
+                       ("b", RNG.normal(size=(8,)).astype(np.float32))])
+    write_model_states(str(tmp_path), ref)
+    write_zero2(str(tmp_path), ref, 2)
+    sd = load_reference_fp32_state_dict(str(tmp_path))
+    tree = to_param_tree(sd)
+
+    model = Tiny()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    import_into_engine(engine, tree)
+    np.testing.assert_allclose(
+        np.asarray(engine.state.master["w"], np.float32), ref["w"],
+        atol=1e-6)
+    batch = {"x": jnp.ones((8, 8), jnp.float32)}
+    l0 = float(engine.train_batch(batch)["loss"])
+    l1 = float(engine.train_batch(batch)["loss"])
+    assert np.isfinite(l0) and l1 < l0
+
+    # structure mismatch is loud
+    with pytest.raises(ValueError, match="do not match"):
+        import_into_engine(engine, {"w": tree["w"]})
+
+
+def test_frozen_params_come_from_module_blob(tmp_path):
+    """Frozen params have no optimizer partitions; their (half) weights
+    in the module blob must survive the import."""
+    trainable = OrderedDict([("w", RNG.normal(size=(8, 8))
+                              .astype(np.float32))])
+    frozen = {"frozen.embed": RNG.normal(size=(16, 4)).astype(np.float32),
+              "pos_ids": np.arange(10, dtype=np.int64)}
+    blob = {
+        "module": {k: torch.tensor(v) for k, v in frozen.items()},
+        "param_shapes": [OrderedDict(w=torch.Size((8, 8)))],
+        "buffer_names": [], "ds_version": "0.8.0"}
+    torch.save(blob, str(tmp_path / "mp_rank_00_model_states.pt"))
+    write_zero2(str(tmp_path), trainable, 2)
+    sd = load_reference_fp32_state_dict(str(tmp_path))
+    np.testing.assert_allclose(sd["frozen.embed"], frozen["frozen.embed"],
+                               atol=1e-6)
+    assert sd["pos_ids"].dtype == np.int64          # ints keep dtype
+    np.testing.assert_allclose(sd["w"], trainable["w"], atol=1e-6)
+
+
+def test_tp_checkpoint_rejected_clearly(tmp_path):
+    params = make_params()
+    write_model_states(str(tmp_path), params)
+    write_zero2(str(tmp_path), params, 2)
+    torch.save({}, str(tmp_path / "mp_rank_01_model_states.pt"))
+    with pytest.raises(NotImplementedError, match="TP>1"):
+        load_reference_fp32_state_dict(str(tmp_path))
+
+
+def test_transpose_rejects_non_2d():
+    flat = {"conv.weight": np.ones((4, 2, 3, 3), np.float32)}
+    with pytest.raises(ValueError, match="ndim"):
+        to_param_tree(flat, transpose_linear_keys=("*.weight",))
