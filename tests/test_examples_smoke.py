@@ -19,3 +19,19 @@ def test_example_help(script):
     p = subprocess.run([sys.executable, path, "--help"],
                        capture_output=True, timeout=120, env=env)
     assert p.returncode == 0, p.stderr.decode()[-500:]
+
+
+def test_dstpu_aio_bench_runs():
+    path = os.path.join(os.path.dirname(__file__), "..", "bin",
+                        "dstpu_aio")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [os.path.join(os.path.dirname(__file__), "..")] +
+                   os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+    p = subprocess.run([sys.executable, path, "--size-mb", "8",
+                        "--threads", "2", "--iters", "1"],
+                       capture_output=True, timeout=120, env=env)
+    assert p.returncode == 0, p.stderr.decode()[-400:]
+    import json
+    out = json.loads(p.stdout.decode().strip().splitlines()[-1])
+    assert out["results"][0]["write_MBps"] > 0
