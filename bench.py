@@ -258,6 +258,11 @@ PHASES = {
     # the reference's training-kernel headline: BERT-large (64 TFLOPS/GPU)
     "train-bert-large": (["--seq", "512", "--micro", "16"], 480),
     "train-350m-flash": (["--preset", "gpt2-350m"], 480),
+    # flash WITHOUT remat: the Mosaic bwd kernel compiles once instead of
+    # twice (no recompute application) — the cheaper flash data point if
+    # the remat+flash compile is what hangs
+    "train-350m-flash-noremat": (["--preset", "gpt2-350m",
+                                  "--no-remat"], 480),
 }
 
 
@@ -392,8 +397,8 @@ def main() -> None:
 
     # headline: flagship (350m) phase if any completed, else 125m fallback
     best = None
-    for name in ("train-350m-flash", "train-350m-noremat",
-                 "train-350m-noflash", "train-125m"):
+    for name in ("train-350m-flash", "train-350m-flash-noremat",
+                 "train-350m-noremat", "train-350m-noflash", "train-125m"):
         if name in results:
             best = results[name]
             break

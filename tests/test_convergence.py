@@ -1,0 +1,101 @@
+"""Model-level convergence sanity checks.
+
+The reference keeps end-to-end convergence tests outside unit scope
+(``tests/model/``: Megatron GPT-2 + BingBertSquad with accuracy
+baselines against DeepSpeedExamples). The TPU analog: small synthetic
+tasks that must train to (near) zero loss through the real engine stack
+— fused step, ZeRO sharding, bf16 master updates, lr schedule — so a
+silent optimizer/precision regression fails a threshold, not just a
+parity diff."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+
+pytestmark = pytest.mark.slow
+
+
+def copy_task_batch(rng, bs, seq, vocab):
+    """Predictable sequences: token t+1 = (token t + 1) % vocab — a
+    next-token task a tiny LM must drive to ~zero loss."""
+    start = rng.integers(0, vocab, size=(bs, 1))
+    ramp = (start + np.arange(seq)[None, :]) % vocab
+    return {"input_ids": jnp.asarray(ramp, jnp.int32)}
+
+
+@pytest.mark.parametrize("stage,precision", [(0, None), (3, "bf16")])
+def test_gpt2_converges_on_copy_task(stage, precision):
+    vocab = 64
+    cfg = GPT2Config(vocab_size=vocab, n_positions=32, n_embd=64,
+                     n_layer=2, n_head=4, use_flash_attention=False,
+                     vocab_pad_multiple=64,
+                     dtype=jnp.bfloat16 if precision else jnp.float32)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=32)
+    ds = {"train_micro_batch_size_per_gpu": 4,
+          "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+          "scheduler": {"type": "WarmupLR",
+                        "params": {"warmup_max_lr": 3e-3,
+                                   "warmup_num_steps": 10}},
+          "zero_optimization": {"stage": stage,
+                                "stage3_param_persistence_threshold": 0}}
+    if precision:
+        ds["bf16"] = {"enabled": True}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=ds)
+    rng = np.random.default_rng(0)
+    first = None
+    for step in range(60):
+        batch = copy_task_batch(rng, engine.train_batch_size, 32, vocab)
+        loss = float(engine.train_batch(batch)["loss"])
+        if first is None:
+            first = loss
+    # from ~ln(64)=4.16 to near-deterministic prediction
+    assert first > 3.0, f"suspicious initial loss {first}"
+    assert loss < 0.3, (f"stage={stage} precision={precision}: loss "
+                        f"{loss:.3f} after 60 steps — engine stack is "
+                        "not learning")
+
+
+def test_moe_model_converges():
+    """The MoE layer (gating + EP dispatch) must not block learning."""
+    from deepspeed_tpu.moe.layer import MoE
+
+    class MoEModel:
+        def __init__(self):
+            self.moe = MoE(hidden_size=32, num_experts=4, k=2,
+                           capacity_factor=2.0, min_capacity=4)
+
+        def init(self, key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            dummy = jnp.zeros((4, 32), jnp.float32)
+            return {"inp": jax.random.normal(k1, (16, 32)) * 0.3,
+                    "moe": self.moe.init({"params": k2}, dummy)["params"],
+                    "out": jax.random.normal(k3, (32, 8)) * 0.3}
+
+        def loss_fn(self, p, batch, rng):
+            h = jnp.tanh(batch["x"] @ p["inp"])
+            h, aux_loss, _ = self.moe.apply({"params": p["moe"]}, h)
+            logits = h @ p["out"]
+            ce = -jnp.mean(jax.nn.log_softmax(logits)[
+                jnp.arange(batch["y"].shape[0]), batch["y"]])
+            return ce + 0.01 * aux_loss
+
+    model = MoEModel()
+    params = model.init(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+                "zero_optimization": {"stage": 0}})
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(engine.train_batch_size, 16)).astype(np.float32)
+    y = rng.integers(0, 8, size=(engine.train_batch_size,))
+    batch = {"x": jnp.asarray(x), "y": jnp.asarray(y, jnp.int32)}
+    losses = [float(engine.train_batch(batch)["loss"])
+              for _ in range(80)]
+    assert losses[-1] < 0.5 * losses[0], (
+        f"MoE model not learning: {losses[0]:.3f} -> {losses[-1]:.3f}")
