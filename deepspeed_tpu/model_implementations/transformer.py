@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.inference.kv_cache import (KVCache, advance, append_token,
                                               write_prompt)
+from deepspeed_tpu.ops.int8_gemm import maybe_int8_matmul
 
 NEG_INF = -1e30
 
@@ -62,6 +63,11 @@ class InferenceTransformerConfig:
     # per-layer sliding-window size (None = global) — GPT-Neo alternates
     # global/local(256); length n_layer when set
     local_windows: Optional[tuple] = None
+    # w8a8: run the MLP in/out GEMMs as int8 x int8 -> int32 on the MXU
+    # when weights are stored int8 (ops/int8_gemm.py). Attention
+    # projections keep the dequant-bf16 path (non-foldable scale grid);
+    # the tied LM head is the embedding table (never quantized).
+    int8_compute: bool = False
     # MoE FFN (reference ops/transformer/inference/moe_inference.py):
     # layers in ``moe_layers`` replace their MLP with num_experts experts
     # behind a top-k gate; experts shard over the ``expert`` mesh axis
@@ -396,9 +402,10 @@ def _qkv(x, a, cfg, positions):
 
 
 def _mlp(x, m, cfg):
-    h = _act((x @ _w(m["wi"], x.dtype) + m["bi"]).astype(jnp.float32),
-             cfg.activation)
-    return h.astype(x.dtype) @ _w(m["wo"], x.dtype) + m["bo"]
+    h = _act((maybe_int8_matmul(x, m["wi"], x.dtype, cfg.int8_compute)
+              + m["bi"]).astype(jnp.float32), cfg.activation)
+    return maybe_int8_matmul(h.astype(x.dtype), m["wo"], x.dtype,
+                             cfg.int8_compute) + m["bo"]
 
 
 def _moe_mlp(x, moe, cfg, mesh=None):

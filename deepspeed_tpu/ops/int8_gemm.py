@@ -1,0 +1,62 @@
+"""True int8×int8 GEMM (w8a8) for inference.
+
+The module_inject int8 path stores weights as {"q": int8, "scale": f32}
+and dequantizes into a bf16 matmul — a memory win only. This op closes
+the compute half: the v5e MXU multiplies int8×int8 at twice the bf16
+rate, so the GEMM itself runs on int8 operands:
+
+    y = x @ (q * s)  with per-row scales s[k]
+      = sum_k (x[k] * s[k]) * q[k, j]          — fold s into the activation
+      ≈ sz * sum_k z_q[k] * q[k, j]            — one dynamic per-row quant
+
+Folding the weight's per-row scales into the activation BEFORE the
+dynamic activation quant makes the int8 dot exact up to ONE activation
+rounding — no per-group partial dots needed. ``preferred_element_type=
+int32`` keeps the accumulator exact; the single fp rescale happens on the
+[..., N] output.
+
+Scope: the MLP in/out GEMMs (the decode-FLOPs majority). 3-D attention
+projections keep the dequant-bf16 path — their scale grid spans output
+heads and is not foldable on either side — and the tied LM head is the
+(never-quantized) embedding table.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w
+
+
+def int8_matmul(x: jax.Array, qw: dict, out_dtype=None) -> jax.Array:
+    """``x [..., K] @ {"q": int8 [K, N], "scale": f32 [K, 1]}`` with the
+    int8 contraction on the MXU."""
+    q = qw["q"]
+    if q.ndim != 2:
+        raise ValueError(f"int8_matmul handles 2-D weights, got "
+                         f"{q.shape} (attention projections keep the "
+                         "dequant path)")
+    out_dtype = out_dtype or x.dtype
+    scale = qw["scale"].astype(jnp.float32).reshape(q.shape[0])   # [K]
+    z = x.astype(jnp.float32) * scale                             # fold
+    amax = jnp.max(jnp.abs(z), axis=-1, keepdims=True)
+    sz = jnp.where(amax > 0, amax / 127.0, 1.0)
+    zq = jnp.clip(jnp.round(z / sz), -127, 127).astype(jnp.int8)
+    y = jax.lax.dot_general(
+        zq, q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (y.astype(jnp.float32) * sz).astype(out_dtype)
+
+
+def maybe_int8_matmul(x: jax.Array, w: Any, dtype,
+                      int8_compute: bool) -> jax.Array:
+    """The fused transformer's 2-D GEMM seam: int8 dot when the leaf is
+    quantized and the config opts in; bf16 dequant-matmul otherwise."""
+    if int8_compute and is_quantized(w) and w["q"].ndim == 2:
+        return int8_matmul(x, w, out_dtype=dtype)
+    from deepspeed_tpu.model_implementations.transformer import _w
+    return (x @ _w(w, dtype)).astype(dtype)

@@ -1,0 +1,95 @@
+"""w8a8 int8 GEMM tests (ops/int8_gemm.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.module_inject.quantize import (dequantize_weight,
+                                                  quantize_weight)
+from deepspeed_tpu.ops.int8_gemm import (int8_matmul, is_quantized,
+                                         maybe_int8_matmul)
+
+RNG = np.random.default_rng(0)
+
+
+def test_int8_matmul_matches_dequant_matmul():
+    x = jnp.asarray(RNG.normal(size=(4, 64)), jnp.float32)
+    w = RNG.normal(size=(64, 128)).astype(np.float32)
+    qw = quantize_weight(w, group_size=16)
+    want = np.asarray(x) @ np.asarray(dequantize_weight(qw))
+    got = np.asarray(int8_matmul(x, qw))
+    # one extra activation rounding on top of the weight quantization:
+    # relative error stays ~1%
+    denom = np.abs(want).mean()
+    assert np.abs(got - want).mean() / denom < 0.02
+    assert np.corrcoef(got.ravel(), want.ravel())[0, 1] > 0.999
+
+
+def test_int8_matmul_batched_and_exact_axes():
+    x = jnp.asarray(RNG.normal(size=(2, 3, 32)), jnp.float32)
+    w = RNG.normal(size=(32, 16)).astype(np.float32)
+    qw = quantize_weight(w, group_size=8)
+    got = int8_matmul(x, qw)
+    assert got.shape == (2, 3, 16)
+    want = np.asarray(x) @ np.asarray(dequantize_weight(qw))
+    assert np.corrcoef(np.asarray(got).ravel(),
+                       want.ravel())[0, 1] > 0.999
+
+
+def test_int8_matmul_zero_row_safe():
+    x = jnp.zeros((2, 16), jnp.float32)
+    qw = quantize_weight(RNG.normal(size=(16, 8)).astype(np.float32),
+                         group_size=4)
+    out = np.asarray(int8_matmul(x, qw))
+    assert np.all(out == 0)
+
+
+def test_int8_matmul_rejects_3d():
+    qw = quantize_weight(RNG.normal(size=(4, 2, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="2-D"):
+        int8_matmul(jnp.zeros((1, 4)), qw)
+
+
+def test_maybe_seam_routing():
+    x = jnp.asarray(RNG.normal(size=(2, 16)), jnp.float32)
+    w_dense = jnp.asarray(RNG.normal(size=(16, 8)), jnp.float32)
+    qw = quantize_weight(np.asarray(w_dense), group_size=4)
+    assert is_quantized(qw) and not is_quantized(w_dense)
+    # dense weight ignores the flag
+    a = maybe_int8_matmul(x, w_dense, jnp.float32, True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(x @ w_dense),
+                               atol=1e-5)
+    # quantized + flag → int8 path; without flag → dequant path
+    b = maybe_int8_matmul(x, qw, jnp.float32, True)
+    c = maybe_int8_matmul(x, qw, jnp.float32, False)
+    assert np.corrcoef(np.asarray(b).ravel(),
+                       np.asarray(c).ravel())[0, 1] > 0.999
+
+
+def test_fused_transformer_int8_compute_end_to_end():
+    """Full causal model with int8-stored weights: int8_compute output
+    stays close to the dequant-bf16 path (generate-level sanity)."""
+    import dataclasses
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, init_params)
+    from deepspeed_tpu.module_inject.quantize import GroupQuantizer
+
+    cfg = InferenceTransformerConfig(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = GroupQuantizer(q_int8=True).quantize_tree(params)
+    prompts = [[5, 9, 2, 7]]
+    outs = {}
+    for int8c in (False, True):
+        c = dataclasses.replace(cfg, int8_compute=int8c)
+        eng = InferenceEngine((c, qparams),
+                              DeepSpeedInferenceConfig(dtype="float32"))
+        outs[int8c] = eng.generate(prompts, max_new_tokens=6)
+    # same prompts, near-identical logits → identical-or-close argmax
+    # trajectories; require >= 4 of 6 tokens agree
+    a, b = outs[False][0][4:], outs[True][0][4:]
+    agree = sum(int(x == y) for x, y in zip(a, b))
+    assert agree >= 4, (a, b)
