@@ -217,6 +217,32 @@ def phase_infer(args) -> dict:
     out["gpt_token_p90_ms"] = round(lat[int(len(lat) * 0.9)], 3)
     log(f"gpt decode p50={out['gpt_token_p50_ms']} ms/token")
 
+    # --- same decode with int8 weights + w8a8 MLP GEMMs
+    try:
+        import dataclasses
+        from deepspeed_tpu.module_inject.quantize import GroupQuantizer
+        from deepspeed_tpu.model_implementations.transformer import (
+            init_params)
+        q_cfg = dataclasses.replace(gpt_cfg, int8_compute=True)
+        qp = GroupQuantizer(q_int8=True).quantize_tree(
+            init_params(jax.random.PRNGKey(0), q_cfg))
+        qeng = InferenceEngine((q_cfg, qp), DeepSpeedInferenceConfig(
+            max_out_tokens=512))
+        t = time.time()
+        qeng.generate(prompt, max_new_tokens=new_tokens)
+        log(f"gpt int8 generate compile+run in {time.time() - t:.1f}s")
+        lat = []
+        for i in range(args.iters):
+            t = time.time()
+            qeng.generate(prompt, max_new_tokens=new_tokens, seed=i)
+            lat.append((time.time() - t) / new_tokens * 1e3)
+        lat.sort()
+        out["gpt_int8_token_p50_ms"] = round(lat[len(lat) // 2], 3)
+        log(f"gpt int8 decode p50={out['gpt_int8_token_p50_ms']} ms/token")
+    except Exception as e:  # noqa: BLE001 — optional metric
+        log(f"int8 decode phase skipped: {type(e).__name__}: "
+            f"{str(e)[:120]}")
+
     # --- BERT-large encoder forward latency (bert-bench.py conventions)
     bert_cfg = InferenceTransformerConfig(
         vocab_size=30522, n_positions=512, n_embd=1024, n_layer=24,
