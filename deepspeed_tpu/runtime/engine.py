@@ -1255,6 +1255,30 @@ class DeepSpeedEngine:
         master = self.state.master if self.mixed_precision else self.state.params
         return jax.device_get(cast_tree(master, jnp.float32))
 
+    def save_16bit_model(self, save_dir,
+                         save_filename: str = "model.safetensors") -> str:
+        """Export the compute-precision weights as ONE flat file
+        (reference ``save_16bit_model``, engine.py:3466 — its
+        'pytorch_model.bin' for downstream serving/upload; here
+        safetensors with dotted names, loadable by
+        ``module_inject.state_dict_loader`` and HF tooling)."""
+        import os
+
+        from safetensors.numpy import save_file
+        self._ensure_params_resident()
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.state.params)[0]:
+            name = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            flat[name] = np.asarray(jax.device_get(leaf))
+        os.makedirs(save_dir, exist_ok=True)
+        out = os.path.join(save_dir, save_filename)
+        save_file(flat, out)
+        log_dist(f"saved 16-bit model: {out} ({len(flat)} tensors)",
+                 ranks=[0])
+        return out
+
     # ------------------------------------------------------------------
     # checkpointing (full impl in runtime/checkpointing.py)
     # ------------------------------------------------------------------

@@ -275,3 +275,18 @@ def test_ignore_unused_parameters():
         assert l1 < l0                      # used param trains
         after = np.asarray(engine.state.params["unused"], np.float32)
         np.testing.assert_allclose(after, before, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_save_16bit_model(tmp_path):
+    """engine.save_16bit_model (reference engine.py:3466): one flat
+    safetensors file of the compute-precision weights."""
+    from safetensors.numpy import load_file
+    engine = build_engine(stage=3, precision="bf16")
+    out = engine.save_16bit_model(str(tmp_path))
+    sd = load_file(out)
+    assert "h_0.attn.c_attn.kernel" in sd
+    assert str(sd["h_0.attn.c_attn.kernel"].dtype) == "bfloat16"
+    want = np.asarray(engine.state.params["h_0"]["attn"]["c_attn"]
+                      ["kernel"])
+    np.testing.assert_array_equal(sd["h_0.attn.c_attn.kernel"], want)
