@@ -1247,6 +1247,28 @@ class DeepSpeedEngine:
     def gradient_accumulation_steps(self) -> int:
         return self.gas
 
+    def set_train_batch_size(self, train_batch_size: int) -> None:
+        """Adjust the global batch by changing the number of micro-batches
+        (GAS); the micro-batch size is unchanged (reference
+        ``set_train_batch_size``, engine.py:444). The fused step bakes the
+        GAS scan length in, so the compiled executables are invalidated —
+        the next ``train_batch`` recompiles with the new schedule."""
+        dp = get_data_parallel_world_size(self.mesh)
+        if train_batch_size % (self.micro_batch_size * dp) != 0:
+            raise ValueError(
+                f"train_batch_size {train_batch_size} is not divisible "
+                f"by micro_batch*dp = {self.micro_batch_size}*{dp}")
+        self.gas = train_batch_size // (self.micro_batch_size * dp)
+        self.train_batch_size = train_batch_size
+        self.config.gradient_accumulation_steps = self.gas
+        self.config.train_batch_size = train_batch_size
+        self._step_fn = None
+        self._grad_fn = None
+        if getattr(self, "_offload_grad_fn", None) is not None:
+            self._offload_grad_fn = None
+        log_dist(f"train_batch_size -> {train_batch_size} "
+                 f"(gas={self.gas})", ranks=[0])
+
     def fp32_master_params(self):
         """Consolidated fp32 weights (analog of
         _zero3_consolidated_16bit_state_dict / zero_to_fp32, engine.py:3396):

@@ -290,3 +290,42 @@ def test_save_16bit_model(tmp_path):
     want = np.asarray(engine.state.params["h_0"]["attn"]["c_attn"]
                       ["kernel"])
     np.testing.assert_array_equal(sd["h_0.attn.c_attn.kernel"], want)
+
+
+@pytest.mark.slow
+def test_set_train_batch_size():
+    """GAS change at runtime (reference engine.py:444): same micro size,
+    recompiled schedule, loss keeps improving."""
+    engine = build_engine(stage=0, gas=1, micro=2)
+    dp = 8   # virtual mesh
+    assert engine.train_batch_size == 16
+    l0 = float(engine.train_batch(make_batch(bs=16))["loss"])
+    engine.set_train_batch_size(32)            # gas 1 -> 2
+    assert engine.gas == 2
+    l1 = float(engine.train_batch(make_batch(bs=32))["loss"])
+    assert np.isfinite(l1) and l1 < l0 + 0.5
+    with pytest.raises(ValueError, match="divisible"):
+        engine.set_train_batch_size(17)
+
+
+def test_memory_estimators():
+    from deepspeed_tpu.runtime.zero.memory_estimators import (
+        estimate_zero_model_states_mem_needs,
+        estimate_zero2_model_states_mem_needs_all_live,
+        estimate_zero3_model_states_mem_needs_all_cold)
+    P = 1_000_000_000
+    base = estimate_zero_model_states_mem_needs(P, stage=0, num_chips=8)
+    z1 = estimate_zero_model_states_mem_needs(P, stage=1, num_chips=8)
+    z3 = estimate_zero_model_states_mem_needs(P, stage=3, num_chips=8)
+    off = estimate_zero_model_states_mem_needs(
+        P, largest_layer_params=P // 50, stage=3, num_chips=8,
+        offload_optimizer=True, offload_param=True)
+    # sharding monotonically shrinks HBM; offload moves states to host
+    assert base["hbm_per_chip"] > z1["hbm_per_chip"] > z3["hbm_per_chip"]
+    assert off["hbm_per_chip"] < z3["hbm_per_chip"]
+    assert off["host_ram"] > 10 * 2 ** 30
+    # all_live/all_cold print tables without error
+    params = {"a": jnp.zeros((1000, 100)), "b": jnp.zeros((10,))}
+    estimate_zero2_model_states_mem_needs_all_live(params, num_chips=8)
+    estimate_zero3_model_states_mem_needs_all_cold(100_000, 10_000,
+                                                   num_chips=8)
