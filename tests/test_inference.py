@@ -234,3 +234,22 @@ def test_profile_model_time():
     times = eng.model_times()
     assert len(times) == 2 and all(t > 0 for t in times)
     assert eng.model_times() == []   # cleared on read
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_decode_matches_prefill(kv_heads):
+    """GQA/MQA (n_kv_head < n_head): every decoded token must equal the
+    argmax of a fresh full-prefix forward — the decode==prefill oracle
+    that catches KV-repeat mask bugs."""
+    cfg = InferenceTransformerConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        n_kv_head=kv_heads, dtype=jnp.float32)
+    eng = InferenceEngine(cfg)
+    prompt = [3, 17, 9, 44, 2]
+    out = eng.generate([prompt], max_new_tokens=5)[0]
+    assert len(out) == len(prompt) + 5
+    for i in range(len(prompt), len(out)):
+        logits = eng.forward(jnp.asarray([out[:i]], jnp.int32))
+        assert int(jnp.argmax(logits[0, -1])) == out[i], (
+            f"token {i}: decode diverged from prefill (kv_heads="
+            f"{kv_heads})")
