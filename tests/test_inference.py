@@ -253,3 +253,27 @@ def test_gqa_decode_matches_prefill(kv_heads):
         assert int(jnp.argmax(logits[0, -1])) == out[i], (
             f"token {i}: decode diverged from prefill (kv_heads="
             f"{kv_heads})")
+
+
+def test_local_window_attention_layers():
+    """GPT-Neo-style alternating global/local(window) attention
+    (local_windows per layer): a token beyond the window must NOT
+    influence a local layer's prediction, and decode==prefill holds."""
+    base = dict(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                n_head=4, dtype=jnp.float32)
+    cfg_local = InferenceTransformerConfig(
+        **base, local_windows=(None, 4))     # layer 1: window 4
+    eng = InferenceEngine(cfg_local)
+    prompt = [7, 3, 99, 5, 21, 8, 13, 2, 40, 6]
+    out = eng.generate([prompt], max_new_tokens=4)[0]
+    for i in range(len(prompt), len(out)):
+        logits = eng.forward(jnp.asarray([out[:i]], jnp.int32))
+        assert int(jnp.argmax(logits[0, -1])) == out[i], (
+            f"token {i}: local-window decode diverged from prefill")
+    # the window binds: same params, fully-global config, same prompt →
+    # different logits (distant tokens re-enter layer 1's attention)
+    cfg_glob = InferenceTransformerConfig(**base)
+    eng2 = InferenceEngine((cfg_glob, eng.params))
+    a = np.asarray(eng.forward(jnp.asarray([prompt], jnp.int32)))
+    b = np.asarray(eng2.forward(jnp.asarray([prompt], jnp.int32)))
+    assert not np.allclose(a[0, -1], b[0, -1])
