@@ -273,7 +273,29 @@ class DeepSpeedConfig:
             "curriculum_learning",
             de.get("data_sampling", {}).get("curriculum_learning", {}))
 
+        # communication_data_type (reference constants.py:119): the DP
+        # gradient-reduction dtype; engine maps it onto the accumulation
+        # buffer (reduction happens at the accumulated dtype under GSPMD)
+        cdt = pd.get("communication_data_type")
+        if cdt is not None:
+            cdt = {"fp32": "fp32", "float32": "fp32", "fp16": "fp16",
+                   "float16": "fp16", "bf16": "bf16",
+                   "bfloat16": "bf16"}.get(str(cdt))
+            if cdt is None:
+                raise ValueError(
+                    f"communication_data_type must be fp32/fp16/bf16, "
+                    f"got {pd.get('communication_data_type')!r}")
+        self.communication_data_type: Optional[str] = cdt
         self.amp = AMPConfig(**pd.get("amp", {}))
+        # validate the comm-dtype/accum-dtype pairing HERE — a conflict
+        # must not survive until the first train_batch of a pod job
+        _acc = pd.get("data_types", {}).get("grad_accum_dtype")
+        if _acc and cdt and _acc != cdt:
+            raise ValueError(
+                f"data_types.grad_accum_dtype={_acc!r} conflicts with "
+                f"communication_data_type={cdt!r} — they name the same "
+                "buffer (grads reduce at their accumulated dtype under "
+                "GSPMD)")
         self.eigenvalue = EigenvalueConfig(**pd.get("eigenvalue", {}))
         self.data_types = DataTypesConfig(**pd.get("data_types", {}))
         self.sparse_gradients: bool = pd.get("sparse_gradients", False)

@@ -319,6 +319,34 @@ class DeepSpeedEngine:
                 stability=ev.stability)
         self._gas_boundary_ctr = 0
         self.block_eigenvalue: Optional[Dict[str, float]] = None
+        if config.prescale_gradients or \
+                config.gradient_predivide_factor != 1.0:
+            # no-op BY DESIGN, not silently: the reference pre-divides
+            # fp16 grads to dodge overflow in large-DP ring reductions
+            # (engine.py:2339); here grads accumulate/reduce in fp32 (or
+            # the configured dtype) inside XLA, so the range concern the
+            # knob exists for does not arise and the final grads are
+            # identical either way.
+            if comm.get_rank() == 0:
+                logger.warning(
+                    "prescale_gradients/gradient_predivide_factor have "
+                    "no effect: gradient reduction runs at the "
+                    "accumulation dtype inside XLA (fp32 by default) — "
+                    "the fp16-range motivation does not apply")
+        if config.dump_state:
+            # reference dump_state: print the full engine configuration
+            # (rank-0 only — N hosts must not dump N copies)
+            if comm.get_rank() == 0:
+                config.print_config()
+            n_params = sum(int(np.prod(p.shape))
+                           for p in jax.tree.leaves(self.state.params))
+            log_dist(
+                f"engine state: {n_params / 1e6:.1f}M params, "
+                f"zero_stage={self.zero_stage} "
+                f"mixed_precision={self.mixed_precision} "
+                f"offload_optimizer={self._offload_cfg is not None} "
+                f"offload_param={self._param_offload_cfg is not None}",
+                ranks=[0])
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} "
             f"dtype={config.precision_dtype} mesh="
@@ -425,9 +453,14 @@ class DeepSpeedEngine:
         # data_types.grad_accum_dtype (constants.py:389-394): dtype of the
         # GAS accumulation buffer. Default fp32 (the reference's safe
         # default); bf16/fp16 halve accumulator HBM at a precision cost.
+        # communication_data_type (constants.py:119) maps onto the same
+        # buffer (conflict validated at config construction): under GSPMD
+        # the DP reduction happens at the accumulated grads' dtype, so
+        # the comm-bytes knob IS the accumulator dtype.
+        acc_key = (self.config.data_types.grad_accum_dtype or
+                   self.config.communication_data_type)
         acc_dtype = {"fp32": jnp.float32, "fp16": jnp.float16,
-                     "bf16": jnp.bfloat16, None: jnp.float32}[
-                         self.config.data_types.grad_accum_dtype]
+                     "bf16": jnp.bfloat16, None: jnp.float32}[acc_key]
         grad_spec = self.policy.spec_of(
             self.policy.grad_sharding(self.state.params))
         mesh = self.mesh
@@ -941,7 +974,25 @@ class DeepSpeedEngine:
                     "excludes compilation")
                 self._step_fn.lower(self.state, batch, rng).compile()
             self.flops_profiler.start_profile()
+        t_step = (time.perf_counter()
+                  if self.config.wall_clock_breakdown else None)
         self.state, metrics = self._step_fn(self.state, batch, rng)
+        if t_step is not None and self.global_steps > 0 and \
+                (self.global_steps + 1) % self.config.steps_per_print == 0:
+            # wall_clock_breakdown (reference EngineTimers): the fused
+            # step has no fwd/bwd/step phases to split — one synced step
+            # time on print steps is the honest breakdown. Step 1 is
+            # skipped (it would report XLA compile time). The host
+            # transfer is deliberate: through remote relays
+            # block_until_ready returns before execution finishes, so
+            # the fetch IS the barrier — the figure includes <=1 sync
+            # RTT.
+            jax.block_until_ready(metrics["loss"])
+            float(metrics["loss"])
+            log_dist(f"step {self.global_steps + 1}: "
+                     f"{(time.perf_counter() - t_step) * 1e3:.1f} ms "
+                     "(fused fwd+bwd+step, incl. one sync RTT)",
+                     ranks=[0])
         if self._eager_param_staging:
             self.state = self.state.replace(params=jax.device_put(
                 self.state.params, self._state_shardings.params))

@@ -96,3 +96,15 @@ def test_json_file_roundtrip(tmp_path):
     c = DeepSpeedConfig(str(p), dp_world_size=8)
     assert c.train_batch_size == 16
     assert c.optimizer.type == "AdamW"
+
+
+def test_communication_data_type_parses_and_validates():
+    from deepspeed_tpu.config.config import DeepSpeedConfig
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "communication_data_type": "fp16"},
+                        dp_world_size=1)
+    assert c.communication_data_type == "fp16"
+    with pytest.raises(ValueError, match="fp32/fp16/bf16"):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "communication_data_type": "int7"},
+                        dp_world_size=1)

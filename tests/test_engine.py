@@ -329,3 +329,34 @@ def test_memory_estimators():
     estimate_zero2_model_states_mem_needs_all_live(params, num_chips=8)
     estimate_zero3_model_states_mem_needs_all_cold(100_000, 10_000,
                                                    num_chips=8)
+
+
+@pytest.mark.slow
+def test_wired_runtime_knobs():
+    """dump_state prints, wall_clock_breakdown logs synced step times,
+    comm dtype conflicts are loud, prescale warns (act-or-raise audit).
+    (The repo logger is propagate=False with a pre-captured stdout
+    handler — attach a recording handler directly.)"""
+    import logging
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    records = []
+    h = logging.Handler()
+    h.emit = lambda r: records.append(r.getMessage())
+    ds_logger.addHandler(h)
+    try:
+        engine = build_engine(stage=0, gas=2, micro=1, extra={
+            "dump_state": True, "wall_clock_breakdown": True,
+            "steps_per_print": 1, "prescale_gradients": True,
+            "communication_data_type": "bf16"})
+        engine.train_batch(make_batch())   # step 1: breakdown skipped
+        engine.train_batch(make_batch())   # (compile time would mislead)
+    finally:
+        ds_logger.removeHandler(h)
+    text = "\n".join(records)
+    assert "engine state:" in text
+    assert "fused fwd+bwd+step" in text
+    assert "prescale_gradients" in text
+    with pytest.raises(ValueError, match="conflicts"):
+        build_engine(stage=0, gas=2, micro=1, extra={
+            "communication_data_type": "bf16",
+            "data_types": {"grad_accum_dtype": "fp32"}})
