@@ -75,6 +75,15 @@ class InferenceEngine:
         # activations are cast to model_config.dtype inside the forward
         self.model_config = dataclasses.replace(self.model_config,
                                                 dtype=self._act_dtype)
+        if self.config.quant.activation.enabled:
+            # w8a8: dynamic activation quant at the MLP GEMM seams
+            # (ops/int8_gemm.py) — only meaningful over int8-stored weights
+            if not self._weight_quant:
+                raise ValueError(
+                    "quant.activation.enabled (w8a8 GEMMs) requires int8 "
+                    "weight storage — set dtype='int8' or quant.enabled")
+            self.model_config = dataclasses.replace(self.model_config,
+                                                    int8_compute=True)
         self.mesh = mesh or self._build_mesh()
         if self.mesh is not None:
             tp = self.config.tp_size

@@ -93,3 +93,21 @@ def test_fused_transformer_int8_compute_end_to_end():
     a, b = outs[False][0][4:], outs[True][0][4:]
     agree = sum(int(x == y) for x, y in zip(a, b))
     assert agree >= 4, (a, b)
+
+
+def test_engine_activation_quant_config_wires_w8a8():
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig)
+    cfg = InferenceTransformerConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        dtype=jnp.float32)
+    eng = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+        dtype="int8", quant={"activation": {"enabled": True}}))
+    assert eng.model_config.int8_compute
+    out = eng.generate([[1, 2, 3]], max_new_tokens=2)
+    assert len(out[0]) == 5
+    with pytest.raises(ValueError, match="int8 weight storage"):
+        InferenceEngine(cfg, DeepSpeedInferenceConfig(
+            dtype="float32", quant={"activation": {"enabled": True}}))
