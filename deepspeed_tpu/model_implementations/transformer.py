@@ -56,7 +56,9 @@ class InferenceTransformerConfig:
     rotary_interleaved: bool = False         # True → GPT-J style pairs
     rotary_base: float = 10000.0
     parallel_attn_mlp: bool = False          # GPT-J / GPT-NeoX parallel block
-    activation: str = "gelu_new"             # gelu | gelu_new | relu
+    activation: str = "gelu_new"             # gelu | gelu_new | relu | silu
+    norm_type: str = "layernorm"             # layernorm | rmsnorm (LLaMA)
+    gated_mlp: bool = False                  # SwiGLU: wg gate projection
     layer_norm_eps: float = 1e-5
     tied_lm_head: bool = True
     attn_scale: Optional[float] = None       # default 1/sqrt(head_dim)
@@ -116,9 +118,15 @@ def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
         return (jax.random.normal(key, shape, jnp.float32)
                 / math.sqrt(fan_in)).astype(dt)
 
+    def norm():
+        p = {"scale": jnp.ones((E,), dt)}
+        if cfg.norm_type != "rmsnorm":   # RMSNorm has no bias (see
+            p["bias"] = jnp.zeros((E,), dt)   # _layer_norm dispatch)
+        return p
+
     params: Dict[str, Any] = {
         "wte": dense(next(keys), (cfg.vocab_size, E), E),
-        "ln_f": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+        "ln_f": norm(),
         "layers": [],
     }
     if cfg.positional == "learned":
@@ -127,7 +135,7 @@ def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
         params["lm_head"] = dense(next(keys), (E, cfg.vocab_size), E)
     for _ in range(cfg.n_layer):
         layer = {
-            "ln1": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+            "ln1": norm(),
             "attn": {
                 "wq": dense(next(keys), (E, H, D), E),
                 "wk": dense(next(keys), (E, KH, D), E),
@@ -145,10 +153,12 @@ def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
                 "bo": jnp.zeros((E,), dt),
             },
         }
+        if cfg.gated_mlp:
+            layer["mlp"]["wg"] = dense(jax.random.fold_in(next(keys), 7),
+                                       (E, F), E)
         if not (cfg.parallel_attn_mlp and cfg.pre_layer_norm
                 and cfg.positional == "rotary" and cfg.rotary_interleaved):
-            layer["ln2"] = {"scale": jnp.ones((E,), dt),
-                            "bias": jnp.zeros((E,), dt)}
+            layer["ln2"] = norm()
         params["layers"].append(layer)
     # MoE layers replace their MLP with a gate + stacked experts
     for i, layer in enumerate(params["layers"]):
@@ -194,8 +204,8 @@ def tp_param_specs(params: Dict) -> Dict:
             return P("tensor", None)
         if path.endswith("attn.wo"):
             return P("tensor", None, None)
-        if path.endswith("mlp.wi"):
-            return P(None, "tensor")
+        if path.endswith(("mlp.wi", "mlp.wg")):   # wg: SwiGLU gate, same
+            return P(None, "tensor")              # column-parallel split
         if path.endswith("mlp.bi"):
             return P("tensor")
         if path.endswith("mlp.wo"):
@@ -241,7 +251,13 @@ def _w(w, dtype):
 
 
 def _layer_norm(x, p, eps):
+    """LayerNorm, or RMSNorm when the param dict carries no bias (the
+    LLaMA family: no centering, scale only) — data-driven so every call
+    site serves both."""
     xf = x.astype(jnp.float32)
+    if "bias" not in p:
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+        return (y * p["scale"].astype(jnp.float32)).astype(x.dtype)
     mean = xf.mean(-1, keepdims=True)
     var = xf.var(-1, keepdims=True)
     y = (xf - mean) * jax.lax.rsqrt(var + eps)
@@ -256,6 +272,8 @@ def _act(x, kind):
         return jax.nn.gelu(x, approximate=False)
     if kind == "quick_gelu":                 # CLIP: x * sigmoid(1.702 x)
         return x * jax.nn.sigmoid(1.702 * x)
+    if kind in ("silu", "swish"):            # LLaMA/Mistral gate act
+        return jax.nn.silu(x)
     return jax.nn.gelu(x, approximate=True)  # gelu_new / gelu_fast
 
 
@@ -402,8 +420,15 @@ def _qkv(x, a, cfg, positions):
 
 
 def _mlp(x, m, cfg):
-    h = _act((maybe_int8_matmul(x, m["wi"], x.dtype, cfg.int8_compute)
-              + m["bi"]).astype(jnp.float32), cfg.activation)
+    up = maybe_int8_matmul(x, m["wi"], x.dtype, cfg.int8_compute) + m["bi"]
+    if "wg" in m:
+        # gated MLP (LLaMA SwiGLU): down(act(gate(x)) * up(x))
+        gate = _act(maybe_int8_matmul(x, m["wg"], x.dtype,
+                                      cfg.int8_compute)
+                    .astype(jnp.float32), cfg.activation)
+        h = gate * up.astype(jnp.float32)
+    else:
+        h = _act(up.astype(jnp.float32), cfg.activation)
     return maybe_int8_matmul(h.astype(x.dtype), m["wo"], x.dtype,
                              cfg.int8_compute) + m["bo"]
 

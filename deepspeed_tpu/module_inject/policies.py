@@ -482,6 +482,62 @@ class CLIPTextPolicy(HFPolicy):
 
 
 @register_policy
+class LlamaPolicy(HFPolicy):
+    """LLaMA / Mistral-style decoders (beyond the v0.8.0 snapshot —
+    the reference's policy table predates the family): RMSNorm,
+    SwiGLU gated MLP, non-interleaved full-dim rotary, GQA via
+    ``num_key_value_heads``, untied LM head."""
+    model_types = ("llama", "mistral")
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, \
+            hf.num_hidden_layers
+        D = E // H
+        KH = getattr(hf, "num_key_value_heads", H) or H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H, n_kv_head=KH,
+            intermediate_size=hf.intermediate_size,
+            positional="rotary", rotary_dim=D,
+            rotary_base=getattr(hf, "rope_theta", 10000.0),
+            activation="silu", norm_type="rmsnorm", gated_mlp=True,
+            layer_norm_eps=hf.rms_norm_eps,
+            tied_lm_head=bool(getattr(hf, "tie_word_embeddings", False)),
+            dtype=dtype)
+        base = model.model if hasattr(model, "model") else model
+        params = {
+            "wte": _t2j(base.embed_tokens.weight, dtype),
+            "ln_f": {"scale": _t2j(base.norm.weight, dtype)},
+            "layers": [],
+        }
+        if not cfg.tied_lm_head:
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+        zb = jnp.zeros((H, D), dtype)
+        zkb = jnp.zeros((KH, D), dtype)
+        for b in base.layers:
+            at = b.self_attn
+            params["layers"].append({
+                "ln1": {"scale": _t2j(b.input_layernorm.weight, dtype)},
+                "ln2": {"scale": _t2j(b.post_attention_layernorm.weight,
+                                      dtype)},
+                "attn": _attn_params(
+                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.k_proj, dtype).reshape(E, KH, D),
+                    _linear_w(at.v_proj, dtype).reshape(E, KH, D),
+                    zb, zkb, zkb,
+                    _linear_w(at.o_proj, dtype).reshape(H, D, E),
+                    jnp.zeros((E,), dtype)),
+                "mlp": {"wg": _linear_w(b.mlp.gate_proj, dtype),
+                        "wi": _linear_w(b.mlp.up_proj, dtype),
+                        "bi": jnp.zeros((cfg.ffn,), dtype),
+                        "wo": _linear_w(b.mlp.down_proj, dtype),
+                        "bo": jnp.zeros((E,), dtype)}})
+        return cfg, params
+
+
+@register_policy
 class MegatronGPT2Policy(HFPolicy):
     """Megatron-LM GPT-2 (reference MegatronLayerPolicy,
     replace_policy.py:405): pre-LN, per-head fused QKV, learned positions.

@@ -282,3 +282,26 @@ def test_megatron_gpt2_policy_from_state_dict():
                             jnp.asarray([[1, 2, 3]], jnp.int32))
     assert logits.shape == (1, 3, V)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_llama_parity(kv_heads):
+    """LLaMA family (beyond the v0.8.0 snapshot): RMSNorm + SwiGLU +
+    full-dim rotary + GQA, logits parity vs transformers."""
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=kv_heads, rms_norm_eps=1e-6,
+        attention_dropout=0.0, tie_word_embeddings=False))
+    _check_causal(hf, _ids())
+
+
+def test_mistral_parity():
+    torch.manual_seed(1)
+    hf = transformers.MistralForCausalLM(transformers.MistralConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, sliding_window=None,
+        attention_dropout=0.0))
+    _check_causal(hf, _ids())
