@@ -77,11 +77,20 @@ class InferenceEngine:
                                                 dtype=self._act_dtype)
         if self.config.quant.activation.enabled:
             # w8a8: dynamic activation quant at the MLP GEMM seams
-            # (ops/int8_gemm.py) — only meaningful over int8-stored weights
-            if not self._weight_quant:
+            # (ops/int8_gemm.py) — only meaningful over int8-stored
+            # weights, whether quantized HERE (config) or already stored
+            # quantized (serving-checkpoint reload)
+            def _tree_has_int8(tree):
+                for path, _ in jax.tree_util.tree_flatten_with_path(
+                        tree)[0]:
+                    if any(getattr(p, "key", None) == "q" for p in path):
+                        return True
+                return False
+            if not self._weight_quant and not _tree_has_int8(params):
                 raise ValueError(
                     "quant.activation.enabled (w8a8 GEMMs) requires int8 "
-                    "weight storage — set dtype='int8' or quant.enabled")
+                    "weight storage — set dtype='int8'/quant.enabled or "
+                    "load an int8 serving checkpoint")
             self.model_config = dataclasses.replace(self.model_config,
                                                     int8_compute=True)
         self.mesh = mesh or self._build_mesh()

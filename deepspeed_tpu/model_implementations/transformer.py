@@ -154,8 +154,7 @@ def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
             },
         }
         if cfg.gated_mlp:
-            layer["mlp"]["wg"] = dense(jax.random.fold_in(next(keys), 7),
-                                       (E, F), E)
+            layer["mlp"]["wg"] = dense(next(keys), (E, F), E)
         if not (cfg.parallel_attn_mlp and cfg.pre_layer_norm
                 and cfg.positional == "rotary" and cfg.rotary_interleaved):
             layer["ln2"] = norm()
@@ -423,9 +422,10 @@ def _mlp(x, m, cfg):
     up = maybe_int8_matmul(x, m["wi"], x.dtype, cfg.int8_compute) + m["bi"]
     if "wg" in m:
         # gated MLP (LLaMA SwiGLU): down(act(gate(x)) * up(x))
-        gate = _act(maybe_int8_matmul(x, m["wg"], x.dtype,
-                                      cfg.int8_compute)
-                    .astype(jnp.float32), cfg.activation)
+        g = maybe_int8_matmul(x, m["wg"], x.dtype, cfg.int8_compute)
+        if "bg" in m:
+            g = g + m["bg"]
+        gate = _act(g.astype(jnp.float32), cfg.activation)
         h = gate * up.astype(jnp.float32)
     else:
         h = _act(up.astype(jnp.float32), cfg.activation)

@@ -495,6 +495,9 @@ class LlamaPolicy(HFPolicy):
             hf.num_hidden_layers
         D = E // H
         KH = getattr(hf, "num_key_value_heads", H) or H
+        # Mistral's sliding-window attention maps onto the per-layer
+        # local_windows machinery (GPT-Neo uses the same)
+        window = getattr(hf, "sliding_window", None)
         cfg = InferenceTransformerConfig(
             vocab_size=hf.vocab_size,
             n_positions=hf.max_position_embeddings,
@@ -504,6 +507,7 @@ class LlamaPolicy(HFPolicy):
             rotary_base=getattr(hf, "rope_theta", 10000.0),
             activation="silu", norm_type="rmsnorm", gated_mlp=True,
             layer_norm_eps=hf.rms_norm_eps,
+            local_windows=((int(window),) * L if window else None),
             tied_lm_head=bool(getattr(hf, "tie_word_embeddings", False)),
             dtype=dtype)
         base = model.model if hasattr(model, "model") else model
@@ -514,8 +518,14 @@ class LlamaPolicy(HFPolicy):
         }
         if not cfg.tied_lm_head:
             params["lm_head"] = _linear_w(model.lm_head, dtype)
-        zb = jnp.zeros((H, D), dtype)
-        zkb = jnp.zeros((KH, D), dtype)
+        def bias(mod, shape):
+            # attention_bias/mlp_bias checkpoints carry real bias
+            # tensors; the common bias-less case maps to zeros
+            b = getattr(mod, "bias", None)
+            if b is None:
+                return jnp.zeros(shape, dtype)
+            return _t2j(b, dtype).reshape(shape)
+
         for b in base.layers:
             at = b.self_attn
             params["layers"].append({
@@ -526,14 +536,16 @@ class LlamaPolicy(HFPolicy):
                     _linear_w(at.q_proj, dtype).reshape(E, H, D),
                     _linear_w(at.k_proj, dtype).reshape(E, KH, D),
                     _linear_w(at.v_proj, dtype).reshape(E, KH, D),
-                    zb, zkb, zkb,
+                    bias(at.q_proj, (H, D)), bias(at.k_proj, (KH, D)),
+                    bias(at.v_proj, (KH, D)),
                     _linear_w(at.o_proj, dtype).reshape(H, D, E),
-                    jnp.zeros((E,), dtype)),
+                    bias(at.o_proj, (E,))),
                 "mlp": {"wg": _linear_w(b.mlp.gate_proj, dtype),
+                        "bg": bias(b.mlp.gate_proj, (cfg.ffn,)),
                         "wi": _linear_w(b.mlp.up_proj, dtype),
-                        "bi": jnp.zeros((cfg.ffn,), dtype),
+                        "bi": bias(b.mlp.up_proj, (cfg.ffn,)),
                         "wo": _linear_w(b.mlp.down_proj, dtype),
-                        "bo": jnp.zeros((E,), dtype)}})
+                        "bo": bias(b.mlp.down_proj, (E,))}})
         return cfg, params
 
 

@@ -305,3 +305,31 @@ def test_mistral_parity():
         num_key_value_heads=2, sliding_window=None,
         attention_dropout=0.0))
     _check_causal(hf, _ids())
+
+
+def test_mistral_sliding_window_maps_to_local_windows():
+    torch.manual_seed(2)
+    hf = transformers.MistralForCausalLM(transformers.MistralConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, sliding_window=8, attention_dropout=0.0))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.local_windows == (8, 8)
+    _check_causal(hf, _ids())   # windowed logits still match HF
+
+
+def test_llama_attention_bias_checkpoints():
+    torch.manual_seed(3)
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, attention_bias=True, mlp_bias=True,
+        attention_dropout=0.0, tie_word_embeddings=False))
+    # real bias tensors must be carried, not zeroed
+    assert hf.model.layers[0].self_attn.q_proj.bias is not None
+    with torch.no_grad():
+        for lyr in hf.model.layers:
+            lyr.self_attn.q_proj.bias.normal_()
+            lyr.mlp.gate_proj.bias.normal_()
+    _check_causal(hf, _ids())
