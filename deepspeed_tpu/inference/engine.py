@@ -232,7 +232,8 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0,
+                 top_p: float = 0.0, num_beams: int = 1,
+                 length_penalty: float = 1.0,
                  eos_token_id: Optional[int] = None,
                  attention_mask=None, seed: int = 0) -> list:
         """Greedy/sampled generation. ``input_ids``: a list of token lists
@@ -265,6 +266,31 @@ class InferenceEngine:
                 f"but config.max_out_tokens={self.config.max_out_tokens} "
                 "(the reference sizes its workspace from free HBM, "
                 "inference_context.h:124; here the budget is explicit)")
+        if num_beams > 1:
+            if float(temperature) > 0.0 or top_k or top_p:
+                raise ValueError(
+                    "beam search composes with greedy scoring only "
+                    "(sampling+beams is not supported, matching HF's "
+                    "separate code paths)")
+            # tiled prefill: every beam shares the prefix; one pass per
+            # beam is wasteful but keeps one prefill program for all modes
+            tiled_ids = np.repeat(ids, num_beams, axis=0)
+            tiled_len = np.repeat(lengths, num_beams, axis=0)
+            cache = self._make_cache(B * num_beams, max_seq)
+            logits, cache = self._prefill_jit(
+                self.params, input_ids=jnp.asarray(tiled_ids),
+                lengths=jnp.asarray(tiled_len), cache=cache)
+            loop = self._beam_loop(max_new_tokens, num_beams)
+            out_buf, n_gen, _ = loop(
+                self.params, logits, cache, jnp.asarray(lengths),
+                jnp.int32(-1 if eos_token_id is None else eos_token_id),
+                jnp.float32(length_penalty))
+            out_np = np.asarray(out_buf)
+            n_np = np.asarray(n_gen)
+            if t0 is not None:
+                self._model_times.append(_time.perf_counter() - t0)
+            return [np.asarray(ids[b, :lengths[b]]).tolist()
+                    + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
         cache = self._make_cache(B, max_seq)
         logits, cache = self._prefill_jit(
             self.params, input_ids=jnp.asarray(ids),
@@ -286,6 +312,85 @@ class InferenceEngine:
             self._model_times.append(_time.perf_counter() - t0)
         return [np.asarray(ids[b, :lengths[b]]).tolist()
                 + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
+
+    def _beam_loop(self, max_new_tokens: int, num_beams: int):
+        """Jitted beam search (the reference serves beams through HF's
+        patched ``generate`` over its fused forward, inference/engine.py:
+        523; here the whole search is ONE compiled program). Finished
+        beams freeze in place (t5x-style) — identical to HF's beam search
+        whenever no beam ends before the token budget, and a documented
+        simplification of the hypothesis pool when one does."""
+        key = ("beam", max_new_tokens, num_beams)
+        loop = self._gen_loops.get(key)
+        if loop is not None:
+            return loop
+        cfg = self.model_config
+        mesh = self.mesh
+        nb = num_beams
+
+        def run(params, logits, cache, prompt_lens, eos, length_penalty):
+            Bnb = logits.shape[0]
+            B = Bnb // nb
+            V = logits.shape[-1]
+            logp0 = jax.nn.log_softmax(
+                logits.astype(jnp.float32), -1).reshape(B, nb, V)
+            # all beams start from the same prefix: seed with the top-nb
+            # DISTINCT first tokens of beam 0's distribution
+            scores, tok = jax.lax.top_k(logp0[:, 0], nb)     # [B, nb]
+            out = jnp.zeros((B, nb, max_new_tokens), jnp.int32)
+            out = out.at[:, :, 0].set(tok)
+            finished = tok == eos
+            n_gen = jnp.ones((B, nb), jnp.int32)
+
+            def cond(c):
+                step, _, _, _, finished, _, _ = c
+                return (step < max_new_tokens) & \
+                    jnp.logical_not(finished.all())
+
+            def body(c):
+                step, tok, cache, scores, finished, out, n_gen = c
+                lg, cache = decode_step(params, cfg, tok.reshape(-1),
+                                        cache, mesh=mesh)
+                logp = jax.nn.log_softmax(
+                    lg.astype(jnp.float32), -1).reshape(B, nb, V)
+                # frozen-finished: a finished beam may only emit pad(0)
+                # at unchanged score
+                pad_row = jnp.full((V,), -jnp.inf).at[0].set(0.0)
+                logp = jnp.where(finished[:, :, None], pad_row, logp)
+                cand = scores[:, :, None] + logp            # [B, nb, V]
+                scores, flat = jax.lax.top_k(cand.reshape(B, nb * V), nb)
+                parent = flat // V                           # [B, nb]
+                tok = (flat % V).astype(jnp.int32)
+                flat_parent = (jnp.arange(B)[:, None] * nb +
+                               parent).reshape(-1)
+                cache = cache.replace(
+                    k=cache.k[:, flat_parent], v=cache.v[:, flat_parent],
+                    lengths=cache.lengths[flat_parent])
+                out = jnp.take_along_axis(out, parent[:, :, None], axis=1)
+                finished = jnp.take_along_axis(finished, parent, axis=1)
+                n_gen = jnp.take_along_axis(n_gen, parent, axis=1)
+                out = out.at[:, :, step].set(jnp.where(finished, 0, tok))
+                n_gen = n_gen + jnp.where(finished, 0, 1)
+                finished = finished | (tok == eos)
+                return step + 1, tok, cache, scores, finished, out, n_gen
+
+            carry = (jnp.int32(1), tok, cache, scores, finished, out,
+                     n_gen)
+            step, tok, cache, scores, finished, out, n_gen = \
+                jax.lax.while_loop(cond, body, carry)
+            # HF convention (BeamSearchScorer): rank by
+            # score / full_len**penalty, full_len = prompt + generated
+            full_len = (prompt_lens[:, None] + n_gen).astype(jnp.float32)
+            norm = scores / (full_len ** length_penalty)
+            best = jnp.argmax(norm, axis=1)                  # [B]
+            sel = jnp.take_along_axis(
+                out, best[:, None, None], axis=1)[:, 0]      # [B, T]
+            n_sel = jnp.take_along_axis(n_gen, best[:, None], axis=1)[:, 0]
+            return sel, n_sel, cache
+
+        loop = jax.jit(run, donate_argnames=("cache",))
+        self._gen_loops[key] = loop
+        return loop
 
     def _generate_loop(self, max_new_tokens: int, sampled: bool,
                        top_k_on: bool, top_p_on: bool = False):

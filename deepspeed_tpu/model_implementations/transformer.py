@@ -205,7 +205,7 @@ def tp_param_specs(params: Dict) -> Dict:
             return P("tensor", None, None)
         if path.endswith(("mlp.wi", "mlp.wg")):   # wg: SwiGLU gate, same
             return P(None, "tensor")              # column-parallel split
-        if path.endswith("mlp.bi"):
+        if path.endswith(("mlp.bi", "mlp.bg")):
             return P("tensor")
         if path.endswith("mlp.wo"):
             return P("tensor", None)

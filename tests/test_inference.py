@@ -277,3 +277,47 @@ def test_local_window_attention_layers():
     a = np.asarray(eng.forward(jnp.asarray([prompt], jnp.int32)))
     b = np.asarray(eng2.forward(jnp.asarray([prompt], jnp.int32)))
     assert not np.allclose(a[0, -1], b[0, -1])
+
+
+def test_beam_search_matches_hf():
+    """num_beams>1: our jitted beam search must reproduce transformers'
+    beam search exactly on a converted model (fixed length, no EOS —
+    the regime where the frozen-finished simplification is exact)."""
+    import torch
+    import transformers
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+    hf.eval()
+    prompt = [[5, 9, 2, 7]]
+    want = hf.generate(
+        torch.tensor(prompt), max_new_tokens=6, num_beams=3,
+        do_sample=False, eos_token_id=None, pad_token_id=0,
+        early_stopping=False, length_penalty=1.0)[0].tolist()
+    eng = InferenceEngine(hf, DeepSpeedInferenceConfig(dtype="float32"))
+    got = eng.generate(prompt, max_new_tokens=6, num_beams=3,
+                       length_penalty=1.0)[0]
+    assert got == want, (got, want)
+    # beams must be able to beat greedy on score; at minimum they differ
+    # or agree legitimately — check the API also handles batches
+    got2 = eng.generate([[5, 9], [44, 3, 17]], max_new_tokens=4,
+                        num_beams=2)
+    assert len(got2) == 2 and len(got2[0]) == 6 and len(got2[1]) == 7
+
+
+def test_beam_search_eos_stops_and_validates():
+    cfg = InferenceTransformerConfig(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        dtype=jnp.float32)
+    eng = InferenceEngine(cfg)
+    # zero every weight: logits become uniform, greedy/beam pick token 0
+    # deterministically — with eos_token_id=0 the top beam must finish on
+    # its FIRST generated token and win the length-normalized ranking
+    eng.params = jax.tree.map(jnp.zeros_like, eng.params)
+    out = eng.generate([[1, 2, 3]], max_new_tokens=8, num_beams=2,
+                       eos_token_id=0)
+    assert out[0] == [1, 2, 3, 0], out   # stopped at eos, not the budget
+    with pytest.raises(ValueError, match="beam search"):
+        eng.generate([[1, 2]], max_new_tokens=2, num_beams=2,
+                     temperature=0.7)
