@@ -234,6 +234,8 @@ class InferenceEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, num_beams: int = 1,
                  length_penalty: float = 1.0,
+                 repetition_penalty: float = 1.0,
+                 min_new_tokens: int = 0,
                  eos_token_id: Optional[int] = None,
                  attention_mask=None, seed: int = 0) -> list:
         """Greedy/sampled generation. ``input_ids``: a list of token lists
@@ -272,6 +274,10 @@ class InferenceEngine:
                     "beam search composes with greedy scoring only "
                     "(sampling+beams is not supported, matching HF's "
                     "separate code paths)")
+            if float(repetition_penalty) != 1.0 or min_new_tokens:
+                raise NotImplementedError(
+                    "repetition_penalty/min_new_tokens are wired into "
+                    "the greedy/sampled loop, not beam search")
             # tiled prefill: every beam shares the prefix; one pass per
             # beam is wasteful but keeps one prefill program for all modes
             tiled_ids = np.repeat(ids, num_beams, axis=0)
@@ -296,13 +302,28 @@ class InferenceEngine:
             self.params, input_ids=jnp.asarray(ids),
             lengths=jnp.asarray(lengths), cache=cache)
 
+        rep_on = float(repetition_penalty) != 1.0
         loop = self._generate_loop(max_new_tokens, float(temperature) > 0.0,
-                                   int(top_k) > 0, float(top_p) > 0.0)
+                                   int(top_k) > 0, float(top_p) > 0.0,
+                                   rep_on)
+        # presence mask over the PROMPT (HF's repetition penalty scores
+        # every prior token, context included); pads (beyond lengths) and
+        # the loop's generated tokens extend it on device
+        if rep_on:
+            V = self.model_config.vocab_size
+            presence = np.zeros((B, V), bool)
+            for b in range(B):
+                presence[b, np.asarray(ids[b, :lengths[b]])] = True
+            presence = jnp.asarray(presence)
+        else:
+            presence = jnp.zeros((B, 1), bool)   # unused placeholder
         out_buf, n_gen, _ = loop(
             self.params, logits, cache, jax.random.PRNGKey(seed),
             jnp.float32(temperature), jnp.int32(top_k),
             jnp.float32(top_p),
-            jnp.int32(-1 if eos_token_id is None else eos_token_id))
+            jnp.int32(-1 if eos_token_id is None else eos_token_id),
+            presence, jnp.float32(repetition_penalty),
+            jnp.int32(min_new_tokens))
         # ONE host sync per generation (the reference built CUDA graphs to
         # kill per-token launch overhead, inference/engine.py:454-473; the
         # per-token RTT through a remote relay is the TPU analog).
@@ -393,19 +414,34 @@ class InferenceEngine:
         return loop
 
     def _generate_loop(self, max_new_tokens: int, sampled: bool,
-                       top_k_on: bool, top_p_on: bool = False):
+                       top_k_on: bool, top_p_on: bool = False,
+                       rep_on: bool = False):
         """Compile (and cache) the whole decode loop as ONE program: a
         ``lax.while_loop`` over the donated KV cache with on-device
         sampling and EOS bookkeeping. Early-exits when every row is done.
         Only structure is baked into the compile key (length, greedy vs
-        sampled, top-k on/off); temperature/top_k/eos ride as traced
-        scalars so sweeps over them don't recompile."""
-        key = (max_new_tokens, sampled, top_k_on, top_p_on)
+        sampled, top-k/top-p/repetition on/off); temperature/top_k/eos/
+        penalties ride as traced scalars so sweeps don't recompile."""
+        key = (max_new_tokens, sampled, top_k_on, top_p_on, rep_on)
         loop = self._gen_loops.get(key)
         if loop is not None:
             return loop
         cfg = self.model_config
         mesh = self.mesh  # MoE: decode hot path needs the EP constraint too
+
+        def adjust(lg, presence, rep, min_left, eos):
+            if rep_on:
+                # HF RepetitionPenaltyLogitsProcessor: seen tokens'
+                # logits divide (positive) or multiply (negative) by p
+                pen = jnp.where(lg > 0, lg / rep, lg * rep)
+                lg = jnp.where(presence, pen, lg)
+            # min_new_tokens: suppress EOS while the floor is unmet
+            # (HF MinNewTokensLengthLogitsProcessor); eos==-1 disables
+            lg = jnp.where(
+                (min_left > 0) & (eos >= 0) &
+                (jnp.arange(lg.shape[-1])[None, :] == eos),
+                -jnp.inf, lg)
+            return lg
 
         def select(lg, rng, temperature, top_k, top_p):
             if not sampled:
@@ -429,33 +465,42 @@ class InferenceEngine:
             return jax.random.categorical(rng, lg, -1).astype(jnp.int32)
 
         def run(params, logits, cache, rng, temperature, top_k, top_p,
-                eos):
+                eos, presence, rep, min_new):
             B = logits.shape[0]
             # token 0 comes from the prefill logits; each loop iteration
             # decodes the previous token first, so the final token never
             # pays a wasted trailing decode_step. eos == -1 disables EOS
             # stopping (token ids are non-negative).
             rng, sub = jax.random.split(rng)
+            logits = adjust(logits, presence, rep, min_new, eos)
             tok = select(logits, sub, temperature, top_k, top_p)
+            if rep_on:
+                presence = presence.at[jnp.arange(B), tok].set(True)
             out = jnp.zeros((B, max_new_tokens), jnp.int32).at[:, 0].set(tok)
             done = tok == eos
             n_gen = jnp.ones((B,), jnp.int32)
 
             def cond(c):
-                step, _, _, done, _, _, _ = c
+                step = c[0]
+                done = c[3]
                 return (step < max_new_tokens) & jnp.logical_not(done.all())
 
             def body(c):
-                step, tok, cache, done, out, n_gen, rng = c
+                step, tok, cache, done, out, n_gen, rng, presence = c
                 lg, cache = decode_step(params, cfg, tok, cache, mesh=mesh)
                 rng, sub = jax.random.split(rng)
+                lg = adjust(lg, presence, rep, min_new - step, eos)
                 nxt = select(lg, sub, temperature, top_k, top_p)
+                if rep_on:
+                    presence = presence.at[jnp.arange(B), nxt].set(True)
                 out = out.at[:, step].set(jnp.where(done, 0, nxt))
                 n_gen = n_gen + jnp.where(done, 0, 1)
                 done = done | (nxt == eos)
-                return step + 1, nxt, cache, done, out, n_gen, rng
+                return (step + 1, nxt, cache, done, out, n_gen, rng,
+                        presence)
 
-            carry = (jnp.int32(1), tok, cache, done, out, n_gen, rng)
+            carry = (jnp.int32(1), tok, cache, done, out, n_gen, rng,
+                     presence)
             carry = jax.lax.while_loop(cond, body, carry)
             # the final cache is returned (and dropped by the caller) so
             # the donated input cache can actually alias an output

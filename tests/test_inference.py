@@ -321,3 +321,38 @@ def test_beam_search_eos_stops_and_validates():
     with pytest.raises(ValueError, match="beam search"):
         eng.generate([[1, 2]], max_new_tokens=2, num_beams=2,
                      temperature=0.7)
+
+
+def test_repetition_penalty_and_min_new_tokens_match_hf():
+    import torch
+    import transformers
+    torch.manual_seed(4)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+    hf.eval()
+    prompt = [[5, 9, 2, 7, 9]]
+    eng = InferenceEngine(hf, DeepSpeedInferenceConfig(dtype="float32"))
+    # repetition penalty (greedy): token-for-token HF agreement
+    want = hf.generate(torch.tensor(prompt), max_new_tokens=8,
+                       do_sample=False, repetition_penalty=1.5,
+                       eos_token_id=None, pad_token_id=0)[0].tolist()
+    got = eng.generate(prompt, max_new_tokens=8,
+                       repetition_penalty=1.5)[0]
+    assert got == want, (got, want)
+    # the penalty changes the trajectory (it binds)
+    plain = eng.generate(prompt, max_new_tokens=8)[0]
+    assert plain != got
+    # min_new_tokens: eos suppressed until the floor is met. Zero weights
+    # → uniform logits → greedy emits token 0 (== eos) immediately;
+    # the floor forces exactly min_new non-eos tokens first.
+    cfg = InferenceTransformerConfig(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        dtype=jnp.float32)
+    zeng = InferenceEngine(cfg)
+    zeng.params = jax.tree.map(jnp.zeros_like, zeng.params)
+    out = zeng.generate([[1, 2]], max_new_tokens=8, eos_token_id=0,
+                        min_new_tokens=4)[0]
+    assert len(out) == 2 + 4 + 1   # 4 forced non-eos tokens, then eos
+    short = zeng.generate([[1, 2]], max_new_tokens=8, eos_token_id=0)[0]
+    assert len(short) == 3
