@@ -302,6 +302,10 @@ class InferenceEngine:
             self.params, input_ids=jnp.asarray(ids),
             lengths=jnp.asarray(lengths), cache=cache)
 
+        if float(repetition_penalty) <= 0.0:
+            raise ValueError(
+                "repetition_penalty must be strictly positive (HF raises "
+                "the same); 1.0 disables it")
         rep_on = float(repetition_penalty) != 1.0
         loop = self._generate_loop(max_new_tokens, float(temperature) > 0.0,
                                    int(top_k) > 0, float(top_p) > 0.0,

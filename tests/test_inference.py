@@ -356,3 +356,12 @@ def test_repetition_penalty_and_min_new_tokens_match_hf():
     assert len(out) == 2 + 4 + 1   # 4 forced non-eos tokens, then eos
     short = zeng.generate([[1, 2]], max_new_tokens=8, eos_token_id=0)[0]
     assert len(short) == 3
+
+
+def test_repetition_penalty_validation():
+    cfg = InferenceTransformerConfig(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        dtype=jnp.float32)
+    eng = InferenceEngine(cfg)
+    with pytest.raises(ValueError, match="strictly positive"):
+        eng.generate([[1, 2]], max_new_tokens=2, repetition_penalty=0.0)
