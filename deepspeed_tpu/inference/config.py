@@ -77,6 +77,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     max_out_tokens: int = Field(default=1024, alias="max_tokens")
     min_out_tokens: int = 1
     max_batch_size: int = 8
+    # long-context serving: shard the KV cache sequence dim over a `seq`
+    # mesh axis of this extent (flash-decoding-style distributed softmax)
+    seq_parallel_size: int = Field(default=1, alias="sp_size", ge=1)
     # accepted for API parity; jit compile-caching subsumes CUDA graphs
     enable_cuda_graph: bool = False
     checkpoint: Optional[Any] = None

@@ -94,6 +94,14 @@ class InferenceEngine:
             self.model_config = dataclasses.replace(self.model_config,
                                                     int8_compute=True)
         self.mesh = mesh or self._build_mesh()
+        if self.config.seq_parallel_size > 1:
+            if self.mesh is None or "seq" not in self.mesh.axis_names:
+                raise ValueError("seq_parallel_size>1 needs a mesh with "
+                                 "a 'seq' axis")
+            # the decode attention must take the GSPMD-partitionable
+            # path — flag it on the model config
+            self.model_config = dataclasses.replace(self.model_config,
+                                                    seq_shard_kv=True)
         if self.mesh is not None:
             tp = self.config.tp_size
             if self.model_config.kv_heads % tp or \
@@ -125,16 +133,18 @@ class InferenceEngine:
         tp = self.config.tp_size
         ep = (self.config.moe.ep_size
               if self.model_config.num_experts > 0 else 1)
-        if tp <= 1 and ep <= 1:
+        sp = self.config.seq_parallel_size
+        if tp <= 1 and ep <= 1 and sp <= 1:
             return None
         devs = jax.devices()
-        if len(devs) < tp * ep:
-            raise ValueError(f"tp_size={tp} * ep_size={ep} but only "
-                             f"{len(devs)} devices")
-        # expert outermost (EP all-to-alls are per-MoE-layer; TP
-        # allreduces are per-GEMM and want the innermost ICI)
-        return Mesh(np.asarray(devs[:ep * tp]).reshape(ep, tp),
-                    ("expert", "tensor"))
+        if len(devs) < tp * ep * sp:
+            raise ValueError(f"tp_size={tp} * ep_size={ep} * "
+                             f"sp_size={sp} but only {len(devs)} devices")
+        # expert outermost (EP all-to-alls are per-MoE-layer), seq next
+        # (per-layer attention reductions), TP innermost (per-GEMM
+        # allreduces want the tightest ICI)
+        return Mesh(np.asarray(devs[:ep * sp * tp]).reshape(ep, sp, tp),
+                    ("expert", "seq", "tensor"))
 
     def _place_params(self, params):
         dtype = self._act_dtype
@@ -177,7 +187,15 @@ class InferenceEngine:
                            self.model_config.head_dim,
                            dtype=self._act_dtype)
         if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P(None, None, None, "tensor", None))
+            # long-context: the S dim shards over the seq axis — GSPMD
+            # turns the decode softmax into the shard-local
+            # score/logsumexp + cross-shard combine of flash-decoding,
+            # so per-chip cache HBM drops by sp_size (beyond the
+            # v0.8.0 reference, whose KV cache is single-GPU-resident)
+            seq_ax = ("seq" if "seq" in self.mesh.axis_names and
+                      self.mesh.shape["seq"] > 1 else None)
+            sh = NamedSharding(self.mesh,
+                               P(None, None, seq_ax, "tensor", None))
             cache = cache.replace(
                 k=jax.device_put(cache.k, sh),
                 v=jax.device_put(cache.v, sh))

@@ -59,6 +59,10 @@ class InferenceTransformerConfig:
     activation: str = "gelu_new"             # gelu | gelu_new | relu | silu
     norm_type: str = "layernorm"             # layernorm | rmsnorm (LLaMA)
     gated_mlp: bool = False                  # SwiGLU: wg gate projection
+    # KV cache S dim sharded over the mesh `seq` axis: the decode
+    # attention must take the XLA path (GSPMD partitions its softmax;
+    # the Pallas kernel is single-shard)
+    seq_shard_kv: bool = False
     layer_norm_eps: float = 1e-5
     tied_lm_head: bool = True
     attn_scale: Optional[float] = None       # default 1/sqrt(head_dim)
@@ -379,7 +383,8 @@ def _decode_attention(q, k_cache, v_cache, live,
     KH = k_cache.shape[2]
     S = k_cache.shape[1]
     if cfg.positional != "alibi" and window is None \
-            and jax.default_backend() == "tpu" and H == KH:
+            and jax.default_backend() == "tpu" and H == KH \
+            and not cfg.seq_shard_kv:
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         kc = jnp.swapaxes(k_cache, 1, 2)  # [B, KH, S, D]
         vc = jnp.swapaxes(v_cache, 1, 2)

@@ -91,7 +91,7 @@ class TestMoEInference:
             moe={"ep_size": 2}))
         assert eng.mesh is not None and \
             dict(zip(eng.mesh.axis_names, eng.mesh.devices.shape)) == \
-            {"expert": 2, "tensor": 2}
+            {"expert": 2, "seq": 1, "tensor": 2}
         got = eng.forward(ids)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
