@@ -1,0 +1,151 @@
+"""Property-based tests (hypothesis) for the pure-math components:
+round-trip identities and error bounds that example-based tests can only
+spot-check."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+SETTINGS = dict(max_examples=25, deadline=None)
+
+
+# ----------------------------------------------------- megatron shards
+@settings(**SETTINGS)
+@given(h=st.sampled_from([4, 8, 16]), world=st.sampled_from([1, 2, 4]),
+       ver=st.sampled_from([1.0, 2.0]), seed=st.integers(0, 2**16))
+def test_megatron_split_merge_identity(h, world, ver, seed):
+    from deepspeed_tpu.module_inject.megatron_shards import (
+        merge_megatron_shards, split_megatron_state_dict)
+    rng = np.random.default_rng(seed)
+    sd = {
+        "l.attention.query_key_value.weight":
+            rng.normal(size=(3 * h * world, h)).astype(np.float32),
+        "l.attention.dense.weight":
+            rng.normal(size=(h, h * world)).astype(np.float32),
+        "l.mlp.dense_h_to_4h.weight":
+            rng.normal(size=(4 * h * world, h)).astype(np.float32),
+        "l.mlp.dense_4h_to_h.weight":
+            rng.normal(size=(h, 4 * h * world)).astype(np.float32),
+        "l.input_layernorm.weight":
+            rng.normal(size=(h,)).astype(np.float32),
+    }
+    shards = [split_megatron_state_dict(sd, world, r,
+                                        checkpoint_version=ver)
+              for r in range(world)]
+    merged = merge_megatron_shards(shards, checkpoint_version=ver)
+    for k in sd:
+        np.testing.assert_allclose(merged[k], sd[k], atol=1e-6,
+                                   err_msg=k)
+
+
+# ----------------------------------------------------- sparse rows
+@settings(**SETTINGS)
+@given(rows=st.integers(8, 64), d=st.sampled_from([1, 4, 8]),
+       support=st.integers(0, 7), seed=st.integers(0, 2**16))
+def test_sparse_rows_identity_when_capacity_covers(rows, d, support,
+                                                   seed):
+    from deepspeed_tpu.runtime.sparse_tensor import SparseRows
+    rng = np.random.default_rng(seed)
+    dense = np.zeros((rows, d), np.float32)
+    idx = rng.choice(rows, size=min(support, rows - 1), replace=False)
+    for i in idx:
+        dense[i] = rng.normal(size=d)
+    cap = min(7, rows - 1)
+    sp = SparseRows.from_dense(jnp.asarray(dense), capacity=cap)
+    np.testing.assert_array_equal(np.asarray(sp.to_dense(rows)), dense)
+
+
+# ----------------------------------------------------- quantizer bound
+@settings(**SETTINGS)
+@given(rows=st.sampled_from([16, 32]), cols=st.sampled_from([8, 32]),
+       group=st.sampled_from([4, 8, 16]), seed=st.integers(0, 2**16))
+def test_int8_weight_quant_error_bound(rows, cols, group, seed):
+    """|w - dequant(quant(w))| <= scale/2, scale = group absmax / 127."""
+    from deepspeed_tpu.module_inject.quantize import (dequantize_weight,
+                                                      quantize_weight)
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(rows, cols)).astype(np.float32) * \
+        rng.uniform(0.1, 10)
+    qw = quantize_weight(w, group_size=group)
+    err = np.abs(np.asarray(dequantize_weight(qw)) - w)
+    scale = np.asarray(qw["scale"])        # [rows, 1]
+    assert np.all(err <= scale / 2 + 1e-7)
+
+
+# ----------------------------------------------------- int8 gemm bound
+@settings(**SETTINGS)
+@given(k=st.sampled_from([16, 64]), n=st.sampled_from([8, 32]),
+       seed=st.integers(0, 2**16))
+def test_int8_matmul_close_to_dequant(k, n, seed):
+    from deepspeed_tpu.module_inject.quantize import (dequantize_weight,
+                                                      quantize_weight)
+    from deepspeed_tpu.ops.int8_gemm import int8_matmul
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(3, k)), jnp.float32)
+    qw = quantize_weight(rng.normal(size=(k, n)).astype(np.float32),
+                         group_size=8)
+    got = np.asarray(int8_matmul(x, qw))
+    want = np.asarray(x) @ np.asarray(dequantize_weight(qw))
+    denom = np.abs(want).mean() + 1e-6
+    assert np.abs(got - want).mean() / denom < 0.05
+
+
+# ----------------------------------------------------- ddim identity
+@settings(**SETTINGS)
+@given(alpha=st.floats(0.05, 0.95), seed=st.integers(0, 2**16))
+def test_ddim_full_denoise_recovers_x0(alpha, seed):
+    from deepspeed_tpu.model_implementations.diffusers.scheduler import (
+        ddim_step)
+    rng = np.random.default_rng(seed)
+    x0 = jnp.asarray(rng.normal(size=(1, 4, 4, 2)), jnp.float32)
+    eps = jnp.asarray(rng.normal(size=(1, 4, 4, 2)), jnp.float32)
+    a = jnp.float32(alpha)
+    xt = jnp.sqrt(a) * x0 + jnp.sqrt(1 - a) * eps
+    out = ddim_step(eps, xt, a, jnp.float32(1.0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x0),
+                               atol=5e-4)
+
+
+# ----------------------------------------------------- partitions
+@settings(**SETTINGS)
+@given(n=st.integers(1, 200), parts=st.integers(1, 16))
+def test_partition_uniform_invariants(n, parts):
+    from deepspeed_tpu.parallel.pipe.module import partition_uniform
+    bounds = partition_uniform(n, parts)
+    assert bounds[0] == 0 and bounds[-1] == n
+    assert len(bounds) == parts + 1
+    sizes = [b - a for a, b in zip(bounds, bounds[1:])]
+    assert all(s >= 0 for s in sizes)
+    assert max(sizes) - min(sizes) <= 1
+
+
+@settings(**SETTINGS)
+@given(weights=st.lists(st.floats(0.01, 10), min_size=1, max_size=40),
+       parts=st.integers(1, 8))
+def test_partition_balanced_covers_and_orders(weights, parts):
+    from deepspeed_tpu.parallel.pipe.module import partition_balanced
+    parts = min(parts, len(weights))
+    bounds = partition_balanced(weights, parts)
+    assert bounds[0] == 0 and bounds[-1] == len(weights)
+    assert all(a <= b for a, b in zip(bounds, bounds[1:]))
+
+
+# ----------------------------------------------------- tuner budget
+@settings(**SETTINGS)
+@given(n=st.integers(1, 30), budget=st.integers(1, 30),
+       seed=st.integers(0, 2**16))
+def test_random_tuner_budget_and_no_replacement(n, budget, seed):
+    from deepspeed_tpu.autotuning.tuner import RandomTuner
+    cands = [{"i": i} for i in range(n)]
+    t = RandomTuner(cands, max_trials=budget, seed=seed)
+    seen = []
+    while True:
+        i = t.next_trial()
+        if i is None:
+            break
+        seen.append(i)
+    assert len(seen) == min(n, budget)
+    assert len(set(seen)) == len(seen)
