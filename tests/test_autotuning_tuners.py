@@ -244,3 +244,33 @@ def test_write_trial_script_shape(tmp_path):
     text = open(p).read()
     assert "build_engine(cfg)" in text and "json.dumps" in text
     compile(text, p, "exec")       # syntactically valid
+
+
+def test_dstpu_autotune_cli_end_to_end(tmp_path):
+    """The launcher-level autotuning entry (reference runner.py:351):
+    synthetic trial script, subprocess trials, best-config artifact."""
+    import subprocess
+    import sys
+    script = tmp_path / "trial.py"
+    script.write_text(
+        "import json, sys\n"
+        "cfg = json.load(open(sys.argv[1]))\n"
+        "m = cfg['train_micro_batch_size_per_gpu']\n"
+        "s = cfg['zero_optimization']['stage']\n"
+        "print(json.dumps({'throughput': m * 10.0 - s, 'latency_s': 1.0/m}))\n")
+    cli = os.path.join(os.path.dirname(__file__), "..", "bin",
+                       "dstpu_autotune")
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+        [os.path.join(os.path.dirname(__file__), "..")] +
+        os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+    p = subprocess.run(
+        [sys.executable, cli, "--trial-script", str(script),
+         "--results-dir", str(tmp_path / "res"), "--micro", "1", "2",
+         "--stages", "0", "1", "--timeout", "60"],
+        capture_output=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stderr.decode()[-500:]
+    summary = json.loads(p.stdout.decode().strip().splitlines()[-1])
+    assert summary["best_metrics"]["throughput"] == 20.0   # mbs2, z0
+    best = json.loads((tmp_path / "res" / "best_config.json").read_text())
+    assert best["train_micro_batch_size_per_gpu"] == 2
+    assert (tmp_path / "res" / "autotuner_results.json").exists()
