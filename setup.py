@@ -29,5 +29,6 @@ setup(
     extras_require={"hf": ["transformers", "safetensors"],
                     "monitor": ["tensorboard", "wandb"]},
     scripts=["bin/dstpu", "bin/dstpu_report", "bin/dstpu_elastic",
-             "bin/dstpu_bench"],
+             "bin/dstpu_bench", "bin/dstpu_ssh", "bin/dstpu_aio",
+             "bin/dstpu_autotune"],
 )
