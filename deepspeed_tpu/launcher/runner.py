@@ -56,6 +56,16 @@ def parse_args(args=None):
                    help="TPU resource name (gcloud launcher)")
     p.add_argument("--elastic_training", action="store_true")
     p.add_argument("--force_multi", action="store_true")
+    # reference runner.py:351: `deepspeed --autotuning {run,tune}` runs
+    # the autotuner before/instead of training. Here the user script IS
+    # the trial script (prints one metrics-JSON line; see
+    # autotuning.write_trial_script) and the search runs locally.
+    p.add_argument("--autotuning", type=str, default="",
+                   choices=("", "run", "tune"),
+                   help="tune: search and write best_config.json; "
+                        "run: tune then launch the script with it")
+    p.add_argument("--autotuning_results", type=str,
+                   default="autotune_results")
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(args)
@@ -184,8 +194,29 @@ def _validate_elastic(args, active) -> None:
     logger.info(f"elastic: batch={batch} world={world} valid={valid}")
 
 
+def run_autotuning(args) -> int:
+    """`--autotuning tune|run` (reference runner.py:351): grid-search the
+    user TRIAL script via the subprocess scheduler; `run` re-launches the
+    script with the winning config on argv[1]."""
+    from deepspeed_tpu.autotuning import Autotuner, ResourceManager
+    rm = ResourceManager(args.user_script, args.autotuning_results)
+    tuner = Autotuner(engine_builder=None, batch_builder=None,
+                      base_config={}, resource_manager=rm)
+    out = tuner.tune()
+    best = os.path.join(args.autotuning_results, "best_config.json")
+    with open(best, "w") as f:
+        json.dump(out["best_config"], f, indent=2)
+    logger.info(f"autotuning best: {out['best_metrics']} -> {best}")
+    if args.autotuning == "run":
+        return subprocess.call([sys.executable, args.user_script, best,
+                                *args.user_args])
+    return 0
+
+
 def main(args=None):
     args = parse_args(args)
+    if args.autotuning:
+        return run_autotuning(args)
     resources = fetch_hostfile(args.hostfile)
 
     if not resources and not args.force_multi:

@@ -227,3 +227,37 @@ def test_single_host_launch_end_to_end(tmp_path):
     payload = json.loads(out.stdout.strip().splitlines()[-1])
     assert payload["rank"] == "0" and payload["world"] == "1"
     assert payload["coord"].endswith(":29500")
+
+
+def test_runner_autotuning_tune_and_run(tmp_path):
+    """`dstpu --autotuning {tune,run}` (reference runner.py:351)."""
+    from deepspeed_tpu.launcher import runner as runner_mod
+    trial = tmp_path / "trial.py"
+    trial.write_text(
+        "import json, sys\n"
+        "cfg = json.load(open(sys.argv[1]))\n"
+        "m = cfg['train_micro_batch_size_per_gpu']\n"
+        "print(json.dumps({'throughput': m * 10.0 if m <= 4 else 1.0,\n"
+        "                  'latency_s': 1.0}))\n")
+    res = tmp_path / "res"
+    rc = runner_mod.main(["--autotuning", "tune",
+                          "--autotuning_results", str(res), str(trial)])
+    assert rc == 0
+    import json as _json
+    best = _json.loads((res / "best_config.json").read_text())
+    assert best["train_micro_batch_size_per_gpu"] == 4
+    # `run`: the trial script is re-executed with the best config path
+    marker = tmp_path / "ran.txt"
+    trial2 = tmp_path / "trial2.py"
+    trial2.write_text(
+        "import json, sys\n"
+        "cfg = json.load(open(sys.argv[1]))\n"
+        "open(%r, 'a').write(str(cfg['train_micro_batch_size_per_gpu'])\n"
+        "                    + '\\n')\n"
+        "print(json.dumps({'throughput': 1.0, 'latency_s': 1.0}))\n"
+        % str(marker))
+    rc = runner_mod.main(["--autotuning", "run",
+                          "--autotuning_results",
+                          str(tmp_path / "res2"), str(trial2)])
+    assert rc == 0
+    assert marker.exists()
