@@ -1,0 +1,39 @@
+"""Shared tune-and-write-best flow for the two autotuning entry points
+(``bin/dstpu_autotune`` and ``dstpu --autotuning``) — one implementation
+so the CLIs cannot drift."""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+
+def tune_from_cli(trial_script: str, results_dir: str,
+                  base_config: Optional[Dict] = None,
+                  micro_batches: Sequence[int] = (1, 2, 4, 8),
+                  zero_stages: Sequence[int] = (0, 1, 2, 3),
+                  mesh_shapes=None,
+                  tuner_type: str = "gridsearch",
+                  max_trials: Optional[int] = None,
+                  metric: str = "throughput",
+                  timeout_s: float = 600.0,
+                  trial_args: Sequence[str] = ()) -> Tuple[Dict, str]:
+    """Run the search over ``trial_script`` (argv: config path +
+    ``trial_args``; prints one metrics-JSON line); returns
+    ``(tune_result, best_config_path)``."""
+    from deepspeed_tpu.autotuning import Autotuner, ResourceManager
+
+    rm = ResourceManager(trial_script, results_dir, timeout_s=timeout_s,
+                         trial_args=trial_args)
+    tuner = Autotuner(engine_builder=None, batch_builder=None,
+                      base_config=dict(base_config or {}),
+                      micro_batches=tuple(micro_batches),
+                      zero_stages=tuple(zero_stages),
+                      mesh_shapes=mesh_shapes, metric=metric,
+                      tuner_type=tuner_type, max_trials=max_trials,
+                      resource_manager=rm)
+    out = tuner.tune()
+    best_path = os.path.join(results_dir, "best_config.json")
+    with open(best_path, "w") as f:
+        json.dump(out["best_config"], f, indent=2)
+    return out, best_path

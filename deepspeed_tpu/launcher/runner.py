@@ -66,6 +66,9 @@ def parse_args(args=None):
                         "run: tune then launch the script with it")
     p.add_argument("--autotuning_results", type=str,
                    default="autotune_results")
+    p.add_argument("--autotuning_max_trials", type=int, default=None)
+    p.add_argument("--autotuning_timeout", type=float, default=600.0,
+                   help="per-trial subprocess timeout (s)")
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(args)
@@ -194,29 +197,25 @@ def _validate_elastic(args, active) -> None:
     logger.info(f"elastic: batch={batch} world={world} valid={valid}")
 
 
-def run_autotuning(args) -> int:
-    """`--autotuning tune|run` (reference runner.py:351): grid-search the
-    user TRIAL script via the subprocess scheduler; `run` re-launches the
-    script with the winning config on argv[1]."""
-    from deepspeed_tpu.autotuning import Autotuner, ResourceManager
-    rm = ResourceManager(args.user_script, args.autotuning_results)
-    tuner = Autotuner(engine_builder=None, batch_builder=None,
-                      base_config={}, resource_manager=rm)
-    out = tuner.tune()
-    best = os.path.join(args.autotuning_results, "best_config.json")
-    with open(best, "w") as f:
-        json.dump(out["best_config"], f, indent=2)
-    logger.info(f"autotuning best: {out['best_metrics']} -> {best}")
-    if args.autotuning == "run":
-        return subprocess.call([sys.executable, args.user_script, best,
-                                *args.user_args])
-    return 0
-
-
 def main(args=None):
     args = parse_args(args)
     if args.autotuning:
-        return run_autotuning(args)
+        # `--autotuning tune|run` (reference runner.py:351): the user
+        # script doubles as the TRIAL script (argv: config path + its own
+        # flags, one metrics-JSON line on stdout). Tuning runs locally;
+        # `run` then falls through to the NORMAL launch path — hostfile /
+        # include / exclude / env propagation all apply to the real job.
+        from deepspeed_tpu.autotuning.cli import tune_from_cli
+        out, best = tune_from_cli(
+            args.user_script, args.autotuning_results,
+            max_trials=args.autotuning_max_trials,
+            timeout_s=args.autotuning_timeout,
+            trial_args=tuple(args.user_args))
+        logger.info(f"autotuning best: {out['best_metrics']} -> {best}")
+        if args.autotuning != "run":
+            return 0
+        args.user_args = [best, *args.user_args]
+        args.autotuning = ""
     resources = fetch_hostfile(args.hostfile)
 
     if not resources and not args.force_multi:
