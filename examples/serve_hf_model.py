@@ -15,6 +15,10 @@ def main():
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--num-beams", type=int, default=1)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-p", type=float, default=0.0)
+    ap.add_argument("--repetition-penalty", type=float, default=1.0)
     ap.add_argument("--prompt-ids", default="1,2,3,4",
                     help="comma-separated token ids (no tokenizer dep)")
     args = ap.parse_args()
@@ -23,7 +27,10 @@ def main():
     eng = deepspeed_tpu.init_inference(
         args.path, dtype=args.dtype, tp={"tp_size": args.tp})
     prompt = [int(t) for t in args.prompt_ids.split(",")]
-    out = eng.generate([prompt], max_new_tokens=args.max_new_tokens)
+    out = eng.generate([prompt], max_new_tokens=args.max_new_tokens,
+                       num_beams=args.num_beams,
+                       temperature=args.temperature, top_p=args.top_p,
+                       repetition_penalty=args.repetition_penalty)
     print("generated ids:", out[0])
 
 
