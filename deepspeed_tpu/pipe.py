@@ -1,0 +1,10 @@
+"""Import-path parity with ``deepspeed.pipe`` (reference
+``deepspeed/pipe/__init__.py`` re-exports ``PipelineModule``/
+``LayerSpec``/``TiedLayerSpec``): ``from deepspeed_tpu.pipe import
+PipelineModule`` works exactly like the reference spelling. The
+implementation lives in :mod:`deepspeed_tpu.parallel.pipe`."""
+from deepspeed_tpu.parallel.pipe import (LayerSpec, PipelineEngine,
+                                         PipelineModule, TiedLayerSpec)
+
+__all__ = ["LayerSpec", "TiedLayerSpec", "PipelineModule",
+           "PipelineEngine"]

@@ -531,3 +531,11 @@ class TestPipelineZero1:
     def test_zero2_rejected_on_pipeline_path(self):
         with pytest.raises(ValueError, match="stage 0 or 1"):
             self._engine_z(4, pipe=2, data=4, M=2, zero_stage=2)
+
+
+def test_reference_import_paths():
+    """`from deepspeed_tpu.pipe import PipelineModule` — the reference's
+    deepspeed.pipe spelling."""
+    from deepspeed_tpu.pipe import (LayerSpec, PipelineEngine,
+                                    PipelineModule, TiedLayerSpec)
+    assert PipelineModule is not None and LayerSpec is not None
