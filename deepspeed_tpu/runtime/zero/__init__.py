@@ -1,0 +1,20 @@
+"""ZeRO family (reference ``deepspeed/runtime/zero/``): sharding
+policies, host/NVMe offload, tiling, memory estimators."""
+from deepspeed_tpu.runtime.zero.memory_estimators import (
+    estimate_zero2_model_states_mem_needs_all_cold,
+    estimate_zero2_model_states_mem_needs_all_live,
+    estimate_zero3_model_states_mem_needs_all_cold,
+    estimate_zero3_model_states_mem_needs_all_live,
+    estimate_zero_model_states_mem_needs)
+from deepspeed_tpu.runtime.zero.partition import (ZeroShardingPolicy,
+                                                  shard_leaf_spec)
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+__all__ = [
+    "ZeroShardingPolicy", "shard_leaf_spec", "TiledLinear",
+    "estimate_zero_model_states_mem_needs",
+    "estimate_zero2_model_states_mem_needs_all_live",
+    "estimate_zero2_model_states_mem_needs_all_cold",
+    "estimate_zero3_model_states_mem_needs_all_live",
+    "estimate_zero3_model_states_mem_needs_all_cold",
+]
