@@ -324,6 +324,13 @@ class InferenceEngine:
             raise ValueError(
                 "repetition_penalty must be strictly positive (HF raises "
                 "the same); 1.0 disables it")
+        if (int(top_k) > 0 or float(top_p) > 0.0) and \
+                float(temperature) <= 0.0:
+            raise ValueError(
+                "top_k/top_p are sampling filters — pass temperature>0 "
+                "(HF samples at temperature=1.0 by default); "
+                "temperature=0 means greedy and would silently ignore "
+                "them")
         rep_on = float(repetition_penalty) != 1.0
         loop = self._generate_loop(max_new_tokens, float(temperature) > 0.0,
                                    int(top_k) > 0, float(top_p) > 0.0,

@@ -8,10 +8,12 @@ from deepspeed_tpu.runtime.zero.memory_estimators import (
     estimate_zero_model_states_mem_needs)
 from deepspeed_tpu.runtime.zero.partition import (ZeroShardingPolicy,
                                                   shard_leaf_spec)
-from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+from deepspeed_tpu.runtime.zero.tiling import (TiledLinear,
+                                               TiledLinearReturnBias)
 
 __all__ = [
     "ZeroShardingPolicy", "shard_leaf_spec", "TiledLinear",
+    "TiledLinearReturnBias",
     "estimate_zero_model_states_mem_needs",
     "estimate_zero2_model_states_mem_needs_all_live",
     "estimate_zero2_model_states_mem_needs_all_cold",

@@ -11,7 +11,7 @@ from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
 pytestmark = pytest.mark.slow
 
 
-def test_training_kitchen_sink():
+def test_training_kitchen_sink(tmp_path):
     """ZeRO-3 + TP + SP + GAS + bf16 + grad clip + WarmupLR + MoQ +
     curriculum + wall_clock_breakdown in ONE engine on the 8-dev mesh."""
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
@@ -62,8 +62,7 @@ def test_training_kitchen_sink():
     assert all(np.isfinite(losses)), losses
     assert engine.quantizer.qsteps == 4          # step-0 + 3 boundaries
     # save/restore the whole composition
-    import tempfile
-    d = tempfile.mkdtemp()
+    d = str(tmp_path)
     engine.save_checkpoint(d)
     engine2, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=model.init(

@@ -403,3 +403,14 @@ def test_seq_parallel_requires_seq_axis():
     with pytest.raises(ValueError, match="seq"):
         InferenceEngine(cfg, DeepSpeedInferenceConfig(
             dtype="float32", sp_size=2), mesh=mesh)
+
+
+def test_sampling_filters_require_temperature():
+    cfg = InferenceTransformerConfig(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        dtype=jnp.float32)
+    eng = InferenceEngine(cfg)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.generate([[1, 2]], max_new_tokens=2, top_p=0.9)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.generate([[1, 2]], max_new_tokens=2, top_k=5)
