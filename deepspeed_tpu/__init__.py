@@ -47,6 +47,20 @@ def init_inference(model=None, config=None, **kwargs):
         merged = dict(config)
         merged.update(kwargs)
         config = DeepSpeedInferenceConfig(**merged)
+    if model is None and config.checkpoint is not None:
+        # reference init_inference(checkpoint=..., base_dir=...): load
+        # from files with no model object (inference/engine.py:268)
+        import os as _os
+        ckpt = config.checkpoint
+        if isinstance(ckpt, dict):
+            ckpt = ckpt.get("checkpoint") or ckpt.get("path") or \
+                ckpt.get("checkpoints")
+        if not isinstance(ckpt, str):
+            raise ValueError(
+                "config.checkpoint must be a path (or a dict with a "
+                f"'checkpoint'/'path' entry), got {config.checkpoint!r}")
+        model = _os.path.join(config.base_dir, ckpt) if config.base_dir \
+            else ckpt
     if isinstance(model, str):
         from deepspeed_tpu.module_inject.state_dict_loader import (
             load_inference_checkpoint)

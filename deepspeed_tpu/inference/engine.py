@@ -75,6 +75,19 @@ class InferenceEngine:
         # activations are cast to model_config.dtype inside the forward
         self.model_config = dataclasses.replace(self.model_config,
                                                 dtype=self._act_dtype)
+        if self.config.injection_policy is not None:
+            raise NotImplementedError(
+                "custom injection_policy dicts are torch-module surgery "
+                "(reference replace_module.py) — register a conversion "
+                "policy instead: subclass HFPolicy and decorate with "
+                "deepspeed_tpu.module_inject.policies.register_policy")
+        if not self.config.triangular_masking and \
+                self.model_config.pre_layer_norm and \
+                self.model_config.head != "none":
+            raise NotImplementedError(
+                "triangular_masking=False on a causal LM (bidirectional "
+                "decoding) is not supported; encoder models are already "
+                "bidirectional and ignore the flag")
         if self.config.quant.activation.enabled:
             # w8a8: dynamic activation quant at the MLP GEMM seams
             # (ops/int8_gemm.py) — only meaningful over int8-stored
@@ -274,11 +287,21 @@ class InferenceEngine:
               if getattr(self, "model_profile_enabled", False) else None)
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
+        if B > self.config.max_batch_size:
+            raise ValueError(
+                f"batch {B} exceeds max_batch_size="
+                f"{self.config.max_batch_size} (the reference sizes its "
+                "workspace the same way; raise the config knob)")
         if max_new_tokens <= 0:   # no-op budget: prompts unchanged
             if t0 is not None:    # keep model_times 1:1 with calls
                 self._model_times.append(_time.perf_counter() - t0)
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
+        if max_new_tokens < self.config.min_out_tokens:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} is below "
+                f"min_out_tokens={self.config.min_out_tokens} (reference "
+                "inference/engine.py rejects un-schedulable budgets)")
         max_seq = _round_up(int(lengths.max()) + max_new_tokens, 128)
         if max_seq > _round_up(self.config.max_out_tokens, 128):
             raise ValueError(

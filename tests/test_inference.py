@@ -414,3 +414,42 @@ def test_sampling_filters_require_temperature():
         eng.generate([[1, 2]], max_new_tokens=2, top_p=0.9)
     with pytest.raises(ValueError, match="temperature"):
         eng.generate([[1, 2]], max_new_tokens=2, top_k=5)
+
+
+def test_remaining_inference_config_knobs(tmp_path):
+    """checkpoint/base_dir route init_inference, max_batch_size and
+    min_out_tokens validate, injection_policy and causal
+    triangular_masking=False are loud (silent-knob audit)."""
+    import transformers
+    import torch
+    import deepspeed_tpu
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=1, n_head=4))
+    sub = tmp_path / "m"
+    sub.mkdir()
+    hf.save_pretrained(str(sub), safe_serialization=True)
+    eng = deepspeed_tpu.init_inference(
+        None, {"dtype": "float32", "base_dir": str(tmp_path),
+               "checkpoint": "m"})
+    out = eng.generate([[1, 2, 3]], max_new_tokens=2)
+    assert len(out[0]) == 5
+    with pytest.raises(ValueError, match="max_batch_size"):
+        eng2 = deepspeed_tpu.init_inference(
+            None, {"dtype": "float32", "checkpoint": str(sub),
+                   "max_batch_size": 1})
+        eng2.generate([[1], [2]], max_new_tokens=1)
+    with pytest.raises(ValueError, match="min_out_tokens"):
+        eng3 = deepspeed_tpu.init_inference(
+            None, {"dtype": "float32", "checkpoint": str(sub),
+                   "min_out_tokens": 4})
+        eng3.generate([[1]], max_new_tokens=2)
+    cfg = InferenceTransformerConfig(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        dtype=jnp.float32)
+    with pytest.raises(NotImplementedError, match="injection_policy"):
+        InferenceEngine(cfg, DeepSpeedInferenceConfig(
+            dtype="float32", injection_dict={"x": 1}))
+    with pytest.raises(NotImplementedError, match="triangular"):
+        InferenceEngine(cfg, DeepSpeedInferenceConfig(
+            dtype="float32", tm=False))
