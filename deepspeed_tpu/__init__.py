@@ -47,14 +47,28 @@ def init_inference(model=None, config=None, **kwargs):
         merged = dict(config)
         merged.update(kwargs)
         config = DeepSpeedInferenceConfig(**merged)
-    if model is None and config.checkpoint is not None:
+    if config.checkpoint is not None:
         # reference init_inference(checkpoint=..., base_dir=...): load
         # from files with no model object (inference/engine.py:268)
+        if model is not None:
+            raise ValueError(
+                "pass ONE weight source: either a model/path argument or "
+                "config.checkpoint — with both, which weights serve "
+                "would be ambiguous (the reference overwrites the live "
+                "module from the checkpoint; here load from the "
+                "checkpoint alone)")
         import os as _os
         ckpt = config.checkpoint
         if isinstance(ckpt, dict):
             ckpt = ckpt.get("checkpoint") or ckpt.get("path") or \
                 ckpt.get("checkpoints")
+        if isinstance(ckpt, (list, tuple)):
+            if len(ckpt) != 1:
+                raise NotImplementedError(
+                    "multi-file 'checkpoints' lists are model-parallel "
+                    "shards — point at the directory instead (Megatron "
+                    "mp_rank_* layouts merge automatically)")
+            ckpt = ckpt[0]
         if not isinstance(ckpt, str):
             raise ValueError(
                 "config.checkpoint must be a path (or a dict with a "

@@ -47,6 +47,13 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
                  mesh: Optional[Mesh] = None):
         self.config = config or DeepSpeedInferenceConfig()
+        if self.config.injection_policy is not None:
+            # config-only check: fail BEFORE any multi-GB conversion/load
+            raise NotImplementedError(
+                "custom injection_policy dicts are torch-module surgery "
+                "(reference replace_module.py) — register a conversion "
+                "policy instead: subclass HFPolicy and decorate with "
+                "deepspeed_tpu.module_inject.policies.register_policy")
         # dtype="int8" means WEIGHT STORAGE (reference GroupQuantizer):
         # activations run bf16, weights quantize to int8+scales at
         # placement time — resolved before conversion so the policy table
@@ -75,12 +82,6 @@ class InferenceEngine:
         # activations are cast to model_config.dtype inside the forward
         self.model_config = dataclasses.replace(self.model_config,
                                                 dtype=self._act_dtype)
-        if self.config.injection_policy is not None:
-            raise NotImplementedError(
-                "custom injection_policy dicts are torch-module surgery "
-                "(reference replace_module.py) — register a conversion "
-                "policy instead: subclass HFPolicy and decorate with "
-                "deepspeed_tpu.module_inject.policies.register_policy")
         if not self.config.triangular_masking and \
                 self.model_config.pre_layer_norm and \
                 self.model_config.head != "none":
@@ -287,16 +288,20 @@ class InferenceEngine:
               if getattr(self, "model_profile_enabled", False) else None)
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
-        if B > self.config.max_batch_size:
-            raise ValueError(
-                f"batch {B} exceeds max_batch_size="
-                f"{self.config.max_batch_size} (the reference sizes its "
-                "workspace the same way; raise the config knob)")
-        if max_new_tokens <= 0:   # no-op budget: prompts unchanged
+        if max_new_tokens <= 0:
+            # explicit no-op budget: prompts unchanged (exempt from the
+            # schedulability checks below — nothing is being scheduled)
             if t0 is not None:    # keep model_times 1:1 with calls
                 self._model_times.append(_time.perf_counter() - t0)
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
+        if "max_batch_size" in self.config.model_fields_set and \
+                B > self.config.max_batch_size:
+            # enforced only when the USER set the knob — the default must
+            # not reject batches the per-call KV allocation handles fine
+            raise ValueError(
+                f"batch {B} exceeds the configured max_batch_size="
+                f"{self.config.max_batch_size}")
         if max_new_tokens < self.config.min_out_tokens:
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} is below "

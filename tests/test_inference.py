@@ -434,6 +434,10 @@ def test_remaining_inference_config_knobs(tmp_path):
                "checkpoint": "m"})
     out = eng.generate([[1, 2, 3]], max_new_tokens=2)
     assert len(out[0]) == 5
+    # both a model AND config.checkpoint is ambiguous → loud
+    with pytest.raises(ValueError, match="ONE weight source"):
+        deepspeed_tpu.init_inference(str(sub), {"dtype": "float32",
+                                                "checkpoint": str(sub)})
     with pytest.raises(ValueError, match="max_batch_size"):
         eng2 = deepspeed_tpu.init_inference(
             None, {"dtype": "float32", "checkpoint": str(sub),
