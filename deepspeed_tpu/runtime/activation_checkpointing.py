@@ -78,6 +78,29 @@ def configure(config=None, _by_engine: bool = False, **kwargs) -> None:
              f"number_checkpoints={config.number_checkpoints}", ranks=[0])
 
 
+def model_parallel_seed(seed: int):
+    """Analog of ``model_parallel_cuda_manual_seed`` /
+    ``CudaRNGStatesTracker`` (reference checkpointing.py:130,198): a PRNG
+    key that is (a) DISTINCT per tensor-parallel shard inside
+    ``shard_map`` — dropout masks must differ across TP ranks — and (b)
+    identical across recompute for free: ``jax.checkpoint`` replays the
+    same key-consuming ops, so the tracker machinery the reference needs
+    (stash/restore RNG states around recomputation) has no analog to
+    manage. Under GSPMD (no Manual tensor axis) the single global key is
+    already correct — XLA shards one global mask."""
+    import jax
+
+    key = jax.random.PRNGKey(seed)
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and not mesh.empty and \
+            "tensor" in mesh.axis_names:
+        types = dict(zip(mesh.axis_names, mesh.axis_types))
+        if types["tensor"] == jax.sharding.AxisType.Manual:
+            key = jax.random.fold_in(
+                key, jax.lax.axis_index("tensor"))
+    return key
+
+
 def is_configured() -> bool:
     return _CONFIG is not None
 

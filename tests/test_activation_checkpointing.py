@@ -134,3 +134,27 @@ class TestCheckpointSequential:
         fns = [lambda h: h + 1.0 for _ in range(4)]
         out = ckpt.checkpoint_sequential(fns, jnp.zeros((2,)))
         np.testing.assert_allclose(np.asarray(out), 4.0)
+
+
+def test_model_parallel_seed_distinct_per_tp_shard():
+    """model_parallel_cuda_manual_seed analog: distinct keys per TP rank
+    inside shard_map, one key under GSPMD/no mesh."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.runtime.activation_checkpointing import (
+        model_parallel_seed)
+    # no mesh: plain key
+    k0 = model_parallel_seed(7)
+    np.testing.assert_array_equal(np.asarray(k0),
+                                  np.asarray(jax.random.PRNGKey(7)))
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("tensor",))
+
+    def body(_):
+        k = model_parallel_seed(7)
+        return jax.random.uniform(k, (1,))
+
+    outs = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"),
+        check_vma=False))(jnp.zeros((4,)))
+    vals = np.asarray(outs)
+    assert len(np.unique(vals)) == 4      # distinct dropout per TP rank
