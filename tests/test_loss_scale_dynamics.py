@@ -1,0 +1,80 @@
+"""Dynamic loss-scale unit dynamics (reference tests/unit/runtime/
+half_precision/test_dynamic_loss_scale.py scenarios against
+fp16/loss_scaler.py semantics)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.config import FP16Config
+from deepspeed_tpu.runtime.precision import (LossScaleState,
+                                             make_loss_scale,
+                                             update_loss_scale)
+
+GOOD = jnp.bool_(True)
+BAD = jnp.bool_(False)
+
+
+def make(window=4, hysteresis=2, init_power=4, min_scale=1.0):
+    return make_loss_scale(FP16Config(
+        enabled=True, loss_scale=0.0, initial_scale_power=init_power,
+        loss_scale_window=window, hysteresis=hysteresis,
+        min_loss_scale=min_scale))
+
+
+def test_growth_after_window_of_good_steps():
+    s = make(window=4)
+    assert float(s.scale) == 16.0
+    for i in range(3):
+        s = update_loss_scale(s, GOOD)
+        assert float(s.scale) == 16.0, i        # not yet
+    s = update_loss_scale(s, GOOD)              # 4th good step
+    assert float(s.scale) == 32.0
+    assert int(s.growth_tracker) == 0           # window restarts
+
+
+def test_overflow_consumes_hysteresis_then_backs_off():
+    s = make(hysteresis=2)
+    s = update_loss_scale(s, BAD)               # 1st overflow: tolerated
+    assert float(s.scale) == 16.0
+    s = update_loss_scale(s, BAD)               # 2nd: cut + hysteresis reset
+    assert float(s.scale) == 8.0
+    s = update_loss_scale(s, BAD)
+    assert float(s.scale) == 8.0                # tolerated again
+    s = update_loss_scale(s, BAD)
+    assert float(s.scale) == 4.0
+
+
+def test_overflow_resets_growth_tracker():
+    s = make(window=3)
+    s = update_loss_scale(s, GOOD)
+    s = update_loss_scale(s, GOOD)
+    s = update_loss_scale(s, BAD)               # tolerated, tracker reset
+    for _ in range(2):
+        s = update_loss_scale(s, GOOD)
+    assert float(s.scale) == 16.0               # window must restart
+    s = update_loss_scale(s, GOOD)
+    assert float(s.scale) == 32.0
+
+
+def test_min_scale_floor():
+    s = make(hysteresis=1, init_power=1, min_scale=1.0)   # scale 2
+    s = update_loss_scale(s, BAD)
+    assert float(s.scale) == 1.0
+    s = update_loss_scale(s, BAD)
+    assert float(s.scale) == 1.0                # floored
+
+
+def test_static_scale_never_moves():
+    s = make_loss_scale(FP16Config(enabled=True, loss_scale=128.0))
+    for flag in (GOOD, BAD, GOOD, BAD):
+        s = update_loss_scale(s, flag)
+    assert float(s.scale) == 128.0
+
+
+def test_update_is_jittable():
+    s = make(window=2)
+    step = jax.jit(update_loss_scale)
+    s = step(s, GOOD)
+    s = step(s, GOOD)
+    assert float(s.scale) == 32.0
