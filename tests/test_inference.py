@@ -457,3 +457,21 @@ def test_remaining_inference_config_knobs(tmp_path):
     with pytest.raises(NotImplementedError, match="triangular"):
         InferenceEngine(cfg, DeepSpeedInferenceConfig(
             dtype="float32", tm=False))
+
+
+def test_fp16_inference_dtype():
+    """dtype='fp16' (the reference's torch.half default): decode stays
+    consistent with prefill re-scoring at half precision."""
+    cfg = InferenceTransformerConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dtype=jnp.float16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine((cfg, params),
+                          DeepSpeedInferenceConfig(dtype="fp16"))
+    assert eng.model_config.dtype == jnp.float16
+    prompt = [5, 9, 2, 7]
+    out = eng.generate([prompt], max_new_tokens=4)[0]
+    assert len(out) == 8
+    for i in range(len(prompt), len(out)):
+        logits = eng.forward(jnp.asarray([out[:i]], jnp.int32))
+        assert int(jnp.argmax(logits[0, -1])) == out[i]
