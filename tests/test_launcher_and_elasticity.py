@@ -229,6 +229,7 @@ def test_single_host_launch_end_to_end(tmp_path):
     assert payload["coord"].endswith(":29500")
 
 
+@pytest.mark.slow   # ~8 subprocess trials x full jax import
 def test_runner_autotuning_tune_and_run(tmp_path, monkeypatch):
     """`dstpu --autotuning {tune,run}` (reference runner.py:351)."""
     from deepspeed_tpu.launcher import runner as runner_mod
@@ -242,6 +243,7 @@ def test_runner_autotuning_tune_and_run(tmp_path, monkeypatch):
         "                  'latency_s': 1.0}))\n")
     res = tmp_path / "res"
     rc = runner_mod.main(["--autotuning", "tune",
+                          "--autotuning_max_trials", "6",
                           "--autotuning_results", str(res), str(trial),
                           "--epochs", "1"])
     assert rc == 0
