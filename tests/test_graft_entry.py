@@ -1,0 +1,34 @@
+"""The driver-facing entry points must stay healthy: entry() lowers
+under jit; bench.py parses args and exposes its phases."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+pytestmark = pytest.mark.slow
+
+
+def test_entry_lowers():
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(ROOT, "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    lowered = jax.jit(fn).lower(*args)      # trace+lower only, no compile
+    assert "hlo" in lowered.as_text()[:2000].lower() or \
+        lowered.as_text()                    # non-empty HLO text
+
+
+def test_bench_cli_parses():
+    env = dict(os.environ, DSTPU_BENCH_PLATFORM="cpu")
+    p = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py"),
+                       "--help"], capture_output=True, timeout=120,
+                       env=env)
+    assert p.returncode == 0
+    out = p.stdout.decode()
+    assert "--phases" in out and "--budget" in out
