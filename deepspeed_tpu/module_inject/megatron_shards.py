@@ -58,26 +58,34 @@ def _kind(key: str) -> str:
 
 def merge_qkv(parts: Sequence[np.ndarray],
               checkpoint_version: float) -> np.ndarray:
-    """reference ``merge_query_key_value`` (:243): pre-2.0 checkpoints
-    interleave [q_1..q_n, k_1.., v_1..] per shard — each shard splits
-    into its q/k/v thirds and same-role thirds concatenate; 2.0+ fuses
-    per-head and a plain axis-0 cat is correct."""
-    if checkpoint_version >= 2.0:
+    """reference ``merge_query_key_value`` (:243-279): only the
+    unversioned legacy format (version 0, layout ``[(3*np*hn), h]``)
+    stores each shard as stacked q/k/v thirds that must be re-grouped
+    per role; versions 1.0 and 2.0 fuse per-head (``[(np*hn*3), h]`` /
+    ``[(np*3*hn), h]``) and a plain axis-0 cat is correct."""
+    if checkpoint_version == 0:
+        thirds = [np.split(p, 3, axis=0) for p in parts]
+        return np.concatenate(
+            [np.concatenate([t[i] for t in thirds], axis=0)
+             for i in range(3)], axis=0)
+    if checkpoint_version in (1.0, 2.0):
         return np.concatenate(parts, axis=0)
-    thirds = [np.split(p, 3, axis=0) for p in parts]
-    return np.concatenate(
-        [np.concatenate([t[i] for t in thirds], axis=0)
-         for i in range(3)], axis=0)
+    raise ValueError(
+        f"checkpoint version {checkpoint_version} is not supported")
 
 
 def split_qkv(param: np.ndarray, n: int, offset: int,
               checkpoint_version: float) -> np.ndarray:
-    """reference ``split_query_key_value`` (:281)."""
-    if checkpoint_version >= 2.0:
+    """reference ``split_query_key_value`` (:281-322); same version
+    rule as :func:`merge_qkv`."""
+    if checkpoint_version == 0:
+        q, k, v = np.split(param, 3, axis=0)
+        return np.concatenate([np.split(x, n, axis=0)[offset]
+                               for x in (q, k, v)], axis=0)
+    if checkpoint_version in (1.0, 2.0):
         return np.split(param, n, axis=0)[offset]
-    q, k, v = np.split(param, 3, axis=0)
-    return np.concatenate([np.split(x, n, axis=0)[offset]
-                           for x in (q, k, v)], axis=0)
+    raise ValueError(
+        f"checkpoint version {checkpoint_version} is not supported")
 
 
 def merge_megatron_shards(shards: Sequence[Dict[str, Any]],
@@ -235,5 +243,8 @@ def load_megatron_checkpoint(path: str,
         if ver is None and isinstance(blob, dict):
             ver = blob.get("checkpoint_version")
         shards.append(_flat_model_sd(blob))
+    # a MISSING version means the unversioned legacy format (version 0,
+    # interleaved QKV) — reference ``get_checkpoint_version`` defaults
+    # to 0, never 2.0
     return merge_megatron_shards(
-        shards, checkpoint_version=2.0 if ver is None else float(ver))
+        shards, checkpoint_version=0 if ver is None else float(ver))

@@ -450,17 +450,7 @@ class DeepSpeedEngine:
         loss_fn = self.loss_fn
         fp16 = self.config.fp16.enabled
         clip = self.config.gradient_clipping
-        # data_types.grad_accum_dtype (constants.py:389-394): dtype of the
-        # GAS accumulation buffer. Default fp32 (the reference's safe
-        # default); bf16/fp16 halve accumulator HBM at a precision cost.
-        # communication_data_type (constants.py:119) maps onto the same
-        # buffer (conflict validated at config construction): under GSPMD
-        # the DP reduction happens at the accumulated grads' dtype, so
-        # the comm-bytes knob IS the accumulator dtype.
-        acc_key = (self.config.data_types.grad_accum_dtype or
-                   self.config.communication_data_type)
-        acc_dtype = {"fp32": jnp.float32, "fp16": jnp.float16,
-                     "bf16": jnp.bfloat16, None: jnp.float32}[acc_key]
+        acc_dtype = self._grad_accum_dtype()
         grad_spec = self.policy.spec_of(
             self.policy.grad_sharding(self.state.params))
         mesh = self.mesh
@@ -632,6 +622,20 @@ class DeepSpeedEngine:
 
         return self._wrap_explicit_dp(local_step, batch)
 
+    def _grad_accum_dtype(self):
+        """GAS accumulation-buffer dtype, shared by the fused GSPMD step
+        and the explicit-exchange shard_map steps (1-bit/sparse) so the
+        two paths cannot drift. data_types.grad_accum_dtype
+        (constants.py:389-394) wins; else communication_data_type
+        (constants.py:119) — under GSPMD the DP reduction happens at the
+        accumulated grads' dtype, so the comm-bytes knob IS the
+        accumulator dtype (conflict validated at config construction);
+        else the reference's safe default, fp32."""
+        return {"fp32": jnp.float32, "fp16": jnp.float16,
+                "bf16": jnp.bfloat16, None: jnp.float32}[
+                    self.config.data_types.grad_accum_dtype or
+                    self.config.communication_data_type]
+
     def _make_local_grads_fn(self, axes):
         """Per-worker gradient producer shared by the explicit-exchange
         shard_map steps (1-bit compressed, sparse): distinct rng per
@@ -640,9 +644,7 @@ class DeepSpeedEngine:
         gas = self.gas
         loss_fn = self.loss_fn
         axis_sizes = {a: self.mesh.shape[a] for a in axes}
-        acc_dtype = {"fp32": jnp.float32, "fp16": jnp.float16,
-                     "bf16": jnp.bfloat16, None: jnp.float32}[
-                         self.config.data_types.grad_accum_dtype]
+        acc_dtype = self._grad_accum_dtype()
 
         def local_grads(params, batch, rng):
             # distinct dropout/randomness per worker: the exact GSPMD path

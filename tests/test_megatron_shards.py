@@ -40,7 +40,7 @@ def full_sd():
 
 
 @pytest.mark.parametrize("world", [2, 4])
-@pytest.mark.parametrize("ver", [1.0, 2.0])
+@pytest.mark.parametrize("ver", [0, 1.0, 2.0])
 def test_split_merge_round_trip(world, ver):
     sd = full_sd()
     shards = [split_megatron_state_dict(sd, world, r,
@@ -55,16 +55,46 @@ def test_split_merge_round_trip(world, ver):
                                    err_msg=key)
 
 
-def test_qkv_interleave_pre20_differs_from_20():
-    """pre-2.0 shards carry [q_i, k_i, v_i] stacked — a naive axis-0 cat
-    scrambles roles; merge_qkv reorders them."""
+def test_qkv_interleave_version0_differs_from_versioned():
+    """Unversioned (version-0) shards carry [q_i, k_i, v_i] stacked — a
+    naive axis-0 cat scrambles roles; merge_qkv reorders them. Versions
+    1.0/2.0 fuse per-head, so there the plain cat IS correct (reference
+    merge_query_key_value :262-277)."""
     sd = full_sd()
     k = "language_model.transformer.layers.0.attention.query_key_value.weight"
-    parts = [split_qkv(sd[k], 2, r, 1.0) for r in range(2)]
+    parts = [split_qkv(sd[k], 2, r, 0) for r in range(2)]
     naive = np.concatenate(parts, axis=0)
-    fixed = merge_qkv(parts, 1.0)
+    fixed = merge_qkv(parts, 0)
     assert not np.allclose(naive, sd[k])
     np.testing.assert_allclose(fixed, sd[k], atol=1e-6)
+    # v1.0 must NOT get the interleaved treatment
+    parts_v1 = [split_qkv(sd[k], 2, r, 1.0) for r in range(2)]
+    np.testing.assert_allclose(np.concatenate(parts_v1, axis=0), sd[k],
+                               atol=1e-6)
+
+
+def test_qkv_unknown_version_raises():
+    sd = full_sd()
+    k = "language_model.transformer.layers.0.attention.query_key_value.weight"
+    with pytest.raises(ValueError, match="not supported"):
+        merge_qkv([sd[k]], 3.0)
+    with pytest.raises(ValueError, match="not supported"):
+        split_qkv(sd[k], 2, 0, 0.5)
+
+
+def test_missing_checkpoint_version_defaults_to_0(tmp_path):
+    """A blob with NO checkpoint_version key is the legacy interleaved
+    format — reference get_checkpoint_version defaults to 0, not 2.0."""
+    sd = full_sd()
+    for r in range(2):
+        shard = split_megatron_state_dict(sd, 2, r, checkpoint_version=0)
+        d = tmp_path / f"mp_rank_{r:02d}"
+        d.mkdir()
+        torch.save({"model": {k: torch.tensor(v) for k, v in shard.items()}},
+                   str(d / "model_optim_rng.pt"))
+    merged = load_megatron_checkpoint(str(tmp_path))
+    k = "language_model.transformer.layers.0.attention.query_key_value.weight"
+    np.testing.assert_allclose(merged[k], sd[k], atol=1e-6)
 
 
 def test_replicated_mismatch_is_loud():

@@ -15,7 +15,7 @@ SETTINGS = dict(max_examples=25, deadline=None)
 # ----------------------------------------------------- megatron shards
 @settings(**SETTINGS)
 @given(h=st.sampled_from([4, 8, 16]), world=st.sampled_from([1, 2, 4]),
-       ver=st.sampled_from([1.0, 2.0]), seed=st.integers(0, 2**16))
+       ver=st.sampled_from([0, 1.0, 2.0]), seed=st.integers(0, 2**16))
 def test_megatron_split_merge_identity(h, world, ver, seed):
     from deepspeed_tpu.module_inject.megatron_shards import (
         merge_megatron_shards, split_megatron_state_dict)
