@@ -32,8 +32,10 @@ This module is the TPU-native analog with the same memory property:
   tied-weight copies are summed across the owning stages and written back
   to every copy, so per-stage optimizer steps keep the copies bit-identical.
 * ``ReduceGrads`` needs no code: within a stage program the batch is sharded
-  over the data axes and parameters are replicated, so SPMD already emits
-  the gradient ``psum`` — the reference's DP allreduce.
+  over the data axes while each param leaf follows its committed placement
+  (replicated by default; tensor-sharded under ``param_specs``), so SPMD
+  already emits the gradient ``psum`` over the data axes — the reference's
+  DP allreduce — and keeps TP-sharded grads sharded.
 
 Trade-off vs the compiled executor (``pipeline.py``): one compiled program
 per (stage, direction) and a host dispatch per instruction, instead of a
@@ -97,7 +99,8 @@ class PipelineEngine:
                  micro_batches: int,
                  loss_fn: Optional[Callable] = None,
                  mesh: Optional[Mesh] = None,
-                 zero_stage: int = 0):
+                 zero_stage: int = 0,
+                 param_specs: Optional[Sequence[Any]] = None):
         mesh = mesh or get_global_mesh()
         if PIPE_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh has no {PIPE_AXIS!r} axis")
@@ -126,6 +129,30 @@ class PipelineEngine:
         self._param_sh = [NamedSharding(m, P()) for m in self.stage_meshes]
         self._act_sh = [NamedSharding(m, P(data_axes if data_axes else None))
                         for m in self.stage_meshes]
+        # Megatron-TP inside a stage (PP x TP): ``param_specs`` gives one
+        # PartitionSpec pytree per LAYER (or None = replicated); specs name
+        # the non-pipe mesh axes (e.g. 'tensor'). The stage fns are jitted
+        # without explicit shardings, so committed param placements flow
+        # through vjp and the optimizer step unchanged — XLA inserts the
+        # within-stage collectives (reference: megatron rows/cols inside
+        # runtime/pipe stages).
+        if param_specs is not None and len(param_specs) != module.num_layers:
+            raise ValueError("need one param spec tree (or None) per layer")
+
+        def layer_sh(s: int, li: int):
+            if param_specs is None or param_specs[li] is None:
+                return self._param_sh[s]
+            m = self.stage_meshes[s]
+            return jax.tree.map(lambda spec: NamedSharding(m, spec),
+                                param_specs[li],
+                                is_leaf=lambda x: isinstance(x, P))
+
+        # per-stage tuple of per-layer sharding (pytree-prefix of the
+        # stage param tuple — device_put/jit broadcast single shardings
+        # over a layer's whole tree)
+        self._param_tree_sh = [
+            tuple(layer_sh(s, li) for li in module.stage_layer_indices(s))
+            for s in range(self.num_stages)]
 
         # -- stage functions ------------------------------------------------
         self._stage_layer_fns: List[List[Callable]] = []
@@ -138,7 +165,7 @@ class PipelineEngine:
             trees = tuple(layer_params[i]
                           for i in module.stage_layer_indices(s))
             self.stage_params.append(
-                jax.device_put(trees, self._param_sh[s]))
+                jax.device_put(trees, self._param_tree_sh[s]))
 
         # ZeRO-1 composition (reference engine.py:1533: pipeline engines
         # compose with stage<=1 — params/grads must stay whole for the
@@ -153,14 +180,37 @@ class PipelineEngine:
 
         def opt_shardings(s):
             if zero_stage == 0 or not data_axes:
+                # single replicated sharding: broadcasts over ANY optax
+                # state structure (a per-layer tuple would not prefix-
+                # match). TP moments stay replicated under zero-0; ZeRO-1
+                # shards them over the data axes below.
                 return self._param_sh[s]
             from deepspeed_tpu.runtime.zero.partition import shard_leaf_spec
             m = self.stage_meshes[s]
+            # optimizer moments mirror the param tree somewhere inside the
+            # optax state (mu/nu under ScaleByAdamState etc.); recover each
+            # moment leaf's TP base spec from the already-placed params by
+            # path-SUFFIX + shape match, so ZeRO-1 extends the TP placement
+            # instead of resharding moments onto the data axes alone
+            by_suffix: dict = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    self.stage_params[s])[0]:
+                by_suffix[tuple(str(k) for k in path)] = (
+                    leaf.shape, leaf.sharding.spec)
             shape_tree = jax.eval_shape(self.optimizer.init,
                                         self.stage_params[s])
-            return jax.tree.map(
-                lambda l: NamedSharding(
-                    m, shard_leaf_spec(l.shape, None, m)), shape_tree)
+
+            def per_leaf(path, l):
+                keys = tuple(str(k) for k in path)
+                base = None
+                for start in range(len(keys)):
+                    hit = by_suffix.get(keys[start:])
+                    if hit is not None and hit[0] == l.shape:
+                        base = hit[1]
+                        break
+                return NamedSharding(m, shard_leaf_spec(l.shape, base, m))
+
+            return jax.tree_util.tree_map_with_path(per_leaf, shape_tree)
 
         self._opt_sh = [opt_shardings(s) for s in range(self.num_stages)]
         self.opt_state = [
@@ -183,7 +233,7 @@ class PipelineEngine:
         # sharded across steps (an unconstrained jit may re-replicate)
         self._opt_step_fns = [
             jax.jit(opt_step,
-                    out_shardings=(self._param_sh[s], self._opt_sh[s]))
+                    out_shardings=(self._param_tree_sh[s], self._opt_sh[s]))
             for s in range(self.num_stages)]
 
         # observability: the 1F1B memory bound, per stage
@@ -443,10 +493,10 @@ class PipelineEngine:
             total = grads[own_s][own_i]
             for s, i in sites[1:]:
                 total = self._acc(total, jax.device_put(
-                    grads[s][i], self._param_sh[own_s]))
+                    grads[s][i], self._param_tree_sh[own_s][own_i]))
             for s, i in sites:
                 g = list(grads[s])
-                g[i] = jax.device_put(total, self._param_sh[s])
+                g[i] = jax.device_put(total, self._param_tree_sh[s][i])
                 grads[s] = tuple(g)
 
     # ------------------------------------------------------------------

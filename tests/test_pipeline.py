@@ -378,6 +378,68 @@ class Test1F1BExecutor:
         assert eng.max_live_buffers[0] > eng.max_live_buffers[S - 1]
         assert eng.residual_bytes_per_buffer[0] > 0
 
+    def test_pp_tp_combined_mesh_parity(self):
+        """PP x TP: layer weights sharded over the 'tensor' axis inside
+        each pipe stage (Megatron rows/cols inside a stage — reference
+        composes megatron mp with runtime/pipe). Losses and params must
+        match the sequential reference bit-for-bit-ish, and the placed
+        params must REALLY be sharded over tensor."""
+        import optax
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.parallel.pipe import (LayerSpec, PipelineEngine,
+                                                 PipelineModule)
+        L, M, B = 4, 2, 8
+        mesh = build_mesh(MeshConfig(data=2, tensor=2, pipe=2))
+        set_global_mesh(mesh)
+        specs = [LayerSpec(lambda: self._layer) for _ in range(L)]
+        pm = PipelineModule(specs, num_stages=2,
+                            partition_method="uniform", loss_fn=self._loss)
+        params = self._params(L, key=11)
+        tp_spec = {"w": P(None, "tensor"), "b": P("tensor")}
+        eng = PipelineEngine(pm, params, optax.sgd(0.1), micro_batches=M,
+                             mesh=mesh, zero_stage=1,
+                             param_specs=[tp_spec] * L)
+        # placement check: the column dim is genuinely split over tensor
+        w0 = eng.stage_params[0][0]["w"]
+        assert w0.sharding.spec == P(None, "tensor"), w0.sharding
+        # ZeRO-1 moments must COMPOSE with the TP spec (data-shard the
+        # row dim, keep the tensor column shard), not replicate over it —
+        # sgd carries no moments, so probe with an adam-backed engine
+        aeng = PipelineEngine(pm, params, optax.adam(1e-3),
+                              micro_batches=M, mesh=mesh, zero_stage=1,
+                              param_specs=[tp_spec] * L)
+        mom_specs = {l.sharding.spec
+                     for l in jax.tree_util.tree_leaves(aeng.opt_state[0])
+                     if getattr(l, "ndim", 0) == 2}
+        assert P("data", "tensor") in mom_specs, mom_specs
+        key = jax.random.PRNGKey(13)
+        x = jax.random.normal(key, (B, self.C))
+        labels = jax.random.normal(jax.random.fold_in(key, 1), (B, self.C))
+        for step in range(2):
+            m = eng.train_batch(x, labels)
+            ref_loss, params, _ = self._ref_step(params, x, labels)
+            assert m["loss"] == pytest.approx(ref_loss, rel=1e-4), \
+                f"step {step} loss mismatch"
+        for got, want in zip(eng.all_params(), params):
+            jax.tree.map(lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+                got, want)
+
+    def test_param_specs_length_mismatch_raises(self):
+        import optax
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.parallel.pipe import (LayerSpec, PipelineEngine,
+                                                 PipelineModule)
+        mesh = build_mesh(MeshConfig(tensor=2, pipe=2))
+        set_global_mesh(mesh)
+        pm = PipelineModule([LayerSpec(lambda: self._layer)
+                             for _ in range(4)], num_stages=2,
+                            partition_method="uniform", loss_fn=self._loss)
+        with pytest.raises(ValueError, match="per layer"):
+            PipelineEngine(pm, self._params(4), optax.sgd(0.1),
+                           micro_batches=2, mesh=mesh,
+                           param_specs=[{"w": P(None, "tensor")}])
+
     def test_tied_weight_reduction(self):
         """Tied embedding at both ends (reference pipe/module.py:420-442):
         grads of the copies are summed, copies stay bit-identical, and the
