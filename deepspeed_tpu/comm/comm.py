@@ -170,11 +170,22 @@ def init_distributed(dist_backend: str = "xla",
     global _INITIALIZED
     if _INITIALIZED:
         return
-    coordinator_address = coordinator_address or os.environ.get("DS_COORDINATOR_ADDR")
-    if num_processes is None and "DS_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["DS_NUM_PROCESSES"])
-    if process_id is None and "DS_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["DS_PROCESS_ID"])
+    # DS_* names take precedence; COORDINATOR_ADDRESS/NUM_PROCESSES/
+    # PROCESS_ID are what launcher/launch.py exports (build_env) — the
+    # launcher → init_distributed chain rendezvouses through them.
+    coordinator_address = (coordinator_address or
+                           os.environ.get("DS_COORDINATOR_ADDR") or
+                           os.environ.get("COORDINATOR_ADDRESS"))
+    if num_processes is None:
+        for var in ("DS_NUM_PROCESSES", "NUM_PROCESSES"):
+            if var in os.environ:
+                num_processes = int(os.environ[var])
+                break
+    if process_id is None:
+        for var in ("DS_PROCESS_ID", "PROCESS_ID"):
+            if var in os.environ:
+                process_id = int(os.environ[var])
+                break
     if auto_mpi_discovery and num_processes is None and \
             ("OMPI_COMM_WORLD_SIZE" in os.environ or in_aws_sm()):
         # an explicitly-supplied coordinator waives the discovery's
@@ -186,7 +197,10 @@ def init_distributed(dist_backend: str = "xla",
             num_processes, process_id = size, rank
             logger.info(f"mpi discovery: process {rank}/{size} "
                         f"coordinator={coordinator_address}")
-    multi_host = coordinator_address is not None or num_processes not in (None, 1)
+    # NUM_PROCESSES=1 (launcher single-proc run) needs no rendezvous even
+    # though the launcher always exports a coordinator address.
+    multi_host = (num_processes is not None and num_processes > 1) or \
+                 (num_processes is None and coordinator_address is not None)
     if multi_host:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

@@ -41,6 +41,11 @@ from deepspeed_tpu.utils.logging import logger
 MESH_AXES = ("pipe", "data", "fsdp", "seq", "tensor")
 # Expert parallelism reuses devices from (data × fsdp): see expert_mesh().
 
+# Batch leading-dim sharding: the global batch splits over plain DP and the
+# hybrid-shard axis together. Single source of truth — the engine, models,
+# dataloader, and pipeline executors all import this.
+DATA_AXES = ("data", "fsdp")
+
 _GLOBAL_MESH: Optional[Mesh] = None
 
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-DATA_AXES = ("data", "fsdp")
+from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
 
 
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain

@@ -22,7 +22,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.models.gpt2 import Block, GPT2Config, _maybe_constrain
 from deepspeed_tpu.parallel.pipe.pipeline import pipeline_apply
 
-DATA_AXES = ("data", "fsdp")
+from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
 
 
 class GPT2PipeModel:

@@ -64,7 +64,7 @@ from deepspeed_tpu.parallel.pipe.schedule import (BackwardPass, ForwardPass,
                                                   TrainSchedule)
 
 PIPE_AXIS = "pipe"
-DATA_AXES = ("data", "fsdp")
+from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
 
 
 def _as_layer_fn(obj) -> Callable:
@@ -118,6 +118,30 @@ class PipelineEngine:
             raise ValueError("a loss_fn is required for training")
         self.optimizer = optimizer
         self._mesh = mesh
+
+        # -- multi-host boundary --------------------------------------------
+        # This executor is single-controller MPMD: stage handoffs are
+        # ``jax.device_put`` between sub-mesh shardings and every stage
+        # program is dispatched from THIS process, so every mesh device must
+        # be addressable here. On a multi-process pod that does not hold
+        # (each process addresses only its local chips), and a silent
+        # device_put to a non-addressable device would fail deep inside the
+        # schedule. Refuse up front and point at the SPMD path — the
+        # compiled scan+ppermute executor (``pipeline.py``) runs 1F1B-depth
+        # memory via remat and works per-host like any pjit program (the
+        # reference's cross-node path is runtime/pipe/p2p.py).
+        if jax.process_count() > 1:
+            local = set(jax.local_devices())
+            missing = [d for d in mesh.devices.flat if d not in local]
+            if missing:
+                raise NotImplementedError(
+                    "the host-driven 1F1B executor is single-controller: "
+                    f"{len(missing)} of {mesh.devices.size} mesh devices "
+                    "are not addressable from this process. On a "
+                    "multi-process pod use the compiled pipeline executor "
+                    "(deepspeed_tpu.parallel.pipe.pipeline, scan+ppermute "
+                    "SPMD) — see docs/parallelism.md 'Multi-host "
+                    "boundaries'.")
 
         # -- per-stage sub-meshes -------------------------------------------
         pipe_idx = list(mesh.axis_names).index(PIPE_AXIS)

@@ -15,6 +15,39 @@ from typing import Any, Iterator, Optional
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
+
+
+def assemble_global_batch(batch, mesh=None):
+    """Form global batch arrays from this process's local shard.
+
+    In JAX's SPMD model the compiled step consumes *global* ``jax.Array``s;
+    on a multi-host pod each process can only materialize the rows its own
+    devices hold. Feed each process its local shard (``global_batch /
+    process_count`` rows, the reference's per-rank batch convention —
+    ``runtime/dataloader.py`` samples per DP rank) and this assembles the
+    global array sharded over the data axis.
+
+    Single-process: returns the batch unchanged (pjit shards host arrays
+    itself). Leaves that are already global (non-fully-addressable)
+    ``jax.Array``s pass through untouched.
+    """
+    if jax.process_count() == 1:
+        return batch
+    if mesh is None:
+        from deepspeed_tpu.comm.mesh import get_global_mesh
+        mesh = get_global_mesh()
+    sharding = NamedSharding(mesh, P(DATA_AXES))
+
+    def to_global(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(x))
+
+    return jax.tree.map(to_global, batch)
 
 
 class RepeatingLoader:

@@ -44,7 +44,7 @@ from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import ThroughputTimer
 
-DATA_AXES = ("data", "fsdp")  # batch dim sharding
+from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
 
 
 @struct.dataclass
@@ -923,6 +923,12 @@ class DeepSpeedEngine:
         micro-batches (SURVEY §3.2)."""
         if batch is None:
             batch = next(self.training_dataloader)
+        if jax.process_count() > 1:
+            # multi-host: each process feeds its local shard of the global
+            # batch (the reference's per-rank convention); assemble the
+            # global jax.Array the compiled SPMD step consumes
+            from deepspeed_tpu.runtime.dataloader import assemble_global_batch
+            batch = assemble_global_batch(batch, self.mesh)
         leading = jax.tree.leaves(batch)[0].shape[0]
         expected = self.micro_batch_size * self.gas * \
             get_data_parallel_world_size(self.mesh)
@@ -1119,11 +1125,21 @@ class DeepSpeedEngine:
                     self._state_shardings.params))
 
     # -- DS-shaped micro-batch API -------------------------------------
+    def _global_micro_batch(self, batch):
+        """Multi-host: the micro-batch API follows the same per-process
+        local-shard feeding convention as train_batch — assemble the
+        global micro-batch before the jitted consumer."""
+        if jax.process_count() > 1:
+            from deepspeed_tpu.runtime.dataloader import assemble_global_batch
+            batch = assemble_global_batch(batch, self.mesh)
+        return batch
+
     def forward(self, batch):
         """Loss for one micro-batch (no grad) — engine.forward analog."""
         if self._grad_fn is None:
             self._build_grad_fn()
         self._ensure_params_resident()
+        batch = self._global_micro_batch(batch)
         self._rng, rng = jax.random.split(self._rng)
         return self._loss_only_fn(self.state.params, batch, rng)
 
@@ -1141,6 +1157,7 @@ class DeepSpeedEngine:
         if self._grad_fn is None:
             self._build_grad_fn()
         self._ensure_params_resident()
+        batch = self._global_micro_batch(batch)
         if self.quantizer is not None and self.global_steps == 0 and \
                 self._micro_steps == 0:
             # step-0 quantization on this path too (engine.py:1786)

@@ -1,0 +1,87 @@
+"""Worker for the multi-process launcher E2E test.
+
+Launched through ``deepspeed_tpu.launcher.launch`` (NOT collected by
+pytest): the full chain launcher → launch.py env export →
+``init_distributed`` → ``jax.distributed.initialize`` runs for real over
+N CPU processes, forms the global mesh, and trains a tiny GPT-2 with the
+engine. The reference analog is ``tests/unit/common.py:29-141``
+(DistributedExec spawning real NCCL process groups per test).
+
+Process 0 prints one ``RESULT {json}`` line with the per-step losses and a
+final parameter checksum; the spawning test asserts parity between a
+2-process x 2-device run and a 1-process x 4-device run.
+"""
+import json
+import os
+import sys
+
+# each process contributes DEVS_PER_PROC virtual CPU devices to the
+# cluster; must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count="
+        + os.environ.get("DEVS_PER_PROC", "2"))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+# belt-and-braces: a sitecustomize may have registered the real-TPU relay
+# backend despite JAX_PLATFORMS=cpu in the env; pin cpu before first use
+jax.config.update("jax_platforms", "cpu")
+
+import deepspeed_tpu  # noqa: E402
+
+
+def main():
+    deepspeed_tpu.init_distributed()
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    total = jax.device_count()
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    model = GPT2LMModel(GPT2Config(
+        n_layer=2, n_embd=64, n_head=4, vocab_size=256, n_positions=64,
+        use_flash_attention=False, vocab_pad_multiple=64))
+    params = model.init(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                # fp32 end to end: parity between process topologies is
+                # asserted tightly by the test
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}})
+
+    rng = np.random.default_rng(1234)
+    micro, seq = 2, 32
+    global_rows = micro * total
+    local_rows = global_rows // nproc
+    losses = []
+    for _ in range(3):
+        # every process generates the identical global batch from the
+        # shared seed, then feeds ONLY its local shard — the engine
+        # assembles the global array (assemble_global_batch)
+        full = rng.integers(0, 256, (global_rows, seq)).astype(np.int32)
+        local = full[pid * local_rows:(pid + 1) * local_rows]
+        metrics = engine.train_batch({"input_ids": local})
+        losses.append(float(metrics["loss"]))
+
+    # params are replicated under ZeRO-1 → every process holds the full
+    # value; a scalar checksum pins the trained weights across topologies
+    checksum = float(sum(
+        jnp.sum(x.astype(jnp.float32) ** 2)
+        for x in jax.tree.leaves(engine.state.params)))
+    if pid == 0:
+        print("RESULT " + json.dumps({
+            "process_count": nproc,
+            "device_count": total,
+            "losses": losses,
+            "param_sq_norm": checksum,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -342,6 +342,18 @@ class Test1F1BExecutor:
         new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
         return float(loss), new, grads
 
+    def test_executor_refuses_nonaddressable_mesh(self, monkeypatch):
+        """Multi-host boundary (docs/parallelism.md): the host-driven
+        executor is single-controller; on a simulated 2-process pod where
+        half the mesh devices are non-addressable it must refuse at
+        construction and point at the compiled SPMD executor — not fail
+        inside the schedule. Reference cross-node path: runtime/pipe/p2p.py."""
+        devices = jax.devices()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "local_devices", lambda: devices[:4])
+        with pytest.raises(NotImplementedError, match="compiled pipeline"):
+            self._engine(L=4, pipe=4, data=2, M=4)
+
     def test_train_parity_vs_sequential(self):
         L, M, B = 8, 4, 8
         eng, params = self._engine(L, pipe=4, data=2, M=M)
