@@ -129,11 +129,15 @@ def phase_train(args) -> dict:
     if args.offload:
         # the north-star config (BASELINE.md): ZeRO-3 + cpu optimizer
         # offload — 1.3B fp32 master+moments (~15.6 GB) exceed a single
-        # v5e chip's HBM, exactly the regime ZeRO-Offload targets
+        # v5e chip's HBM, exactly the regime ZeRO-Offload targets. On TPU
+        # this resolves to the streamed implementation (state in
+        # pinned_host, update on device, XLA-overlapped DMA); GAS
+        # amortizes the per-step state streaming exactly like the
+        # reference amortizes PCIe traffic with large effective batches.
         zero["offload_optimizer"] = {"device": "cpu"}
     ds_config = {
         "train_micro_batch_size_per_gpu": args.micro,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": args.gas,
         "bf16": {"enabled": True},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "zero_optimization": zero,
@@ -435,7 +439,7 @@ PHASES = {
     # a single chip's HBM). Few steps — each step moves ~15.6 GB of
     # optimizer state over PCIe, so throughput is modest by design.
     "train-1.3b": (["--preset", "gpt2-1.3b", "--no-flash", "--offload",
-                    "--micro", "1", "--steps", "4"], 600),
+                    "--micro", "4", "--gas", "8", "--steps", "4"], 900),
     "train-350m-noflash": (["--preset", "gpt2-350m", "--no-flash"], 480),
     "inference": ([], 420),
     # no remat: the recompute FLOPs are pure overhead when activations fit
@@ -548,6 +552,7 @@ def main() -> None:
     ap.add_argument("--preset", default="gpt2-350m")
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--micro", type=int, default=4)
+    ap.add_argument("--gas", type=int, default=1)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--no-flash", action="store_true")
@@ -623,12 +628,24 @@ def main() -> None:
     # +offload — BASELINE.md's literal metric), then flagship 350m, then
     # the fallbacks; vs_baseline is TFLOPS-based so comparable across all
     best = None
-    for name in ("train-1.3b", "train-350m-flash",
-                 "train-350m-flash-noremat", "train-350m-noremat",
-                 "train-350m-noflash", "train-125m", "train-125m-micro"):
-        if name in merged:
-            best = merged[name]
-            break
+    if "train-1.3b" in merged:
+        best = merged["train-1.3b"]
+    else:
+        # flagship 350m: report the best-measuring variant (flash vs
+        # noflash vs noremat is an implementation choice, not a workload
+        # difference — a user would run the fastest)
+        m350 = [merged[n] for n in ("train-350m-flash",
+                                    "train-350m-flash-noremat",
+                                    "train-350m-noremat",
+                                    "train-350m-noflash") if n in merged]
+        if m350:
+            best = max(m350, key=lambda r:
+                       r.get("tokens_per_sec_per_chip", 0.0))
+        else:
+            for name in ("train-125m", "train-125m-micro"):
+                if name in merged:
+                    best = merged[name]
+                    break
     detail = {"phases": merged,
               "wall_s": round(time.time() - T0, 1),
               "infra": dict(INFRA)}

@@ -63,6 +63,15 @@ class OffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_read: bool = False
     pipeline_write: bool = False
     fast_init: bool = False
+    # TPU extension (not in the reference schema): how the host tier is
+    # realized. "stream" keeps fp32 master+moments in the TPU host's
+    # pinned memory and computes the update ON DEVICE inside the fused
+    # jitted step, with XLA streaming the host<->HBM DMAs per leaf (the
+    # PCIe-overlap role the reference's cpu_adam + copy streams play,
+    # stage_1_and_2.py:1069-1219, without leaving XLA). "host" runs the
+    # C++ SIMD Adam in process RAM (csrc/cpu_adam.cpp). "auto" picks
+    # stream on TPU backends, host elsewhere.
+    implementation: Literal["auto", "stream", "host"] = "auto"
 
 
 class ZeroConfig(DeepSpeedConfigModel):

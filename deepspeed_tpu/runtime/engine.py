@@ -219,6 +219,42 @@ class DeepSpeedEngine:
         oc = config.zero_config.offload_optimizer
         self._offload_cfg = oc if (oc is not None and
                                    oc.device != "none") else None
+        # Streamed offload (config.py OffloadOptimizerConfig.implementation):
+        # fp32 master+moments live in TPU-host pinned memory and the update
+        # runs on device inside the fused step, XLA overlapping the per-leaf
+        # host<->HBM DMAs — the role cpu_adam + PCIe copy streams play in
+        # the reference, kept inside one XLA program. The NVMe tier and
+        # non-TPU backends (XLA:CPU has no memory-space shardings) use the
+        # C++ host path.
+        self._offload_stream = False
+        if self._offload_cfg is not None:
+            impl = self._offload_cfg.implementation
+            if impl == "auto":
+                # fp16 stays on the host path (its loss-scale skip cond
+                # cannot wrap memory-space transfers); explicit 'stream'
+                # + fp16 is refused below
+                impl = ("stream" if (jax.default_backend() == "tpu" and
+                                     self._offload_cfg.device == "cpu" and
+                                     not config.fp16.enabled)
+                        else "host")
+            if impl == "stream":
+                if self._offload_cfg.device == "nvme":
+                    raise ValueError(
+                        "offload_optimizer.implementation='stream' holds "
+                        "state in TPU-host pinned memory; the nvme tier "
+                        "needs implementation='host' (aio swap files)")
+                if jax.default_backend() != "tpu":
+                    raise ValueError(
+                        "offload_optimizer.implementation='stream' needs "
+                        "a TPU backend (XLA:CPU lacks memory-space "
+                        "shardings); use 'host' or 'auto'")
+                if config.fp16.enabled:
+                    raise ValueError(
+                        "streamed offload supports bf16/fp32 training; "
+                        "fp16's overflow-skip cond cannot wrap "
+                        "memory-space transfers — use "
+                        "implementation='host' for fp16")
+            self._offload_stream = impl == "stream"
         # ZeRO-3 parameter offload (stage3.py:448; partitioned_param_swapper)
         pc = config.zero_config.offload_param
         self._param_offload_cfg = pc if (pc is not None and
@@ -246,7 +282,7 @@ class DeepSpeedEngine:
                 self._param_offload_cfg.nvme_path)
         self.state = self._init_state(params)
         self.host_opt = None
-        if self._offload_cfg is not None:
+        if self._offload_cfg is not None and not self._offload_stream:
             opt_type = (opt_cfg.type if opt_cfg else "AdamW").lower()
             if opt_type not in ("adam", "adamw", "fusedadam", "cpuadam"):
                 raise ValueError(
@@ -304,7 +340,7 @@ class DeepSpeedEngine:
                     "params are re-derived from the unquantized fp32 "
                     "master each step (reference engine.py:1412 asserts "
                     "fp16)")
-            if self.host_opt is not None:
+            if self.host_opt is not None or self._offload_stream:
                 raise NotImplementedError(
                     "MoQ is not wired into the ZeRO-Offload host step; "
                     "disable offload_optimizer or in-forward quantize "
@@ -368,9 +404,14 @@ class DeepSpeedEngine:
         compute_dtype = self.compute_dtype
         mixed = self.mixed_precision
         opt_init = self.optimizer.init
-        # host offload: fp32 master + moments live in host RAM/NVMe
-        # (runtime/zero/offload.py) — nothing optimizer-shaped on device
-        offload = self._offload_cfg is not None
+        # host offload (C++ path): fp32 master + moments live in process
+        # RAM/NVMe (runtime/zero/offload.py) — nothing optimizer-shaped on
+        # device. Streamed offload instead keeps them as jax arrays in
+        # pinned_host memory, handled below.
+        offload = self._offload_cfg is not None and not self._offload_stream
+        if self._offload_stream:
+            host_kind = lambda s: s.with_memory_kind("pinned_host")  # noqa: E731
+            master_sh = jax.tree.map(host_kind, master_sh)
 
         def init_fn(p):
             p32 = cast_tree(p, jnp.float32)
@@ -395,6 +436,11 @@ class DeepSpeedEngine:
                 if hasattr(opt_shape, field) and \
                         getattr(opt_shape, field) is not None:
                     opt_sh = opt_sh.replace(**{field: master_sh})
+            if self._offload_stream:
+                # the whole optimizer tree (moments + scalar counters)
+                # lives in TPU-host pinned memory between steps
+                opt_sh = jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"), opt_sh)
 
         mixed = mixed and not offload
         shardings = (param_sh, master_sh if mixed else None, opt_sh)
@@ -537,6 +583,20 @@ class DeepSpeedEngine:
         mixed = self.mixed_precision
         fp16 = self.config.fp16.enabled
         grad_core = self._make_grad_core()
+        stream = self._offload_stream
+        if stream:
+            # streamed offload: master/moments enter in pinned_host; move
+            # each leaf into device space for the update and back after.
+            # XLA's latency-hiding scheduler pipelines the per-leaf DMAs
+            # against the update arithmetic (the overlap the reference
+            # builds by hand with copy streams, stage_1_and_2.py:1069).
+            to_dev = lambda tree, sh: jax.tree.map(  # noqa: E731
+                lambda x, s: jax.device_put(x, s.with_memory_kind("device")),
+                tree, sh)
+            to_host = lambda tree, sh: jax.tree.map(  # noqa: E731
+                lambda x, s: jax.device_put(x, s), tree, sh)
+            master_host_sh = self._state_shardings.master
+            opt_host_sh = self._state_shardings.opt_state
 
         def step_fn(state: TrainState, batch, rng):
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
@@ -547,6 +607,10 @@ class DeepSpeedEngine:
 
             def do_update(operand):
                 grads_, master_, opt_state_ = operand
+                if stream:
+                    if mixed:
+                        master_ = to_dev(master_, master_host_sh)
+                    opt_state_ = to_dev(opt_state_, opt_host_sh)
                 updates, new_opt = optimizer.update(
                     grads_, opt_state_, master_, lr)
                 new_master = jax.tree.map(jnp.add, master_, updates)
@@ -565,12 +629,20 @@ class DeepSpeedEngine:
                     (grads, master, state.opt_state))
 
             if mixed:
+                # cast to compute dtype while the fresh master is still in
+                # device space (stream: BEFORE spilling it back to host —
+                # a host-space input here would put the cast off-device)
                 new_params = cast_tree(new_master, self.compute_dtype)
+                if stream:
+                    new_master = to_host(new_master, master_host_sh)
+                    new_opt = to_host(new_opt, opt_host_sh)
                 new_state = state.replace(
                     step=state.step + 1, params=new_params,
                     master=new_master, opt_state=new_opt,
                     loss_scale=update_loss_scale(state.loss_scale, finite))
             else:
+                if stream:
+                    new_opt = to_host(new_opt, opt_host_sh)
                 new_state = state.replace(
                     step=state.step + 1, params=new_master,
                     opt_state=new_opt,
@@ -923,12 +995,7 @@ class DeepSpeedEngine:
         micro-batches (SURVEY §3.2)."""
         if batch is None:
             batch = next(self.training_dataloader)
-        if jax.process_count() > 1:
-            # multi-host: each process feeds its local shard of the global
-            # batch (the reference's per-rank convention); assemble the
-            # global jax.Array the compiled SPMD step consumes
-            from deepspeed_tpu.runtime.dataloader import assemble_global_batch
-            batch = assemble_global_batch(batch, self.mesh)
+        batch = self._global_micro_batch(batch)
         leading = jax.tree.leaves(batch)[0].shape[0]
         expected = self.micro_batch_size * self.gas * \
             get_data_parallel_world_size(self.mesh)

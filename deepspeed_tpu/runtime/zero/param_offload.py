@@ -39,18 +39,25 @@ from deepspeed_tpu.utils.tree import flatten_with_names
 class ParamSwapper:
     """Spills the (host-resident) param pytree to swap files between steps.
 
-    ``swap_out(params)`` writes every leaf through the aio pool, drops the
-    array references, and returns a placeholder tree of
-    ``jax.ShapeDtypeStruct``; ``swap_in(shardings)`` reads the files back
-    and re-materializes the tree with the given shardings (host memory
-    kind). ``partitioned_param_swapper.py`` semantics; swap granularity is
-    the whole tree per step (the fused step consumes all params at once).
+    ``swap_out(params)`` streams every leaf through the aio pool —
+    device→host copy of leaf ``i+1`` overlaps the file write of leaf
+    ``i``, draining whenever more than ``inflight_bytes`` of staged
+    buffers are outstanding, so host RAM during the spill is bounded by
+    the drain threshold (+ the leaf being staged), not the model, and
+    nothing stays pinned between steps. ``swap_in`` re-materializes per
+    leaf with a one-leaf-ahead read pipeline — the file read of leaf
+    ``i+1`` is in flight while leaf ``i``'s host→memory placement
+    dispatches. This is the reference's double-buffered per-param
+    streaming (``partitioned_param_swapper.py:1-422`` +
+    ``async_swapper.py``), with the aio queue as the buffer pool.
     """
 
-    def __init__(self, swap_dir: str, num_threads: int = 4):
+    def __init__(self, swap_dir: str, num_threads: int = 4,
+                 inflight_bytes: int = 256 << 20):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
         self.aio = AsyncIOHandle(num_threads)
+        self.inflight_bytes = inflight_bytes
         self.on_disk = False
         self._meta: Optional[dict] = None
         self._treedef = None
@@ -61,17 +68,28 @@ class ParamSwapper:
         safe = key.replace("/", "_").replace(".", "_")
         return os.path.join(self.swap_dir, f"param_{safe}.swp")
 
+    def _drain(self, what: str) -> None:
+        if self.aio.wait() != 0:
+            raise IOError(f"param {what} failed")
+
     def swap_out(self, params: Any) -> Any:
         leaves = flatten_with_names(params)
         if self._meta is None:
             self._meta = {k: (v.shape, v.dtype) for k, v in leaves.items()}
             self._treedef = jax.tree_util.tree_structure(params)
-        host = {k: np.asarray(v) for k, v in leaves.items()}
-        for k, arr in host.items():
-            arr = np.ascontiguousarray(arr)
-            self.aio.pwrite(self._path(k), arr)
-        if self.aio.wait() != 0:
-            raise IOError("param swap-out failed")
+        staged = 0
+        for k, v in leaves.items():
+            # np.asarray is the (synchronous) device→host pull of THIS
+            # leaf; the aio write it feeds runs while the next leaf pulls
+            buf = np.ascontiguousarray(np.asarray(v))
+            self.aio.pwrite(self._path(k), buf)
+            staged += buf.nbytes
+            if staged >= self.inflight_bytes:
+                # bound host RAM: the aio handle pins staged buffers until
+                # wait(); drain before staging another threshold's worth
+                self._drain("swap-out")
+                staged = 0
+        self._drain("swap-out")
         self.on_disk = True
         placeholders = [jax.ShapeDtypeStruct(*self._meta[k])
                         for k in leaves]
@@ -81,17 +99,23 @@ class ParamSwapper:
         if not self.on_disk:
             raise RuntimeError("swap_in with no params on disk")
         keys = list(self._meta)
-        bufs = {}
-        for k in keys:
-            shape, dtype = self._meta[k]
-            buf = np.empty(shape, np.dtype(dtype))
-            self.aio.pread(self._path(k), buf)
-            bufs[k] = buf
-        if self.aio.wait() != 0:
-            raise IOError("param swap-in failed")
         sh_leaves = jax.tree_util.tree_leaves(
             shardings, is_leaf=lambda x: hasattr(x, "memory_kind"))
-        arrays = [jax.device_put(bufs[k], s)
-                  for k, s in zip(keys, sh_leaves)]
+        bufs = {}
+
+        def submit(key: str) -> None:
+            shape, dtype = self._meta[key]
+            buf = np.empty(shape, np.dtype(dtype))
+            self.aio.pread(self._path(key), buf)
+            bufs[key] = buf
+
+        arrays = []
+        if keys:
+            submit(keys[0])
+        for i, k in enumerate(keys):
+            self._drain("swap-in")          # read of leaf i complete
+            if i + 1 < len(keys):
+                submit(keys[i + 1])         # in flight during placement
+            arrays.append(jax.device_put(bufs.pop(k), sh_leaves[i]))
         self.on_disk = False
         return jax.tree_util.tree_unflatten(self._treedef, arrays)

@@ -282,3 +282,27 @@ def test_step_streamed_matches_step():
         np.testing.assert_array_equal(opt1.master[k], opt2.master[k])
         np.testing.assert_array_equal(opt1.state[k]["m"], opt2.state[k]["m"])
     assert opt1.adam.step_count == opt2.adam.step_count == 4
+
+
+# ------------------------------------------------ streamed offload guards
+
+def test_stream_offload_requires_tpu_backend():
+    """implementation='stream' (pinned_host state + on-device update) needs
+    memory-space shardings — absent on XLA:CPU; must refuse loudly."""
+    with pytest.raises(ValueError, match="TPU backend"):
+        _make_engine({"offload_optimizer": {"device": "cpu",
+                                            "implementation": "stream"}})
+
+
+def test_stream_offload_rejects_nvme():
+    with pytest.raises(ValueError, match="nvme"):
+        _make_engine({"offload_optimizer": {"device": "nvme",
+                                            "nvme_path": "/tmp/x",
+                                            "implementation": "stream"}})
+
+
+def test_offload_auto_resolves_to_host_on_cpu_backend():
+    """auto on the CPU test backend must keep the C++ host path working
+    (the parity test above already exercises it end to end)."""
+    eng = _make_engine({"offload_optimizer": {"device": "cpu"}})
+    assert eng.host_opt is not None and not eng._offload_stream
