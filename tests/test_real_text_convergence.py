@@ -1,0 +1,69 @@
+"""Real-data convergence: tiny GPT-2 on vendored English prose.
+
+The reference ships accuracy-baselined model tests that train on real
+corpora to a known loss (tests/model/Megatron_GPT2/, BingBertSquad) —
+synthetic-data smoke tests cannot catch a subtly-wrong attention mask or
+position encoding that still "trains" on noise. This is the TPU-native
+analog: byte-level LM on a vendored 63 KB slice of real English text
+(system license prose — redistributable), trained through the full
+engine + DeepSpeedDataLoader stack to a pinned loss.
+
+Calibration (8-device CPU mesh, seed 0): step-0 loss 5.548 (≈ ln 256 =
+5.545, the uniform baseline), step 200 ≈ 2.20, step 400 ≈ 1.26. The
+threshold pins well above the observed value but far below what any
+degenerate model reaches.
+"""
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+SEQ = 128
+
+
+class ByteDataset:
+    def __init__(self):
+        path = os.path.join(os.path.dirname(__file__), "data",
+                            "real_text.txt")
+        raw = open(path, "rb").read()
+        self.data = np.frombuffer(raw, np.uint8).astype(np.int32)
+
+    def __len__(self):
+        return (len(self.data) - 1) // SEQ
+
+    def __getitem__(self, i):
+        return {"input_ids": self.data[i * SEQ:(i + 1) * SEQ]}
+
+
+def test_tiny_gpt2_converges_on_real_text():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+
+    model = GPT2LMModel(GPT2Config(
+        n_layer=2, n_embd=128, n_head=4, vocab_size=256, n_positions=SEQ,
+        use_flash_attention=False, remat=False, vocab_pad_multiple=128))
+    params = model.init(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        training_data=ByteDataset(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 50}},
+                "zero_optimization": {"stage": 1}})
+
+    first = float(engine.train_batch()["loss"])
+    # byte-uniform start: a wrong vocab padding/logit mask would shift this
+    assert abs(first - np.log(256)) < 0.25, first
+
+    loss = first
+    for _ in range(199):
+        loss = engine.train_batch()["loss"]
+    final = float(loss)
+    # calibrated ~2.20 at step 200; 2.75 leaves noise margin while being
+    # unreachable without genuinely modeling the text (English byte
+    # entropy); also well below half the uniform baseline
+    assert final < 2.75, f"no real-text convergence: step-200 loss {final}"
