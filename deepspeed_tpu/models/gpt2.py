@@ -241,8 +241,14 @@ class GPT2(nn.Module):
                     t, "block", self.fetch_table),
                 trans_out_fn=lambda t: t, mutable=True, init=True)
         if cfg.remat:
-            block = nn.remat(block, prevent_cse=False,
-                             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            # dots-saveable + the flash kernel's tagged output: the policy
+            # cannot see through the kernel's custom_vjp, so without the
+            # name the flash forward re-runs in backward (ops/attention.py)
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_attn_out"))
+            block = nn.remat(block, prevent_cse=False, policy=policy)
         for i in range(cfg.n_layer):
             x = block(cfg, name=f"h_{i}")(x, deterministic)
 

@@ -43,7 +43,16 @@ def causal_attention_reference(q, k, v, scale=None, causal=True):
 
 
 def causal_attention(q, k, v):
-    """Causal self-attention ``[B, T, H, D] -> [B, T, H, D]``."""
+    """Causal self-attention ``[B, T, H, D] -> [B, T, H, D]``.
+
+    The flash output is tagged with ``checkpoint_name('flash_attn_out')``:
+    under ``jax.checkpoint`` the dots-saveable remat policy cannot see
+    inside the kernel's custom_vjp, so without the tag the whole flash
+    forward would re-run during backward — measured as a net train-step
+    LOSS vs unfused attention at seq 1024 despite the kernel itself being
+    several times faster. Models extend their policy with
+    ``save_only_these_names('flash_attn_out')`` (models/gpt2.py).
+    """
     if _on_tpu() and q.shape[1] >= 256:
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
@@ -52,5 +61,7 @@ def causal_attention(q, k, v):
             warning_once("pallas flash attention unavailable; falling back to "
                          "O(T^2) reference attention")
         else:
-            return flash_attention(q, k, v, causal=True)
+            from jax.ad_checkpoint import checkpoint_name
+            return checkpoint_name(flash_attention(q, k, v, causal=True),
+                                   "flash_attn_out")
     return causal_attention_reference(q, k, v)

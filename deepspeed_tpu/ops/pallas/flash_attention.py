@@ -26,8 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
 
@@ -42,7 +42,10 @@ def _should_interpret() -> bool:
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
                 block_q: int, block_k: int, seq_len: int, causal: bool):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    # keep the dot INPUTS in the storage dtype (bf16): the MXU runs bf16
+    # at full rate and accumulates fp32 via preferred_element_type; an
+    # upfront fp32 cast would quarter the matmul throughput
+    q = q_ref[0]  # [BQ, D]
     bq, d = q.shape
 
     m = jnp.full((bq, 1), NEG_INF, jnp.float32)
@@ -59,10 +62,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             col = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -72,7 +75,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -119,8 +122,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale: float, block_q: int, block_k: int,
                    seq_len: int, causal: bool):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    # bf16 dot inputs, fp32 accumulation (see _fwd_kernel note)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0]  # [BQ, 1]
     delta = delta_ref[0]  # [BQ, 1]
     bq, d = q.shape
@@ -133,10 +137,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             col = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -144,7 +148,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -156,8 +160,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale: float, block_q: int,
                     block_k: int, seq_len: int, causal: bool):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [BK, D]
-    v = v_ref[0].astype(jnp.float32)
+    # bf16 dot inputs, fp32 accumulation (see _fwd_kernel note)
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
     bk, d = k.shape
     dk = jnp.zeros((bk, d), jnp.float32)
     dv = jnp.zeros((bk, d), jnp.float32)
@@ -168,31 +173,31 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) \
-            * scale
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]  # [BQ, 1]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             row = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(row >= col, s, NEG_INF)
         p = jnp.exp(s - lse)
+        p16 = p.astype(k.dtype)
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p16, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dk_new = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
     dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -288,8 +293,20 @@ def flash_attention(q, k, v, causal: bool = True,
     pads to n_positions, itself a multiple of 128).
     """
     B, T, H, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
+
+    def fit(b: int) -> int:
+        # largest power-of-two fraction of the requested block ≥ 128 that
+        # tiles T exactly (callers gate on T % 128 == 0, so 128 always
+        # fits; the 256 default would otherwise reject T = 384, 640, ...).
+        # Never shrinks below 128 — smaller tiles don't fit the MXU; a T
+        # that defeats even 128 still errors below, as before.
+        b = min(b, T)
+        while b > 128 and T % b:
+            b //= 2
+        return b
+
+    block_q = fit(block_q)
+    block_k = fit(block_k)
     if T % block_q or T % block_k:
         raise ValueError(f"seq len {T} not divisible by blocks "
                          f"({block_q}, {block_k})")

@@ -61,6 +61,39 @@ class TestFlashAttention:
         with pytest.raises(ValueError):
             flash_attention(q, k, v, block_q=128, block_k=64)
 
+    def test_block_fallback_on_128_multiples(self):
+        """The 256 defaults must not reject T that only divides by 128
+        (callers gate flash on T % 128 == 0 — ops/transformer.py:163)."""
+        q, k, v = self._qkv(T=384)
+        o = flash_attention(q, k, v, causal=True)
+        o_ref = causal_attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_bf16_forward_and_grad_parity(self):
+        """The production dtype: kernel dots take bf16 inputs with fp32
+        accumulation; p/ds are downcast before the MXU dots. Parity vs the
+        fp32 reference within bf16-rounding tolerances."""
+        q, k, v = self._qkv(T=256, dtype=jnp.bfloat16)
+        q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+
+        o = flash_attention(q, k, v, causal=True)
+        o_ref = causal_attention_reference(q32, k32, v32)
+        np.testing.assert_allclose(np.asarray(o, np.float32),
+                                   np.asarray(o_ref), rtol=2e-2, atol=2e-2)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v).astype(jnp.float32) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(causal_attention_reference(q, k, v) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q32, k32, v32)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b), rtol=1e-1, atol=0.15)
+
 
 class TestDecodeAttention:
     def test_parity_with_ragged_lengths(self):
