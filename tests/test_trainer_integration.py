@@ -1,0 +1,123 @@
+"""Community-trainer integration smoke.
+
+The reference ships a PyTorch-Lightning integration test
+(tests/lightning/test_simple.py: DeepSpeed as a drop-in strategy under a
+third-party training loop). The flax/optax analog: a user's OWN plain
+``nn.Module`` and loss closure — not our model wrappers — must train
+through ``deepspeed_tpu.initialize`` unchanged, and the engine must be a
+drop-in for a hand-written optax loop (bit-close trajectory parity).
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+
+class UserMLP(nn.Module):
+    """A module a community user would write — no framework hooks."""
+    hidden: int = 64
+    classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.classes)(x)
+
+
+def _data(n=256, d=32, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes))
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(n, classes)), axis=1)
+    return x, y.astype(np.int32)
+
+
+def _loss_fn(model):
+    def loss_fn(params, batch, rng=None):
+        logits = model.apply({"params": params}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+    return loss_fn
+
+
+def test_plain_flax_module_trains_and_checkpoints(tmp_path):
+    import deepspeed_tpu
+    model = UserMLP()
+    x, y = _data()
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, loss_fn=_loss_fn(model),
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 3},
+                "bf16": {"enabled": True}})
+    bs = engine.train_batch_size
+    losses = []
+    for step in range(15):
+        lo = (step * bs) % (len(x) - bs)
+        m = engine.train_batch({"x": x[lo:lo + bs], "y": y[lo:lo + bs]})
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    # the user's own inference path keeps working on the trained params
+    logits = model.apply({"params": jax.device_get(engine.state.params)},
+                         jnp.asarray(x[:16]))
+    assert logits.shape == (16, 10)
+
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, loss_fn=_loss_fn(model),
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 3},
+                "bf16": {"enabled": True}})
+    engine2.load_checkpoint(str(tmp_path / "ck"))
+    assert engine2.global_steps == 15
+
+
+def test_engine_matches_hand_written_optax_loop():
+    """Drop-in claim, quantified: fp32 / ZeRO-1 engine training equals a
+    vanilla optax adamw loop on the same data to float tolerance."""
+    import deepspeed_tpu
+    model = UserMLP()
+    x, y = _data(seed=3)
+    params = model.init(jax.random.PRNGKey(1), x[:1])["params"]
+    loss_fn = _loss_fn(model)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, loss_fn=loss_fn,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 1e-2, "weight_decay": 0.01,
+                                         "betas": [0.9, 0.999],
+                                         "eps": 1e-8}},
+                "zero_optimization": {"stage": 1}})
+    bs = engine.train_batch_size
+
+    tx = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    opt_state = tx.init(params)
+    ref = params
+
+    @jax.jit
+    def ref_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for step in range(8):
+        lo = (step * bs) % (len(x) - bs)
+        batch = {"x": x[lo:lo + bs], "y": y[lo:lo + bs]}
+        m = engine.train_batch(batch)
+        ref, opt_state, ref_loss = ref_step(ref, opt_state, batch)
+        assert float(m["loss"]) == pytest.approx(float(ref_loss), rel=2e-4)
+
+    for a, b in zip(jax.tree.leaves(engine.state.params),
+                    jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
