@@ -238,22 +238,23 @@ class DeepSpeedEngine:
                                      not config.fp16.enabled)
                         else "host")
             if impl == "stream":
+                # backend-independent refusals first (testable everywhere)
                 if self._offload_cfg.device == "nvme":
                     raise ValueError(
                         "offload_optimizer.implementation='stream' holds "
                         "state in TPU-host pinned memory; the nvme tier "
                         "needs implementation='host' (aio swap files)")
-                if jax.default_backend() != "tpu":
-                    raise ValueError(
-                        "offload_optimizer.implementation='stream' needs "
-                        "a TPU backend (XLA:CPU lacks memory-space "
-                        "shardings); use 'host' or 'auto'")
                 if config.fp16.enabled:
                     raise ValueError(
                         "streamed offload supports bf16/fp32 training; "
                         "fp16's overflow-skip cond cannot wrap "
                         "memory-space transfers — use "
                         "implementation='host' for fp16")
+                if jax.default_backend() != "tpu":
+                    raise ValueError(
+                        "offload_optimizer.implementation='stream' needs "
+                        "a TPU backend (XLA:CPU lacks memory-space "
+                        "shardings); use 'host' or 'auto'")
             self._offload_stream = impl == "stream"
         # ZeRO-3 parameter offload (stage3.py:448; partitioned_param_swapper)
         pc = config.zero_config.offload_param

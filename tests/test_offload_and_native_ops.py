@@ -301,6 +301,15 @@ def test_stream_offload_rejects_nvme():
                                             "implementation": "stream"}})
 
 
+def test_stream_offload_rejects_fp16():
+    """fp16's overflow-skip cond cannot wrap memory-space transfers; the
+    refusal fires before the backend check so it pins everywhere."""
+    with pytest.raises(ValueError, match="fp16"):
+        _make_engine({"offload_optimizer": {"device": "cpu",
+                                            "implementation": "stream"}},
+                     dtype="fp16")
+
+
 def test_offload_auto_resolves_to_host_on_cpu_backend():
     """auto on the CPU test backend must keep the C++ host path working
     (the parity test above already exercises it end to end)."""
