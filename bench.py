@@ -462,7 +462,33 @@ PHASES = {
 }
 
 
-INFRA = {"relay_probes_ok": 0, "relay_probes_failed": 0}
+INFRA = {"relay_probes_ok": 0, "relay_probes_failed": 0,
+         "relay_dead_checks": 0}
+
+# /root/.relay.py PORTS — the stdio tunnel's listeners. Clients block
+# identically in device init whether the relay is WEDGED (server busy;
+# can clear) or DEAD (process gone; unrecoverable in-session), so the
+# LISTEN check is the only cheap discriminator.
+RELAY_PORTS = {8082, 8083, 8087, 8092, 8093, 8097, 8102, 8103, 8107,
+               8112, 8113, 8117}
+
+
+def relay_listening() -> bool:
+    """True if any relay tunnel port has a LISTEN socket (state 0A)."""
+    found = False
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as fh:
+                next(fh)
+                for line in fh:
+                    parts = line.split()
+                    if parts[3] != "0A":
+                        continue
+                    if int(parts[1].rsplit(":", 1)[1], 16) in RELAY_PORTS:
+                        found = True
+        except (OSError, StopIteration, ValueError, IndexError):
+            return True  # cannot tell — assume alive, let probes decide
+    return found
 
 
 def chip_responsive(timeout_s: float = 60.0) -> bool:
@@ -483,15 +509,24 @@ def chip_responsive(timeout_s: float = 60.0) -> bool:
 
 
 def wait_for_chip(budget_left: float) -> bool:
-    """Poll until the relay answers or the budget is nearly gone."""
+    """Poll until the relay answers or the budget is nearly gone. A DEAD
+    relay (no tunnel listener) is polled cheaply without burning 60-s
+    device-init probes; it can still come back if the orchestrator
+    restarts it, so keep checking until the budget says stop."""
     t0 = time.time()
     while budget_left - (time.time() - t0) > 180:
+        if not relay_listening():
+            INFRA["relay_dead_checks"] += 1
+            log("relay DEAD (no tunnel listener on relay ports) — "
+                "cheap-polling for an orchestrator restart")
+            time.sleep(60)
+            continue
         if chip_responsive(60):
             return True
         log("relay unresponsive — waiting 60s before re-probing "
             "(killed-mid-compile wedge; see verify SKILL.md)")
         time.sleep(60)
-    return chip_responsive(30)
+    return relay_listening() and chip_responsive(30)
 
 
 def run_phase(name: str, budget_left: float, adaptive: bool = False):
@@ -654,16 +689,24 @@ def main() -> None:
         detail["inference_p50"] = {
             k: v for k, v in infer.items() if k != "phase"}
     if best is None:
+        relay_dead = (INFRA["relay_dead_checks"] > 0 and
+                      INFRA["relay_probes_ok"] == 0)
         relay_wedged = (INFRA["relay_probes_failed"] > 0 and
                         INFRA["relay_probes_ok"] == 0)
+        if relay_dead:
+            err = ("infrastructure: axon relay process DEAD (no tunnel "
+                   "listener) for the whole window — no phase started "
+                   "(framework not exercised; not a framework slowness)")
+        elif relay_wedged:
+            err = ("infrastructure: axon relay never answered a device-"
+                   "init probe — no phase started (framework not "
+                   "exercised; not a framework slowness)")
+        else:
+            err = "no training phase completed within budget"
         print(json.dumps({
             "metric": "zero3_bf16_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
-            "error": ("infrastructure: axon relay never answered a device-"
-                      "init probe — no phase started (framework not "
-                      "exercised; not a framework slowness)"
-                      if relay_wedged else
-                      "no training phase completed within budget"),
+            "error": err,
             "detail": detail}), flush=True)
         return
     tps = best["tokens_per_sec_per_chip"]
