@@ -157,8 +157,11 @@ class BertPreTrainingModel:
         labels = batch["mlm_labels"]
         live = labels != -100
         safe = jnp.where(live, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        tok_ll = jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+        # lse - gold (not log_softmax): reductions only, no fp32 [.., V]
+        # log-prob tensor materialized (see models/gpt2.py loss_fn)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[..., None], -1)[..., 0]
+        tok_ll = gold - lse
         denom = jnp.maximum(jnp.sum(live), 1)
         loss = -jnp.sum(jnp.where(live, tok_ll, 0.0)) / denom
         if cfg.with_nsp and "nsp_labels" in batch:

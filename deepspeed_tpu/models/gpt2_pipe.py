@@ -91,8 +91,12 @@ class GPT2PipeModel:
             labels = input_ids[:, 1:]
             logits = logits[:, :-1]
         logits = logits.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        # lse - gold (see models/gpt2.py loss_fn): no [B, T, V] fp32
+        # log-prob tensor
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None],
+                                   axis=-1)[..., 0]
+        nll = lse - gold
         mask = (labels >= 0) & (labels < self.config.vocab_size)
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
 
