@@ -459,6 +459,13 @@ PHASES = {
     # the remat+flash compile is what hangs
     "train-350m-flash-noremat": (["--preset", "gpt2-350m",
                                   "--no-remat"], 480),
+    # long-context: seq 4096 is where streaming K/V through VMEM beats
+    # materialized [T,T] attention outright (isolated kernel sweep: ~6x);
+    # the no-flash twin quantifies the delta on the same workload
+    "train-350m-flash-seq4k": (["--preset", "gpt2-350m", "--seq", "4096",
+                                "--micro", "1"], 480),
+    "train-350m-noflash-seq4k": (["--preset", "gpt2-350m", "--seq", "4096",
+                                  "--micro", "1", "--no-flash"], 480),
 }
 
 

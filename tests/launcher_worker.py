@@ -40,14 +40,21 @@ def main():
     nproc = jax.process_count()
     pid = jax.process_index()
     total = jax.device_count()
+    # DSTPU_WORKER_TENSOR=2 runs Megatron-TP with the tensor axis SPANNING
+    # the process boundary (2 procs x 1 device): every qkv/mlp psum is a
+    # real cross-process collective
+    tensor = int(os.environ.get("DSTPU_WORKER_TENSOR", "1"))
 
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
     model = GPT2LMModel(GPT2Config(
         n_layer=2, n_embd=64, n_head=4, vocab_size=256, n_positions=64,
         use_flash_attention=False, vocab_pad_multiple=64))
     params = model.init(jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(tensor=tensor))
     engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model, model_parameters=params,
+        model=model, model_parameters=params, mesh=mesh,
+        tp_specs=model.tp_specs() if tensor > 1 else None,
         config={"train_micro_batch_size_per_gpu": 2,
                 # fp32 end to end: parity between process topologies is
                 # asserted tightly by the test
@@ -56,23 +63,34 @@ def main():
 
     rng = np.random.default_rng(1234)
     micro, seq = 2, 32
-    global_rows = micro * total
-    local_rows = global_rows // nproc
+    dp = total // tensor
+    global_rows = micro * dp
+    # per-rank feeding convention: each process supplies the rows its own
+    # devices hold under the data-axis sharding — with the data axis not
+    # spanning processes (pure TP), that is the whole batch
+    local_rows = global_rows // nproc if dp >= nproc else global_rows
     losses = []
     for _ in range(3):
         # every process generates the identical global batch from the
         # shared seed, then feeds ONLY its local shard — the engine
         # assembles the global array (assemble_global_batch)
         full = rng.integers(0, 256, (global_rows, seq)).astype(np.int32)
-        local = full[pid * local_rows:(pid + 1) * local_rows]
+        if dp >= nproc:
+            local = full[pid * local_rows:(pid + 1) * local_rows]
+        else:
+            local = full
         metrics = engine.train_batch({"input_ids": local})
         losses.append(float(metrics["loss"]))
 
-    # params are replicated under ZeRO-1 → every process holds the full
-    # value; a scalar checksum pins the trained weights across topologies
-    checksum = float(sum(
-        jnp.sum(x.astype(jnp.float32) ** 2)
-        for x in jax.tree.leaves(engine.state.params)))
+    # scalar checksum pins the trained weights across topologies; the
+    # jitted reduction handles TP-sharded (non-addressable) params too —
+    # the replicated scalar output is readable from every process
+    @jax.jit
+    def _sq_norm(tree):
+        return sum(jnp.sum(x.astype(jnp.float32) ** 2)
+                   for x in jax.tree.leaves(tree))
+
+    checksum = float(_sq_norm(engine.state.params))
     if pid == 0:
         print("RESULT " + json.dumps({
             "process_count": nproc,

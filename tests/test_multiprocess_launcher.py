@@ -28,7 +28,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch(num_procs: int, devs_per_proc: int) -> dict:
+def _launch(num_procs: int, devs_per_proc: int, tensor: int = 1) -> dict:
     env = os.environ.copy()
     # the worker sets its own per-process device count; the pytest
     # conftest's 8-device flag must not leak in
@@ -42,6 +42,7 @@ def _launch(num_procs: int, devs_per_proc: int) -> dict:
     # devices. Keep only the repo root.
     env["PYTHONPATH"] = os.path.abspath(ROOT)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["DSTPU_WORKER_TENSOR"] = str(tensor)
     cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
            "--nnodes", "1", "--node_rank", "0",
            "--master_addr", "127.0.0.1",
@@ -75,3 +76,19 @@ def test_two_process_dp_matches_single_process():
     np.testing.assert_allclose(multi["param_sq_norm"],
                                single["param_sq_norm"], rtol=1e-5)
     assert all(np.isfinite(multi["losses"]))
+
+
+def test_cross_process_tensor_parallel_matches_single_process():
+    """Megatron-TP with the tensor axis SPANNING processes (2 procs x 1
+    device): every qkv/mlp reduction is a real cross-process collective —
+    the boundary the single-process dryrun cannot exercise."""
+    multi = _launch(num_procs=2, devs_per_proc=1, tensor=2)
+    single = _launch(num_procs=1, devs_per_proc=2, tensor=2)
+
+    assert multi["process_count"] == 2 and multi["device_count"] == 2
+    assert single["process_count"] == 1 and single["device_count"] == 2
+
+    np.testing.assert_allclose(multi["losses"], single["losses"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(multi["param_sq_norm"],
+                               single["param_sq_norm"], rtol=1e-5)
