@@ -310,6 +310,8 @@ class DeepSpeedEngine:
         self._micro_steps = 0
         self.global_steps = 0
         self.skipped_steps = 0
+        self._train_mode = True
+        self._last_skipped = None
         self._rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
@@ -1012,6 +1014,7 @@ class DeepSpeedEngine:
         if self.host_opt is not None:
             out = self._offload_train_batch(batch)
             self._maybe_swap_params_out()
+            self._last_skipped = out.get("skipped")
             return out
         if (self._sparse_grad_axes and self._step_fn is not None and
                 tuple(tuple(x.shape) for x in jax.tree.leaves(batch))
@@ -1026,7 +1029,8 @@ class DeepSpeedEngine:
         profiling = (self.flops_profiler is not None and
                      self.global_steps + 1 ==
                      self.flops_profiler.profile_step)
-        if self.quantizer is not None and self.global_steps == 0:
+        if self.quantizer is not None and self.global_steps == 0 and \
+                not getattr(self, "_moq_step0_done", False):
             # "quantization happens at step 0" (reference engine.py:1786):
             # the initial weights are quantized before the first update
             self._moq_boundary(batch, overflow=False, step_zero=True)
@@ -1095,6 +1099,7 @@ class DeepSpeedEngine:
             self.flops_profiler.print_model_profile()
         self.global_steps += 1
         self._micro_steps += self.gas
+        self._last_skipped = metrics.get("skipped")
         if self.config.fp16.enabled and bool(metrics["skipped"]):
             self.skipped_steps += 1
         self.tput_timer.stop(global_step=self.global_steps,
@@ -1113,6 +1118,8 @@ class DeepSpeedEngine:
         Mirrors the reference boundary block (engine.py:2146-2166):
         eigenvalue recompute every ``gas_boundary_resolution`` boundaries
         while a precision switch is still pending, then quantize."""
+        if step_zero:
+            self._moq_step0_done = True
         if self.global_steps < self.quantizer.cfg.schedule_offset:
             # full-precision warmup (shared_parameters.schedule_offset —
             # the compression scheduler gates the reference the same way)
@@ -1203,11 +1210,15 @@ class DeepSpeedEngine:
         return batch
 
     def forward(self, batch):
-        """Loss for one micro-batch (no grad) — engine.forward analog."""
+        """Loss for one micro-batch (no grad) — engine.forward analog.
+        In eval mode (``engine.eval()``) no rng is passed, so dropout and
+        any other rng-gated stochasticity are off."""
         if self._grad_fn is None:
             self._build_grad_fn()
         self._ensure_params_resident()
         batch = self._global_micro_batch(batch)
+        if not getattr(self, "_train_mode", True):
+            return self._loss_only_fn(self.state.params, batch, None)
         self._rng, rng = jax.random.split(self._rng)
         return self._loss_only_fn(self.state.params, batch, rng)
 
@@ -1227,8 +1238,10 @@ class DeepSpeedEngine:
         self._ensure_params_resident()
         batch = self._global_micro_batch(batch)
         if self.quantizer is not None and self.global_steps == 0 and \
-                self._micro_steps == 0:
-            # step-0 quantization on this path too (engine.py:1786)
+                self._micro_steps == 0 and \
+                not getattr(self, "_moq_step0_done", False):
+            # step-0 quantization on this path too (engine.py:1786);
+            # one-shot — zero_grad() must not re-arm it
             self._moq_boundary(batch, overflow=False, step_zero=True)
         self._last_micro_batch = batch  # eigenvalue probe batch for step()
         self._rng, rng = jax.random.split(self._rng)
@@ -1250,6 +1263,7 @@ class DeepSpeedEngine:
         engine.step analog (engine.py:2124). No-op off-boundary, like the
         reference under GAS."""
         if not self.is_gradient_accumulation_boundary():
+            self._last_skipped = True  # no-op step: nothing applied
             return None
         if self._pending_grads is None:
             raise RuntimeError("step() called with no accumulated gradients")
@@ -1266,6 +1280,7 @@ class DeepSpeedEngine:
             overflow = self.config.fp16.enabled and bool(metrics["skipped"])
             self._moq_boundary(self._last_micro_batch, overflow=overflow)
         self.global_steps += 1
+        self._last_skipped = metrics.get("skipped")
         if self.config.fp16.enabled and bool(metrics["skipped"]):
             self.skipped_steps += 1
         return metrics
@@ -1406,6 +1421,149 @@ class DeepSpeedEngine:
             self._offload_grad_fn = None
         log_dist(f"train_batch_size -> {train_batch_size} "
                  f"(gas={self.gas})", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # DS engine API compat: the reference exposes a large family of
+    # config accessors and mode toggles on the engine object
+    # (engine.py:612-1030 properties, :1734 train/eval, :2321 get_mom).
+    # Thin and honest — each returns the live config/engine state.
+    # ------------------------------------------------------------------
+    def get_batch_info(self):
+        """(train_batch_size, micro_batch_size, gas) — engine.py:428."""
+        return self.train_batch_size, self.micro_batch_size, self.gas
+
+    def optimizer_name(self):
+        return self.config.optimizer.type if self.config.optimizer else None
+
+    def optimizer_params(self):
+        return dict(self.config.optimizer.params) \
+            if self.config.optimizer else None
+
+    def scheduler_name(self):
+        return self.config.scheduler.type if self.config.scheduler else None
+
+    def scheduler_params(self):
+        return dict(self.config.scheduler.params) \
+            if self.config.scheduler else None
+
+    def get_mom(self):
+        """Momentum (SGD/RMSprop) or betas (Adam family) — engine.py:2321."""
+        params = self.optimizer_params() or {}
+        if (self.optimizer_name() or "").lower() in ("sgd", "rmsprop"):
+            return [params.get("momentum", 0.0)]
+        return [tuple(params.get("betas", (0.9, 0.999)))]
+
+    def gradient_clipping(self) -> float:
+        return self.config.gradient_clipping
+
+    def loss_scale(self) -> float:
+        return self.get_loss_scale()
+
+    def dynamic_loss_scale(self) -> bool:
+        return (self.config.fp16.enabled and
+                self.config.fp16.dynamic_loss_scale)
+
+    def steps_per_print(self) -> int:
+        return self.config.steps_per_print
+
+    def wall_clock_breakdown(self) -> bool:
+        return self.config.wall_clock_breakdown
+
+    def memory_breakdown(self) -> bool:
+        return self.config.memory_breakdown
+
+    def communication_data_type(self):
+        return self.config.communication_data_type
+
+    def zero_optimization(self) -> bool:
+        return self.zero_stage > 0
+
+    def zero_cpu_offload(self) -> bool:
+        return self._offload_cfg is not None
+
+    def zero_offload_optimizer(self):
+        return self._offload_cfg
+
+    def zero_offload_param(self):
+        return self._param_offload_cfg
+
+    def sparse_gradients_enabled(self) -> bool:
+        return self.config.sparse_gradients
+
+    def curriculum_enabled(self) -> bool:
+        return self.curriculum_scheduler is not None
+
+    def train(self, mode: bool = True):
+        """Training/eval mode toggle (engine.py:1734): in eval mode
+        ``forward`` runs without an rng, so dropout is disabled."""
+        self._train_mode = bool(mode)
+
+    def eval(self):
+        self.train(False)
+
+    def zero_grad(self) -> None:
+        """Drop gradients accumulated via ``backward`` (the reference's
+        hook-based zero_grad; here the pending accumulator)."""
+        self._pending_grads = None
+        self._pending_losses = []
+        # roll the boundary counter back to the last boundary (not to 0 —
+        # a monotonic counter must not re-arm one-shot step-0 hooks)
+        self._micro_steps -= self._micro_steps % self.gas
+
+    def was_step_applied(self) -> bool:
+        """True if the latest step updated parameters (engine.py:1660);
+        False after an fp16 overflow skip or off-boundary step(). The
+        skipped flag stays on device until asked for (no per-step sync)."""
+        skipped = getattr(self, "_last_skipped", None)
+        if skipped is None:
+            return False
+        return not bool(skipped)
+
+    def module_state_dict(self):
+        """Module weights as a flat {path: numpy} dict (engine.py
+        module_state_dict analog)."""
+        self._ensure_params_resident()
+        from deepspeed_tpu.utils.tree import flatten_with_names
+        params = self.state.params
+        if jax.process_count() > 1:
+            # cross-process sharded leaves are not addressable from one
+            # process; replicate first (every process then holds full
+            # values, like the TP checksum in tests/launcher_worker.py)
+            rep = jax.tree.map(
+                lambda _: NamedSharding(self.mesh, P()), params)
+            params = jax.jit(lambda t: t, out_shardings=rep)(params)
+        return {k: np.asarray(v) for k, v in
+                flatten_with_names(params).items()}
+
+    def load_module_state_dict(self, state_dict) -> None:
+        """Load module weights only (engine load_module_state_dict):
+        optimizer state is untouched, the fp32 master resyncs from the
+        loaded weights (same contract as load_checkpoint(
+        load_module_only=True))."""
+        from deepspeed_tpu.utils.tree import flatten_with_names
+        cur = flatten_with_names(self.state.params)
+        missing = set(cur) - set(state_dict)
+        if missing:
+            raise KeyError(f"state_dict missing params: {sorted(missing)[:5]}")
+        leaves, treedef = jax.tree_util.tree_flatten(self.state.params)
+        names = list(flatten_with_names(self.state.params))
+        new = [jnp.asarray(state_dict[n], dtype=l.dtype)
+               for n, l in zip(names, leaves)]
+        params = jax.device_put(jax.tree_util.tree_unflatten(treedef, new),
+                                self._state_shardings.params)
+        self.state = self.state.replace(params=params)
+        if self.mixed_precision and self.state.master is not None:
+            self.state = self.state.replace(master=jax.device_put(
+                cast_tree(params, jnp.float32),
+                self._state_shardings.master))
+
+    def destroy(self) -> None:
+        """Release compiled executables and pending state (engine.destroy)."""
+        self._step_fn = None
+        self._grad_fn = None
+        self._apply_fn = None
+        self._offload_grad_fn = None
+        self.zero_grad()
 
     def fp32_master_params(self):
         """Consolidated fp32 weights (analog of
