@@ -223,8 +223,10 @@ class GPT2(nn.Module):
         if cfg.offload_params:
             wte = _fetch_to_device(wte, "wte", self.fetch_table)
             wpe = _fetch_to_device(wpe, "wpe", self.fetch_table)
-        x = wte.astype(cfg.dtype)[input_ids] + \
-            wpe.astype(cfg.dtype)[jnp.arange(T)][None]
+        # gather rows THEN cast (16 MB vs casting the whole fp32 table to
+        # a 100+ MB bf16 copy per step), and slice positions statically
+        x = wte[input_ids].astype(cfg.dtype) + \
+            wpe[:T].astype(cfg.dtype)[None]
         x = _maybe_constrain(x, P(DATA_AXES, "seq", None))
         if cfg.dropout > 0.0 and not deterministic:
             x = nn.Dropout(cfg.dropout)(x, deterministic=False)
