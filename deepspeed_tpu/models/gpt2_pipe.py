@@ -69,8 +69,9 @@ class GPT2PipeModel:
     def apply(self, params, input_ids):
         cfg = self.config
         B, T = input_ids.shape
-        x = params["wte"].astype(cfg.dtype)[input_ids] + \
-            params["wpe"].astype(cfg.dtype)[jnp.arange(T)][None]
+        # gather rows THEN cast; static position slice (models/gpt2.py)
+        x = params["wte"][input_ids].astype(cfg.dtype) + \
+            params["wpe"][:T].astype(cfg.dtype)[None]
         x = _maybe_constrain(x, P(DATA_AXES, "seq", None))
         x = pipeline_apply(self._block_fn, params["blocks"], x,
                            num_microbatches=self.num_microbatches,
