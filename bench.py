@@ -466,6 +466,11 @@ PHASES = {
                                 "--micro", "1"], 480),
     "train-350m-noflash-seq4k": (["--preset", "gpt2-350m", "--seq", "4096",
                                   "--micro", "1", "--no-flash"], 480),
+    # bigger micro with flash: naive attention gained nothing from micro 8
+    # (the [T,T] score traffic scales with batch); flash removes that
+    # traffic, so larger rows-per-matmul should finally lift MFU
+    "train-350m-flash-mb16": (["--preset", "gpt2-350m", "--micro", "16"],
+                              480),
 }
 
 
