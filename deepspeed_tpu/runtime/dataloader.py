@@ -70,6 +70,12 @@ class RepeatingLoader:
 
 
 class DeepSpeedDataLoader:
+    """Samples global batches; on a multi-process pod every process runs
+    the same sampler (same seed → same order) and each yields only its
+    contiguous row block of the global batch — the per-rank feeding
+    convention ``assemble_global_batch`` expects (reference: per-DP-rank
+    DistributedSampler semantics, runtime/dataloader.py:55)."""
+
     def __init__(self, dataset, batch_size: int,
                  shuffle: bool = True, seed: int = 0,
                  collate_fn=None, drop_last: bool = True):
@@ -104,8 +110,17 @@ class DeepSpeedDataLoader:
         if self.shuffle:
             self._rng.shuffle(order)
         self._epoch += 1
+        nproc, pid = jax.process_count(), jax.process_index()
+        if nproc > 1 and self.batch_size % nproc:
+            raise ValueError(
+                f"global batch {self.batch_size} does not split over "
+                f"{nproc} processes; feed per-process batches to "
+                "train_batch directly")
+        rows = self.batch_size // nproc
         for start in range(0, n - self.batch_size + 1, self.batch_size):
-            idx = order[start:start + self.batch_size]
+            # same global order everywhere (same seed); each process LOADS
+            # only its contiguous row block (per-rank feeding convention)
+            idx = order[start + pid * rows:start + (pid + 1) * rows]
             samples = [self.dataset[int(i)] for i in idx]
             if self.collate_fn is not None:
                 yield self.collate_fn(samples)

@@ -1,0 +1,62 @@
+"""DeepSpeedDataLoader: sampling, restart, and per-process sharding.
+
+The multi-process convention (same seed → same global order; each process
+loads only its contiguous row block) is pinned by monkeypatching
+jax.process_count/index — the real multi-process path runs in
+tests/test_multiprocess_launcher.py.
+"""
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+
+
+class Rows:
+    def __init__(self, n=32, d=4):
+        self.x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i]}
+
+
+def test_batches_cover_dataset_without_replacement():
+    dl = DeepSpeedDataLoader(Rows(), batch_size=8, seed=0)
+    seen = np.concatenate([b["x"][:, 0] for b in dl])
+    assert len(seen) == 32 and len(np.unique(seen)) == 32
+
+
+def test_repeating_loader_restarts():
+    dl = DeepSpeedDataLoader(Rows(n=16), batch_size=8, shuffle=False)
+    rl = RepeatingLoader(dl)
+    batches = [next(rl) for _ in range(5)]  # 2 per epoch -> wraps twice
+    np.testing.assert_array_equal(batches[0]["x"], batches[2]["x"])
+
+
+def test_per_process_sharding_partitions_the_global_batch(monkeypatch):
+    """2 simulated processes: same seed, disjoint halves whose union is
+    exactly the single-process global batch, in order."""
+    full = [b["x"] for b in DeepSpeedDataLoader(Rows(), batch_size=8,
+                                                seed=3)]
+    shards = []
+    for pid in range(2):
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda pid=pid: pid)
+        shards.append([b["x"] for b in DeepSpeedDataLoader(
+            Rows(), batch_size=8, seed=3)])
+    monkeypatch.undo()
+    assert all(s.shape == (4, 4) for sh in shards for s in sh)
+    for gb, s0, s1 in zip(full, shards[0], shards[1]):
+        np.testing.assert_array_equal(np.concatenate([s0, s1]), gb)
+
+
+def test_indivisible_batch_over_processes_is_loud(monkeypatch):
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    dl = DeepSpeedDataLoader(Rows(), batch_size=8, seed=0)
+    with pytest.raises(ValueError, match="split over 3 processes"):
+        next(iter(dl))
