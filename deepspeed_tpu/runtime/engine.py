@@ -1557,6 +1557,19 @@ class DeepSpeedEngine:
                 cast_tree(params, jnp.float32),
                 self._state_shardings.master))
 
+    def deepspeed_io(self, dataset, batch_size=None, route=None,
+                     pin_memory=None, data_sampler=None, collate_fn=None,
+                     num_local_io_workers=None):
+        """Build a loader over ``dataset`` (engine.deepspeed_io analog,
+        engine.py:1506 — the Megatron integration entry point). torch-
+        specific knobs (pin_memory, worker counts, samplers) are accepted
+        and ignored; sampling is the loader's seeded shuffle with
+        per-process sharding."""
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        return DeepSpeedDataLoader(
+            dataset, batch_size=batch_size or self.train_batch_size,
+            collate_fn=collate_fn, seed=self.config.seed)
+
     def destroy(self) -> None:
         """Release compiled executables and pending state (engine.destroy)."""
         self._step_fn = None

@@ -118,3 +118,21 @@ def test_destroy_releases_compiled_state():
     # engine still usable: next call recompiles
     m = eng.train_batch(_batch(eng))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_deepspeed_io_builds_loader():
+    eng = _engine()
+
+    class Ds:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return {"input_ids": np.full((16,), i % 64, np.int32)}
+
+    loader = eng.deepspeed_io(Ds(), pin_memory=True,
+                              num_local_io_workers=4)
+    b = next(iter(loader))
+    assert b["input_ids"].shape == (eng.train_batch_size, 16)
+    m = eng.train_batch(b)
+    assert np.isfinite(float(m["loss"]))
