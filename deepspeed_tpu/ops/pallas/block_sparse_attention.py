@@ -46,7 +46,10 @@ def _kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, o_ref, *,
     h = pl.program_id(1)
     qb = pl.program_id(2)
     count = counts_ref[h, qb]
-    q = q_ref[0, 0].astype(jnp.float32) * scale        # [block, D]
+    # bf16 dot inputs, fp32 accumulation via preferred_element_type —
+    # an upfront fp32 cast would quarter the MXU rate (see
+    # pallas/flash_attention.py)
+    q = q_ref[0, 0]                                    # [block, D]
     D = q.shape[-1]
 
     m = jnp.full((block, 1), NEG_INF, jnp.float32)
@@ -59,10 +62,10 @@ def _kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, o_ref, *,
     def body(j, carry):
         m, l, acc = carry
         kb = lut_ref[h, qb, j]
-        k = k_ref[0, 0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(kb * block, block), :]
+        v = v_ref[0, 0, pl.ds(kb * block, block), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             col = kb * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
@@ -72,7 +75,7 @@ def _kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, o_ref, *,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 

@@ -35,9 +35,13 @@ SEQ_AXIS = "seq"
 
 
 def _block_scores(q, k, scale, q_start, k_start, causal):
-    """Masked scores s [B, H, Tq, Tk] in fp32 plus the bool mask."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    """Masked scores s [B, H, Tq, Tk] in fp32 plus the bool mask.
+
+    Inputs stay in their storage dtype (bf16) so the MXU runs at full
+    rate; fp32 comes from the accumulator (preferred_element_type), the
+    same fix as the Pallas flash kernels."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
         qpos = q_start + jnp.arange(Tq)
@@ -84,7 +88,8 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * jnp.moveaxis(alpha, 1, 2) + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+            "bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (m_new, l, acc, k_nxt, v_nxt), None
@@ -126,13 +131,19 @@ def _ring_bwd(axis_name, causal, scale, res, do):
         if mask is not None:
             p = p * mask
         # dv += p^T do ; ds = p*(dp - delta); dk += ds^T q ; dq += ds k
-        dv_cur = dv_cur + jnp.einsum("bhqk,bqhd->bkhd", p, do32)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v_cur.astype(jnp.float32))
+        dv_cur = dv_cur + jnp.einsum(
+            "bhqk,bqhd->bkhd", p.astype(do.dtype), do,
+            preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do, v_cur,
+                        preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
+        ds16 = ds.astype(q.dtype)
         dk_cur = dk_cur + jnp.einsum(
-            "bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+            "bhqk,bqhd->bkhd", ds16, q,
+            preferred_element_type=jnp.float32) * scale
         dq = dq + jnp.einsum(
-            "bhqk,bkhd->bqhd", ds, k_cur.astype(jnp.float32)) * scale
+            "bhqk,bkhd->bqhd", ds16, k_cur,
+            preferred_element_type=jnp.float32) * scale
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
