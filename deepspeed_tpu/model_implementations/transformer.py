@@ -352,8 +352,10 @@ def _prefill_attention(q, k, v, cfg: InferenceTransformerConfig,
     if use_flash:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True, scale=cfg.scale)
-    att = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                     k.astype(jnp.float32)) * cfg.scale
+    # bf16 dot inputs, fp32 accumulation — an upfront fp32 cast would
+    # quarter the MXU rate (same fix as the Pallas kernels)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                     preferred_element_type=jnp.float32) * cfg.scale
     if cfg.positional == "alibi":
         slopes = alibi_slopes(H)
         # BLOOM bias: slope * (key_pos - query_pos) under causal mask
@@ -390,8 +392,8 @@ def _decode_attention(q, k_cache, v_cache, live,
         vc = jnp.swapaxes(v_cache, 1, 2)
         return decode_attention(q, kc, vc, live, scale=cfg.scale,
                                 block_k=128)
-    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
-                   _repeat_kv(k_cache, H // KH).astype(jnp.float32))
+    s = jnp.einsum("bhd,bshd->bhs", q, _repeat_kv(k_cache, H // KH),
+                   preferred_element_type=jnp.float32)
     s = s * cfg.scale
     pos = jnp.arange(S)[None, None, :]
     if cfg.positional == "alibi":
