@@ -112,9 +112,10 @@ class SparseSelfAttention:
             # padded keys masked in the oracle path (reference applies the
             # same inside its softmax kernel)
             scale = 1.0 / (D ** 0.5)
-            att = jnp.einsum("bqhd,bkhd->bhqk",
-                             query.astype(jnp.float32),
-                             key.astype(jnp.float32)) * scale
+            # bf16 dot inputs, fp32 accumulation (MXU full rate); the
+            # fp32-cast form above stays only in the test oracle
+            att = jnp.einsum("bqhd,bkhd->bhqk", query, key,
+                             preferred_element_type=jnp.float32) * scale
             mask = jnp.asarray(layout_to_dense_mask(
                 lay, self.sparsity_config.block, self.causal))[None]
             mask = mask & key_padding_mask[:, None, None, :].astype(bool)
