@@ -390,3 +390,100 @@ def broadcast_obj(obj: Any, root: int = 0) -> Any:
 
 def log_summary():
     comms_logger.log_all()
+
+
+# ---------------------------------------------------------------------------
+# reference-name compat shims (deepspeed/comm/comm.py public surface).
+# Groups ARE mesh axes here: anywhere the reference takes a ProcessGroup,
+# these take (or return) axis names usable as ``axis_name=`` in the
+# collective dispatchers above.
+# ---------------------------------------------------------------------------
+
+def is_available() -> bool:
+    """torch.distributed.is_available analog — XLA collectives are always
+    compiled in."""
+    return True
+
+
+def get_world_group():
+    """The 'world' group = every axis of the global mesh (usable directly
+    as ``axis_name=`` in the dispatchers; reference comm.py
+    get_world_group)."""
+    from deepspeed_tpu.comm.mesh import get_global_mesh
+    return tuple(get_global_mesh().axis_names)
+
+
+def get_global_rank(group=None, group_rank: int = 0) -> int:
+    """Translate a group-relative rank to a global rank. Identity for the
+    world group; sub-axis translation needs the caller's mesh coordinates
+    and has no single answer — refuse loudly there."""
+    world = set(get_world_group())
+    if group is None or set(group if isinstance(group, (tuple, list))
+                            else (group,)) == world:
+        return group_rank
+    raise NotImplementedError(
+        "get_global_rank for sub-axis groups: ranks are mesh coordinates "
+        "here — compute them from Mesh.devices / parallel.topology instead")
+
+
+def new_group(ranks=None):
+    """Process groups are STATIC mesh axes under XLA SPMD — collectives
+    take ``axis_name=``; slicing devices dynamically the NCCL way has no
+    compiled analog (SURVEY §7.1)."""
+    raise NotImplementedError(
+        "new_group: define parallel groups as mesh axes "
+        "(comm.mesh.MeshConfig) and pass axis_name= to the collectives; "
+        "arbitrary rank subsets do not exist under compiled SPMD")
+
+
+def has_allgather_base() -> bool:
+    return True
+
+
+def has_reduce_scatter_base() -> bool:
+    return True
+
+
+def all_gather_base(x, axis_name: str = "data", **kw):
+    """_all_gather_base/allgather_fn analog (flat-tensor all-gather);
+    XLA has no separate flat path — same dispatcher."""
+    return all_gather(x, axis_name=axis_name)
+
+
+allgather_fn = all_gather_base
+
+
+def reduce_scatter_base(x, axis_name: str = "data", **kw):
+    return reduce_scatter(x, axis_name=axis_name)
+
+
+reduce_scatter_fn = reduce_scatter_base
+
+
+def send(*a, **k):
+    raise NotImplementedError(
+        "host-level p2p send/recv has no compiled-SPMD analog; use "
+        "send_recv (ppermute ring) inside jit, or jax.device_put for "
+        "host-driven handoffs")
+
+
+recv = isend = irecv = send
+
+
+def set_backend(backend=None) -> None:
+    """Single backend (XLA) — accepted and ignored for script compat."""
+    logger.warning("set_backend: XLA is the only backend; ignored")
+
+
+def init_deepspeed_backend(*a, **k) -> None:
+    """Reference-internal init hook; init_distributed is the real entry."""
+    init_distributed()
+
+
+def destroy_process_group(group=None) -> None:
+    """Tear down the multi-host runtime (torch destroy_process_group
+    analog)."""
+    global _INITIALIZED
+    if jax.process_count() > 1:
+        jax.distributed.shutdown()
+    _INITIALIZED = False

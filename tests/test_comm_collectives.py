@@ -147,3 +147,24 @@ def test_mpi_discovery_waives_addr_when_supplied(monkeypatch):
     monkeypatch.delenv("DS_COORDINATOR_ADDR", raising=False)
     addr, size, rank = comm.mpi_discovery(require_addr=False)
     assert addr is None and (size, rank) == (2, 0)
+
+
+def test_reference_name_compat_shims():
+    """deepspeed.comm public-surface names a migrating script calls."""
+    from deepspeed_tpu.comm import comm as C
+    assert C.is_available() is True
+    assert C.has_allgather_base() and C.has_reduce_scatter_base()
+    # world group = all mesh axes, usable as axis_name
+    wg = C.get_world_group()
+    assert set(wg) == set(("pipe", "data", "fsdp", "seq", "tensor"))
+    assert C.get_global_rank(None, 3) == 3
+    assert C.get_global_rank(wg, 2) == 2
+    with pytest.raises(NotImplementedError):
+        C.get_global_rank(("tensor",), 0)
+    with pytest.raises(NotImplementedError):
+        C.new_group([0, 1])
+    with pytest.raises(NotImplementedError):
+        C.send(None, 0)
+    C.set_backend("nccl")    # accepted and ignored
+    assert C.allgather_fn is C.all_gather_base
+    assert C.reduce_scatter_fn is C.reduce_scatter_base
