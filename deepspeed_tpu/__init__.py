@@ -11,6 +11,10 @@ reference where it makes sense:
 """
 from deepspeed_tpu.version import __version__, git_branch, git_hash
 from deepspeed_tpu import comm
+# reference namespace parity: deepspeed.zero.Init, deepspeed.pipe.*,
+# deepspeed.moe.*, deepspeed.module_inject.* resolve without an explicit
+# submodule import (deepspeed/__init__.py imports these eagerly)
+from deepspeed_tpu import zero, pipe, moe, module_inject  # noqa: F401
 # deepspeed.checkpointing analog (activation checkpointing, NOT model
 # save/load — that lives on the engine): reference runtime/
 # activation_checkpointing/checkpointing.py
@@ -83,6 +87,12 @@ def init_inference(model=None, config=None, **kwargs):
                       else config.jnp_dtype)
         model = load_inference_checkpoint(model, dtype=load_dtype)
     return InferenceEngine(model, config)
+
+
+def default_inference_config():
+    """Default inference configuration dict (deepspeed/__init__.py:226)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    return DeepSpeedInferenceConfig().dict()
 
 
 def add_config_arguments(parser):
