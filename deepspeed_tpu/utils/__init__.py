@@ -1,0 +1,22 @@
+"""deepspeed.utils namespace parity (reference deepspeed/utils/__init__.py):
+logger/log_dist, OnDevice, the groups accessors (mesh-axis based here),
+RepeatingLoader, and zero_to_fp32 under its reference import path. The
+torch-specific exports (nvtx instrumentation, tensor_fragment /
+mixed-precision linkage — hook plumbing for torch optimizers) have no XLA
+analog; sharded state is first-class jax arrays instead."""
+from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0
+from deepspeed_tpu.utils.init_on_device import OnDevice
+# groups accessors: the reference re-exports deepspeed.utils.groups.*;
+# here parallel "groups" are mesh axes (comm/mesh.py)
+from deepspeed_tpu.comm.mesh import (  # noqa: F401
+    get_data_parallel_world_size, get_model_parallel_world_size,
+    get_sequence_parallel_world_size, get_pipe_parallel_world_size,
+    get_expert_parallel_world_size)
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader  # noqa: F401
+
+__all__ = ["logger", "log_dist", "print_rank_0", "OnDevice",
+           "RepeatingLoader", "get_data_parallel_world_size",
+           "get_model_parallel_world_size",
+           "get_sequence_parallel_world_size",
+           "get_pipe_parallel_world_size",
+           "get_expert_parallel_world_size"]

@@ -1,0 +1,7 @@
+"""Reference import-path alias: ``deepspeed.utils.zero_to_fp32`` is where
+migration guides tell users to import the checkpoint converters from; the
+implementation lives in checkpoint/zero_to_fp32.py."""
+from deepspeed_tpu.checkpoint.zero_to_fp32 import (  # noqa: F401
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+    load_state_dict_from_zero_checkpoint)
