@@ -4,4 +4,9 @@ implementation lives in checkpoint/zero_to_fp32.py."""
 from deepspeed_tpu.checkpoint.zero_to_fp32 import (  # noqa: F401
     convert_zero_checkpoint_to_fp32_state_dict,
     get_fp32_state_dict_from_zero_checkpoint,
-    load_state_dict_from_zero_checkpoint)
+    load_state_dict_from_zero_checkpoint, main)
+
+if __name__ == "__main__":
+    # the reference file is canonically run as a CLI:
+    #   python zero_to_fp32.py <ckpt_dir> <output>
+    main()
