@@ -271,7 +271,7 @@ def test_onebit_adam_compressed_converges_under_shard_map():
         shard_map, mesh=mesh,
         in_specs=(P(), jax.tree.map(lambda _: P(), st), P("data")),
         out_specs=(P(), jax.tree.map(lambda _: P(), st)),
-        check_rep=False)
+        check_vma=False)
     def step(p, st, tgt):
         g = local_grad(p, tgt[0])
         upd, st = opt.update(g, st, p, 0.02)
@@ -351,7 +351,7 @@ def test_zero_one_adam_local_steps_sync_under_shard_map():
         shard_map, mesh=mesh,
         in_specs=(P(), jax.tree.map(lambda _: P(), st), P("data")),
         out_specs=(P(), jax.tree.map(lambda _: P(), st)),
-        check_rep=False)
+        check_vma=False)
     def step(p, st, tgt):
         g = jax.grad(lambda q: jnp.sum((q["x"] - tgt[0]) ** 2))(p)
         upd, st = opt.update(g, st, p, 0.02)
@@ -425,7 +425,7 @@ def test_onebit_lamb_compressed_under_shard_map():
         shard_map, mesh=mesh,
         in_specs=(P(), jax.tree.map(lambda _: P(), st), P("data")),
         out_specs=(P(), jax.tree.map(lambda _: P(), st)),
-        check_rep=False)
+        check_vma=False)
     def step(p, st, tgt):
         g = jax.grad(lambda q: jnp.sum((q["x"] - tgt[0]) ** 2))(p)
         upd, st = opt.update(g, st, p, 0.02)
