@@ -28,6 +28,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 T0 = time.time()
@@ -624,7 +625,15 @@ def run_phase(name: str, budget_left: float, adaptive: bool = False):
         log(f"phase {name}: SKIPPED (relay still wedged)")
         return None
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", name] + extra
-    log(f"phase {name}: start (timeout {timeout:.0f}s)")
+    # child stderr streams to a file (not a PIPE): a phase blocked in
+    # device init behind a wedged relay is otherwise a black box until its
+    # timeout — with a file, `tail -f` (or the parent, post-mortem) can
+    # tell "never acquired devices" from "compiling" from "measuring".
+    # PID-qualified so concurrent bench runs can't clobber or cross-read
+    # each other's capture.
+    errpath = os.path.join(tempfile.gettempdir(),
+                           f"bench_phase_{name}.{os.getpid()}.err")
+    log(f"phase {name}: start (timeout {timeout:.0f}s, stderr {errpath})")
 
     def last_json(raw: bytes):
         for line in reversed((raw or b"").decode().strip().splitlines()):
@@ -636,11 +645,23 @@ def run_phase(name: str, budget_left: float, adaptive: bool = False):
                 return parsed
         return None
 
+    def read_err() -> str:
+        try:
+            with open(errpath, errors="replace") as fh:
+                return fh.read()
+        except OSError:
+            return ""
+
     try:
-        proc = subprocess.run(cmd, stdout=subprocess.PIPE,
-                              stderr=subprocess.PIPE, timeout=timeout)
+        try:
+            errf = open(errpath, "wb")
+        except OSError:  # unwritable tempdir must not abort the phase
+            errf = open(os.devnull, "wb")
+        with errf:
+            proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                                  stderr=errf, timeout=timeout)
     except subprocess.TimeoutExpired as e:
-        sys.stderr.write((e.stderr or b"").decode(errors="replace"))
+        sys.stderr.write(read_err())
         # the phase may have printed a '-partial' warm-step record before
         # the measurement loop was killed — salvage it
         partial = last_json(e.stdout)
@@ -648,15 +669,14 @@ def run_phase(name: str, budget_left: float, adaptive: bool = False):
             + ("; salvaged partial record" if partial else "")
             + "; continuing with remaining phases")
         return partial
-    sys.stderr.write((proc.stderr or b"").decode(errors="replace"))
+    sys.stderr.write(read_err())
     if proc.returncode != 0:
         # a crash (OOM, Mosaic abort) after the warm step still printed a
         # '-partial' record — salvage it like the timeout path does.
         # HBM OOM surfaces only in the relay client's stderr (the child's
         # exception is an opaque HTTP 500), so the child-side oom_record
         # may have missed it — synthesize it here from stderr
-        partial = last_json(proc.stdout) or oom_record(
-            (proc.stderr or b"").decode(errors="replace"), name)
+        partial = last_json(proc.stdout) or oom_record(read_err(), name)
         log(f"phase {name}: FAILED rc={proc.returncode}"
             + ("; salvaged partial record" if partial else ""))
         return partial

@@ -1,0 +1,152 @@
+"""Capture an xprof trace of a train step and print where the time goes.
+
+The reference ships a flops profiler (deepspeed/profiling/flops_profiler)
+and relies on nsys/torch-profiler for kernel-level timing; on TPU the
+equivalent evidence is an XLA op profile from ``jax.profiler.trace``.
+TensorBoard's profile plugin can't load in this image (native binding
+mismatch), so this script parses the raw ``*.xplane.pb`` XSpace protos
+directly and aggregates device-plane event self-times by HLO op
+category — enough to rank stalls (which fusion, which convert, which
+copy) without any viewer.
+
+Usage:
+    python scripts/profile_step.py [--preset gpt2-350m] [--micro 8]
+        [--seq 1024] [--no-flash] [--steps 3] [--top 25]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+import sys
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_xspace(trace_dir: str, top: int = 25) -> dict:
+    """Aggregate device-plane op self-times from the newest xplane.pb."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    xspace = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as fh:
+        xspace.ParseFromString(fh.read())
+
+    report = {"planes": [p.name for p in xspace.planes], "by_op": {},
+              "by_category": {}, "device_total_us": 0.0}
+    # the device plane carries per-HLO events; host planes carry runtime
+    # noise we don't want in the ranking. On a CPU-only capture (smoke
+    # tests) the XLA ops live in /host:CPU instead.
+    planes = [p for p in xspace.planes
+              if "TPU" in p.name or "Device" in p.name]
+    if not planes:
+        planes = [p for p in xspace.planes if p.name == "/host:CPU"]
+    for plane in planes:
+        stat_names = {sid: sm.name for sid, sm in plane.stat_metadata.items()}
+        by_op: dict = collections.defaultdict(float)
+        by_cat: dict = collections.defaultdict(float)
+        occ: dict = collections.defaultdict(int)
+        for line in plane.lines:
+            for ev in line.events:
+                md = plane.event_metadata.get(ev.metadata_id)
+                name = md.display_name or md.name if md else "?"
+                dur_us = ev.duration_ps / 1e6
+                by_op[name] += dur_us
+                occ[name] += 1
+                cat = None
+                stats = list(ev.stats) + (list(md.stats) if md else [])
+                for st in stats:
+                    if stat_names.get(st.metadata_id) in (
+                            "hlo_category", "category", "tf_op"):
+                        cat = (st.str_value or
+                               stat_names.get(st.metadata_id))
+                        break
+                by_cat[cat or "uncategorized"] += dur_us
+        if not by_op:
+            continue
+        total = sum(by_op.values())
+        report["device_total_us"] += total
+        report["by_op"] = {
+            k: {"us": round(v, 1), "pct": round(100 * v / total, 2),
+                "count": occ[k]}
+            for k, v in sorted(by_op.items(), key=lambda kv: -kv[1])[:top]}
+        report["by_category"] = {
+            k: {"us": round(v, 1), "pct": round(100 * v / total, 2)}
+            for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1])}
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gpt2-350m")
+    ap.add_argument("--micro", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--no-flash", action="store_true")
+    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--trace-dir", default="/tmp/dstpu_trace")
+    ap.add_argument("--parse-only", action="store_true",
+                    help="skip capture; just parse --trace-dir")
+    args = ap.parse_args()
+
+    if not args.parse_only:
+        import json
+        import time
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
+
+        cfg = config_for(args.preset, n_positions=max(1024, args.seq),
+                         dtype=jnp.bfloat16,
+                         use_flash_attention=not args.no_flash,
+                         remat=not args.no_remat)
+        model = GPT2LMModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), batch_size=1,
+                            seq_len=128)
+        ds_config = {
+            "train_micro_batch_size_per_gpu": args.micro,
+            "gradient_accumulation_steps": 1,
+            "zero_optimization": {"stage": 3},
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=ds_config)
+        del params
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(rng.integers(
+            0, cfg.vocab_size,
+            size=(engine.train_batch_size, args.seq)), jnp.int32)}
+        t = time.time()
+        float(engine.train_batch(batch)["loss"])
+        print(f"step 1 (compile) in {time.time() - t:.1f}s",
+              file=sys.stderr)
+        float(engine.train_batch(batch)["loss"])  # warm (donation/layout)
+        times = []
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(args.steps):
+                t = time.time()
+                float(engine.train_batch(batch)["loss"])
+                times.append(time.time() - t)
+        print(json.dumps({"step_ms": [round(t * 1e3, 1) for t in times]}),
+              file=sys.stderr)
+
+    import json
+    rep = parse_xspace(args.trace_dir, args.top)
+    print(json.dumps(rep, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -155,3 +155,22 @@ def test_live_capture_goes_to_store_and_is_not_stale(bench, monkeypatch):
     bench.save_partial("train-125m", {"tokens_per_sec_per_chip": 50.0})
     st = bench.load_partials()["train-125m"]
     assert st["captured_unix"] >= bench.T0 - 1.0  # rounded to 0.1s
+
+
+def test_run_phase_streams_child_stderr_to_file(bench, monkeypatch,
+                                                tmp_path):
+    """A phase child's stderr goes to a FILE, not a PIPE: a child blocked
+    behind a wedged relay is observable (tail the file) instead of a
+    black box until its timeout, and the crash path still surfaces the
+    traceback after the fact."""
+    monkeypatch.setitem(bench.PHASES, "crash-test",
+                        (["--preset", "no-such-preset"], 150))
+    monkeypatch.setattr(bench, "wait_for_chip", lambda budget: True)
+    monkeypatch.setattr(bench.tempfile, "gettempdir",
+                        lambda: str(tmp_path))
+    monkeypatch.setenv("DSTPU_BENCH_PLATFORM", "cpu")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bench.run_phase("crash-test", budget_left=300) is None
+    errpath = tmp_path / f"bench_phase_crash-test.{os.getpid()}.err"
+    err = errpath.read_text(errors="replace")
+    assert "no-such-preset" in err  # the child's ValueError traceback
