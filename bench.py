@@ -709,10 +709,10 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.phase:  # child mode: one phase, one JSON line on stdout
-        plat = os.environ.get("DSTPU_BENCH_PLATFORM")
-        if plat:  # testing hook — the axon sitecustomize pins JAX_PLATFORMS
-            import jax
-            jax.config.update("jax_platforms", plat)
+        # testing hook — the axon sitecustomize pins JAX_PLATFORMS and the
+        # env var alone does not override it
+        from deepspeed_tpu.testing import pin_platform
+        pin_platform()
         cache = os.environ.get(
             "DSTPU_COMPILE_CACHE",
             os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -48,6 +48,26 @@ def virtual_mesh(n: Optional[int] = None,
     return Mesh(np.array(devices[:n]).reshape(shape), names)
 
 
+def pin_platform(platform: Optional[str] = None) -> Optional[str]:
+    """Pin jax's platform before first device use, reliably.
+
+    The axon sitecustomize on this environment registers the TPU backend
+    in every spawned python and ``JAX_PLATFORMS`` in the env does NOT
+    override it — an explicit ``jax.config.update`` before the first
+    backend query is the only pin that sticks. Resolution order: explicit
+    ``platform`` arg, then ``DSTPU_PLATFORM``, then
+    ``DSTPU_BENCH_PLATFORM`` (bench.py's historical spelling). Returns
+    the platform pinned, or None when nothing was requested (backend
+    default applies)."""
+    import os
+
+    plat = (platform or os.environ.get("DSTPU_PLATFORM")
+            or os.environ.get("DSTPU_BENCH_PLATFORM"))
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    return plat
+
+
 def requires_devices(n: int):
     """``@requires_devices(8)`` — skip when the backend has fewer
     devices (the harness analog of the reference's world-size skips).

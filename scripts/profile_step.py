@@ -95,12 +95,27 @@ def main() -> None:
     ap.add_argument("--trace-dir", default="/tmp/dstpu_trace")
     ap.add_argument("--parse-only", action="store_true",
                     help="skip capture; just parse --trace-dir")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + CPU-friendly shapes: validates the "
+                         "capture+parse path without hardware")
     args = ap.parse_args()
+    if args.smoke:
+        # shrink only values the user left at their defaults
+        for k, v in (("micro", 2), ("seq", 128), ("steps", 2)):
+            if getattr(args, k) == ap.get_default(k):
+                setattr(args, k, v)
 
     if not args.parse_only:
         import json
         import time
 
+        from deepspeed_tpu.testing import pin_platform
+
+        # --smoke means "no hardware": default it to cpu so a bare smoke
+        # run can't hang on an unreachable TPU tunnel
+        pin_platform("cpu" if (args.smoke and
+                               not os.environ.get("DSTPU_PLATFORM"))
+                     else None)
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -108,10 +123,15 @@ def main() -> None:
         import deepspeed_tpu
         from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
 
-        cfg = config_for(args.preset, n_positions=max(1024, args.seq),
+        overrides = dict(n_positions=max(1024, args.seq),
                          dtype=jnp.bfloat16,
                          use_flash_attention=not args.no_flash,
                          remat=not args.no_remat)
+        if args.smoke:
+            overrides.update(n_positions=args.seq, n_layer=2, n_embd=128,
+                             n_head=2, vocab_size=512,
+                             use_flash_attention=False)
+        cfg = config_for(args.preset, **overrides)
         model = GPT2LMModel(cfg)
         params = model.init(jax.random.PRNGKey(0), batch_size=1,
                             seq_len=128)

@@ -27,14 +27,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
+    # honor DSTPU_PLATFORM so the CPU smoke run cannot contend for the
+    # real chip (env-var JAX_PLATFORMS alone does not stick — see helper)
+    from deepspeed_tpu.testing import pin_platform
+    pin_platform()
     import jax
-
-    # the axon sitecustomize registers the TPU backend in every spawned
-    # python and JAX_PLATFORMS in the env does NOT override it — honor an
-    # explicit pin so the CPU smoke run cannot contend for the real chip
-    plat = os.environ.get("DSTPU_PLATFORM")
-    if plat:
-        jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
     import numpy as np
 
