@@ -148,13 +148,19 @@ def _phase_train(args) -> dict:
     import numpy as np
     log(f"backend={jax.default_backend()} devices={jax.device_count()}")
     import deepspeed_tpu
-    from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
+
+    if args.preset.startswith("llama"):
+        from deepspeed_tpu.models.llama import LlamaLMModel, config_for
+        model_cls = LlamaLMModel
+    else:
+        from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
+        model_cls = GPT2LMModel
 
     n_chips = jax.device_count()
     cfg = config_for(args.preset, n_positions=args.seq, dtype=jnp.bfloat16,
                      remat=not args.no_remat,
                      use_flash_attention=not args.no_flash)
-    model = GPT2LMModel(cfg)
+    model = model_cls(cfg)
     log(f"init {args.preset} seq={args.seq} flash={not args.no_flash}")
     params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=128)
 
@@ -539,6 +545,11 @@ PHASES = {
                                 "--micro", "1"], 480),
     "train-350m-noflash-seq4k": (["--preset", "gpt2-350m", "--seq", "4096",
                                   "--micro", "1", "--no-flash"], 480),
+    # modern-decoder family (RoPE/RMSNorm/SwiGLU — models/llama.py):
+    # evidence the framework trains today's architectures at speed, not
+    # just the reference's GPT-2/BERT ladder
+    "train-llama-1b": (["--preset", "llama-1b", "--seq", "2048",
+                        "--micro", "4"], 600),
 }
 
 

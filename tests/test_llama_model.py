@@ -1,0 +1,147 @@
+"""LLaMA training model: HF-numerics parity, GQA, engine integration.
+
+The training-side counterpart of the module_inject LLaMA/Mistral
+inference policies: tests pin the flax model's logits against torch
+``LlamaForCausalLM`` (the de-facto weight layout), grouped-query
+attention against its MHA expansion, and the engine contract (ZeRO-3
+train step, tensor-parallel specs on the virtual mesh)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.llama import (LlamaConfig, LlamaLMModel,
+                                        config_for, params_from_hf)
+
+jnp32 = lambda x: jnp.asarray(np.asarray(x), jnp.float32)  # noqa: E731
+
+
+def _tiny_cfg(**kw):
+    base = dict(vocab_size=512, n_positions=64, n_embd=64, n_layer=2,
+                n_head=4, n_kv_head=4, intermediate_size=176,
+                dtype=jnp.float32, remat=False,
+                use_flash_attention=False)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def test_presets_and_validation():
+    cfg = config_for("llama-7b-gqa")
+    assert cfg.n_kv_head == 8 and cfg.head_dim == 128
+    with pytest.raises(ValueError):
+        config_for("llama-99t")
+    with pytest.raises(ValueError):
+        LlamaConfig(n_head=6, n_kv_head=4)
+
+
+@pytest.mark.parametrize("n_kv", [4, 2], ids=["mha", "gqa"])
+def test_logits_match_hf_llama(n_kv):
+    """Bit-level architecture parity with torch LlamaForCausalLM in fp32
+    (RMSNorm placement, rotate-half RoPE, GQA repeat, SwiGLU)."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    hf_cfg = HFConfig(vocab_size=512, hidden_size=64,
+                      intermediate_size=176, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=n_kv,
+                      max_position_embeddings=64, rms_norm_eps=1e-5,
+                      rope_theta=10000.0, tie_word_embeddings=False,
+                      attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(hf_cfg).eval().float()
+
+    cfg = _tiny_cfg(n_kv_head=n_kv)
+    model = LlamaLMModel(cfg)
+    params = params_from_hf(hf.state_dict(), cfg)
+
+    ids = np.random.default_rng(0).integers(0, 512, size=(2, 48))
+    with torch.no_grad():
+        ref = hf(torch.as_tensor(ids)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gqa_equals_expanded_mha():
+    """A GQA model must equal the MHA model whose k/v weights are its
+    per-group duplicates (the repeat_kv contract)."""
+    cfg_gqa = _tiny_cfg(n_kv_head=2)
+    cfg_mha = _tiny_cfg(n_kv_head=4)
+    m_gqa, m_mha = LlamaLMModel(cfg_gqa), LlamaLMModel(cfg_mha)
+    p = m_gqa.init(jax.random.PRNGKey(0), batch_size=1, seq_len=16)
+
+    def expand(kernel):  # [E, HKV*D] -> [E, H*D] duplicating per group
+        E = kernel.shape[0]
+        D = cfg_gqa.head_dim
+        k = kernel.reshape(E, cfg_gqa.n_kv_head, D)
+        return jnp.repeat(k, cfg_mha.n_head // cfg_gqa.n_kv_head,
+                          axis=1).reshape(E, -1)
+
+    p_mha = jax.tree.map(lambda x: x, p)
+    for i in range(cfg_gqa.n_layer):
+        a = p_mha[f"layers_{i}"]["attn"]
+        a["wk"] = {"kernel": expand(a["wk"]["kernel"])}
+        a["wv"] = {"kernel": expand(a["wv"]["kernel"])}
+
+    ids = jnp.asarray(np.random.default_rng(1).integers(
+        0, 512, size=(2, 32)), jnp.int32)
+    np.testing.assert_allclose(np.asarray(m_gqa.apply(p, ids)),
+                               np.asarray(m_mha.apply(p_mha, ids)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_tied_embeddings_share_table():
+    cfg = _tiny_cfg(tie_embeddings=True)
+    model = LlamaLMModel(cfg)
+    p = model.init(jax.random.PRNGKey(0))
+    assert "lm_head" not in p
+    assert "lm_head" not in model.tp_specs()
+
+
+@pytest.mark.slow
+def test_engine_zero3_train_step_and_tp():
+    """Full engine contract: ZeRO-3 + tensor parallel on the virtual
+    mesh, loss decreases over a few steps."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import (MeshConfig, build_mesh,
+                                         set_global_mesh)
+
+    cfg = _tiny_cfg(dtype=jnp.bfloat16)
+    model = LlamaLMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=32)
+    set_global_mesh(build_mesh(MeshConfig(data=2, tensor=2),
+                               devices=jax.devices()[:4]))
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            tp_specs=model.tp_specs(),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "zero_optimization": {"stage": 3},
+                    "bf16": {"enabled": True},
+                    "optimizer": {"type": "AdamW",
+                                  "params": {"lr": 5e-3}}})
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size,
+                         size=(engine.train_batch_size, 32)), jnp.int32)}
+        losses = [float(engine.train_batch(batch)["loss"])
+                  for _ in range(8)]
+        assert losses[-1] < losses[0]
+    finally:
+        from deepspeed_tpu.comm.mesh import reset_global_mesh
+        reset_global_mesh()
+
+
+def test_flops_per_token_counts_gqa():
+    mha = config_for("llama-7b")
+    gqa = config_for("llama-7b-gqa")
+    f_mha = LlamaLMModel(mha).flops_per_token()
+    f_gqa = LlamaLMModel(gqa).flops_per_token()
+    # GQA shrinks k/v projections; its larger MLP more than compensates,
+    # but the attention share must reflect n_kv_head
+    assert f_mha != f_gqa
+    # 6*N consistency on the tiny config (initializing a 7B tree on the
+    # CPU test backend takes minutes): flops_per_token ~ 6 * param_count
+    n = LlamaLMModel(_tiny_cfg(n_kv_head=2))
+    p = n.init(jax.random.PRNGKey(0), batch_size=1, seq_len=8)
+    assert abs(n.flops_per_token() / (6 * n.param_count(p)) - 1) < 0.05
