@@ -109,6 +109,15 @@ def reset_global_mesh() -> None:
     _GLOBAL_MESH = None
 
 
+def seq_axis_active() -> bool:
+    """True when the global mesh shards the ``seq`` axis — the condition
+    models gate their sequence-parallel attention dispatch on."""
+    if not has_global_mesh():
+        return False
+    mesh = get_global_mesh()
+    return "seq" in mesh.axis_names and mesh.shape["seq"] > 1
+
+
 # ---------------------------------------------------------------------------
 # Axis-size accessors — the analog of deepspeed/utils/groups.py accessors
 # (get_data_parallel_world_size etc., groups.py:287-399).

@@ -25,17 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
-
-
+from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
-
-
-def _seq_axis_active() -> bool:
-    from deepspeed_tpu.comm.mesh import has_global_mesh, get_global_mesh
-    if not has_global_mesh():
-        return False
-    mesh = get_global_mesh()
-    return "seq" in mesh.axis_names and mesh.shape["seq"] > 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -28,15 +28,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES
+from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
-
-
-def _seq_axis_active() -> bool:
-    from deepspeed_tpu.comm.mesh import has_global_mesh, get_global_mesh
-    if not has_global_mesh():
-        return False
-    mesh = get_global_mesh()
-    return "seq" in mesh.axis_names and mesh.shape["seq"] > 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,18 +128,16 @@ class LlamaAttention(nn.Module):
         k = dense(HKV * D, "wk")(x).reshape(B, T, HKV, D)
         v = dense(HKV * D, "wv")(x).reshape(B, T, HKV, D)
         q, k = _rope(q, k, jnp.arange(T), cfg.rope_theta)
-        if HKV != H:  # GQA: each KV head serves n_head/n_kv_head queries
-            # Known limitation: expanding before the attention dispatch
-            # forfeits GQA's k/v bandwidth saving inside the cores (the
-            # ring-SP hops in particular ppermute H/HKV x the bytes).
-            # Logits-level parity is what tests pin, so the cores can
-            # later take unexpanded k/v and broadcast per query group
-            # without touching this module's contract.
-            rep = H // HKV
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        sp_active = cfg.sequence_parallel and _seq_axis_active()
+        if HKV != H and sp_active:
+            # the SP cores (ring/Ulysses) still require expanded k/v —
+            # their hops ppermute H/HKV x the bytes GQA could save; the
+            # flash and reference paths below consume unexpanded k/v
+            # (ops/attention.py GQA support) and keep the saving
+            k = jnp.repeat(k, H // HKV, axis=2)
+            v = jnp.repeat(v, H // HKV, axis=2)
 
-        if cfg.sequence_parallel and _seq_axis_active():
+        if sp_active:
             from deepspeed_tpu.comm.mesh import get_global_mesh
             if cfg.sp_mode == "ulysses":
                 from deepspeed_tpu.ops.ulysses_attention import (

@@ -27,23 +27,37 @@ def _on_tpu() -> bool:
 def causal_attention_reference(q, k, v, scale=None, causal=True):
     """Numerics oracle: plain softmax attention, fp32 accumulation.
 
-    Shapes: q/k/v ``[B, T, H, D]`` → ``[B, T, H, D]``. Also serves the
-    sequence-parallel modes' dense core and degenerate-mesh fallbacks, so
-    scale/causal overrides live HERE, once.
+    Shapes: q ``[B, T, H, D]`` → ``[B, T, H, D]``; k/v may carry fewer
+    heads (``[B, T, HKV, D]``, HKV | H — grouped-query attention,
+    broadcast per query group without materializing repeated k/v). Also
+    serves the sequence-parallel modes' dense core and degenerate-mesh
+    fallbacks, so scale/causal overrides live HERE, once.
     """
     B, T, H, D = q.shape
+    HKV = k.shape[2]
+    if H % HKV:
+        raise ValueError(f"q heads {H} not divisible by kv heads {HKV}")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    # one body serves MHA (g=1) and GQA: the group axis broadcasts k/v per
+    # query group without materializing repeats, and XLA drops the
+    # degenerate axis for plain attention
+    g = H // HKV
+    q5 = q.reshape(B, T, HKV, g, D)
+    att = (jnp.einsum("bqhgd,bkhd->bhgqk", q5, k).astype(jnp.float32)
+           * scale)
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
-        att = jnp.where(mask[None, None], att, -1e30)
+        att = jnp.where(mask[None, None, None], att, -1e30)
     att = jax.nn.softmax(att, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", att.astype(v.dtype), v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", att.astype(v.dtype), v)
+    return out.reshape(B, T, H, D)
 
 
 def causal_attention(q, k, v):
-    """Causal self-attention ``[B, T, H, D] -> [B, T, H, D]``.
+    """Causal self-attention ``[B, T, H, D] -> [B, T, H, D]``; k/v may
+    carry fewer heads (grouped-query attention — both the flash kernel
+    and the reference path consume unexpanded k/v).
 
     The flash output is tagged with ``checkpoint_name('flash_attn_out')``:
     under ``jax.checkpoint`` the dots-saveable remat policy cannot see

@@ -6,11 +6,14 @@ transformer kernel N10). Instead of materializing the [T, T] attention matrix
 in HBM, the kernel streams K/V blocks through VMEM with the online-softmax
 recurrence, accumulating in fp32 — O(T) memory, MXU-shaped [128, D] matmuls.
 
-Layout: q/k/v ``[B, T, H, D]`` (same as ops/attention.causal_attention).
-The kernel works on ``[B*H, T, D]`` with a (batch-head, q-block) grid; K/V
-for one batch-head live whole in VMEM (T·D·2B·2 ≤ ~8 MB ⇒ T ≤ 16k at
-D=128 — longer sequences shard over the ``seq`` axis via ring attention,
-see ops/ring_attention.py).
+Layout: q ``[B, T, H, D]``; k/v may carry fewer heads (``[B, T, HKV, D]``,
+HKV | H — grouped-query attention without materializing repeated k/v).
+The kernel works on ``[B*H, T, D]`` q with a (kv-head, group, q-block)
+grid whose group axis revisits each K/V block, so one kv head streams
+through VMEM once for its whole query group. K/V for one batch-head live
+whole in VMEM (T·D·2B·2 ≤ ~8 MB ⇒ T ≤ 16k at D=128, independent of the
+group size — longer sequences shard over the ``seq`` axis via ring
+attention, see ops/ring_attention.py).
 
 Backward is the standard two-kernel flash decomposition (dQ sweep over K
 blocks; dK/dV sweep over Q blocks) wired through ``jax.custom_vjp`` with the
@@ -41,7 +44,7 @@ def _should_interpret() -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
                 block_q: int, block_k: int, seq_len: int, causal: bool):
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     # keep the dot INPUTS in the storage dtype (bf16): the MXU runs bf16
     # at full rate and accumulates fp32 via preferred_element_type; an
     # upfront fp32 cast would quarter the matmul throughput
@@ -85,8 +88,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
 
 def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
+    """q3 ``[B*H, T, D]``; k3/v3 ``[B*HKV, T, D]`` (HKV | H — grouped-query
+    attention streams each K/V head into VMEM ONCE for its whole query
+    group: grid order is (kv-head, group, q-block) with the q-block axis
+    fastest, so the K/V block index is constant across an entire group and
+    pallas reloads it only when the kv-head changes)."""
     BH, T, D = q3.shape
-    grid = (BH, T // block_q)
+    BKH = k3.shape[0]
+    rep = BH // BKH
+    grid = (BKH, rep, T // block_q)
     out_shape = [
         jax.ShapeDtypeStruct(q3.shape, q3.dtype),
         # trailing singleton lane dim satisfies TPU tiling (block last dim
@@ -96,17 +106,18 @@ def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
     ]
     kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
                                block_k=block_k, seq_len=T, causal=causal)
+    qmap = lambda bkh, g, qi: (bkh * rep + g, qi, 0)  # noqa: E731
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, T, D), lambda bkh, g, qi: (bkh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bkh, g, qi: (bkh, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
         ],
         out_shape=out_shape,
         interpret=interpret,
@@ -121,7 +132,7 @@ def _flash_fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale: float, block_q: int, block_k: int,
                    seq_len: int, causal: bool):
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     # bf16 dot inputs, fp32 accumulation (see _fwd_kernel note)
     q = q_ref[0]
     do = do_ref[0]
@@ -157,15 +168,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale: float, block_q: int,
-                    block_k: int, seq_len: int, causal: bool):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    block_q: int, block_k: int, seq_len: int, causal: bool,
+                    rep: int):
     ki = pl.program_id(1)
+    g = pl.program_id(2)
     # bf16 dot inputs, fp32 accumulation (see _fwd_kernel note)
     k = k_ref[0]  # [BK, D]
     v = v_ref[0]
     bk, d = k.shape
-    dk = jnp.zeros((bk, d), jnp.float32)
-    dv = jnp.zeros((bk, d), jnp.float32)
+
+    # grouped-query attention: this K/V head serves `rep` query heads.
+    # The group axis is the INNERMOST grid dim, so the dk/dv output block
+    # is revisited on consecutive steps: fp32 VMEM scratch accumulates
+    # across the group (q/do blocks stay (1, T, D) — no rep-times VMEM
+    # inflation), and the final group member flushes to the output.
+    @pl.when(g == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros((bk, d), jnp.float32)
+        dv_acc[...] = jnp.zeros((bk, d), jnp.float32)
 
     num_qb = seq_len // block_q
     first_qb = (ki * block_k) // block_q if causal else 0
@@ -196,57 +217,77 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk, dv))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body,
+                               (dk_acc[...], dv_acc[...]))
+    dk_acc[...] = dk
+    dv_acc[...] = dv
+
+    @pl.when(g == rep - 1)
+    def _flush():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, block_q, block_k,
                causal, interpret):
     BH, T, D = q3.shape
+    BKH = k3.shape[0]
+    rep = BH // BKH
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, T, 1]
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
                                   block_q=block_q, block_k=block_k,
                                   seq_len=T, causal=causal)
+    qmap = lambda bkh, g, qi: (bkh * rep + g, qi, 0)  # noqa: E731
+    kvmap = lambda bkh, g, qi: (bkh, 0, 0)  # noqa: E731
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(BH, T // block_q),
+        grid=(BKH, rep, T // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, T, D), kvmap),
+            pl.BlockSpec((1, T, D), kvmap),
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D), qmap),
         out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
                                    block_q=block_q, block_k=block_k,
-                                   seq_len=T, causal=causal)
+                                   seq_len=T, causal=causal, rep=rep)
+    # group axis INNERMOST: consecutive grid steps revisit the same dk/dv
+    # block (and the same k/v block), so the scratch accumulation in the
+    # kernel is a legal sequential reduction and k/v stay resident in VMEM
+    # across the whole query group
+    gq = lambda bkh, ki, g: (bkh * rep + g, 0, 0)  # noqa: E731
+    kvm = lambda bkh, ki, g: (bkh, ki, 0)  # noqa: E731
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(BH, T // block_k),
+        grid=(BKH, T // block_k, rep),
         in_specs=[
-            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, T, 1), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, T, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), gq),
+            pl.BlockSpec((1, block_k, D), kvm),
+            pl.BlockSpec((1, block_k, D), kvm),
+            pl.BlockSpec((1, T, D), gq),
+            pl.BlockSpec((1, T, 1), gq),
+            pl.BlockSpec((1, T, 1), gq),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), kvm),
+            pl.BlockSpec((1, block_k, D), kvm),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k3.shape, k3.dtype),
             jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
@@ -287,12 +328,24 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     scale: float | None = None):
-    """Fused attention, ``[B, T, H, D] -> [B, T, H, D]``.
+    """Fused attention, ``q [B, T, H, D] -> [B, T, H, D]``.
+
+    ``k``/``v`` may carry fewer heads (``[B, T, HKV, D]`` with HKV | H):
+    grouped-query attention runs WITHOUT materializing the repeated k/v —
+    each kv head streams through VMEM once for its whole query group, so
+    GQA's HBM-bandwidth saving survives into the kernel (models pass
+    unexpanded k/v; see models/llama.py).
 
     Sequence length must be divisible by the block sizes (the model layer
     pads to n_positions, itself a multiple of 128).
     """
     B, T, H, D = q.shape
+    HKV = k.shape[2]
+    if k.shape != v.shape or k.shape[:2] != (B, T) or k.shape[3] != D:
+        raise ValueError(f"k/v shape {k.shape}/{v.shape} incompatible "
+                         f"with q {q.shape}")
+    if H % HKV:
+        raise ValueError(f"q heads {H} not divisible by kv heads {HKV}")
 
     def fit(b: int) -> int:
         # largest power-of-two fraction of the requested block ≥ 128 that
@@ -314,7 +367,8 @@ def flash_attention(q, k, v, causal: bool = True,
         scale = 1.0 / math.sqrt(D)
 
     def to3(x):
-        return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+        h = x.shape[2]
+        return jnp.swapaxes(x, 1, 2).reshape(B * h, T, D)
 
     o3 = _flash_attention(to3(q), to3(k), to3(v), float(scale),
                           block_q, block_k, causal)

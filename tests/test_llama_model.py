@@ -13,9 +13,6 @@ import pytest
 from deepspeed_tpu.models.llama import (LlamaConfig, LlamaLMModel,
                                         config_for, params_from_hf)
 
-jnp32 = lambda x: jnp.asarray(np.asarray(x), jnp.float32)  # noqa: E731
-
-
 def _tiny_cfg(**kw):
     base = dict(vocab_size=512, n_positions=64, n_embd=64, n_layer=2,
                 n_head=4, n_kv_head=4, intermediate_size=176,

@@ -70,6 +70,53 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.parametrize("hkv", [1, 2])
+    def test_gqa_forward_and_grad_parity(self, hkv):
+        """Grouped-query attention: unexpanded k/v ([B, T, HKV, D],
+        HKV | H) through the kernel must equal the expanded-MHA oracle,
+        including dk/dv (which accumulate over the whole query group)."""
+        q, _, _ = self._qkv(T=256, H=4)
+        _, k, v = self._qkv(T=256, H=hkv)
+        rep = 4 // hkv
+        kx = jnp.repeat(k, rep, axis=2)
+        vx = jnp.repeat(v, rep, axis=2)
+
+        o = flash_attention(q, k, v, causal=True)
+        o_ref = causal_attention_reference(q, kx, vx)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)
+
+        def loss_r(q, k, v):
+            o = causal_attention_reference(
+                q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2))
+            return jnp.sum(o ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert a.shape == b.shape
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-4)
+
+    def test_gqa_reference_matches_expanded(self):
+        """The jnp oracle's own GQA path vs explicit expansion."""
+        q, _, _ = self._qkv(T=128, H=4)
+        _, k, v = self._qkv(T=128, H=2)
+        o = causal_attention_reference(q, k, v)
+        o_ref = causal_attention_reference(q, jnp.repeat(k, 2, axis=2),
+                                           jnp.repeat(v, 2, axis=2))
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_gqa_rejects_indivisible_heads(self):
+        q, _, _ = self._qkv(T=128, H=4)
+        _, k, v = self._qkv(T=128, H=3)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v)
+
     def test_bf16_forward_and_grad_parity(self):
         """The production dtype: kernel dots take bf16 inputs with fp32
         accumulation; p/ds are downcast before the MXU dots. Parity vs the
