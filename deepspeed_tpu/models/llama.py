@@ -129,13 +129,15 @@ class LlamaAttention(nn.Module):
         v = dense(HKV * D, "wv")(x).reshape(B, T, HKV, D)
         q, k = _rope(q, k, jnp.arange(T), cfg.rope_theta)
         sp_active = cfg.sequence_parallel and _seq_axis_active()
-        if HKV != H and sp_active:
-            # the SP cores (ring/Ulysses) still require expanded k/v —
-            # their hops ppermute H/HKV x the bytes GQA could save; the
-            # flash and reference paths below consume unexpanded k/v
-            # (ops/attention.py GQA support) and keep the saving
-            k = jnp.repeat(k, H // HKV, axis=2)
-            v = jnp.repeat(v, H // HKV, axis=2)
+        if HKV != H and sp_active and cfg.sp_mode == "ulysses":
+            from deepspeed_tpu.comm.mesh import get_global_mesh
+            if HKV % get_global_mesh().shape["seq"]:
+                # Ulysses' head all-to-all only preserves GQA group
+                # alignment when kv heads split evenly across the seq
+                # axis; otherwise fall back to expanded k/v. Ring, flash,
+                # and the reference path always consume unexpanded k/v.
+                k = jnp.repeat(k, H // HKV, axis=2)
+                v = jnp.repeat(v, H // HKV, axis=2)
 
         if sp_active:
             from deepspeed_tpu.comm.mesh import get_global_mesh

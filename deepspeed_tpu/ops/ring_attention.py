@@ -34,21 +34,26 @@ NEG_INF = -1e30
 SEQ_AXIS = "seq"
 
 
-def _block_scores(q, k, scale, q_start, k_start, causal):
-    """Masked scores s [B, H, Tq, Tk] in fp32 plus the bool mask.
+def _block_scores(q5, k, scale, q_start, k_start, causal):
+    """Masked scores s ``[B, HKV, G, Tq, Tk]`` in fp32 plus the bool mask.
 
-    Inputs stay in their storage dtype (bf16) so the MXU runs at full
-    rate; fp32 comes from the accumulator (preferred_element_type), the
-    same fix as the Pallas flash kernels."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    ``q5`` is the query block in grouped layout ``[B, Tq, HKV, G, D]``
+    (G = n_head / n_kv_head; G=1 for plain MHA) against an UNEXPANDED
+    k ``[B, Tk, HKV, D]`` — grouped-query attention's k/v stay at their
+    native head count through every ring hop, so GQA's ICI-bandwidth
+    saving survives sequence parallelism. Inputs stay in their storage
+    dtype (bf16) so the MXU runs at full rate; fp32 comes from the
+    accumulator (preferred_element_type), the same fix as the Pallas
+    flash kernels."""
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
-        Tq, Tk = q.shape[1], k.shape[1]
+        Tq, Tk = q5.shape[1], k.shape[1]
         qpos = q_start + jnp.arange(Tq)
         kpos = k_start + jnp.arange(Tk)
         mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-        return s, mask[None, None]
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+        return s, mask[None, None, None]
     return s, None
 
 
@@ -70,25 +75,30 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
+    HKV = k.shape[2]
+    G = H // HKV
+    q5 = q.reshape(B, Tl, HKV, G, D)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    m = _varying(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32), axis_name)
-    l = _varying(jnp.zeros((B, H, Tl, 1), jnp.float32), axis_name)
-    acc = _varying(jnp.zeros((B, Tl, H, D), jnp.float32), axis_name)
+    m = _varying(jnp.full((B, HKV, G, Tl, 1), NEG_INF, jnp.float32),
+                 axis_name)
+    l = _varying(jnp.zeros((B, HKV, G, Tl, 1), jnp.float32), axis_name)
+    acc = _varying(jnp.zeros((B, Tl, HKV, G, D), jnp.float32), axis_name)
     q_start = idx * Tl
 
     def step_fn(carry, step):
         m, l, acc, k_cur, v_cur = carry
         src = (idx - step) % sp
-        s, mask = _block_scores(q, k_cur, scale, q_start, src * Tl, causal)
+        s, mask = _block_scores(q5, k_cur, scale, q_start, src * Tl,
+                                causal)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         if mask is not None:
             p = p * mask
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * jnp.moveaxis(alpha, 1, 2) + jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
+        acc = acc * jnp.moveaxis(alpha, 3, 1) + jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p.astype(v_cur.dtype), v_cur,
             preferred_element_type=jnp.float32)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
@@ -97,8 +107,9 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
     (m, l, acc, _, _), _ = jax.lax.scan(
         step_fn, (m, l, acc, k, v), jnp.arange(sp))
     l_safe = jnp.maximum(l, 1e-30)
-    o = (acc / jnp.moveaxis(l_safe, 1, 2)).astype(q.dtype)
-    lse = m + jnp.log(l_safe)  # [B, H, Tl, 1]
+    o = (acc / jnp.moveaxis(l_safe, 3, 1)).astype(q.dtype)
+    o = o.reshape(B, Tl, H, D)
+    lse = m + jnp.log(l_safe)  # [B, HKV, G, Tl, 1]
     return o, lse
 
 
@@ -112,37 +123,46 @@ def _ring_bwd(axis_name, causal, scale, res, do):
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
+    HKV = k.shape[2]
+    G = H // HKV
+    q5 = q.reshape(B, Tl, HKV, G, D)
+    do5 = do.reshape(B, Tl, HKV, G, D)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    do32 = do.astype(jnp.float32)
-    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [B, Tl, H]
-    delta = jnp.moveaxis(delta, 1, 2)[..., None]  # [B, H, Tl, 1]
+    do32 = do5.astype(jnp.float32)
+    delta = jnp.sum(do32 * o.astype(jnp.float32).reshape(do5.shape),
+                    axis=-1)  # [B, Tl, HKV, G]
+    delta = jnp.moveaxis(delta, 1, 3)[..., None]  # [B, HKV, G, Tl, 1]
     q_start = idx * Tl
 
-    dq = _varying(jnp.zeros(q.shape, jnp.float32), axis_name)
+    dq = _varying(jnp.zeros(q5.shape, jnp.float32), axis_name)
+    # dk/dv accumulate (and ride the ring) at the UNEXPANDED head count:
+    # the einsums below sum each kv head's query group, so GQA's hop
+    # traffic shrinks by G in backward too
     dk0 = _varying(jnp.zeros(k.shape, jnp.float32), axis_name)
     dv0 = _varying(jnp.zeros(v.shape, jnp.float32), axis_name)
 
     def step_fn(carry, step):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         src = (idx - step) % sp
-        s, mask = _block_scores(q, k_cur, scale, q_start, src * Tl, causal)
+        s, mask = _block_scores(q5, k_cur, scale, q_start, src * Tl,
+                                causal)
         p = jnp.exp(s - lse)
         if mask is not None:
             p = p * mask
         # dv += p^T do ; ds = p*(dp - delta); dk += ds^T q ; dq += ds k
         dv_cur = dv_cur + jnp.einsum(
-            "bhqk,bqhd->bkhd", p.astype(do.dtype), do,
+            "bhgqk,bqhgd->bkhd", p.astype(do.dtype), do5,
             preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", do, v_cur,
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", do5, v_cur,
                         preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         ds16 = ds.astype(q.dtype)
         dk_cur = dk_cur + jnp.einsum(
-            "bhqk,bqhd->bkhd", ds16, q,
+            "bhgqk,bqhgd->bkhd", ds16, q5,
             preferred_element_type=jnp.float32) * scale
         dq = dq + jnp.einsum(
-            "bhqk,bkhd->bqhd", ds16, k_cur,
+            "bhgqk,bkhd->bqhgd", ds16, k_cur,
             preferred_element_type=jnp.float32) * scale
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
@@ -153,7 +173,8 @@ def _ring_bwd(axis_name, causal, scale, res, do):
     (dq, _, _, dk, dv), _ = jax.lax.scan(
         step_fn, (dq, k, v, dk0, dv0), jnp.arange(sp))
     # after sp hops the accumulators are back at their home rank
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.reshape(q.shape).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
 
 
 _ring_attention.defvjp(_ring_fwd, _ring_bwd)
@@ -177,6 +198,9 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     """Global-array entry point: shards [B, T, H, D] over the ``seq`` axis
     and runs the ring. Works inside jit (other mesh axes stay automatic)."""
     mesh = mesh or get_global_mesh()
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"q heads {q.shape[2]} not divisible by kv "
+                         f"heads {k.shape[2]}")
     if SEQ_AXIS not in mesh.axis_names or mesh.shape[SEQ_AXIS] == 1:
         from deepspeed_tpu.ops.attention import causal_attention_reference
         return causal_attention_reference(q, k, v, scale=scale,

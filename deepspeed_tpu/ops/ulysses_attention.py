@@ -68,6 +68,16 @@ def ulysses_attention_sharded(q, k, v, axis_name: str = SEQ_AXIS,
     if q.shape[2] % sp:
         raise ValueError(f"n_head {q.shape[2]} not divisible by seq "
                          f"axis {sp} (use ring attention instead)")
+    if k.shape[2] % sp:
+        # grouped-query attention rides through natively when the kv
+        # heads split evenly: rank r's H/sp query heads map exactly onto
+        # its HKV/sp kv heads (H/sp is a multiple of the group size), so
+        # the GQA-aware dense core computes the same result on
+        # unexpanded k/v. An uneven split breaks that alignment.
+        raise ValueError(
+            f"n_kv_head {k.shape[2]} not divisible by seq axis {sp}: "
+            f"expand k/v to the query head count first (jnp.repeat) or "
+            f"use ring attention")
     qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     out = _dense_attention(qh, kh, vh, causal, float(scale))
     return head_to_seq(out)
