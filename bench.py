@@ -163,6 +163,8 @@ def _phase_train(args) -> dict:
     model = model_cls(cfg)
     log(f"init {args.preset} seq={args.seq} flash={not args.no_flash}")
     params = model.init(jax.random.PRNGKey(0), batch_size=1, seq_len=128)
+    jax.block_until_ready(params)
+    log("params materialized")
 
     zero: dict = {"stage": 3}
     if args.offload:
@@ -548,8 +550,15 @@ PHASES = {
     # modern-decoder family (RoPE/RMSNorm/SwiGLU — models/llama.py):
     # evidence the framework trains today's architectures at speed, not
     # just the reference's GPT-2/BERT ladder
+    # a ~1.2B-param model can't hold fp32 master+moments (~13 GB) plus
+    # activations in 15.75G HBM any more than gpt2-1.3b can — it needs the
+    # same streamed optimizer offload (micro 4 on-device OOMed at 18.47G,
+    # micro 2 + gas 2 at 19.67G once the fp32 GAS grad carry was added)
+    # 900s: every llama executable is compile-cache cold the first time,
+    # and a kill mid-Mosaic-compile wedges the relay (see ORDER note)
     "train-llama-1b": (["--preset", "llama-1b", "--seq", "2048",
-                        "--micro", "4"], 600),
+                        "--micro", "4", "--gas", "8", "--offload",
+                        "--steps", "2"], 900),
 }
 
 

@@ -129,8 +129,9 @@ class LlamaAttention(nn.Module):
         v = dense(HKV * D, "wv")(x).reshape(B, T, HKV, D)
         q, k = _rope(q, k, jnp.arange(T), cfg.rope_theta)
         sp_active = cfg.sequence_parallel and _seq_axis_active()
-        if HKV != H and sp_active and cfg.sp_mode == "ulysses":
+        if sp_active:
             from deepspeed_tpu.comm.mesh import get_global_mesh
+        if HKV != H and sp_active and cfg.sp_mode == "ulysses":
             if HKV % get_global_mesh().shape["seq"]:
                 # Ulysses' head all-to-all only preserves GQA group
                 # alignment when kv heads split evenly across the seq
@@ -140,7 +141,6 @@ class LlamaAttention(nn.Module):
                 v = jnp.repeat(v, H // HKV, axis=2)
 
         if sp_active:
-            from deepspeed_tpu.comm.mesh import get_global_mesh
             if cfg.sp_mode == "ulysses":
                 from deepspeed_tpu.ops.ulysses_attention import (
                     ulysses_self_attention)

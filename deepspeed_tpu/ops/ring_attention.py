@@ -187,6 +187,9 @@ def ring_attention_sharded(q, k, v, axis_name: str = SEQ_AXIS,
 
     q/k/v: per-device sequence shards ``[B, T/sp, H, D]``.
     """
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"q heads {q.shape[2]} not divisible by kv "
+                         f"heads {k.shape[2]}")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     return _ring_attention(q, k, v, axis_name, causal, float(scale))
