@@ -424,6 +424,42 @@ def phase_infer(args) -> dict:
     trim = lat[1:-1] if len(lat) > 4 else lat  # warmup-trim convention
     out["bert_fwd_p50_ms"] = round(trim[len(trim) // 2], 3)
     log(f"bert fwd p50={out['bert_fwd_p50_ms']} ms")
+
+    # salvage point: everything above survives even if the cold llama
+    # compile below overruns the phase cap (run_phase keeps the LAST
+    # parseable JSON line on a timeout kill)
+    print(json.dumps({**out, "partial": True}), flush=True)
+
+    # --- llama-1b-shaped decode (modern-decoder family: RMSNorm + SwiGLU
+    # + full-dim rotary; the reference's gpt-bench conventions applied to
+    # the architecture class users actually serve today). LAST in the
+    # phase: its ~1.2B-param engine is the only compile-cache-cold work
+    # here, and a kill mid-compile must not cost the earlier metrics.
+    try:
+        llama_cfg = InferenceTransformerConfig(
+            vocab_size=32000, n_positions=2048, n_embd=2048, n_layer=16,
+            n_head=16, intermediate_size=5504, positional="rotary",
+            norm_type="rmsnorm", gated_mlp=True, activation="silu",
+            tied_lm_head=False, dtype=jnp.bfloat16)
+        leng = InferenceEngine(llama_cfg, DeepSpeedInferenceConfig(
+            max_out_tokens=1024))
+        t = time.time()
+        leng.generate(prompt, max_new_tokens=new_tokens)
+        log(f"llama generate compile+run in {time.time() - t:.1f}s")
+        lat = []
+        for i in range(args.iters):
+            t = time.time()
+            leng.generate(prompt, max_new_tokens=new_tokens, seed=i)
+            lat.append((time.time() - t) / new_tokens * 1e3)
+        lat.sort()
+        out["llama1b_token_p50_ms"] = round(lat[len(lat) // 2], 3)
+        log(f"llama decode p50={out['llama1b_token_p50_ms']} ms/token")
+        marg = measure_marginal(leng, out["llama1b_token_p50_ms"], "llama")
+        if marg is not None:
+            out["llama1b_token_marginal_ms"] = marg
+    except Exception as e:  # noqa: BLE001 — optional metric
+        log(f"llama decode phase skipped: {type(e).__name__}: "
+            f"{str(e)[:120]}")
     return out
 
 
@@ -515,15 +551,18 @@ PHASES = {
     # captured in a healthy window.
     "train-1.3b": (["--preset", "gpt2-1.3b", "--offload",
                     "--micro", "2", "--gas", "64", "--steps", "2"], 900),
-    # flagship 350m at its measured sweet spot: flash + micro 8 = 83.5 TF
-    # / 42.4% MFU (micro 12 regresses to 74.6 under memory pressure,
-    # micro 16 OOMs by 372M; naive attention gains nothing from micro>4 —
-    # the [T,T] score traffic scales with batch, flash removes it).
+    # flagship 350m at its measured sweet spot: flash + micro 8 = 83.1 TF
+    # / 42.2% MFU captured (micro 12 regresses to 74.6 under memory
+    # pressure, micro 16 OOMs by 372M; naive attention gains nothing from
+    # micro>4 — the [T,T] score traffic scales with batch, flash removes
+    # it).
     "train-350m-flash-mb8": (["--preset", "gpt2-350m", "--micro", "8"],
                              480),
     # the reference's training-kernel headline: BERT-large (64 TFLOPS/GPU)
     "train-bert-large": (["--seq", "512", "--micro", "16"], 480),
-    "inference": ([], 480),
+    # 900s: ends with the compile-cache-cold llama-1b decode engine (the
+    # phase prints a salvage line first, so a cap kill costs only llama)
+    "inference": ([], 900),
     "train-125m": (["--preset", "gpt2-125m", "--no-flash"], 420),
     "train-350m-flash": (["--preset", "gpt2-350m"], 480),
     "train-350m-noflash": (["--preset", "gpt2-350m", "--no-flash"], 480),

@@ -36,6 +36,10 @@ ARCH_KNOBS = {
     "gpt-neox": dict(positional="rotary", rotary_dim=8,
                      parallel_attn_mlp=True),
     "bloom": dict(positional="alibi"),
+    # llama/mistral family: RMSNorm + SwiGLU + GQA + full-head-dim rotary
+    "llama": dict(positional="rotary", norm_type="rmsnorm", gated_mlp=True,
+                  activation="silu", n_kv_head=2, tied_lm_head=False,
+                  intermediate_size=176),
 }
 
 
