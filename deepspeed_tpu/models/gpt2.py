@@ -54,12 +54,38 @@ class GPT2Config:
     # weights into HBM *inside* its remat region — backward re-fetches, so
     # HBM holds only a few layers of weights at a time.
     offload_params: bool = False
+    # MoE FFN (reference Megatron-MoE training recipe: deepspeed/moe/layer
+    # dropped into the FFN slot). num_experts > 0 turns the layers in
+    # ``moe_layers`` (None → every OTHER layer starting at 1, the
+    # Megatron-Deepspeed expert_interval=2 default) into expert-parallel
+    # MoE blocks; experts shard over the data/fsdp axes via MoE.tp_specs.
+    # The model's ``__call__``/``loss_fn`` fold the gate load-balancing
+    # loss in with weight ``moe_aux_weight``.
+    num_experts: int = 0
+    moe_layers: Optional[tuple] = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_mode must be 'ring' or 'ulysses', got "
                 f"{self.sp_mode!r}")
+        if self.num_experts > 0 and self.offload_params:
+            raise ValueError(
+                "num_experts > 0 with offload_params is unsupported: the "
+                "in-step fetch table shares one block structure across "
+                "layers, and MoE layers have a different param tree than "
+                "dense ones")
+
+    @property
+    def moe_layer_set(self) -> frozenset:
+        if self.num_experts <= 0:
+            return frozenset()
+        if self.moe_layers is not None:
+            return frozenset(self.moe_layers)
+        return frozenset(range(1, self.n_layer, 2))
 
     @property
     def padded_vocab_size(self) -> int:
@@ -144,7 +170,14 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
+    """Transformer block. With ``moe=True`` the FFN slot holds an
+    expert-parallel MoE (reference deepspeed/moe/layer.py inside a
+    Megatron-MoE GPT layer) and ``__call__`` returns ``(x, l_aux)`` — the
+    gate's load-balancing loss rides out as a scalar so remat never needs
+    a mutable collection. One class for both so the LN/attention/residual
+    structure cannot drift between dense and MoE models."""
     config: GPT2Config
+    moe: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -154,6 +187,19 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
         x = x + CausalSelfAttention(cfg, name="attn")(h, deterministic)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        if self.moe:
+            from deepspeed_tpu.moe.layer import MoE
+            B, T, C = x.shape
+            # tokens flatten to one group; the expert dispatch reshard
+            # over the EP axes (= data/fsdp) IS the all-to-all
+            y, l_aux, _ = MoE(hidden_size=C, num_experts=cfg.num_experts,
+                              k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor,
+                              eval_capacity_factor=cfg.moe_capacity_factor,
+                              min_capacity=4, dtype=cfg.dtype,
+                              name="moe")(h.reshape(B * T, C),
+                                          train=not deterministic)
+            return x + y.reshape(B, T, C), l_aux
         x = x + MLP(cfg, name="mlp")(h, deterministic)
         return x
 
@@ -233,6 +279,7 @@ class GPT2(nn.Module):
                 trans_in_fn=lambda t: _fetch_to_device(
                     t, "block", self.fetch_table),
                 trans_out_fn=lambda t: t, mutable=True, init=True)
+        moe_set = cfg.moe_layer_set
         if cfg.remat:
             # dots-saveable + the flash kernel's tagged output: the policy
             # cannot see through the kernel's custom_vjp, so without the
@@ -242,8 +289,14 @@ class GPT2(nn.Module):
                 jax.checkpoint_policies.save_only_these_names(
                     "flash_attn_out"))
             block = nn.remat(block, prevent_cse=False, policy=policy)
+        l_aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x, deterministic)
+            if i in moe_set:
+                x, l_aux = block(cfg, moe=True,
+                                 name=f"h_{i}")(x, deterministic)
+                l_aux_total = l_aux_total + l_aux.astype(jnp.float32)
+            else:
+                x = block(cfg, name=f"h_{i}")(x, deterministic)
 
         ln_f = nn.LayerNorm
         if cfg.offload_params:
@@ -254,6 +307,8 @@ class GPT2(nn.Module):
                 trans_out_fn=lambda t: t, mutable=True, init=True)
         x = ln_f(dtype=cfg.dtype, name="ln_f")(x)
         logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype))
+        if moe_set:
+            return logits, l_aux_total
         return logits
 
 
@@ -310,16 +365,28 @@ class GPT2LMModel:
         return variables["params"]
 
     def apply(self, params, input_ids, deterministic=True, rngs=None):
+        """Returns logits; with MoE layers, ``(logits, l_aux_total)``."""
         return self.module.apply({"params": params}, input_ids,
                                  deterministic=deterministic, rngs=rngs)
 
     def loss_fn(self, params, batch, rng=None):
+        cfg = self.config
         input_ids = batch["input_ids"]
         labels = batch.get("labels")
-        rngs = {"dropout": rng} if (rng is not None and
-                                    self.config.dropout > 0.0) else None
-        logits = self.apply(params, input_ids,
-                            deterministic=rngs is None, rngs=rngs)
+        rngs = {}
+        if rng is not None and cfg.dropout > 0.0:
+            rngs["dropout"] = rng
+        if rng is not None and cfg.num_experts > 0:
+            # gate randomness (rts noise / top-2 second-expert sampling)
+            rngs["gating"] = jax.random.fold_in(rng, 1)
+        rngs = rngs or None
+        out = self.apply(params, input_ids,
+                         deterministic=rng is None, rngs=rngs)
+        l_aux = None
+        if cfg.num_experts > 0:
+            logits, l_aux = out
+        else:
+            logits = out
         if labels is None:
             labels = input_ids[:, 1:]
             logits = logits[:, :-1]
@@ -332,7 +399,10 @@ class GPT2LMModel:
                                    axis=-1)[..., 0]
         nll = lse - gold
         mask = (labels >= 0) & (labels < self.config.vocab_size)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        if l_aux is not None:
+            loss = loss + cfg.moe_aux_weight * l_aux
+        return loss
 
     def tp_specs(self):
         """Megatron-style tensor-parallel placement: attention qkv + mlp up
@@ -354,17 +424,29 @@ class GPT2LMModel:
         }
         specs = {"wte": P("tensor", None), "wpe": P(),
                  "ln_f": {"scale": P(), "bias": P()}}
+        moe_set = cfg.moe_layer_set
+        if moe_set:
+            from deepspeed_tpu.moe.layer import MoE
+            moe_block = dict(block)
+            del moe_block["mlp"]
+            moe_block["moe"] = MoE.tp_specs()
         for i in range(cfg.n_layer):
-            specs[f"h_{i}"] = block
+            specs[f"h_{i}"] = moe_block if i in moe_set else block
         return specs
 
     def param_count(self, params) -> int:
         return sum(int(p.size) for p in jax.tree.leaves(params))
 
     def flops_per_token(self) -> float:
-        """~6 * N_params per token (training fwd+bwd)."""
+        """~6 * N_active_params per token (training fwd+bwd). MoE layers
+        count attention + top_k expert FFNs — the ACTIVE compute, not the
+        parameter count (standard MoE throughput accounting)."""
         cfg = self.config
+        n_moe = len(cfg.moe_layer_set)
+        dense_ffn = 8 * cfg.n_embd ** 2
         n = (cfg.padded_vocab_size * cfg.n_embd
              + cfg.n_positions * cfg.n_embd
-             + cfg.n_layer * (12 * cfg.n_embd ** 2))
+             + cfg.n_layer * (4 * cfg.n_embd ** 2)            # attention
+             + (cfg.n_layer - n_moe) * dense_ffn              # dense FFN
+             + n_moe * cfg.moe_top_k * dense_ffn)             # active experts
         return 6.0 * n
