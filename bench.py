@@ -125,6 +125,23 @@ def oom_record(text: str, phase: str, **extra):
             **extra}
 
 
+def train_phase_name(args, *, seq_suffix: bool = False,
+                     partial: bool = False) -> str:
+    """The one assembly point for train-phase record names — the salvage
+    store and baseline matching key on these strings."""
+    name = (f"train-{args.preset}"
+            + (f"-moe{args.experts}" if args.experts else "")
+            + ("-micro" if args.adaptive_steps else "")
+            + ("-noflash" if args.no_flash else "")
+            + ("-noremat" if args.no_remat else "")
+            + ("-offload" if args.offload else ""))
+    if seq_suffix:
+        name += f"-seq{args.seq}"
+    if partial:
+        name += "-partial"
+    return name
+
+
 def phase_train(args) -> dict:
     try:
         return _phase_train(args)
@@ -132,10 +149,7 @@ def phase_train(args) -> dict:
         # (e.g. naive attention at seq 4096 cannot run at all — flash is
         # what makes long context fit on a chip)
         rec = oom_record(
-            str(e),
-            f"train-{args.preset}"
-            + (f"-moe{args.experts}" if args.experts else "")
-            + ("-noflash" if args.no_flash else "") + f"-seq{args.seq}",
+            str(e), train_phase_name(args, seq_suffix=True),
             preset=args.preset, seq=args.seq,
             global_batch=args.micro * args.gas)
         if rec is None:
@@ -216,9 +230,7 @@ def _phase_train(args) -> dict:
     fpt = model.flops_per_token()
     warm_tf = tokens_per_step / warm_s / n_chips * fpt / 1e12
     print(json.dumps({
-        "phase": (f"train-{args.preset}"
-                  + (f"-moe{args.experts}" if args.experts else "")
-                  + "-partial"),
+        "phase": train_phase_name(args, partial=True),
         "preset": args.preset,
         "tokens_per_sec_per_chip": round(tokens_per_step / warm_s /
                                          n_chips, 2),
@@ -244,12 +256,7 @@ def _phase_train(args) -> dict:
     tps_chip = tokens_per_step * steps / dt / n_chips
     tf_chip = tps_chip * fpt / 1e12
     return {
-        "phase": (f"train-{args.preset}" +
-                  (f"-moe{args.experts}" if args.experts else "") +
-                  ("-micro" if args.adaptive_steps else "") +
-                  ("-noflash" if args.no_flash else "") +
-                  ("-noremat" if args.no_remat else "") +
-                  ("-offload" if args.offload else "")),
+        "phase": train_phase_name(args),
         "preset": args.preset,
         "tokens_per_sec_per_chip": round(tps_chip, 2),
         "tflops_per_chip": round(tf_chip, 2),

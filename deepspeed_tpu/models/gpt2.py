@@ -78,6 +78,18 @@ class GPT2Config:
                 "in-step fetch table shares one block structure across "
                 "layers, and MoE layers have a different param tree than "
                 "dense ones")
+        if self.num_experts > 0:
+            layers = (self.moe_layers if self.moe_layers is not None
+                      else tuple(range(1, self.n_layer, 2)))
+            if not layers:
+                raise ValueError(
+                    "num_experts > 0 needs at least one MoE layer "
+                    "(moe_layers is empty)")
+            bad = [i for i in layers if not 0 <= i < self.n_layer]
+            if bad:
+                raise ValueError(
+                    f"moe_layers {bad} out of range for n_layer="
+                    f"{self.n_layer}")
 
     @property
     def moe_layer_set(self) -> frozenset:
@@ -288,7 +300,11 @@ class GPT2(nn.Module):
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 jax.checkpoint_policies.save_only_these_names(
                     "flash_attn_out"))
-            block = nn.remat(block, prevent_cse=False, policy=policy)
+            # deterministic is control flow (dropout gate, MoE train-mode
+            # capacity), not data — keep it static under the remat trace
+            # (argnum 2: flax counts the module instance as 0)
+            block = nn.remat(block, prevent_cse=False, policy=policy,
+                             static_argnums=(2,))
         l_aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layer):
             if i in moe_set:

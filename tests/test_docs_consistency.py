@@ -40,3 +40,18 @@ def test_cited_paths_exist(doc):
             continue
         missing.append(p)
     assert not missing, f"{doc} cites missing paths: {missing}"
+
+
+def test_config_reference_up_to_date():
+    """docs/config.md is GENERATED from the pydantic config models
+    (scripts/gen_config_reference.py); regeneration must be byte-identical,
+    so a config-model change without a doc regen fails here."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "gen_config_reference",
+        os.path.join(ROOT, "scripts", "gen_config_reference.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    on_disk = open(os.path.join(ROOT, "docs", "config.md")).read()
+    assert mod.generate() == on_disk, (
+        "docs/config.md is stale — run scripts/gen_config_reference.py")

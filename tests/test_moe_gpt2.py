@@ -90,6 +90,25 @@ class TestModel:
         with pytest.raises(ValueError, match="offload_params"):
             GPT2Config(**TINY, num_experts=4, offload_params=True)
 
+    def test_empty_and_out_of_range_moe_layers_refused(self):
+        with pytest.raises(ValueError, match="at least one"):
+            GPT2Config(**TINY, num_experts=4, moe_layers=())
+        with pytest.raises(ValueError, match="out of range"):
+            GPT2Config(**TINY, num_experts=4, moe_layers=(5,))
+
+    def test_remat_moe_trains(self):
+        """remat + MoE: `deterministic` must stay static under the remat
+        trace (static_argnums) or `train=not deterministic` explodes on a
+        tracer — the default-remat bench phase exercises exactly this."""
+        cfg = GPT2Config(**{**TINY, "remat": True}, num_experts=4,
+                         moe_capacity_factor=2.0)
+        model = GPT2LMModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        loss, grads = jax.value_and_grad(model.loss_fn)(
+            params, _batch(), jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+        assert np.isfinite(float(jax.tree.leaves(grads)[0].sum()))
+
 
 class TestTraining:
     def test_engine_trains_ep_sharded(self):
