@@ -79,13 +79,12 @@ class GPT2Config:
                 "layers, and MoE layers have a different param tree than "
                 "dense ones")
         if self.num_experts > 0:
-            layers = (self.moe_layers if self.moe_layers is not None
-                      else tuple(range(1, self.n_layer, 2)))
+            layers = self.moe_layer_set
             if not layers:
                 raise ValueError(
                     "num_experts > 0 needs at least one MoE layer "
                     "(moe_layers is empty)")
-            bad = [i for i in layers if not 0 <= i < self.n_layer]
+            bad = sorted(i for i in layers if not 0 <= i < self.n_layer)
             if bad:
                 raise ValueError(
                     f"moe_layers {bad} out of range for n_layer="

@@ -99,11 +99,23 @@ def _default_repr(f) -> str:
     return repr(f.default)
 
 
+def _doc_to_md(doc: str) -> str:
+    """Docstring → markdown: keep paragraph/line structure, turn RST-style
+    ``x`` literals into `x` code spans."""
+    import re
+    import textwrap
+    lines = doc.strip().splitlines()
+    if len(lines) > 1:
+        body = textwrap.dedent("\n".join(lines[1:]))
+        doc = lines[0] + "\n" + body
+    return re.sub(r"``([^`]+)``", r"`\1`", doc)
+
+
 def emit_model(buf, title: str, model, note: str = "") -> None:
     buf.write(f"### `{title}`\n\n")
     doc = (model.__doc__ or "").strip()
     if doc:
-        buf.write(" ".join(line.strip() for line in doc.splitlines()))
+        buf.write(_doc_to_md(doc))
         buf.write("\n\n")
     if note:
         buf.write(note + "\n\n")
@@ -118,7 +130,7 @@ def emit_dataclass(buf, title: str, dc, note: str = "") -> None:
     buf.write(f"### `{title}`\n\n")
     doc = (dc.__doc__ or "").strip()
     if doc:
-        buf.write(" ".join(line.strip() for line in doc.splitlines()))
+        buf.write(_doc_to_md(doc))
         buf.write("\n\n")
     if note:
         buf.write(note + "\n\n")
