@@ -176,8 +176,10 @@ def _phase_train(args) -> dict:
                      remat=not args.no_remat,
                      use_flash_attention=not args.no_flash)
     if args.experts:
-        # MoE FFN every other layer, top-2 gate (Megatron-MoE recipe);
-        # single-chip EP=1 still measures the dispatch/expert compute
+        # MoE FFN with each family's canonical layout: gpt2 = every other
+        # layer (Megatron-MoE expert_interval=2), llama = every layer with
+        # gated-SwiGLU experts (Mixtral). Single-chip EP=1 still measures
+        # the dispatch/expert compute; flops accounting is active-params.
         overrides["num_experts"] = args.experts
     cfg = config_for(args.preset, **overrides)
     model = model_cls(cfg)
@@ -759,7 +761,8 @@ def main() -> None:
     ap.add_argument("--no-flash", action="store_true")
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--experts", type=int, default=0,
-                    help="MoE FFN every other layer with N experts (top-2)")
+                    help="N-expert MoE FFN, top-2 (gpt2: every other "
+                         "layer; llama: every layer, Mixtral layout)")
     ap.add_argument("--offload", action="store_true",
                     help="ZeRO-3 + cpu offload_optimizer (north-star cfg)")
     ap.add_argument("--adaptive-steps", action="store_true",

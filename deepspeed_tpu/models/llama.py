@@ -20,7 +20,7 @@ bookkeeping).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import flax.linen as nn
 import jax
@@ -49,6 +49,15 @@ class LlamaConfig:
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     sp_mode: str = "ring"
+    # Mixtral-style MoE: num_experts > 0 replaces the SwiGLU FFN of the
+    # layers in ``moe_layers`` (None → EVERY layer, the Mixtral layout)
+    # with top-k gated SwiGLU experts sharded over the data/fsdp axes.
+    # Gate aux loss folds into loss_fn with moe_aux_weight.
+    num_experts: int = 0
+    moe_layers: Optional[tuple] = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.n_head % self.n_kv_head:
@@ -57,6 +66,23 @@ class LlamaConfig:
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got "
                              f"{self.sp_mode!r}")
+        if self.num_experts > 0:
+            layers = self.moe_layer_set
+            if not layers:
+                raise ValueError("num_experts > 0 needs at least one MoE "
+                                 "layer (moe_layers is empty)")
+            bad = sorted(i for i in layers if not 0 <= i < self.n_layer)
+            if bad:
+                raise ValueError(f"moe_layers {bad} out of range for "
+                                 f"n_layer={self.n_layer}")
+
+    @property
+    def moe_layer_set(self) -> frozenset:
+        if self.num_experts <= 0:
+            return frozenset()
+        if self.moe_layers is not None:
+            return frozenset(self.moe_layers)
+        return frozenset(range(self.n_layer))
 
     @property
     def head_dim(self) -> int:
@@ -77,6 +103,14 @@ PRESETS: Dict[str, dict] = {
     # mistral-style GQA variant
     "llama-7b-gqa": dict(n_embd=4096, n_layer=32, n_head=32, n_kv_head=8,
                          intermediate_size=14336, n_positions=4096),
+    # Mixtral layout: GQA + top-2 gated-SwiGLU experts in EVERY layer
+    "mixtral-tiny": dict(vocab_size=512, n_positions=256, n_embd=128,
+                         n_layer=2, n_head=4, n_kv_head=2,
+                         intermediate_size=352, num_experts=4,
+                         moe_capacity_factor=2.0),
+    "mixtral-8x7b": dict(n_embd=4096, n_layer=32, n_head=32, n_kv_head=8,
+                         intermediate_size=14336, n_positions=4096,
+                         num_experts=8, moe_top_k=2),
 }
 
 
@@ -173,10 +207,15 @@ class LlamaMLP(nn.Module):
 
 
 class LlamaBlock(nn.Module):
+    """Decoder block. With ``moe=True`` (Mixtral layout) the FFN slot
+    holds top-k gated-SwiGLU experts and ``__call__`` returns
+    ``(x, l_aux)`` — one class for both so the norm/attention/residual
+    structure cannot drift. ``train`` is static under remat."""
     config: LlamaConfig
+    moe: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         cfg = self.config
         ln1 = self.param("ln_attn", nn.initializers.ones, (cfg.n_embd,),
                          jnp.float32)
@@ -184,16 +223,29 @@ class LlamaBlock(nn.Module):
                          jnp.float32)
         x = x + LlamaAttention(cfg, name="attn")(
             _rms_norm(x, ln1, cfg.rms_eps))
-        return x + LlamaMLP(cfg, name="mlp")(
-            _rms_norm(x, ln2, cfg.rms_eps))
+        h = _rms_norm(x, ln2, cfg.rms_eps)
+        if self.moe:
+            from deepspeed_tpu.moe.layer import MoE
+            B, T, C = x.shape
+            y, l_aux, _ = MoE(hidden_size=C, num_experts=cfg.num_experts,
+                              ffn_hidden_size=cfg.intermediate_size,
+                              k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor,
+                              eval_capacity_factor=cfg.moe_capacity_factor,
+                              min_capacity=4, dtype=cfg.dtype,
+                              activation=jax.nn.silu, gated_experts=True,
+                              name="moe")(h.reshape(B * T, C), train=train)
+            return x + y.reshape(B, T, C), l_aux
+        return x + LlamaMLP(cfg, name="mlp")(h)
 
 
 class Llama(nn.Module):
-    """Causal LM trunk + head. ``__call__`` returns logits [B, T, V]."""
+    """Causal LM trunk + head. ``__call__`` returns logits [B, T, V] —
+    or ``(logits, l_aux_total)`` when the config has MoE layers."""
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, train: bool = False):
         cfg = self.config
         B, T = input_ids.shape
         embed = self.param("embed", nn.initializers.normal(0.02),
@@ -208,18 +260,32 @@ class Llama(nn.Module):
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 jax.checkpoint_policies.save_only_these_names(
                     "flash_attn_out"))
-            block = nn.remat(block, prevent_cse=False, policy=policy)
+            # train is control flow (MoE capacity mode), not data — static
+            # under the remat trace (argnum 2; the instance is 0)
+            block = nn.remat(block, prevent_cse=False, policy=policy,
+                             static_argnums=(2,))
+        moe_set = cfg.moe_layer_set
+        l_aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layer):
-            x = block(cfg, name=f"layers_{i}")(x)
+            if i in moe_set:
+                x, l_aux = block(cfg, moe=True,
+                                 name=f"layers_{i}")(x, train)
+                l_aux_total = l_aux_total + l_aux.astype(jnp.float32)
+            else:
+                x = block(cfg, name=f"layers_{i}")(x, train)
 
         ln_f = self.param("ln_f", nn.initializers.ones, (cfg.n_embd,),
                           jnp.float32)
         x = _rms_norm(x, ln_f, cfg.rms_eps)
         if cfg.tie_embeddings:
-            return jnp.einsum("btc,vc->btv", x, embed.astype(cfg.dtype))
-        head = self.param("lm_head", nn.initializers.normal(0.02),
-                          (cfg.vocab_size, cfg.n_embd), jnp.float32)
-        return jnp.einsum("btc,vc->btv", x, head.astype(cfg.dtype))
+            logits = jnp.einsum("btc,vc->btv", x, embed.astype(cfg.dtype))
+        else:
+            head = self.param("lm_head", nn.initializers.normal(0.02),
+                              (cfg.vocab_size, cfg.n_embd), jnp.float32)
+            logits = jnp.einsum("btc,vc->btv", x, head.astype(cfg.dtype))
+        if moe_set:
+            return logits, l_aux_total
+        return logits
 
 
 class LlamaLMModel:
@@ -241,12 +307,23 @@ class LlamaLMModel:
         return self.module.init(rng, ids)["params"]
 
     def apply(self, params, input_ids, deterministic=True, rngs=None):
-        return self.module.apply({"params": params}, input_ids)
+        """Returns logits; with MoE layers, ``(logits, l_aux_total)``."""
+        return self.module.apply({"params": params}, input_ids,
+                                 train=not deterministic, rngs=rngs)
 
     def loss_fn(self, params, batch, rng=None):
+        cfg = self.config
         input_ids = batch["input_ids"]
         labels = batch.get("labels")
-        logits = self.apply(params, input_ids)
+        rngs = ({"gating": jax.random.fold_in(rng, 1)}
+                if (rng is not None and cfg.num_experts > 0) else None)
+        out = self.apply(params, input_ids, deterministic=rng is None,
+                         rngs=rngs)
+        l_aux = None
+        if cfg.num_experts > 0:
+            logits, l_aux = out
+        else:
+            logits = out
         if labels is None:
             labels = input_ids[:, 1:]
             logits = logits[:, :-1]
@@ -257,11 +334,15 @@ class LlamaLMModel:
                                    axis=-1)[..., 0]
         nll = lse - gold
         mask = (labels >= 0) & (labels < self.config.vocab_size)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        if l_aux is not None:
+            loss = loss + cfg.moe_aux_weight * l_aux
+        return loss
 
     def tp_specs(self):
         """Megatron placement: q/k/v/gate/up column-parallel, o/down
-        row-parallel, embedding + head vocab-parallel."""
+        row-parallel, embedding + head vocab-parallel; MoE experts
+        EP-sharded on their leading expert dim."""
         cfg = self.config
         block = {
             "ln_attn": P(), "ln_mlp": P(),
@@ -273,45 +354,75 @@ class LlamaLMModel:
                     "up": {"kernel": P(None, "tensor")},
                     "down": {"kernel": P("tensor", None)}},
         }
+        moe_set = cfg.moe_layer_set
+        if moe_set:
+            from deepspeed_tpu.moe.layer import MoE
+            moe_block = dict(block)
+            del moe_block["mlp"]
+            moe_block["moe"] = MoE.tp_specs(gated=True)
         specs: dict = {"embed": P("tensor", None), "ln_f": P()}
         if not cfg.tie_embeddings:
             specs["lm_head"] = P("tensor", None)
         for i in range(cfg.n_layer):
-            specs[f"layers_{i}"] = block
+            specs[f"layers_{i}"] = moe_block if i in moe_set else block
         return specs
 
     def param_count(self, params) -> int:
         return sum(int(p.size) for p in jax.tree.leaves(params))
 
     def flops_per_token(self) -> float:
+        """~6 * N_active_params per token; MoE layers count top_k expert
+        FFNs (active compute), like GPT2LMModel.flops_per_token."""
         cfg = self.config
-        per_layer = (2 * cfg.n_embd * (cfg.n_head * cfg.head_dim)      # q,o
-                     + 2 * cfg.n_embd * (cfg.n_kv_head * cfg.head_dim)  # k,v
-                     + 3 * cfg.n_embd * cfg.intermediate_size)
+        attn = (2 * cfg.n_embd * (cfg.n_head * cfg.head_dim)           # q,o
+                + 2 * cfg.n_embd * (cfg.n_kv_head * cfg.head_dim))     # k,v
+        ffn = 3 * cfg.n_embd * cfg.intermediate_size
+        n_moe = len(cfg.moe_layer_set)
         n = (cfg.vocab_size * cfg.n_embd * (1 if cfg.tie_embeddings else 2)
-             + cfg.n_layer * per_layer)
+             + cfg.n_layer * attn
+             + (cfg.n_layer - n_moe) * ffn
+             + n_moe * cfg.moe_top_k * ffn)
         return 6.0 * n
 
 
 def params_from_hf(hf_state_dict, cfg: LlamaConfig):
-    """Map a HuggingFace ``LlamaForCausalLM`` state dict onto this model's
-    param tree (torch [out, in] kernels transpose to flax [in, out]).
-    Accepts torch tensors or numpy arrays."""
+    """Map a HuggingFace ``LlamaForCausalLM`` or ``MixtralForCausalLM``
+    state dict onto this model's param tree (torch [out, in] kernels
+    transpose to flax [in, out]). MoE layers read the Mixtral layout
+    (``block_sparse_moe.gate`` + per-expert ``w1/w2/w3``, stacked on the
+    leading expert dim: w1→wg gate, w3→wi up, w2→wo down). Accepts torch
+    tensors or numpy arrays."""
     import numpy as np
 
-    def t(name, transpose=False):
+    def raw(name):
         w = hf_state_dict[name]
-        w = np.asarray(w.detach().cpu().numpy()
-                       if hasattr(w, "detach") else w, np.float32)
+        return np.asarray(w.detach().cpu().numpy()
+                          if hasattr(w, "detach") else w, np.float32)
+
+    def t(name, transpose=False):
+        w = raw(name)
         return jnp.asarray(w.T if transpose else w)
 
+    def moe_subtree(p):
+        E = cfg.num_experts
+        ex = f"{p}block_sparse_moe.experts."
+        # torch per-expert [out, in] → stacked flax [E, in, out]
+        stack = lambda w: jnp.asarray(np.stack(  # noqa: E731
+            [raw(f"{ex}{e}.{w}.weight").T for e in range(E)]))
+        return {
+            "gate": {"wg": t(p + "block_sparse_moe.gate.weight", True)},
+            "experts": {"wg": stack("w1"), "wo": stack("w2"),
+                        "wi": stack("w3")},
+        }
+
+    moe_set = cfg.moe_layer_set
     params: dict = {"embed": t("model.embed_tokens.weight"),
                     "ln_f": t("model.norm.weight")}
     if not cfg.tie_embeddings:
         params["lm_head"] = t("lm_head.weight")
     for i in range(cfg.n_layer):
         p = f"model.layers.{i}."
-        params[f"layers_{i}"] = {
+        layer = {
             "ln_attn": t(p + "input_layernorm.weight"),
             "ln_mlp": t(p + "post_attention_layernorm.weight"),
             "attn": {
@@ -320,10 +431,14 @@ def params_from_hf(hf_state_dict, cfg: LlamaConfig):
                 "wv": {"kernel": t(p + "self_attn.v_proj.weight", True)},
                 "wo": {"kernel": t(p + "self_attn.o_proj.weight", True)},
             },
-            "mlp": {
+        }
+        if i in moe_set:
+            layer["moe"] = moe_subtree(p)
+        else:
+            layer["mlp"] = {
                 "gate": {"kernel": t(p + "mlp.gate_proj.weight", True)},
                 "up": {"kernel": t(p + "mlp.up_proj.weight", True)},
                 "down": {"kernel": t(p + "mlp.down_proj.weight", True)},
-            },
-        }
+            }
+        params[f"layers_{i}"] = layer
     return params

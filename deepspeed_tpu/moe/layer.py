@@ -22,21 +22,33 @@ from deepspeed_tpu.moe.sharded_moe import (EP_AXES, moe_dispatch_combine,
 
 class Experts(nn.Module):
     """Stacked expert FFNs (reference moe/experts.py — a ModuleList there;
-    one stacked einsum here so the MXU sees a single batched matmul)."""
+    one stacked einsum here so the MXU sees a single batched matmul).
+
+    ``gated=True`` makes each expert a SwiGLU FFN (Mixtral-style:
+    down(act(gate(x)) * up(x)), no biases) instead of the reference's
+    two-matrix gelu FFN."""
     num_experts: int
     d_model: int
     d_hidden: int
     dtype: Any = jnp.bfloat16
     activation: Callable = nn.gelu
+    gated: bool = False
 
     @nn.compact
     def __call__(self, x):  # x: [E, T, M]
         E, M, H = self.num_experts, self.d_model, self.d_hidden
         wi = self.param("wi", nn.initializers.normal(0.02), (E, M, H),
                         jnp.float32)
-        bi = self.param("bi", nn.initializers.zeros, (E, H), jnp.float32)
         wo = self.param("wo", nn.initializers.normal(0.02), (E, H, M),
                         jnp.float32)
+        if self.gated:
+            wg = self.param("wg", nn.initializers.normal(0.02), (E, M, H),
+                            jnp.float32)
+            g = jnp.einsum("etm,emh->eth", x, wg.astype(self.dtype))
+            u = jnp.einsum("etm,emh->eth", x, wi.astype(self.dtype))
+            h = self.activation(g) * u
+            return jnp.einsum("eth,ehm->etm", h, wo.astype(self.dtype))
+        bi = self.param("bi", nn.initializers.zeros, (E, H), jnp.float32)
         bo = self.param("bo", nn.initializers.zeros, (E, M), jnp.float32)
         h = jnp.einsum("etm,emh->eth", x, wi.astype(self.dtype))
         h = self.activation(h + bi.astype(self.dtype)[:, None])
@@ -91,6 +103,8 @@ class MoE(nn.Module):
     drop_tokens: bool = True
     use_rts: bool = True
     dtype: Any = jnp.bfloat16
+    activation: Callable = nn.gelu
+    gated_experts: bool = False    # Mixtral-style SwiGLU experts
 
     @nn.compact
     def __call__(self, x, train: bool = True, rng=None):
@@ -108,7 +122,8 @@ class MoE(nn.Module):
         l_aux, combine, dispatch, exp_counts = gate(x, train=train, rng=rng)
         experts = Experts(self.num_experts, self.hidden_size,
                           self.ffn_hidden_size or 4 * self.hidden_size,
-                          dtype=self.dtype, name="experts")
+                          dtype=self.dtype, activation=self.activation,
+                          gated=self.gated_experts, name="experts")
         y = moe_dispatch_combine(
             lambda _, d: experts(d), None, x.astype(self.dtype),
             combine, dispatch)
@@ -117,15 +132,15 @@ class MoE(nn.Module):
         return y, l_aux, exp_counts
 
     @staticmethod
-    def tp_specs(num_layers_prefix=()):
+    def tp_specs(num_layers_prefix=(), gated: bool = False):
         """Sharding specs for the MoE params: experts sharded over the EP
-        axes on their leading expert dim, gate replicated."""
-        return {
-            "gate": {"wg": P()},
-            "experts": {
-                "wi": P(EP_AXES, None, None),
-                "bi": P(EP_AXES, None),
-                "wo": P(EP_AXES, None, None),
-                "bo": P(EP_AXES, None),
-            },
-        }
+        axes on their leading expert dim, gate replicated. ``gated`` must
+        match the module's ``gated_experts`` (different param tree)."""
+        experts = {"wi": P(EP_AXES, None, None),
+                   "wo": P(EP_AXES, None, None)}
+        if gated:
+            experts["wg"] = P(EP_AXES, None, None)
+        else:
+            experts["bi"] = P(EP_AXES, None)
+            experts["bo"] = P(EP_AXES, None)
+        return {"gate": {"wg": P()}, "experts": experts}
