@@ -164,7 +164,7 @@ def _phase_train(args) -> dict:
     log(f"backend={jax.default_backend()} devices={jax.device_count()}")
     import deepspeed_tpu
 
-    if args.preset.startswith("llama"):
+    if args.preset.startswith(("llama", "mixtral")):
         from deepspeed_tpu.models.llama import LlamaLMModel, config_for
         model_cls = LlamaLMModel
     else:

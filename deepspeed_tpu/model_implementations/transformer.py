@@ -163,22 +163,24 @@ def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
                 and cfg.positional == "rotary" and cfg.rotary_interleaved):
             layer["ln2"] = norm()
         params["layers"].append(layer)
-    # MoE layers replace their MLP with a gate + stacked experts
+    # MoE layers replace their MLP with a gate + stacked experts; with
+    # gated_mlp the experts are SwiGLU (Mixtral layout: wg/wi/wo, no
+    # biases) instead of the reference's two-matrix FFN
     for i, layer in enumerate(params["layers"]):
         if cfg.is_moe_layer(i):
             X = cfg.num_experts
             k = jax.random.fold_in(rng, 1000 + i)
-            ks = jax.random.split(k, 3)
+            ks = jax.random.split(k, 4)
             del layer["mlp"]
-            layer["moe"] = {
-                "gate": dense(ks[0], (E, X), E),
-                "experts": {
-                    "wi": dense(ks[1], (X, E, F), E),
-                    "bi": jnp.zeros((X, F), dt),
-                    "wo": dense(ks[2], (X, F, E), F),
-                    "bo": jnp.zeros((X, E), dt),
-                },
-            }
+            experts = {"wi": dense(ks[1], (X, E, F), E),
+                       "wo": dense(ks[2], (X, F, E), F)}
+            if cfg.gated_mlp:
+                experts["wg"] = dense(ks[3], (X, E, F), E)
+            else:
+                experts["bi"] = jnp.zeros((X, F), dt)
+                experts["bo"] = jnp.zeros((X, E), dt)
+            layer["moe"] = {"gate": dense(ks[0], (E, X), E),
+                            "experts": experts}
     return params
 
 
@@ -215,7 +217,7 @@ def tp_param_specs(params: Dict) -> Dict:
             return P("tensor", None)
         # MoE experts: expert-parallel over dim 0, Megatron TP within
         # (reference moe_inference.py EP groups + per-expert TP slicing)
-        if path.endswith("experts.wi"):
+        if path.endswith(("experts.wi", "experts.wg")):
             return P("expert", None, "tensor")
         if path.endswith("experts.bi"):
             return P("expert", "tensor")
@@ -466,9 +468,17 @@ def _moe_mlp(x, moe, cfg, mesh=None):
     ex = moe["experts"]
     xin = jnp.einsum("sx,se->xse", sel, t)                # [X, S, E]
     xin = _maybe_expert_constrain(xin, mesh)
-    h = _act(jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt)) +
-             ex["bi"][:, None, :], cfg.activation).astype(dt)
-    out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt)) +         ex["bo"][:, None, :]
+    if "wg" in ex:
+        # gated (Mixtral) experts: down(act(gate(x)) * up(x)), no biases
+        g = jnp.einsum("xse,xef->xsf", xin, _w(ex["wg"], dt))
+        u = jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt))
+        h = (_act(g, cfg.activation) * u).astype(dt)
+        out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt))
+    else:
+        h = _act(jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt)) +
+                 ex["bi"][:, None, :], cfg.activation).astype(dt)
+        out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt)) + \
+            ex["bo"][:, None, :]
     out = _maybe_expert_constrain(out, mesh)
     combined = jnp.einsum("sx,xse->se", dispatch, out)    # combine
     return combined.reshape(shape)

@@ -509,7 +509,7 @@ class LlamaPolicy(HFPolicy):
             layer_norm_eps=hf.rms_norm_eps,
             local_windows=((int(window),) * L if window else None),
             tied_lm_head=bool(getattr(hf, "tie_word_embeddings", False)),
-            dtype=dtype)
+            dtype=dtype, **self._cfg_overrides(hf))
         base = model.model if hasattr(model, "model") else model
         params = {
             "wte": _t2j(base.embed_tokens.weight, dtype),
@@ -540,13 +540,49 @@ class LlamaPolicy(HFPolicy):
                     bias(at.v_proj, (KH, D)),
                     _linear_w(at.o_proj, dtype).reshape(H, D, E),
                     bias(at.o_proj, (E,))),
-                "mlp": {"wg": _linear_w(b.mlp.gate_proj, dtype),
+                **self._ffn_params(b, cfg, dtype, bias)})
+        return cfg, params
+
+    @staticmethod
+    def _cfg_overrides(hf) -> dict:
+        return {}
+
+    @staticmethod
+    def _ffn_params(b, cfg, dtype, bias) -> dict:
+        E = cfg.n_embd
+        return {"mlp": {"wg": _linear_w(b.mlp.gate_proj, dtype),
                         "bg": bias(b.mlp.gate_proj, (cfg.ffn,)),
                         "wi": _linear_w(b.mlp.up_proj, dtype),
                         "bi": bias(b.mlp.up_proj, (cfg.ffn,)),
                         "wo": _linear_w(b.mlp.down_proj, dtype),
-                        "bo": bias(b.mlp.down_proj, (E,))}})
-        return cfg, params
+                        "bo": bias(b.mlp.down_proj, (E,))}}
+
+
+@register_policy
+class MixtralPolicy(LlamaPolicy):
+    """Mixtral sparse-MoE decoders: the LLaMA attention/norm layout with
+    top-k gated-SwiGLU experts in every FFN slot
+    (``block_sparse_moe.gate`` + per-expert ``w1/w2/w3``)."""
+    model_types = ("mixtral",)
+
+    @staticmethod
+    def _cfg_overrides(hf) -> dict:
+        return {"num_experts": hf.num_local_experts,
+                "moe_top_k": getattr(hf, "num_experts_per_tok", 2)}
+
+    @staticmethod
+    def _ffn_params(b, cfg, dtype, bias) -> dict:
+        moe = b.block_sparse_moe
+        # per-expert torch [out,in] Linears stack to [E, in, out]
+        stack = lambda ws: jnp.stack(  # noqa: E731
+            [_linear_w(w, dtype) for w in ws])
+        return {"moe": {
+            "gate": _linear_w(moe.gate, dtype),
+            "experts": {
+                "wg": stack([e.w1 for e in moe.experts]),
+                "wo": stack([e.w2 for e in moe.experts]),
+                "wi": stack([e.w3 for e in moe.experts]),
+            }}}
 
 
 @register_policy

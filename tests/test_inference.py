@@ -40,6 +40,11 @@ ARCH_KNOBS = {
     "llama": dict(positional="rotary", norm_type="rmsnorm", gated_mlp=True,
                   activation="silu", n_kv_head=2, tied_lm_head=False,
                   intermediate_size=176),
+    # mixtral: llama knobs + top-2 gated-SwiGLU experts in every layer
+    "mixtral": dict(positional="rotary", norm_type="rmsnorm",
+                    gated_mlp=True, activation="silu", n_kv_head=2,
+                    tied_lm_head=False, intermediate_size=176,
+                    num_experts=4, moe_top_k=2),
 }
 
 

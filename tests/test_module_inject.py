@@ -319,6 +319,23 @@ def test_mistral_sliding_window_maps_to_local_windows():
     _check_causal(hf, _ids())   # windowed logits still match HF
 
 
+def test_mixtral_parity():
+    """Mixtral sparse MoE: top-2 gated-SwiGLU experts, logits parity vs
+    transformers (HF routes with exact top-k too, so logits must match)."""
+    torch.manual_seed(4)
+    hf = transformers.MixtralForCausalLM(transformers.MixtralConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4, num_experts_per_tok=2,
+        attention_dropout=0.0, sliding_window=None,
+        tie_word_embeddings=False))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, params = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.num_experts == 4 and cfg.moe_top_k == 2
+    assert set(params["layers"][0]["moe"]["experts"]) == {"wg", "wi", "wo"}
+    _check_causal(hf, _ids())
+
+
 def test_llama_attention_bias_checkpoints():
     torch.manual_seed(3)
     hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
