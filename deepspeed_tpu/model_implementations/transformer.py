@@ -80,6 +80,11 @@ class InferenceTransformerConfig:
     num_experts: int = 0
     moe_layers: Optional[tuple] = None       # None + num_experts>0 → all
     moe_top_k: int = 1                       # inference default: top-1
+    # renormalize the selected top-k gate probs to sum to 1 (HF-Mixtral
+    # semantics, and what reference top2gating's denom does). False →
+    # GShard top-1 semantics (expert output scaled by its raw softmax
+    # prob) — what models trained with top1_gating expect when served.
+    moe_renormalize: bool = True
     # "lm" → project to vocab logits; "none" → return final hidden states
     # (CLIP text encoder: causal pre-LN trunk with no LM head)
     head: str = "lm"
@@ -459,8 +464,10 @@ def _moe_mlp(x, moe, cfg, mesh=None):
     k = min(cfg.moe_top_k, cfg.num_experts)
     top_p, top_i = jax.lax.top_k(probs, k)               # [S, k]
     # renormalized combine weights over the selected experts (top-2 norm
-    # matches sharded_moe.py's second-place renormalization)
-    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    # matches sharded_moe.py's second-place renormalization); when
+    # moe_renormalize=False keep the raw softmax probs (GShard top-1)
+    if cfg.moe_renormalize:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
     dispatch = jnp.sum(jax.nn.one_hot(top_i, cfg.num_experts, dtype=dt) *
                        top_p[..., None].astype(dt), axis=1)   # [S, X]
     sel = jnp.sum(jax.nn.one_hot(top_i, cfg.num_experts, dtype=dt),

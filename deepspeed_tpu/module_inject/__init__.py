@@ -13,10 +13,11 @@ PartitionSpecs (model_implementations.tp_param_specs) applied at placement,
 and int8 weight quantization (``GroupQuantizer``, ``replace_module.py:140``)
 is groupwise quantization at conversion time.
 """
+from deepspeed_tpu.module_inject.from_training import convert_trained_model
 from deepspeed_tpu.module_inject.policies import (POLICIES, HFPolicy,
                                                   convert_hf_model,
                                                   register_policy)
 from deepspeed_tpu.module_inject.quantize import GroupQuantizer
 
-__all__ = ["convert_hf_model", "POLICIES", "HFPolicy", "register_policy",
-           "GroupQuantizer"]
+__all__ = ["convert_hf_model", "convert_trained_model", "POLICIES",
+           "HFPolicy", "register_policy", "GroupQuantizer"]

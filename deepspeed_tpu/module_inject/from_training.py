@@ -1,0 +1,166 @@
+"""Train-here → serve-here bridge.
+
+The reference's ``init_inference(model)`` injects fused kernels into the
+SAME torch module that was trained (replace_module.py). Here training
+models are flax trees and the inference engine is a functional
+transformer, so the analog is a pure tree conversion:
+``convert_trained_model(model, params)`` maps a ``GPT2LMModel`` /
+``LlamaLMModel`` (+ its trained params) onto
+``(InferenceTransformerConfig, params)`` — directly consumable by
+``InferenceEngine`` / ``init_inference``, with the KV-cache decode, int8
+weight storage, TP/EP sharding, and sampling machinery all applying to
+the model you just trained.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig)
+
+
+def _f(x, dtype):
+    return jnp.asarray(x, dtype)
+
+
+def convert_trained_model(model, params, dtype=None
+                          ) -> Tuple[InferenceTransformerConfig,
+                                     Dict[str, Any]]:
+    """Dispatch on the training-model wrapper type."""
+    from deepspeed_tpu.models.gpt2 import GPT2LMModel
+    from deepspeed_tpu.models.llama import LlamaLMModel
+    if isinstance(model, GPT2LMModel):
+        return gpt2_to_inference(model.config, params, dtype)
+    if isinstance(model, LlamaLMModel):
+        return llama_to_inference(model.config, params, dtype)
+    raise NotImplementedError(
+        f"no training->inference conversion for {type(model).__name__}; "
+        "supported: GPT2LMModel, LlamaLMModel")
+
+
+def gpt2_to_inference(cfg, params, dtype=None):
+    """models/gpt2.py tree → inference tree (GPT2Policy layout: fused
+    c_attn [C, 3C] splits into q|k|v thirds; tied LM head = wte)."""
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "MoE-GPT2 serving conversion is not wired yet (the inference "
+            "MoE expects non-gated experts per layer schema; train-side "
+            "gpt2 MoE matches, but the layer interleave mapping is TODO)")
+    dt = dtype or cfg.dtype
+    E, H = cfg.n_embd, cfg.n_head
+    D = E // H
+    V = cfg.vocab_size
+    icfg = InferenceTransformerConfig(
+        vocab_size=V, n_positions=cfg.n_positions, n_embd=E,
+        n_layer=cfg.n_layer, n_head=H, activation="gelu_new",
+        # flax nn.LayerNorm default epsilon (models/gpt2.py), not HF's 1e-5
+        layer_norm_eps=1e-6,
+        dtype=dt)
+    out: Dict[str, Any] = {
+        # strip MXU-padding rows: inference sizes from vocab_size
+        "wte": _f(params["wte"][:V], dt),
+        "wpe": _f(params["wpe"], dt),
+        "ln_f": {"scale": _f(params["ln_f"]["scale"], dt),
+                 "bias": _f(params["ln_f"]["bias"], dt)},
+        "layers": [],
+    }
+    for i in range(cfg.n_layer):
+        h = params[f"h_{i}"]
+        W = jnp.asarray(h["attn"]["c_attn"]["kernel"])     # [C, 3C]
+        b = jnp.asarray(h["attn"]["c_attn"]["bias"])
+        out["layers"].append({
+            "ln1": {"scale": _f(h["ln_1"]["scale"], dt),
+                    "bias": _f(h["ln_1"]["bias"], dt)},
+            "ln2": {"scale": _f(h["ln_2"]["scale"], dt),
+                    "bias": _f(h["ln_2"]["bias"], dt)},
+            "attn": {
+                "wq": _f(W[:, :E], dt).reshape(E, H, D),
+                "wk": _f(W[:, E:2 * E], dt).reshape(E, H, D),
+                "wv": _f(W[:, 2 * E:], dt).reshape(E, H, D),
+                "bq": _f(b[:E], dt).reshape(H, D),
+                "bk": _f(b[E:2 * E], dt).reshape(H, D),
+                "bv": _f(b[2 * E:], dt).reshape(H, D),
+                "wo": _f(h["attn"]["c_proj"]["kernel"], dt
+                         ).reshape(H, D, E),
+                "bo": _f(h["attn"]["c_proj"]["bias"], dt),
+            },
+            "mlp": {"wi": _f(h["mlp"]["c_fc"]["kernel"], dt),
+                    "bi": _f(h["mlp"]["c_fc"]["bias"], dt),
+                    "wo": _f(h["mlp"]["c_proj"]["kernel"], dt),
+                    "bo": _f(h["mlp"]["c_proj"]["bias"], dt)}})
+    return icfg, out
+
+
+def llama_to_inference(cfg, params, dtype=None):
+    """models/llama.py tree → inference tree (LlamaPolicy layout; MoE
+    layers map to gated experts like MixtralPolicy)."""
+    dt = dtype or cfg.dtype
+    E, H, KH = cfg.n_embd, cfg.n_head, cfg.n_kv_head
+    D = cfg.head_dim
+    F = cfg.intermediate_size
+    moe_set = cfg.moe_layer_set
+    partial_moe = (tuple(sorted(moe_set))
+                   if moe_set and moe_set != frozenset(range(cfg.n_layer))
+                   else None)
+    icfg = InferenceTransformerConfig(
+        vocab_size=cfg.vocab_size, n_positions=cfg.n_positions, n_embd=E,
+        n_layer=cfg.n_layer, n_head=H, n_kv_head=KH,
+        intermediate_size=F, positional="rotary", rotary_dim=D,
+        rotary_base=cfg.rope_theta, activation="silu",
+        norm_type="rmsnorm", gated_mlp=True,
+        layer_norm_eps=cfg.rms_eps,
+        tied_lm_head=cfg.tie_embeddings,
+        num_experts=cfg.num_experts,
+        moe_layers=partial_moe,
+        moe_top_k=cfg.moe_top_k,
+        # training top1_gating scales the expert output by its raw softmax
+        # prob (GShard); top-2 renormalizes — match each at serve time
+        moe_renormalize=cfg.moe_top_k != 1,
+        dtype=dt)
+    out: Dict[str, Any] = {
+        "wte": _f(params["embed"], dt),
+        "ln_f": {"scale": _f(params["ln_f"], dt)},
+        "layers": [],
+    }
+    if not cfg.tie_embeddings:
+        # training stores the head as [V, C] (einsum "btc,vc->btv");
+        # the inference schema wants [in, out] = [C, V]
+        out["lm_head"] = _f(jnp.transpose(params["lm_head"]), dt)
+    zq = jnp.zeros((H, D), dt)
+    zkv = jnp.zeros((KH, D), dt)
+    zE = jnp.zeros((E,), dt)
+    for i in range(cfg.n_layer):
+        lp = params[f"layers_{i}"]
+        layer: Dict[str, Any] = {
+            "ln1": {"scale": _f(lp["ln_attn"], dt)},
+            "ln2": {"scale": _f(lp["ln_mlp"], dt)},
+            "attn": {
+                "wq": _f(lp["attn"]["wq"]["kernel"], dt).reshape(E, H, D),
+                "wk": _f(lp["attn"]["wk"]["kernel"], dt).reshape(E, KH, D),
+                "wv": _f(lp["attn"]["wv"]["kernel"], dt).reshape(E, KH, D),
+                "bq": zq, "bk": zkv, "bv": zkv,
+                "wo": _f(lp["attn"]["wo"]["kernel"], dt).reshape(H, D, E),
+                "bo": zE,
+            },
+        }
+        if i in moe_set:
+            layer["moe"] = {
+                "gate": _f(lp["moe"]["gate"]["wg"], dt),
+                "experts": {
+                    "wg": _f(lp["moe"]["experts"]["wg"], dt),
+                    "wi": _f(lp["moe"]["experts"]["wi"], dt),
+                    "wo": _f(lp["moe"]["experts"]["wo"], dt),
+                }}
+        else:
+            layer["mlp"] = {
+                "wg": _f(lp["mlp"]["gate"]["kernel"], dt),
+                "bg": jnp.zeros((F,), dt),
+                "wi": _f(lp["mlp"]["up"]["kernel"], dt),
+                "bi": jnp.zeros((F,), dt),
+                "wo": _f(lp["mlp"]["down"]["kernel"], dt),
+                "bo": zE,
+            }
+        out["layers"].append(layer)
+    return icfg, out

@@ -111,8 +111,11 @@ class MoE(nn.Module):
         squeeze = x.ndim == 2
         if squeeze:  # [T, M] -> single group
             x = x[None]
-        if rng is None and (self.use_rts or self.k == 2 or
-                            self.noisy_gate_policy):
+        # gate noise (rts, 2nd-expert gumbel, jitter) is a TRAINING
+        # device; eval routing stays deterministic (rng=None) so serving
+        # and train-time eval agree with the exact-top-k inference path
+        if rng is None and train and (self.use_rts or self.k == 2 or
+                                      self.noisy_gate_policy):
             rng = self.make_rng("gating") if self.has_rng("gating") else \
                 jax.random.PRNGKey(0)
         gate = TopKGate(self.num_experts, self.k, self.capacity_factor,

@@ -101,10 +101,10 @@ def top1_gating(logits: jax.Array,
     l_aux = jnp.sum(me * ce) * E
 
     # Random Token Selection: keep a random C-subset instead of the first C
-    # (reference :224-243); deterministic first-come order when disabled.
-    if use_rts:
-        if rng is None:
-            raise ValueError("use_rts needs an rng")
+    # (reference :224-243); deterministic first-come order when disabled —
+    # and also when rng is None (eval routing must be deterministic; the
+    # reference applies RTS in training only).
+    if use_rts and rng is not None:
         score = jax.random.uniform(rng, mask1.shape, jnp.float32)
     else:
         # prefer earlier tokens, mirroring pure cumsum-order dropping
@@ -138,10 +138,11 @@ def top2_gating(logits: jax.Array,
     indices1 = jnp.argmax(gates, axis=-1)
     mask1 = jax.nn.one_hot(indices1, E, dtype=jnp.int32)
 
-    # second expert via the Gumbel-max trick (reference :297-303)
-    if rng is None:
-        raise ValueError("top2 gating needs an rng for the 2nd-expert noise")
-    logits_w_noise = logits + _gumbel(rng, logits.shape)
+    # second expert via the Gumbel-max trick (reference :297-303).
+    # rng=None → deterministic exact-2nd-argmax: eval/serving routing
+    # must not be noisy (the reference's moe_inference uses exact top-k)
+    logits_w_noise = (logits if rng is None
+                      else logits + _gumbel(rng, logits.shape))
     logits_except1 = jnp.where(mask1 > 0, -jnp.inf, logits_w_noise)
     indices2 = jnp.argmax(logits_except1, axis=-1)
     mask2 = jax.nn.one_hot(indices2, E, dtype=jnp.int32)
