@@ -583,6 +583,20 @@ PHASES = {
                                 "--micro", "1"], 480),
     "train-350m-noflash-seq4k": (["--preset", "gpt2-350m", "--seq", "4096",
                                   "--micro", "1", "--no-flash"], 480),
+    # long-context ladder rung 2: seq 8192 single chip — flash + remat
+    # keep activation memory linear in T (naive would need a 64M-entry
+    # score tensor per head)
+    "train-350m-flash-seq8k": (["--preset", "gpt2-350m", "--seq", "8192",
+                                "--micro", "1"], 600),
+    # optimizer-amortization rung for the flagship: gas 4 cuts the ~10 ms
+    # optimizer+grad epilogue to a quarter per micro-step
+    "train-350m-flash-mb8-gas4": (["--preset", "gpt2-350m", "--micro", "8",
+                                   "--gas", "4", "--steps", "5"], 480),
+    # north-star scaling rung: gas 128 halves the per-token share of the
+    # streamed optimizer DMA again (ladder: 8->51.8, 64->83.3 TF)
+    "train-1.3b-gas128": (["--preset", "gpt2-1.3b", "--offload",
+                           "--micro", "2", "--gas", "128", "--steps", "2"],
+                          1200),
     # modern-decoder family (RoPE/RMSNorm/SwiGLU — models/llama.py):
     # evidence the framework trains today's architectures at speed, not
     # just the reference's GPT-2/BERT ladder

@@ -22,7 +22,26 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--aux-weight", type=float, default=0.01)
+    ap.add_argument("--family", choices=["layer", "gpt2", "mixtral"],
+                    default="layer",
+                    help="layer: bare MoE block in a toy classifier; "
+                         "gpt2: MoE-GPT LM (Megatron-MoE layout, experts "
+                         "every other layer); mixtral: llama decoder with "
+                         "gated-SwiGLU experts in every layer")
     args = ap.parse_args()
+
+    if args.family != "layer":
+        # the expert dim EP-shards over the data/fsdp axes, so the expert
+        # count must divide the mesh: default to one expert per device
+        import jax
+        n_dev = jax.device_count()
+        if args.experts % n_dev:
+            print(f"[train_moe] bumping --experts {args.experts} -> "
+                  f"{n_dev} (must divide the {n_dev}-device data axis)",
+                  file=sys.stderr)
+            args.experts = n_dev
+        _train_lm_family(args)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -66,6 +85,50 @@ def main():
     x = rng.normal(size=(bs, 32)).astype(np.float32)
     y = rng.integers(0, 8, size=(bs,))
     batch = {"x": jnp.asarray(x), "y": jnp.asarray(y, jnp.int32)}
+    for step in range(args.steps):
+        loss = float(engine.train_batch(batch)["loss"])
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step:3d}  loss {loss:.4f}", file=sys.stderr)
+    print(f"final loss: {loss:.4f}")
+
+
+def _train_lm_family(args):
+    """MoE inside a full LM: the FFN-slot route (models/{gpt2,llama}.py) —
+    experts EP-shard over the data/fsdp mesh axes automatically."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    if args.family == "gpt2":
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+        cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=args.hidden,
+                         n_layer=4, n_head=4, dtype=jnp.float32, remat=False,
+                         use_flash_attention=False, vocab_pad_multiple=128,
+                         num_experts=args.experts, moe_top_k=args.top_k,
+                         moe_capacity_factor=2.0,
+                         moe_aux_weight=args.aux_weight)
+        model = GPT2LMModel(cfg)
+    else:
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaLMModel
+        cfg = LlamaConfig(vocab_size=512, n_positions=128,
+                          n_embd=args.hidden, n_layer=4, n_head=4,
+                          n_kv_head=2, intermediate_size=args.hidden * 2,
+                          dtype=jnp.float32, remat=False,
+                          use_flash_attention=False,
+                          num_experts=args.experts, moe_top_k=args.top_k,
+                          moe_capacity_factor=2.0,
+                          moe_aux_weight=args.aux_weight)
+        model = LlamaLMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": args.batch,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(
+        0, 512, size=(engine.train_batch_size, 64)), jnp.int32)}
     for step in range(args.steps):
         loss = float(engine.train_batch(batch)["loss"])
         if step % 5 == 0 or step == args.steps - 1:

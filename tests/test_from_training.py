@@ -123,8 +123,9 @@ class TestLlamaBridge:
                                    rtol=5e-4, atol=5e-4)
 
     def test_trained_then_served(self):
-        """Train a few steps, convert, serve — loss of the served model's
-        argmax path stays consistent (end-to-end user story)."""
+        """Train a few steps, convert, serve: the served engine's logits
+        match the training model's eval forward on the TRAINED params
+        (end-to-end user story, catches trained-state-specific bugs)."""
         import deepspeed_tpu
         model = LlamaLMModel(LlamaConfig(**self.TINY))
         params = model.init(jax.random.PRNGKey(0))
@@ -139,6 +140,10 @@ class TestLlamaBridge:
             eng.train_batch(batch)
         trained = jax.device_get(eng.state.params)
         icfg, ip = convert_trained_model(model, trained)
+        ids = _ids()
+        want = np.asarray(model.apply(trained, ids), np.float32)
+        got = np.asarray(causal_forward(ip, icfg, ids), np.float32)
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
         seng = InferenceEngine((icfg, ip),
                                DeepSpeedInferenceConfig(max_out_tokens=64))
         out = seng.generate([list(range(1, 9))], max_new_tokens=4)
