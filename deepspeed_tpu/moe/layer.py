@@ -72,6 +72,11 @@ class TopKGate(nn.Module):
     def __call__(self, x, train: bool = True, rng=None):
         if self.k not in (1, 2):
             raise ValueError("Only top-1 and top-2 gatings are supported")
+        if train and rng is None and (self.use_rts or self.k == 2 or
+                                      self.noisy_gate_policy):
+            from deepspeed_tpu.moe.sharded_moe import \
+                warn_missing_training_rng
+            warn_missing_training_rng("TopKGate")
         # gate math runs in fp32 regardless of compute dtype (reference
         # TopKGate.forward casts input to fp32: sharded_moe.py:400)
         wg = self.param("wg", nn.initializers.normal(0.02),

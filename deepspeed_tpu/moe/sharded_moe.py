@@ -44,22 +44,22 @@ def _gumbel(rng, shape):
     ) + 1e-20)
 
 
-_warned_deterministic: set = set()
+_warned_missing_rng: set = set()
 
 
-def _warn_deterministic(what: str) -> None:
-    """rng=None means deterministic eval-style routing. The MoE layer
-    passes rng only when train=True, so this is intentional there — but a
-    direct caller who FORGOT the rng during training silently loses gate
-    exploration noise, so say it once, loudly (trace-time only; jitted
-    re-executions don't re-enter this Python)."""
-    if what in _warned_deterministic:
+def warn_missing_training_rng(what: str) -> None:
+    """A TRAINING-mode gate without an rng silently loses exploration
+    noise (gumbel 2nd expert, RTS). Called from TopKGate — the layer that
+    knows train intent; rng=None at eval is the CORRECT deterministic
+    routing and must stay silent. Once per process; trace-time only."""
+    if what in _warned_missing_rng:
         return
-    _warned_deterministic.add(what)
+    _warned_missing_rng.add(what)
     from deepspeed_tpu.utils.logging import logger
     logger.warning(
-        "%s called without rng: routing deterministically (eval "
-        "semantics). Pass rng for training-time gate noise.", what)
+        "%s: train=True but no gating rng — routing deterministically "
+        "(no gumbel/RTS noise). Pass rng or provide a 'gating' PRNG "
+        "stream for training-time gate exploration.", what)
 
 
 def _keep_topk_tokens(mask: jax.Array, score: jax.Array, k: int) -> jax.Array:
@@ -125,8 +125,6 @@ def top1_gating(logits: jax.Array,
     if use_rts and rng is not None:
         score = jax.random.uniform(rng, mask1.shape, jnp.float32)
     else:
-        if use_rts:
-            _warn_deterministic("top1_gating (RTS)")
         # prefer earlier tokens, mirroring pure cumsum-order dropping
         score = -jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.float32)[None, :, None], mask1.shape)
@@ -160,12 +158,10 @@ def top2_gating(logits: jax.Array,
 
     # second expert via the Gumbel-max trick (reference :297-303).
     # rng=None → deterministic exact-2nd-argmax: eval/serving routing
-    # must not be noisy (the reference's moe_inference uses exact top-k)
-    if rng is None:
-        _warn_deterministic("top2_gating")
-        logits_w_noise = logits
-    else:
-        logits_w_noise = logits + _gumbel(rng, logits.shape)
+    # must not be noisy (the reference's moe_inference uses exact top-k);
+    # TopKGate warns when a TRAINING call arrives without an rng
+    logits_w_noise = (logits if rng is None
+                      else logits + _gumbel(rng, logits.shape))
     logits_except1 = jnp.where(mask1 > 0, -jnp.inf, logits_w_noise)
     indices2 = jnp.argmax(logits_except1, axis=-1)
     mask2 = jax.nn.one_hot(indices2, E, dtype=jnp.int32)

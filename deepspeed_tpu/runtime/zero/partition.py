@@ -135,8 +135,28 @@ class ZeroShardingPolicy:
             else:
                 base = _normalize_base(tp, len(shape))
                 spec = P(*base) if any(e is not None for e in base) else P()
+            self._check_divisible(path, shape, spec)
             return NamedSharding(self.mesh, spec)
         return jax.tree_util.tree_map_with_path(per_leaf, params_like)
+
+    def _check_divisible(self, path, shape, spec) -> None:
+        """Model-provided TP/EP specs are applied verbatim; a dim that
+        does not divide its mesh axes would surface much later as an
+        opaque pjit out_sharding error. Name the leaf and the fix here
+        instead (e.g. a 4-expert MoE on an 8-device data axis)."""
+        for i, entry in enumerate(tuple(spec)):
+            axes = _spec_entry_axes(entry)
+            if not axes:
+                continue
+            div = int(np.prod([self.mesh.shape[a] for a in axes]))
+            if div > 1 and shape[i] % div:
+                name = jax.tree_util.keystr(path)
+                raise ValueError(
+                    f"param {name!r} dim {i} (size {shape[i]}) is not "
+                    f"divisible by mesh axes {tuple(axes)} (product "
+                    f"{div}) required by its sharding spec {spec}. For "
+                    f"MoE experts, make num_experts a multiple of the "
+                    f"data*fsdp extent (or shrink the mesh).")
 
     # -- the three placements ------------------------------------------------
 

@@ -37,8 +37,8 @@ def main():
         n_dev = jax.device_count()
         if args.experts % n_dev:
             print(f"[train_moe] bumping --experts {args.experts} -> "
-                  f"{n_dev} (must divide the {n_dev}-device data axis)",
-                  file=sys.stderr)
+                  f"{n_dev} (num_experts must be a multiple of the "
+                  f"{n_dev}-device data axis)", file=sys.stderr)
             args.experts = n_dev
         _train_lm_family(args)
         return

@@ -158,3 +158,22 @@ class TestTraining:
         spec0 = wg.sharding.spec[0]
         spec0 = spec0 if isinstance(spec0, tuple) else (spec0,)
         assert "data" in spec0, wg.sharding
+
+
+def test_indivisible_expert_count_fails_loudly():
+    """4 experts cannot EP-shard over an 8-device data axis: the engine
+    names the leaf and the fix instead of surfacing an opaque pjit
+    out_sharding error (runtime/zero/partition.py _check_divisible)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, \
+        set_global_mesh
+    set_global_mesh(build_mesh(MeshConfig(data=8)))
+    model = LlamaLMModel(LlamaConfig(**TINY, num_experts=4,
+                                     moe_capacity_factor=2.0))
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="num_experts a multiple"):
+        deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2}})
