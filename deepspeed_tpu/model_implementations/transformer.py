@@ -85,6 +85,10 @@ class InferenceTransformerConfig:
     # GShard top-1 semantics (expert output scaled by its raw softmax
     # prob) — what models trained with top1_gating expect when served.
     moe_renormalize: bool = True
+    # expert FFN activation when it differs from the dense MLP's (some
+    # imported checkpoints mix activations across the FFN slots).
+    # None → cfg.activation.
+    moe_activation: Optional[str] = None
     # "lm" → project to vocab logits; "none" → return final hidden states
     # (CLIP text encoder: causal pre-LN trunk with no LM head)
     head: str = "lm"
@@ -473,17 +477,18 @@ def _moe_mlp(x, moe, cfg, mesh=None):
     sel = jnp.sum(jax.nn.one_hot(top_i, cfg.num_experts, dtype=dt),
                   axis=1)                                 # 0/1 [S, X]
     ex = moe["experts"]
+    act = cfg.moe_activation or cfg.activation
     xin = jnp.einsum("sx,se->xse", sel, t)                # [X, S, E]
     xin = _maybe_expert_constrain(xin, mesh)
     if "wg" in ex:
         # gated (Mixtral) experts: down(act(gate(x)) * up(x)), no biases
         g = jnp.einsum("xse,xef->xsf", xin, _w(ex["wg"], dt))
         u = jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt))
-        h = (_act(g, cfg.activation) * u).astype(dt)
+        h = (_act(g, act) * u).astype(dt)
         out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt))
     else:
         h = _act(jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt)) +
-                 ex["bi"][:, None, :], cfg.activation).astype(dt)
+                 ex["bi"][:, None, :], act).astype(dt)
         out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt)) + \
             ex["bo"][:, None, :]
     out = _maybe_expert_constrain(out, mesh)

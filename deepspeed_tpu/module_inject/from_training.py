@@ -42,21 +42,24 @@ def convert_trained_model(model, params, dtype=None
 
 def gpt2_to_inference(cfg, params, dtype=None):
     """models/gpt2.py tree → inference tree (GPT2Policy layout: fused
-    c_attn [C, 3C] splits into q|k|v thirds; tied LM head = wte)."""
-    if cfg.num_experts > 0:
-        raise NotImplementedError(
-            "MoE-GPT2 serving conversion is not wired yet (the inference "
-            "MoE expects non-gated experts per layer schema; train-side "
-            "gpt2 MoE matches, but the layer interleave mapping is TODO)")
+    c_attn [C, 3C] splits into q|k|v thirds; tied LM head = wte). MoE
+    layers map onto the non-gated expert schema (identical shapes); the
+    training Experts default (flax nn.gelu) IS tanh-approximate gelu, so
+    the dense config's gelu_new applies to experts too."""
     dt = dtype or cfg.dtype
     E, H = cfg.n_embd, cfg.n_head
     D = E // H
     V = cfg.vocab_size
+    moe_set = cfg.moe_layer_set
     icfg = InferenceTransformerConfig(
         vocab_size=V, n_positions=cfg.n_positions, n_embd=E,
         n_layer=cfg.n_layer, n_head=H, activation="gelu_new",
         # flax nn.LayerNorm default epsilon (models/gpt2.py), not HF's 1e-5
         layer_norm_eps=1e-6,
+        num_experts=cfg.num_experts,
+        moe_layers=tuple(sorted(moe_set)) if moe_set else None,
+        moe_top_k=cfg.moe_top_k,
+        moe_renormalize=cfg.moe_top_k != 1,
         dtype=dt)
     out: Dict[str, Any] = {
         # strip MXU-padding rows: inference sizes from vocab_size
@@ -70,7 +73,7 @@ def gpt2_to_inference(cfg, params, dtype=None):
         h = params[f"h_{i}"]
         W = jnp.asarray(h["attn"]["c_attn"]["kernel"])     # [C, 3C]
         b = jnp.asarray(h["attn"]["c_attn"]["bias"])
-        out["layers"].append({
+        layer: Dict[str, Any] = {
             "ln1": {"scale": _f(h["ln_1"]["scale"], dt),
                     "bias": _f(h["ln_1"]["bias"], dt)},
             "ln2": {"scale": _f(h["ln_2"]["scale"], dt),
@@ -86,10 +89,22 @@ def gpt2_to_inference(cfg, params, dtype=None):
                          ).reshape(H, D, E),
                 "bo": _f(h["attn"]["c_proj"]["bias"], dt),
             },
-            "mlp": {"wi": _f(h["mlp"]["c_fc"]["kernel"], dt),
-                    "bi": _f(h["mlp"]["c_fc"]["bias"], dt),
-                    "wo": _f(h["mlp"]["c_proj"]["kernel"], dt),
-                    "bo": _f(h["mlp"]["c_proj"]["bias"], dt)}})
+        }
+        if i in moe_set:
+            # training Experts (non-gated) and the inference expert
+            # schema are shape-identical: wi [X,E,F] bi [X,F] wo [X,F,E]
+            # bo [X,E]; gate wg [E,X]
+            layer["moe"] = {
+                "gate": _f(h["moe"]["gate"]["wg"], dt),
+                "experts": {k: _f(h["moe"]["experts"][k], dt)
+                            for k in ("wi", "bi", "wo", "bo")},
+            }
+        else:
+            layer["mlp"] = {"wi": _f(h["mlp"]["c_fc"]["kernel"], dt),
+                            "bi": _f(h["mlp"]["c_fc"]["bias"], dt),
+                            "wo": _f(h["mlp"]["c_proj"]["kernel"], dt),
+                            "bo": _f(h["mlp"]["c_proj"]["bias"], dt)}
+        out["layers"].append(layer)
     return icfg, out
 
 

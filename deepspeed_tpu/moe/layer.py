@@ -56,6 +56,12 @@ class Experts(nn.Module):
         return y + bo.astype(self.dtype)[:, None]
 
 
+def _gate_needs_rng(use_rts, k, noisy_gate_policy) -> bool:
+    """True when training-time gating consumes randomness (RTS token
+    selection, gumbel 2nd expert, or jitter noise)."""
+    return bool(use_rts or k == 2 or noisy_gate_policy)
+
+
 class TopKGate(nn.Module):
     """Gating head (reference sharded_moe.py:351 TopKGate): linear in fp32
     then top-1/top-2 gating."""
@@ -72,8 +78,8 @@ class TopKGate(nn.Module):
     def __call__(self, x, train: bool = True, rng=None):
         if self.k not in (1, 2):
             raise ValueError("Only top-1 and top-2 gatings are supported")
-        if train and rng is None and (self.use_rts or self.k == 2 or
-                                      self.noisy_gate_policy):
+        if train and rng is None and _gate_needs_rng(
+                self.use_rts, self.k, self.noisy_gate_policy):
             from deepspeed_tpu.moe.sharded_moe import \
                 warn_missing_training_rng
             warn_missing_training_rng("TopKGate")
@@ -119,10 +125,19 @@ class MoE(nn.Module):
         # gate noise (rts, 2nd-expert gumbel, jitter) is a TRAINING
         # device; eval routing stays deterministic (rng=None) so serving
         # and train-time eval agree with the exact-top-k inference path
-        if rng is None and train and (self.use_rts or self.k == 2 or
-                                      self.noisy_gate_policy):
-            rng = self.make_rng("gating") if self.has_rng("gating") else \
-                jax.random.PRNGKey(0)
+        if rng is None and train and _gate_needs_rng(
+                self.use_rts, self.k, self.noisy_gate_policy):
+            if self.has_rng("gating"):
+                rng = self.make_rng("gating")
+            else:
+                # fixed-key fallback keeps training runnable, but every
+                # step reuses the SAME noise — tell the user where the
+                # missing 'gating' stream should come from
+                from deepspeed_tpu.moe.sharded_moe import \
+                    warn_missing_training_rng
+                warn_missing_training_rng(
+                    "MoE (no 'gating' PRNG stream; fixed-key noise)")
+                rng = jax.random.PRNGKey(0)
         gate = TopKGate(self.num_experts, self.k, self.capacity_factor,
                         self.eval_capacity_factor, self.min_capacity,
                         self.noisy_gate_policy, self.drop_tokens,

@@ -65,15 +65,25 @@ class TestGPT2Bridge:
         out = eng.generate([list(range(1, 9))], max_new_tokens=4)
         assert len(out[0]) == 12
 
-    def test_moe_gpt2_refused_loudly(self):
+    def test_logits_parity_moe(self):
+        """MoE-GPT2 (Megatron-MoE layout, exact-gelu experts) serves with
+        logits parity — large capacity so eval drops nothing."""
         cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64,
-                         n_layer=2, n_head=4, dtype=jnp.float32,
+                         n_layer=4, n_head=4, dtype=jnp.float32,
                          remat=False, use_flash_attention=False,
-                         num_experts=4, vocab_pad_multiple=128)
+                         num_experts=4, moe_top_k=2,
+                         moe_capacity_factor=8.0, vocab_pad_multiple=128)
         model = GPT2LMModel(cfg)
         params = model.init(jax.random.PRNGKey(0))
-        with pytest.raises(NotImplementedError, match="MoE-GPT2"):
-            convert_trained_model(model, params)
+        icfg, ip = convert_trained_model(model, params)
+        # flax nn.gelu (training Experts default) is tanh-approx — the
+        # dense gelu_new applies to experts too, no moe_activation needed
+        assert icfg.moe_layers == (1, 3) and icfg.moe_activation is None
+        ids = _ids()
+        want, _ = model.apply(params, ids)
+        got = np.asarray(causal_forward(ip, icfg, ids), np.float32)
+        np.testing.assert_allclose(got, np.asarray(want, np.float32),
+                                   rtol=5e-4, atol=5e-4)
 
 
 class TestLlamaBridge:
