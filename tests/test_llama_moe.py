@@ -192,7 +192,12 @@ def test_zero3_composes_with_ep():
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={"train_micro_batch_size_per_gpu": 1,
-                "zero_optimization": {"stage": 3},
+                "zero_optimization": {
+                    "stage": 3,
+                    # tiny leaves would otherwise stay replicated under
+                    # the persistence threshold, making the dense-shard
+                    # assertion below vacuous
+                    "stage3_param_persistence_threshold": 0},
                 "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
     rng = np.random.default_rng(0)
     batch = {"input_ids": jnp.asarray(
@@ -200,14 +205,22 @@ def test_zero3_composes_with_ep():
     l0 = float(engine.train_batch(batch)["loss"])
     l1 = float(engine.train_batch(batch)["loss"])
     assert np.isfinite(l0) and np.isfinite(l1)
+
+    def axes_of(spec):
+        out = set()
+        for e in tuple(spec):
+            out.update(e if isinstance(e, tuple) else
+                       ([e] if e is not None else []))
+        return out
+
     wg = engine.state.params["layers_0"]["moe"]["experts"]["wg"]
     spec0 = wg.sharding.spec[0]
     spec0 = spec0 if isinstance(spec0, tuple) else (spec0,)
     assert "data" in spec0          # EP preserved under zero-3
-    # a dense (non-expert) weight is zero-3 sharded on some dim
+    # a dense (non-expert) weight is genuinely ZeRO-3 sharded over a
+    # zero axis (not just carrying the size-1 tensor entry)
     wq = engine.state.params["layers_0"]["attn"]["wq"]["kernel"]
-    assert any(e is not None for e in tuple(wq.sharding.spec)), \
-        wq.sharding
+    assert axes_of(wq.sharding.spec) & {"data", "fsdp"}, wq.sharding
 
 
 @pytest.mark.slow
@@ -231,6 +244,6 @@ def test_moe_composes_with_ring_sp():
     batch = {"input_ids": jnp.asarray(
         rng.integers(0, 256, size=(engine.train_batch_size, 32)),
         jnp.int32)}
-    l0 = float(engine.train_batch(batch)["loss"])
-    l1 = float(engine.train_batch(batch)["loss"])
-    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.1, losses
