@@ -516,11 +516,38 @@ class DeepSpeedEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(
                     x, NamedSharding(mesh, s)), tree, grad_spec)
 
+        def split_loss_out(out):
+            """loss_fn may return a bare scalar or ``(loss, aux_dict)``
+            (the reference's multi-output models: extra per-step scalars
+            ride into train_batch metrics). Reserved metric names stay
+            ours."""
+            if not isinstance(out, tuple):
+                return out, {}
+            loss, aux = out
+            if not isinstance(aux, dict):
+                raise TypeError(
+                    "loss_fn returning a tuple must be (loss, aux_dict); "
+                    f"got aux of type {type(aux).__name__}")
+            reserved = {"loss", "grad_norm", "lr", "loss_scale", "skipped",
+                        "finite"}
+            bad = reserved & set(aux)
+            if bad:
+                raise ValueError(
+                    f"aux metric names {sorted(bad)} collide with engine "
+                    "metrics — rename them")
+            aux = {k: jnp.asarray(v, jnp.float32) for k, v in aux.items()}
+            nonscalar = [k for k, v in aux.items() if v.shape != ()]
+            if nonscalar:
+                raise ValueError(
+                    f"aux metrics must be scalars, got non-scalar "
+                    f"{sorted(nonscalar)} (reduce them in loss_fn)")
+            return loss, aux
+
         def micro_grads(params, scale, mb, rng):
             def scaled_loss(p):
-                loss = loss_fn(p, mb, rng)
-                return (loss * scale / gas).astype(jnp.float32), loss
-            (_, loss), grads = jax.value_and_grad(
+                loss, aux = split_loss_out(loss_fn(p, mb, rng))
+                return (loss * scale / gas).astype(jnp.float32), (loss, aux)
+            (_, (loss, aux)), grads = jax.value_and_grad(
                 scaled_loss, has_aux=True)(params)
             if param_offload:
                 # cotangents of host-resident params may inherit the host
@@ -531,24 +558,28 @@ class DeepSpeedEngine:
                     lambda g, s: jax.device_put(
                         g, NamedSharding(mesh, s, memory_kind="device")),
                     grads, grad_spec)
-            return loss, grads
+            return loss, aux, grads
 
         fetch_sh = jax.tree.map(
             lambda s: s.with_memory_kind("device"),
             self._device_param_shardings) if coarse_fetch else None
 
+        aux_keys_cache: dict = {"keys": None}
+
         def grad_core(params, scale, batch, rng):
-            """→ (grads fp32 clipped+unscaled, mean_loss, gnorm, finite)."""
+            """→ (grads fp32 clipped+unscaled, mean_loss, aux_mean dict,
+            gnorm, finite)."""
             if coarse_fetch:
                 params = jax.tree.map(jax.device_put, params, fetch_sh)
             if gas > 1:
                 def mb_body(carry, mb_rng):
-                    acc, loss_sum = carry
+                    acc, loss_sum, aux_sum = carry
                     mb, r = mb_rng
-                    loss, grads = micro_grads(params, scale, mb, r)
+                    loss, aux, grads = micro_grads(params, scale, mb, r)
                     grads = cast_tree(grads, acc_dtype)
                     acc = constrain(jax.tree.map(jnp.add, acc, grads))
-                    return (acc, loss_sum + loss), None
+                    aux_sum = jax.tree.map(jnp.add, aux_sum, aux)
+                    return (acc, loss_sum + loss, aux_sum), None
 
                 zero_grads = constrain(jax.tree.map(
                     lambda p: jnp.zeros(p.shape, acc_dtype), params))
@@ -556,12 +587,26 @@ class DeepSpeedEngine:
                     lambda x: x.reshape((gas, x.shape[0] // gas)
                                         + x.shape[1:]), batch)
                 rngs = jax.random.split(rng, gas)
-                (grads, loss_sum), _ = jax.lax.scan(
-                    mb_body, (zero_grads, jnp.float32(0.0)), (mbs, rngs))
+                # learn the aux KEY SET without spending FLOPs so the
+                # scan carry can be initialized to matching zeros; the
+                # structure is batch-shape-independent, so one abstract
+                # trace per engine suffices (cached across recompiles)
+                if aux_keys_cache["keys"] is None:
+                    first_mb = jax.tree.map(lambda x: x[0], mbs)
+                    aux_keys_cache["keys"] = tuple(jax.eval_shape(
+                        lambda p: split_loss_out(loss_fn(
+                            p, first_mb, rngs[0]))[1], params))
+                aux_zero = {k: jnp.zeros((), jnp.float32)
+                            for k in aux_keys_cache["keys"]}
+                (grads, loss_sum, aux_sum), _ = jax.lax.scan(
+                    mb_body, (zero_grads, jnp.float32(0.0), aux_zero),
+                    (mbs, rngs))
                 grads = cast_tree(grads, jnp.float32)
                 mean_loss = loss_sum / gas
+                aux_mean = jax.tree.map(lambda a: a / gas, aux_sum)
             else:
-                mean_loss, grads = micro_grads(params, scale, batch, rng)
+                mean_loss, aux_mean, grads = micro_grads(
+                    params, scale, batch, rng)
                 grads = constrain(cast_tree(grads, jnp.float32))
 
             # unscale (fp16) — gas scaling already folded into the loss
@@ -576,7 +621,7 @@ class DeepSpeedEngine:
             if clip > 0.0:
                 coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
-            return grads, mean_loss, gnorm, finite
+            return grads, mean_loss, aux_mean, gnorm, finite
 
         return grad_core
 
@@ -603,7 +648,7 @@ class DeepSpeedEngine:
 
         def step_fn(state: TrainState, batch, rng):
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
-            grads, mean_loss, gnorm, finite = grad_core(
+            grads, mean_loss, aux, gnorm, finite = grad_core(
                 state.params, scale, batch, rng)
             lr = schedule(state.step)
             master = state.master if mixed else state.params
@@ -654,6 +699,7 @@ class DeepSpeedEngine:
             metrics = {"loss": mean_loss, "grad_norm": gnorm, "lr": lr,
                        "loss_scale": scale,
                        "skipped": jnp.logical_not(finite)}
+            metrics.update(aux)   # user aux scalars (multi-output models)
             return new_state, metrics
 
         return step_fn
@@ -731,8 +777,17 @@ class DeepSpeedEngine:
             rng = jax.random.fold_in(rng, widx)
 
             def micro(mb, r):
-                loss, grads = jax.value_and_grad(
-                    lambda p: loss_fn(p, mb, r).astype(jnp.float32))(params)
+                def scalar_loss(p):
+                    out = loss_fn(p, mb, r)
+                    if isinstance(out, tuple):
+                        raise TypeError(
+                            "loss_fn aux metrics ((loss, aux_dict) "
+                            "returns) are supported on the standard "
+                            "engine step only, not the 1-bit/sparse "
+                            "explicit-DP paths — return a bare scalar "
+                            "here")
+                    return out.astype(jnp.float32)
+                loss, grads = jax.value_and_grad(scalar_loss)(params)
                 return loss, grads
 
             if gas > 1:
@@ -910,10 +965,10 @@ class DeepSpeedEngine:
         grad_core = self._make_grad_core()
 
         def grad_fn(params, scale, batch, rng):
-            grads, loss, gnorm, finite = grad_core(params, scale, batch,
-                                                   rng)
+            grads, loss, aux, gnorm, finite = grad_core(params, scale,
+                                                        batch, rng)
             return grads, {"loss": loss, "grad_norm": gnorm,
-                           "finite": finite}
+                           "finite": finite, **aux}
 
         batch_sh = self._batch_sharding(batch)
         param_in_sh = self._state_shardings.params
@@ -983,6 +1038,9 @@ class DeepSpeedEngine:
                              report_speed=True)
         out = {"loss": metrics["loss"], "grad_norm": metrics["grad_norm"],
                "lr": lr, "loss_scale": scale, "skipped": skipped}
+        # user aux scalars computed by grad_fn ride through here too
+        out.update({k: v for k, v in metrics.items()
+                    if k not in ("loss", "grad_norm", "finite")})
         if self.monitor is not None and self.monitor.enabled and \
                 self.global_steps % self.config.steps_per_print == 0:
             self._write_monitor_events(out)
@@ -1168,9 +1226,10 @@ class DeepSpeedEngine:
                 def hvp_fn(full, s32, mb, v, _prefix=prefix):
                     def sub_loss(s):
                         merged = merge_block(full, _prefix, s)
-                        return loss_fn(merged, mb,
-                                       jax.random.PRNGKey(0)
-                                       ).astype(jnp.float32)
+                        out = loss_fn(merged, mb, jax.random.PRNGKey(0))
+                        if isinstance(out, tuple):   # (loss, aux) models
+                            out = out[0]
+                        return out.astype(jnp.float32)
                     return jax.jvp(jax.grad(sub_loss), (s32,), (v,))[1]
                 self._eigen_hvp_cache[prefix] = jax.jit(hvp_fn)
             hvp_jit = self._eigen_hvp_cache[prefix]
@@ -1298,10 +1357,14 @@ class DeepSpeedEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(
                     x, NamedSharding(mesh, s)), tree, grad_spec)
 
+        def loss_of(p, mb, rng):
+            out = loss_fn(p, mb, rng)
+            return out[0] if isinstance(out, tuple) else out
+
         @jax.jit
         def grad_fn(params, scale, mb, rng):
             def scaled(p):
-                loss = loss_fn(p, mb, rng)
+                loss = loss_of(p, mb, rng)
                 return (loss * scale / gas).astype(jnp.float32), loss
             (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params)
             return loss, constrain(cast_tree(grads, jnp.float32))
@@ -1312,7 +1375,7 @@ class DeepSpeedEngine:
 
         @jax.jit
         def loss_only(params, mb, rng):
-            return loss_fn(params, mb, rng)
+            return loss_of(params, mb, rng)
 
         optimizer = self.optimizer
         schedule = self.lr_scheduler

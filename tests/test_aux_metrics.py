@@ -1,0 +1,109 @@
+"""loss_fn aux metrics: (loss, aux_dict) returns ride into train_batch
+metrics (the reference's multi-output models return extra per-step
+tensors through the engine; here extra scalars merge into the metrics
+dict, averaged over gradient accumulation)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+
+
+def _mk(params=None):
+    return {"w": jnp.ones((16, 4), jnp.float32)}
+
+
+def _loss(p, batch, rng):
+    pred = batch["x"] @ p["w"]
+    mse = jnp.mean((pred - batch["y"]) ** 2)
+    z = jnp.mean(pred ** 2)
+    return mse + 0.01 * z, {"z_loss": z, "mse": mse}
+
+
+def _batch(bs):
+    rng = np.random.default_rng(0)
+    return {"x": jnp.asarray(rng.normal(size=(bs, 16)), jnp.float32),
+            "y": jnp.asarray(rng.normal(size=(bs, 4)), jnp.float32)}
+
+
+def test_aux_metrics_in_train_batch():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=_mk(), loss_fn=_loss,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.05}},
+                "zero_optimization": {"stage": 1}})
+    m = engine.train_batch(_batch(engine.train_batch_size))
+    assert {"z_loss", "mse", "loss", "grad_norm"} <= set(m)
+    assert np.isfinite(float(m["z_loss"]))
+    # loss = mse + 0.01*z by construction
+    np.testing.assert_allclose(
+        float(m["loss"]), float(m["mse"]) + 0.01 * float(m["z_loss"]),
+        rtol=1e-5)
+
+
+def test_aux_metrics_averaged_over_gas():
+    """gas=4 and gas=1 on the same global batch agree on the averaged
+    aux values (same micro partitioning maths as the loss)."""
+    def run(gas):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model_parameters=_mk(), loss_fn=_loss,
+            config={"train_micro_batch_size_per_gpu": 4 // gas,
+                    "gradient_accumulation_steps": gas,
+                    "optimizer": {"type": "sgd", "params": {"lr": 0.0}},
+                    "zero_optimization": {"stage": 0}})
+        return engine.train_batch(_batch(engine.train_batch_size))
+
+    m1, m4 = run(1), run(4)
+    np.testing.assert_allclose(float(m1["z_loss"]), float(m4["z_loss"]),
+                               rtol=1e-5)
+
+
+def test_reserved_aux_names_rejected():
+    def bad(p, batch, rng):
+        l, _ = _loss(p, batch, rng)
+        return l, {"loss": l}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=_mk(), loss_fn=bad,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.05}}})
+    with pytest.raises(ValueError, match="collide"):
+        engine.train_batch(_batch(engine.train_batch_size))
+
+
+def test_non_dict_aux_rejected():
+    def bad(p, batch, rng):
+        l, _ = _loss(p, batch, rng)
+        return l, l
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=_mk(), loss_fn=bad,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.05}}})
+    with pytest.raises(TypeError, match="aux_dict"):
+        engine.train_batch(_batch(engine.train_batch_size))
+
+
+def test_non_scalar_aux_rejected():
+    def bad(p, batch, rng):
+        l, _ = _loss(p, batch, rng)
+        return l, {"per_head": jnp.ones((4,))}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=_mk(), loss_fn=bad,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.05}}})
+    with pytest.raises(ValueError, match="scalars"):
+        engine.train_batch(_batch(engine.train_batch_size))
+
+
+def test_aux_metrics_on_offload_path():
+    """ZeRO-Offload (host Adam) returns the aux scalars too."""
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=_mk(), loss_fn=_loss,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+                "zero_optimization": {
+                    "stage": 1,
+                    "offload_optimizer": {"device": "cpu"}}})
+    m = engine.train_batch(_batch(engine.train_batch_size))
+    assert "z_loss" in m and "mse" in m
+    assert np.isfinite(float(m["z_loss"]))
