@@ -66,6 +66,10 @@ class InferenceTransformerConfig:
     layer_norm_eps: float = 1e-5
     tied_lm_head: bool = True
     attn_scale: Optional[float] = None       # default 1/sqrt(head_dim)
+    # ALiBi slope multiplier: BLOOM adds the bias UNscaled (baddbmm
+    # beta=1); Falcon scales (scores + alibi) by 1/sqrt(D) together, so
+    # its effective slopes carry the attn scale — FalconPolicy sets this
+    alibi_scale: float = 1.0
     # per-layer sliding-window size (None = global) — GPT-Neo alternates
     # global/local(256); length n_layer when set
     local_windows: Optional[tuple] = None
@@ -368,7 +372,7 @@ def _prefill_attention(q, k, v, cfg: InferenceTransformerConfig,
     att = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                      preferred_element_type=jnp.float32) * cfg.scale
     if cfg.positional == "alibi":
-        slopes = alibi_slopes(H)
+        slopes = alibi_slopes(H) * cfg.alibi_scale
         # BLOOM bias: slope * (key_pos - query_pos) under causal mask
         rel = (jnp.arange(T)[None, :] - jnp.arange(T)[:, None])[None, None]
         att = att + slopes[None, :, None, None] * rel
@@ -408,7 +412,7 @@ def _decode_attention(q, k_cache, v_cache, live,
     s = s * cfg.scale
     pos = jnp.arange(S)[None, None, :]
     if cfg.positional == "alibi":
-        slopes = alibi_slopes(H)
+        slopes = alibi_slopes(H) * cfg.alibi_scale
         qpos = (live - 1)[:, None, None]  # query sits at the last live slot
         s = s + slopes[None, :, None] * (pos - qpos)
     s = jnp.where(pos < live[:, None, None], s, NEG_INF)

@@ -340,6 +340,107 @@ class BLOOMPolicy(HFPolicy):
 
 
 @register_policy
+class FalconPolicy(HFPolicy):
+    """Falcon decoders, all three layouts (beyond the v0.8.0 snapshot):
+    7b-style (multi-query, parallel attn+MLP, one shared LN), 40b/180b
+    "new decoder architecture" (GQA via ``num_kv_heads``, parallel with
+    separate ln_attn/ln_mlp), and falcon-rw (ALiBi, per-head fused QKV,
+    sequential block). The fused ``query_key_value`` is stored GROUPED BY
+    KV HEAD: each group is [q_per_group query heads | k | v] — the split
+    below mirrors transformers' ``FalconAttention._split_heads``."""
+    model_types = ("falcon",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, \
+            hf.num_hidden_layers
+        D = E // H
+        new_arch = bool(getattr(hf, "new_decoder_architecture", False))
+        multi_query = bool(getattr(hf, "multi_query", True))
+        alibi = bool(getattr(hf, "alibi", False))
+        if new_arch:
+            KH = hf.num_kv_heads
+        elif multi_query:
+            KH = 1
+        else:
+            KH = H
+        # HF runs the block sequentially whenever parallel_attn is False,
+        # new_decoder_architecture or not
+        parallel = bool(getattr(hf, "parallel_attn", True))
+        use_bias = bool(getattr(hf, "bias", False))
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=getattr(hf, "max_position_embeddings", 2048),
+            n_embd=E, n_layer=L, n_head=H, n_kv_head=KH,
+            intermediate_size=getattr(hf, "ffn_hidden_size", None),
+            positional=("alibi" if alibi else "rotary"),
+            rotary_dim=(0 if alibi else D),
+            rotary_base=getattr(hf, "rope_theta", 10000.0),
+            activation="gelu", parallel_attn_mlp=parallel,
+            layer_norm_eps=hf.layer_norm_epsilon,
+            tied_lm_head=bool(getattr(hf, "tie_word_embeddings", True)),
+            # Falcon scales (scores + alibi) jointly by 1/sqrt(D) —
+            # effective alibi slopes carry the attention scale (BLOOM's
+            # don't; see modeling_falcon.py attention_logits math)
+            alibi_scale=(D ** -0.5 if alibi else 1.0),
+            dtype=dtype)
+        tr = model.transformer if hasattr(model, "transformer") else model
+        params = {"wte": _t2j(tr.word_embeddings.weight, dtype),
+                  "ln_f": _ln(tr.ln_f, dtype), "layers": []}
+        if not cfg.tied_lm_head:
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+        q_per = H // KH
+
+        def split_grouped(at):
+            """[E, KH*(q_per+2)*D] kv-grouped fused qkv → q/k/v (+biases)."""
+            W = _linear_w(at.query_key_value, dtype)
+            Wr = W.reshape(E, KH, q_per + 2, D)
+            wq = Wr[:, :, :q_per].reshape(E, H, D)
+            wk = Wr[:, :, q_per]
+            wv = Wr[:, :, q_per + 1]
+            if use_bias:
+                br = _t2j(at.query_key_value.bias, dtype).reshape(
+                    KH, q_per + 2, D)
+                bq = br[:, :q_per].reshape(H, D)
+                bk, bv = br[:, q_per], br[:, q_per + 1]
+            else:
+                bq, bk, bv = (_zeros_b(H, D, dtype),
+                              _zeros_b(KH, D, dtype), _zeros_b(KH, D, dtype))
+            return wq, wk, wv, bq, bk, bv
+
+        for b in tr.h:
+            at = b.self_attention
+            wq, wk, wv, bq, bk, bv = split_grouped(at)
+            bo = (_t2j(at.dense.bias, dtype) if use_bias
+                  else jnp.zeros((E,), dtype))
+            layer = {
+                "attn": _attn_params(
+                    wq, wk, wv, bq, bk, bv,
+                    _linear_w(at.dense, dtype).reshape(H, D, E), bo),
+                "mlp": {
+                    "wi": _linear_w(b.mlp.dense_h_to_4h, dtype),
+                    "bi": (_t2j(b.mlp.dense_h_to_4h.bias, dtype)
+                           if use_bias else jnp.zeros((cfg.ffn,), dtype)),
+                    "wo": _linear_w(b.mlp.dense_4h_to_h, dtype),
+                    "bo": (_t2j(b.mlp.dense_4h_to_h.bias, dtype)
+                           if use_bias else jnp.zeros((E,), dtype)),
+                },
+            }
+            if hasattr(b, "ln_attn"):
+                # new-arch dual-LN parallel block (num_ln_in_parallel_attn
+                # == 2); Falcon2-11B-style new-arch layers carry only
+                # input_layernorm (shared-LN parallel) and land below
+                layer["ln1"] = _ln(b.ln_attn, dtype)
+                layer["ln2"] = _ln(b.ln_mlp, dtype)
+            else:
+                layer["ln1"] = _ln(b.input_layernorm, dtype)
+                if not parallel:   # falcon-rw sequential block
+                    layer["ln2"] = _ln(b.post_attention_layernorm, dtype)
+            params["layers"].append(layer)
+        return cfg, params
+
+
+@register_policy
 class BertPolicy(HFPolicy):
     model_types = ("bert",)
 

@@ -62,6 +62,33 @@ class TrainState:
     loss_scale: LossScaleState
 
 
+def _split_loss_out(out):
+    """loss_fn may return a bare scalar or ``(loss, aux_dict)`` (the
+    reference's multi-output models: extra per-step scalars ride into the
+    step metrics). Reserved metric names stay the engine's."""
+    if not isinstance(out, tuple):
+        return out, {}
+    loss, aux = out
+    if not isinstance(aux, dict):
+        raise TypeError(
+            "loss_fn returning a tuple must be (loss, aux_dict); "
+            f"got aux of type {type(aux).__name__}")
+    reserved = {"loss", "grad_norm", "lr", "loss_scale", "skipped",
+                "finite"}
+    bad = reserved & set(aux)
+    if bad:
+        raise ValueError(
+            f"aux metric names {sorted(bad)} collide with engine "
+            "metrics — rename them")
+    aux = {k: jnp.asarray(v, jnp.float32) for k, v in aux.items()}
+    nonscalar = [k for k, v in aux.items() if v.shape != ()]
+    if nonscalar:
+        raise ValueError(
+            f"aux metrics must be scalars, got non-scalar "
+            f"{sorted(nonscalar)} (reduce them in loss_fn)")
+    return loss, aux
+
+
 class DeepSpeedEngine:
     def __init__(self,
                  loss_fn: Callable,
@@ -306,6 +333,7 @@ class DeepSpeedEngine:
         self._grad_fn = None
         self._pending_grads = None
         self._pending_losses = []
+        self._pending_aux = []
         self._last_micro_batch = None
         self._micro_steps = 0
         self.global_steps = 0
@@ -516,32 +544,7 @@ class DeepSpeedEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(
                     x, NamedSharding(mesh, s)), tree, grad_spec)
 
-        def split_loss_out(out):
-            """loss_fn may return a bare scalar or ``(loss, aux_dict)``
-            (the reference's multi-output models: extra per-step scalars
-            ride into train_batch metrics). Reserved metric names stay
-            ours."""
-            if not isinstance(out, tuple):
-                return out, {}
-            loss, aux = out
-            if not isinstance(aux, dict):
-                raise TypeError(
-                    "loss_fn returning a tuple must be (loss, aux_dict); "
-                    f"got aux of type {type(aux).__name__}")
-            reserved = {"loss", "grad_norm", "lr", "loss_scale", "skipped",
-                        "finite"}
-            bad = reserved & set(aux)
-            if bad:
-                raise ValueError(
-                    f"aux metric names {sorted(bad)} collide with engine "
-                    "metrics — rename them")
-            aux = {k: jnp.asarray(v, jnp.float32) for k, v in aux.items()}
-            nonscalar = [k for k, v in aux.items() if v.shape != ()]
-            if nonscalar:
-                raise ValueError(
-                    f"aux metrics must be scalars, got non-scalar "
-                    f"{sorted(nonscalar)} (reduce them in loss_fn)")
-            return loss, aux
+        split_loss_out = _split_loss_out
 
         def micro_grads(params, scale, mb, rng):
             def scaled_loss(p):
@@ -1304,13 +1307,14 @@ class DeepSpeedEngine:
             self._moq_boundary(batch, overflow=False, step_zero=True)
         self._last_micro_batch = batch  # eigenvalue probe batch for step()
         self._rng, rng = jax.random.split(self._rng)
-        loss, grads = self._grad_fn(self.state.params,
-                                    self.state.loss_scale.scale, batch, rng)
+        loss, aux, grads = self._grad_fn(
+            self.state.params, self.state.loss_scale.scale, batch, rng)
         if self._pending_grads is None:
             self._pending_grads = grads
         else:
             self._pending_grads = self._accum_fn(self._pending_grads, grads)
         self._pending_losses.append(loss)
+        self._pending_aux.append(aux)
         self._micro_steps += 1
         return loss
 
@@ -1331,8 +1335,14 @@ class DeepSpeedEngine:
         self.state, metrics = self._apply_fn(self.state, self._pending_grads)
         metrics["loss"] = sum(jnp.float32(l) for l in self._pending_losses) \
             / max(len(self._pending_losses), 1)
+        if self._pending_aux and self._pending_aux[0]:
+            n = len(self._pending_aux)
+            for k in self._pending_aux[0]:
+                metrics[k] = sum(jnp.float32(a[k])
+                                 for a in self._pending_aux) / n
         self._pending_grads = None
         self._pending_losses = []
+        self._pending_aux = []
         if self.quantizer is not None:
             # same boundary semantics as train_batch (_take_model_step
             # quantizes on the forward/backward/step path too)
@@ -1357,17 +1367,14 @@ class DeepSpeedEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(
                     x, NamedSharding(mesh, s)), tree, grad_spec)
 
-        def loss_of(p, mb, rng):
-            out = loss_fn(p, mb, rng)
-            return out[0] if isinstance(out, tuple) else out
-
         @jax.jit
         def grad_fn(params, scale, mb, rng):
             def scaled(p):
-                loss = loss_of(p, mb, rng)
-                return (loss * scale / gas).astype(jnp.float32), loss
-            (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params)
-            return loss, constrain(cast_tree(grads, jnp.float32))
+                loss, aux = _split_loss_out(loss_fn(p, mb, rng))
+                return (loss * scale / gas).astype(jnp.float32), (loss, aux)
+            (_, (loss, aux)), grads = jax.value_and_grad(
+                scaled, has_aux=True)(params)
+            return loss, aux, constrain(cast_tree(grads, jnp.float32))
 
         @jax.jit
         def accum_fn(a, b):
@@ -1375,7 +1382,7 @@ class DeepSpeedEngine:
 
         @jax.jit
         def loss_only(params, mb, rng):
-            return loss_of(params, mb, rng)
+            return _split_loss_out(loss_fn(params, mb, rng))[0]
 
         optimizer = self.optimizer
         schedule = self.lr_scheduler
@@ -1569,6 +1576,7 @@ class DeepSpeedEngine:
         hook-based zero_grad; here the pending accumulator)."""
         self._pending_grads = None
         self._pending_losses = []
+        self._pending_aux = []
         # roll the boundary counter back to the last boundary (not to 0 —
         # a monotonic counter must not re-arm one-shot step-0 hooks)
         self._micro_steps -= self._micro_steps % self.gas

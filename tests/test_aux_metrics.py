@@ -107,3 +107,20 @@ def test_aux_metrics_on_offload_path():
     m = engine.train_batch(_batch(engine.train_batch_size))
     assert "z_loss" in m and "mse" in m
     assert np.isfinite(float(m["z_loss"]))
+
+
+def test_aux_metrics_on_backward_step_path():
+    """The DS-shaped backward()/step() micro-batch API carries the aux
+    scalars into step() metrics (averaged over the accumulated micros)."""
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=_mk(), loss_fn=_loss,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.01}},
+                "zero_optimization": {"stage": 0}})
+    micro = engine.micro_batch_size * 8  # dp=8
+    for _ in range(2):
+        engine.backward(_batch(micro))
+    m = engine.step()
+    assert m is not None and "z_loss" in m and "mse" in m
+    assert np.isfinite(float(m["z_loss"]))

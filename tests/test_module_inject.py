@@ -350,3 +350,46 @@ def test_llama_attention_bias_checkpoints():
             lyr.self_attn.q_proj.bias.normal_()
             lyr.mlp.gate_proj.bias.normal_()
     _check_causal(hf, _ids())
+
+
+@pytest.mark.parametrize("layout", ["7b", "40b", "rw"])
+def test_falcon_parity(layout):
+    """Falcon's three layouts: 7b (MQA + parallel + shared LN), 40b new
+    decoder architecture (GQA + separate ln_attn/ln_mlp), falcon-rw
+    (ALiBi, per-head fused QKV, sequential). The kv-grouped fused
+    query_key_value split must match FalconAttention._split_heads."""
+    torch.manual_seed(5)
+    kw = dict(vocab_size=V, hidden_size=32, num_hidden_layers=2,
+              num_attention_heads=4, bias=False, max_position_embeddings=64,
+              attention_dropout=0.0, hidden_dropout=0.0)
+    if layout == "7b":
+        kw.update(multi_query=True, parallel_attn=True,
+                  new_decoder_architecture=False, alibi=False)
+    elif layout == "40b":
+        kw.update(new_decoder_architecture=True, num_kv_heads=2,
+                  alibi=False)
+    else:
+        kw.update(multi_query=False, parallel_attn=False,
+                  new_decoder_architecture=False, alibi=True)
+    hf = transformers.FalconForCausalLM(transformers.FalconConfig(**kw))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, params = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.n_kv_head == {"7b": 1, "40b": 2, "rw": 4}[layout]
+    assert cfg.parallel_attn_mlp == (layout != "rw")
+    assert cfg.positional == ("alibi" if layout == "rw" else "rotary")
+    _check_causal(hf, _ids())
+
+
+def test_falcon_new_arch_single_ln_parity():
+    """Falcon2-11B layout: new_decoder_architecture with
+    num_ln_in_parallel_attn=1 — one shared input_layernorm feeds the
+    parallel attention+MLP branches."""
+    torch.manual_seed(6)
+    hf = transformers.FalconForCausalLM(transformers.FalconConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2, bias=False,
+        new_decoder_architecture=True, num_ln_in_parallel_attn=1,
+        parallel_attn=True, alibi=False, max_position_embeddings=64,
+        attention_dropout=0.0, hidden_dropout=0.0))
+    assert not hasattr(hf.transformer.h[0], "ln_attn")
+    _check_causal(hf, _ids())
