@@ -341,13 +341,15 @@ class BLOOMPolicy(HFPolicy):
 
 @register_policy
 class FalconPolicy(HFPolicy):
-    """Falcon decoders, all three layouts (beyond the v0.8.0 snapshot):
+    """Falcon decoders, all four layouts (beyond the v0.8.0 snapshot):
     7b-style (multi-query, parallel attn+MLP, one shared LN), 40b/180b
     "new decoder architecture" (GQA via ``num_kv_heads``, parallel with
-    separate ln_attn/ln_mlp), and falcon-rw (ALiBi, per-head fused QKV,
-    sequential block). The fused ``query_key_value`` is stored GROUPED BY
-    KV HEAD: each group is [q_per_group query heads | k | v] — the split
-    below mirrors transformers' ``FalconAttention._split_heads``."""
+    separate ln_attn/ln_mlp), Falcon2-11B (new arch with a single shared
+    LN — ``num_ln_in_parallel_attn=1``), and falcon-rw (ALiBi, per-head
+    fused QKV, sequential block). The fused ``query_key_value`` is stored
+    GROUPED BY KV HEAD: each group is [q_per_group query heads | k | v] —
+    the split below mirrors transformers'
+    ``FalconAttention._split_heads``."""
     model_types = ("falcon",)
 
     def convert(self, model, dtype):
@@ -364,9 +366,17 @@ class FalconPolicy(HFPolicy):
             KH = 1
         else:
             KH = H
-        # HF runs the block sequentially whenever parallel_attn is False,
-        # new_decoder_architecture or not
-        parallel = bool(getattr(hf, "parallel_attn", True))
+        # HF's residual is parallel whenever new_decoder_architecture OR
+        # parallel_attn (FalconDecoderLayer.forward: `mlp_output +=
+        # attention_output`); new_arch with parallel_attn=False is not a
+        # constructible HF layout (the forward would crash) — refuse it
+        # rather than silently diverge
+        if new_arch and not bool(getattr(hf, "parallel_attn", True)):
+            raise ValueError(
+                "falcon config: new_decoder_architecture=True with "
+                "parallel_attn=False is not a valid HF layout "
+                "(FalconDecoderLayer cannot run it); fix the config")
+        parallel = new_arch or bool(getattr(hf, "parallel_attn", True))
         use_bias = bool(getattr(hf, "bias", False))
         cfg = InferenceTransformerConfig(
             vocab_size=hf.vocab_size,
@@ -584,11 +594,12 @@ class CLIPTextPolicy(HFPolicy):
 
 @register_policy
 class LlamaPolicy(HFPolicy):
-    """LLaMA / Mistral-style decoders (beyond the v0.8.0 snapshot —
-    the reference's policy table predates the family): RMSNorm,
-    SwiGLU gated MLP, non-interleaved full-dim rotary, GQA via
-    ``num_key_value_heads``, untied LM head."""
-    model_types = ("llama", "mistral")
+    """LLaMA / Mistral / Qwen2-style decoders (beyond the v0.8.0
+    snapshot — the reference's policy table predates the family):
+    RMSNorm, SwiGLU gated MLP, non-interleaved full-dim rotary, GQA via
+    ``num_key_value_heads``, untied LM head. Qwen2's always-on q/k/v
+    biases come through the module-level bias reader."""
+    model_types = ("llama", "mistral", "qwen2")
 
     def convert(self, model, dtype):
         hf = model.config
@@ -597,8 +608,24 @@ class LlamaPolicy(HFPolicy):
         D = E // H
         KH = getattr(hf, "num_key_value_heads", H) or H
         # Mistral's sliding-window attention maps onto the per-layer
-        # local_windows machinery (GPT-Neo uses the same)
+        # local_windows machinery (GPT-Neo uses the same); Qwen2 carries
+        # a sliding_window value that is INERT unless use_sliding_window,
+        # and even then only layers >= max_window_layers slide — newer
+        # configs expose that per-layer plan as layer_types
         window = getattr(hf, "sliding_window", None)
+        if not getattr(hf, "use_sliding_window", True):
+            window = None
+        local_windows = None
+        if window is not None:
+            layer_types = getattr(hf, "layer_types", None)
+            if layer_types is not None:
+                local_windows = tuple(
+                    int(window) if t == "sliding_attention" else None
+                    for t in layer_types)
+                if not any(w is not None for w in local_windows):
+                    local_windows = None
+            else:
+                local_windows = (int(window),) * L
         cfg = InferenceTransformerConfig(
             vocab_size=hf.vocab_size,
             n_positions=hf.max_position_embeddings,
@@ -608,7 +635,7 @@ class LlamaPolicy(HFPolicy):
             rotary_base=getattr(hf, "rope_theta", 10000.0),
             activation="silu", norm_type="rmsnorm", gated_mlp=True,
             layer_norm_eps=hf.rms_norm_eps,
-            local_windows=((int(window),) * L if window else None),
+            local_windows=local_windows,
             tied_lm_head=bool(getattr(hf, "tie_word_embeddings", False)),
             dtype=dtype, **self._cfg_overrides(hf))
         base = model.model if hasattr(model, "model") else model

@@ -352,15 +352,16 @@ def test_llama_attention_bias_checkpoints():
     _check_causal(hf, _ids())
 
 
-@pytest.mark.parametrize("layout", ["7b", "40b", "rw"])
-def test_falcon_parity(layout):
+@pytest.mark.parametrize("layout,bias", [("7b", False), ("40b", False),
+                                         ("rw", False), ("rw", True)])
+def test_falcon_parity(layout, bias):
     """Falcon's three layouts: 7b (MQA + parallel + shared LN), 40b new
     decoder architecture (GQA + separate ln_attn/ln_mlp), falcon-rw
     (ALiBi, per-head fused QKV, sequential). The kv-grouped fused
     query_key_value split must match FalconAttention._split_heads."""
     torch.manual_seed(5)
     kw = dict(vocab_size=V, hidden_size=32, num_hidden_layers=2,
-              num_attention_heads=4, bias=False, max_position_embeddings=64,
+              num_attention_heads=4, bias=bias, max_position_embeddings=64,
               attention_dropout=0.0, hidden_dropout=0.0)
     if layout == "7b":
         kw.update(multi_query=True, parallel_attn=True,
@@ -372,6 +373,13 @@ def test_falcon_parity(layout):
         kw.update(multi_query=False, parallel_attn=False,
                   new_decoder_architecture=False, alibi=True)
     hf = transformers.FalconForCausalLM(transformers.FalconConfig(**kw))
+    if bias:   # HF zero-inits biases; randomize so the split is exercised
+        with torch.no_grad():
+            for blk in hf.transformer.h:
+                blk.self_attention.query_key_value.bias.normal_(0, 0.1)
+                blk.self_attention.dense.bias.normal_(0, 0.1)
+                blk.mlp.dense_h_to_4h.bias.normal_(0, 0.1)
+                blk.mlp.dense_4h_to_h.bias.normal_(0, 0.1)
     from deepspeed_tpu.module_inject import convert_hf_model
     cfg, params = convert_hf_model(hf, dtype=jnp.float32)
     assert cfg.n_kv_head == {"7b": 1, "40b": 2, "rw": 4}[layout]
@@ -392,4 +400,29 @@ def test_falcon_new_arch_single_ln_parity():
         parallel_attn=True, alibi=False, max_position_embeddings=64,
         attention_dropout=0.0, hidden_dropout=0.0))
     assert not hasattr(hf.transformer.h[0], "ln_attn")
+    _check_causal(hf, _ids())
+
+
+def test_qwen2_parity():
+    """Qwen2: llama layout + always-on q/k/v biases (o bias-less) and an
+    inert sliding_window when use_sliding_window=False."""
+    torch.manual_seed(7)
+    hf = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, rms_norm_eps=1e-6, use_sliding_window=False,
+        sliding_window=4, attention_dropout=0.0,
+        tie_word_embeddings=False))
+    # HF inits the q/k/v biases to zero — randomize so the parity check
+    # genuinely exercises the bias mapping
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0, 0.1)
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, params = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.local_windows is None          # inert window stays off
+    assert float(np.abs(np.asarray(
+        params["layers"][0]["attn"]["bq"])).sum()) > 0  # real q bias
     _check_causal(hf, _ids())
