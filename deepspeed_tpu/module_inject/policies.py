@@ -451,6 +451,67 @@ class FalconPolicy(HFPolicy):
 
 
 @register_policy
+class PhiPolicy(HFPolicy):
+    """Phi-1/1.5/2 (beyond the v0.8.0 snapshot): GPT-J-style parallel
+    attn+MLP sharing one LayerNorm, separate biased q/k/v/dense, PARTIAL
+    non-interleaved rotary (``partial_rotary_factor``), biased untied LM
+    head."""
+    model_types = ("phi",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, \
+            hf.num_hidden_layers
+        D = E // H
+        KH = getattr(hf, "num_key_value_heads", H) or H
+        if getattr(hf, "qk_layernorm", False):
+            raise NotImplementedError(
+                "phi qk_layernorm=True (per-head q/k LayerNorms) is not "
+                "supported by the fused transformer — refusing rather "
+                "than silently diverging")
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H, n_kv_head=KH,
+            intermediate_size=hf.intermediate_size,
+            positional="rotary",
+            rotary_dim=int(D * getattr(hf, "partial_rotary_factor", 0.5)),
+            rotary_base=getattr(hf, "rope_theta", 10000.0),
+            activation=getattr(hf, "hidden_act", "gelu_new"),
+            parallel_attn_mlp=True,
+            layer_norm_eps=hf.layer_norm_eps,
+            tied_lm_head=bool(getattr(hf, "tie_word_embeddings", False)),
+            dtype=dtype)
+        base = model.model if hasattr(model, "model") else model
+        params = {"wte": _t2j(base.embed_tokens.weight, dtype),
+                  "ln_f": _ln(base.final_layernorm, dtype), "layers": []}
+        if not cfg.tied_lm_head:
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+        # lm_head's bias is unconditional in PhiForCausalLM — tying the
+        # embeddings ties only the weight
+        if getattr(model.lm_head, "bias", None) is not None:
+            params["lm_head_bias"] = _t2j(model.lm_head.bias, dtype)
+        for b in base.layers:
+            at = b.self_attn
+            params["layers"].append({
+                "ln1": _ln(b.input_layernorm, dtype),  # shared (parallel)
+                "attn": _attn_params(
+                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.k_proj, dtype).reshape(E, KH, D),
+                    _linear_w(at.v_proj, dtype).reshape(E, KH, D),
+                    _t2j(at.q_proj.bias, dtype).reshape(H, D),
+                    _t2j(at.k_proj.bias, dtype).reshape(KH, D),
+                    _t2j(at.v_proj.bias, dtype).reshape(KH, D),
+                    _linear_w(at.dense, dtype).reshape(H, D, E),
+                    _t2j(at.dense.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.fc1, dtype),
+                        "bi": _t2j(b.mlp.fc1.bias, dtype),
+                        "wo": _linear_w(b.mlp.fc2, dtype),
+                        "bo": _t2j(b.mlp.fc2.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
 class BertPolicy(HFPolicy):
     model_types = ("bert",)
 
@@ -625,7 +686,13 @@ class LlamaPolicy(HFPolicy):
                 if not any(w is not None for w in local_windows):
                     local_windows = None
             else:
-                local_windows = (int(window),) * L
+                # older configs without layer_types: honor
+                # max_window_layers (layers below it run full attention)
+                mwl = getattr(hf, "max_window_layers", 0) or 0
+                local_windows = tuple(
+                    None if i < mwl else int(window) for i in range(L))
+                if not any(w is not None for w in local_windows):
+                    local_windows = None
         cfg = InferenceTransformerConfig(
             vocab_size=hf.vocab_size,
             n_positions=hf.max_position_embeddings,

@@ -426,3 +426,39 @@ def test_qwen2_parity():
     assert float(np.abs(np.asarray(
         params["layers"][0]["attn"]["bq"])).sum()) > 0  # real q bias
     _check_causal(hf, _ids())
+
+
+def test_phi_parity():
+    """Phi-2 layout: parallel attn+MLP with one shared LN, partial
+    rotary (partial_rotary_factor), biased q/k/v/dense and a biased
+    untied LM head."""
+    torch.manual_seed(8)
+    hf = transformers.PhiForCausalLM(transformers.PhiConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        partial_rotary_factor=0.5, resid_pdrop=0.0, embd_pdrop=0.0,
+        attention_dropout=0.0, tie_word_embeddings=False))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, params = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.rotary_dim == 4 and cfg.parallel_attn_mlp
+    assert "lm_head_bias" in params
+    _check_causal(hf, _ids())
+
+
+def test_phi_gqa_parity_and_qk_layernorm_refused():
+    torch.manual_seed(9)
+    hf = transformers.PhiForCausalLM(transformers.PhiConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, partial_rotary_factor=0.5, resid_pdrop=0.0,
+        embd_pdrop=0.0, attention_dropout=0.0, tie_word_embeddings=False))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.n_kv_head == 2
+    _check_causal(hf, _ids())
+
+    qk = transformers.PhiForCausalLM(transformers.PhiConfig(
+        vocab_size=V, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64, qk_layernorm=True))
+    with pytest.raises(NotImplementedError, match="qk_layernorm"):
+        convert_hf_model(qk, dtype=jnp.float32)
