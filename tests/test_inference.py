@@ -45,6 +45,12 @@ ARCH_KNOBS = {
                     gated_mlp=True, activation="silu", n_kv_head=2,
                     tied_lm_head=False, intermediate_size=176,
                     num_experts=4, moe_top_k=2),
+    # falcon-7b layout class: multi-query + parallel block + rotary
+    "falcon-mqa": dict(positional="rotary", n_kv_head=1,
+                       parallel_attn_mlp=True),
+    # phi layout class: parallel block + PARTIAL rotary + biased head
+    "phi": dict(positional="rotary", rotary_dim=4,
+                parallel_attn_mlp=True, tied_lm_head=False),
 }
 
 
