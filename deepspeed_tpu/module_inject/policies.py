@@ -451,6 +451,61 @@ class FalconPolicy(HFPolicy):
 
 
 @register_policy
+class GPTBigCodePolicy(HFPolicy):
+    """GPT-BigCode / StarCoder family (beyond the v0.8.0 snapshot):
+    GPT-2 block with nn.Linear projections (transposed vs Conv1D),
+    gelu_pytorch_tanh, and packed attention of either flavor —
+    multi-query ``[E q | D k | D v]`` blocks, or per-head ``[q|k|v]``
+    triples when multi_query=False — mirroring GPTBigCodeAttention's
+    view/split."""
+    model_types = ("gpt_bigcode",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.n_embd, hf.n_head, hf.n_layer
+        D = E // H
+        KH = 1 if bool(getattr(hf, "multi_query", True)) else H
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size, n_positions=hf.n_positions, n_embd=E,
+            n_layer=L, n_head=H, n_kv_head=KH,
+            activation=getattr(hf, "activation_function",
+                               "gelu_pytorch_tanh"),
+            layer_norm_eps=hf.layer_norm_epsilon,
+            tied_lm_head=bool(getattr(hf, "tie_word_embeddings", True)),
+            dtype=dtype)
+        tr = model.transformer if hasattr(model, "transformer") else model
+        params = {"wte": _t2j(tr.wte.weight, dtype),
+                  "wpe": _t2j(tr.wpe.weight, dtype),
+                  "ln_f": _ln(tr.ln_f, dtype), "layers": []}
+        if not cfg.tied_lm_head:
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+        for b in tr.h:
+            W = _linear_w(b.attn.c_attn, dtype)
+            bias = _t2j(b.attn.c_attn.bias, dtype)
+            if KH == 1:          # multi-query: [E q | D k | D v] blocks
+                wq = W[:, :E].reshape(E, H, D)
+                wk = W[:, E:E + D].reshape(E, 1, D)
+                wv = W[:, E + D:].reshape(E, 1, D)
+                bq = bias[:E].reshape(H, D)
+                bk = bias[E:E + D].reshape(1, D)
+                bv = bias[E + D:].reshape(1, D)
+            else:                # per-head [q|k|v] triples
+                wq, wk, wv, bq, bk, bv = _split_fused_per_head(
+                    W, bias, E, H, D)
+            params["layers"].append({
+                "ln1": _ln(b.ln_1, dtype), "ln2": _ln(b.ln_2, dtype),
+                "attn": _attn_params(
+                    wq, wk, wv, bq, bk, bv,
+                    _linear_w(b.attn.c_proj, dtype).reshape(H, D, E),
+                    _t2j(b.attn.c_proj.bias, dtype)),
+                "mlp": {"wi": _linear_w(b.mlp.c_fc, dtype),
+                        "bi": _t2j(b.mlp.c_fc.bias, dtype),
+                        "wo": _linear_w(b.mlp.c_proj, dtype),
+                        "bo": _t2j(b.mlp.c_proj.bias, dtype)}})
+        return cfg, params
+
+
+@register_policy
 class PhiPolicy(HFPolicy):
     """Phi-1/1.5/2 (beyond the v0.8.0 snapshot): GPT-J-style parallel
     attn+MLP sharing one LayerNorm, separate biased q/k/v/dense, PARTIAL

@@ -462,3 +462,18 @@ def test_phi_gqa_parity_and_qk_layernorm_refused():
         num_attention_heads=4, intermediate_size=64, qk_layernorm=True))
     with pytest.raises(NotImplementedError, match="qk_layernorm"):
         convert_hf_model(qk, dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("mq", [True, False])
+def test_gpt_bigcode_parity(mq):
+    """StarCoder family: nn.Linear projections, gelu_pytorch_tanh, and
+    the packed attention of both flavors (multi-query [E q | D k | D v]
+    blocks; multi_query=False per-head [q|k|v] triples)."""
+    torch.manual_seed(10)
+    hf = transformers.GPTBigCodeForCausalLM(transformers.GPTBigCodeConfig(
+        vocab_size=V, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        multi_query=mq, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.n_kv_head == (1 if mq else 4)
+    _check_causal(hf, _ids())
