@@ -96,11 +96,17 @@ class InferenceTransformerConfig:
     # "lm" → project to vocab logits; "none" → return final hidden states
     # (CLIP text encoder: causal pre-LN trunk with no LM head)
     head: str = "lm"
+    # head_dim when it is NOT n_embd // n_head (Gemma-7b: 256-dim heads
+    # on a 3072/16 trunk — projections are [E, H*256])
+    explicit_head_dim: Optional[int] = None
+    # input-embedding multiplier (Gemma: sqrt(n_embd), applied to the
+    # embedding only — the tied LM head reads the RAW table)
+    embed_scale: float = 1.0
     dtype: Any = jnp.bfloat16
 
     @property
     def head_dim(self) -> int:
-        return self.n_embd // self.n_head
+        return self.explicit_head_dim or self.n_embd // self.n_head
 
     @property
     def kv_heads(self) -> int:
@@ -587,6 +593,8 @@ def _block_decode(x, layer, cfg, cache, layer_idx, mesh=None):
 
 def _embed(params, cfg, ids, positions, token_type_ids=None):
     x = params["wte"][ids].astype(cfg.dtype)
+    if cfg.embed_scale != 1.0:   # Gemma: x * sqrt(E), head reads raw wte
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
     if cfg.positional == "learned":
         x = x + params["wpe"][positions].astype(cfg.dtype)
     if "wtte" in params:  # BERT token-type embeddings

@@ -721,7 +721,9 @@ class LlamaPolicy(HFPolicy):
         hf = model.config
         E, H, L = hf.hidden_size, hf.num_attention_heads, \
             hf.num_hidden_layers
-        D = E // H
+        # head_dim may be decoupled from E // H (Mistral-Nemo: 128-dim
+        # heads on a 5120/32 trunk)
+        D = getattr(hf, "head_dim", None) or E // H
         KH = getattr(hf, "num_key_value_heads", H) or H
         # Mistral's sliding-window attention maps onto the per-layer
         # local_windows machinery (GPT-Neo uses the same); Qwen2 carries
@@ -752,6 +754,7 @@ class LlamaPolicy(HFPolicy):
             vocab_size=hf.vocab_size,
             n_positions=hf.max_position_embeddings,
             n_embd=E, n_layer=L, n_head=H, n_kv_head=KH,
+            explicit_head_dim=(D if D != E // H else None),
             intermediate_size=hf.intermediate_size,
             positional="rotary", rotary_dim=D,
             rotary_base=getattr(hf, "rope_theta", 10000.0),
@@ -806,6 +809,80 @@ class LlamaPolicy(HFPolicy):
                         "bi": bias(b.mlp.up_proj, (cfg.ffn,)),
                         "wo": _linear_w(b.mlp.down_proj, dtype),
                         "bo": bias(b.mlp.down_proj, (E,))}}
+
+
+@register_policy
+class GemmaPolicy(HFPolicy):
+    """Gemma (beyond the v0.8.0 snapshot): llama-shaped decoder with
+    three quirks, each folded in at conversion — input embeddings scale
+    by sqrt(E) (tied head reads the RAW table → embed_scale knob),
+    GemmaRMSNorm multiplies by (1 + w) (the +1 folds into the stored
+    scale), and head_dim is an independent config field
+    (explicit_head_dim; Gemma-7b runs 256-dim heads on a 3072/16
+    trunk). Gated gelu_pytorch_tanh MLP."""
+    model_types = ("gemma",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, \
+            hf.num_hidden_layers
+        D = getattr(hf, "head_dim", E // H)
+        KH = getattr(hf, "num_key_value_heads", H) or H
+        # installed transformers GemmaMLP reads hidden_act (the
+        # hidden_activation field is legacy and ignored there)
+        act = (getattr(hf, "hidden_act", None)
+               or getattr(hf, "hidden_activation", "gelu_pytorch_tanh"))
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H, n_kv_head=KH,
+            explicit_head_dim=(D if D != E // H else None),
+            intermediate_size=hf.intermediate_size,
+            positional="rotary", rotary_dim=D,
+            rotary_base=getattr(hf, "rope_theta", 10000.0),
+            activation=act, norm_type="rmsnorm", gated_mlp=True,
+            layer_norm_eps=hf.rms_norm_eps,
+            tied_lm_head=bool(getattr(hf, "tie_word_embeddings", True)),
+            embed_scale=float(E) ** 0.5,
+            dtype=dtype)
+        base = model.model if hasattr(model, "model") else model
+
+        def rms(mod):
+            # GemmaRMSNorm computes x * (1 + w) with the add in fp32:
+            # fold the +1 in fp32 and store fp32 (the norm upcasts its
+            # scale anyway) so bf16 serving doesn't quantize the fold
+            return {"scale": _t2j(mod.weight, jnp.float32) + 1.0}
+
+        params = {"wte": _t2j(base.embed_tokens.weight, dtype),
+                  "ln_f": rms(base.norm), "layers": []}
+        if not cfg.tied_lm_head:
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+        def bias(mod, shape):
+            b_ = getattr(mod, "bias", None)
+            if b_ is None:
+                return jnp.zeros(shape, dtype)
+            return _t2j(b_, dtype).reshape(shape)
+
+        for b in base.layers:
+            at = b.self_attn
+            params["layers"].append({
+                "ln1": rms(b.input_layernorm),
+                "ln2": rms(b.post_attention_layernorm),
+                "attn": _attn_params(
+                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
+                    _linear_w(at.k_proj, dtype).reshape(E, KH, D),
+                    _linear_w(at.v_proj, dtype).reshape(E, KH, D),
+                    bias(at.q_proj, (H, D)), bias(at.k_proj, (KH, D)),
+                    bias(at.v_proj, (KH, D)),
+                    _linear_w(at.o_proj, dtype).reshape(H, D, E),
+                    bias(at.o_proj, (E,))),
+                "mlp": {"wg": _linear_w(b.mlp.gate_proj, dtype),
+                        "bg": jnp.zeros((cfg.ffn,), dtype),
+                        "wi": _linear_w(b.mlp.up_proj, dtype),
+                        "bi": jnp.zeros((cfg.ffn,), dtype),
+                        "wo": _linear_w(b.mlp.down_proj, dtype),
+                        "bo": jnp.zeros((E,), dtype)}})
+        return cfg, params
 
 
 @register_policy

@@ -51,6 +51,11 @@ ARCH_KNOBS = {
     # phi layout class: parallel block + PARTIAL rotary + biased head
     "phi": dict(positional="rotary", rotary_dim=4,
                 parallel_attn_mlp=True, tied_lm_head=False),
+    # gemma layout class: scaled embeddings, rmsnorm, gated MLP, and a
+    # head_dim DECOUPLED from n_embd//n_head
+    "gemma": dict(positional="rotary", norm_type="rmsnorm",
+                  gated_mlp=True, n_kv_head=2, explicit_head_dim=32,
+                  rotary_dim=32, embed_scale=8.0, intermediate_size=176),
 }
 
 

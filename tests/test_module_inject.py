@@ -477,3 +477,36 @@ def test_gpt_bigcode_parity(mq):
     cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
     assert cfg.n_kv_head == (1 if mq else 4)
     _check_causal(hf, _ids())
+
+
+@pytest.mark.parametrize("head_dim", [8, 16])
+def test_gemma_parity(head_dim):
+    """Gemma quirks folded at conversion: sqrt(E) embedding scale with a
+    raw-table tied head, (1+w) RMSNorm, and head_dim decoupled from
+    n_embd//n_head (the 16 case runs 16-dim heads on a 32/4 trunk)."""
+    torch.manual_seed(11)
+    hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=head_dim, rms_norm_eps=1e-6,
+        attention_dropout=0.0))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, params = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.head_dim == head_dim and cfg.tied_lm_head
+    assert cfg.embed_scale == pytest.approx(32 ** 0.5)
+    _check_causal(hf, _ids())
+
+
+def test_mistral_nemo_style_decoupled_head_dim():
+    """Mistral-Nemo class: head_dim decoupled from hidden/heads (llama
+    family path through explicit_head_dim)."""
+    torch.manual_seed(12)
+    hf = transformers.MistralForCausalLM(transformers.MistralConfig(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, sliding_window=None,
+        attention_dropout=0.0))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.head_dim == 16
+    _check_causal(hf, _ids())
