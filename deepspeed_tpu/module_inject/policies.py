@@ -106,6 +106,27 @@ def _zeros_b(H, D, dtype):
     return jnp.zeros((H, D), dtype)
 
 
+def _bias_or_zeros(mod, shape, dtype):
+    """Module bias reshaped, or zeros when the checkpoint has none."""
+    b = getattr(mod, "bias", None)
+    if b is None:
+        return jnp.zeros(shape, dtype)
+    return _t2j(b, dtype).reshape(shape)
+
+
+def _separate_proj_attn(at, E, H, KH, D, dtype):
+    """q/k/v/o as separate nn.Linear projections (llama-family layout)."""
+    return _attn_params(
+        _linear_w(at.q_proj, dtype).reshape(E, H, D),
+        _linear_w(at.k_proj, dtype).reshape(E, KH, D),
+        _linear_w(at.v_proj, dtype).reshape(E, KH, D),
+        _bias_or_zeros(at.q_proj, (H, D), dtype),
+        _bias_or_zeros(at.k_proj, (KH, D), dtype),
+        _bias_or_zeros(at.v_proj, (KH, D), dtype),
+        _linear_w(at.o_proj, dtype).reshape(H, D, E),
+        _bias_or_zeros(at.o_proj, (E,), dtype))
+
+
 @register_policy
 class GPT2Policy(HFPolicy):
     model_types = ("gpt2",)
@@ -774,25 +795,15 @@ class LlamaPolicy(HFPolicy):
         def bias(mod, shape):
             # attention_bias/mlp_bias checkpoints carry real bias
             # tensors; the common bias-less case maps to zeros
-            b = getattr(mod, "bias", None)
-            if b is None:
-                return jnp.zeros(shape, dtype)
-            return _t2j(b, dtype).reshape(shape)
+            return _bias_or_zeros(mod, shape, dtype)
 
         for b in base.layers:
-            at = b.self_attn
             params["layers"].append({
                 "ln1": {"scale": _t2j(b.input_layernorm.weight, dtype)},
                 "ln2": {"scale": _t2j(b.post_attention_layernorm.weight,
                                       dtype)},
-                "attn": _attn_params(
-                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
-                    _linear_w(at.k_proj, dtype).reshape(E, KH, D),
-                    _linear_w(at.v_proj, dtype).reshape(E, KH, D),
-                    bias(at.q_proj, (H, D)), bias(at.k_proj, (KH, D)),
-                    bias(at.v_proj, (KH, D)),
-                    _linear_w(at.o_proj, dtype).reshape(H, D, E),
-                    bias(at.o_proj, (E,))),
+                "attn": _separate_proj_attn(b.self_attn, E, H, KH, D,
+                                            dtype),
                 **self._ffn_params(b, cfg, dtype, bias)})
         return cfg, params
 
@@ -809,6 +820,53 @@ class LlamaPolicy(HFPolicy):
                         "bi": bias(b.mlp.up_proj, (cfg.ffn,)),
                         "wo": _linear_w(b.mlp.down_proj, dtype),
                         "bo": bias(b.mlp.down_proj, (E,))}}
+
+
+@register_policy
+class Starcoder2Policy(HFPolicy):
+    """StarCoder2 (beyond the v0.8.0 snapshot): rotary + GQA with plain
+    LayerNorms and a biased non-gated gelu_pytorch_tanh MLP — the
+    llama attention layout with gpt-style norms/FFN."""
+    model_types = ("starcoder2",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.hidden_size, hf.num_attention_heads, \
+            hf.num_hidden_layers
+        D = getattr(hf, "head_dim", None) or E // H
+        KH = getattr(hf, "num_key_value_heads", H) or H
+        window = getattr(hf, "sliding_window", None)
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=hf.max_position_embeddings,
+            n_embd=E, n_layer=L, n_head=H, n_kv_head=KH,
+            explicit_head_dim=(D if D != E // H else None),
+            intermediate_size=hf.intermediate_size,
+            positional="rotary", rotary_dim=D,
+            rotary_base=getattr(hf, "rope_theta", 10000.0),
+            activation=getattr(hf, "hidden_act", "gelu_pytorch_tanh"),
+            layer_norm_eps=getattr(hf, "norm_epsilon", 1e-5),
+            local_windows=((int(window),) * L if window else None),
+            tied_lm_head=bool(getattr(hf, "tie_word_embeddings", True)),
+            dtype=dtype)
+        base = model.model if hasattr(model, "model") else model
+        params = {"wte": _t2j(base.embed_tokens.weight, dtype),
+                  "ln_f": _ln(base.norm, dtype), "layers": []}
+        if not cfg.tied_lm_head:
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+        for b in base.layers:
+            params["layers"].append({
+                "ln1": _ln(b.input_layernorm, dtype),
+                "ln2": _ln(b.post_attention_layernorm, dtype),
+                "attn": _separate_proj_attn(b.self_attn, E, H, KH, D,
+                                            dtype),
+                "mlp": {"wi": _linear_w(b.mlp.c_fc, dtype),
+                        "bi": _bias_or_zeros(b.mlp.c_fc, (cfg.ffn,),
+                                             dtype),
+                        "wo": _linear_w(b.mlp.c_proj, dtype),
+                        "bo": _bias_or_zeros(b.mlp.c_proj, (E,),
+                                             dtype)}})
+        return cfg, params
 
 
 @register_policy
@@ -857,25 +915,12 @@ class GemmaPolicy(HFPolicy):
                   "ln_f": rms(base.norm), "layers": []}
         if not cfg.tied_lm_head:
             params["lm_head"] = _linear_w(model.lm_head, dtype)
-        def bias(mod, shape):
-            b_ = getattr(mod, "bias", None)
-            if b_ is None:
-                return jnp.zeros(shape, dtype)
-            return _t2j(b_, dtype).reshape(shape)
-
         for b in base.layers:
-            at = b.self_attn
             params["layers"].append({
                 "ln1": rms(b.input_layernorm),
                 "ln2": rms(b.post_attention_layernorm),
-                "attn": _attn_params(
-                    _linear_w(at.q_proj, dtype).reshape(E, H, D),
-                    _linear_w(at.k_proj, dtype).reshape(E, KH, D),
-                    _linear_w(at.v_proj, dtype).reshape(E, KH, D),
-                    bias(at.q_proj, (H, D)), bias(at.k_proj, (KH, D)),
-                    bias(at.v_proj, (KH, D)),
-                    _linear_w(at.o_proj, dtype).reshape(H, D, E),
-                    bias(at.o_proj, (E,))),
+                "attn": _separate_proj_attn(b.self_attn, E, H, KH, D,
+                                            dtype),
                 "mlp": {"wg": _linear_w(b.mlp.gate_proj, dtype),
                         "bg": jnp.zeros((cfg.ffn,), dtype),
                         "wi": _linear_w(b.mlp.up_proj, dtype),

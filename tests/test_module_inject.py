@@ -510,3 +510,27 @@ def test_mistral_nemo_style_decoupled_head_dim():
     cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
     assert cfg.head_dim == 16
     _check_causal(hf, _ids())
+
+
+def test_starcoder2_parity():
+    """StarCoder2: rotary + GQA with plain LayerNorms and a biased
+    non-gated gelu_pytorch_tanh MLP (biases randomized so the mapping is
+    exercised; HF zero-inits them)."""
+    torch.manual_seed(13)
+    hf = transformers.Starcoder2ForCausalLM(transformers.Starcoder2Config(
+        vocab_size=V, max_position_embeddings=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, sliding_window=None, use_bias=True,
+        embedding_dropout=0.0, residual_dropout=0.0,
+        attention_dropout=0.0))
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj,
+                         layer.mlp.c_fc, layer.mlp.c_proj):
+                if proj.bias is not None:
+                    proj.bias.normal_(0, 0.1)
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.n_kv_head == 2 and cfg.norm_type == "layernorm"
+    _check_causal(hf, _ids())
