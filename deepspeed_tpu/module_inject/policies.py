@@ -823,6 +823,66 @@ class LlamaPolicy(HFPolicy):
 
 
 @register_policy
+class MptPolicy(HFPolicy):
+    """MPT (beyond the v0.8.0 snapshot): ALiBi decoder with bias-less
+    everything — fused Wqkv in [q|k|v] blocks, bias-less LayerNorms,
+    exact-gelu 4x MLP. MPT adds the (unscaled) alibi AFTER the score
+    scale, i.e. BLOOM semantics (alibi_scale=1.0); its slope formula
+    equals BLOOM's for power-of-two head counts (all released MPT
+    models), so non-power-of-two configs are refused."""
+    model_types = ("mpt",)
+
+    def convert(self, model, dtype):
+        hf = model.config
+        E, H, L = hf.d_model, hf.n_heads, hf.n_layers
+        D = E // H
+        if H & (H - 1):
+            raise NotImplementedError(
+                "mpt with a non-power-of-two head count uses a different "
+                "ALiBi slope cut than BLOOM — unsupported")
+        ac = getattr(hf, "attn_config", None)
+        if getattr(ac, "clip_qkv", None):
+            raise NotImplementedError("mpt attn_config.clip_qkv is not "
+                                      "supported by the fused transformer")
+        cfg = InferenceTransformerConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=getattr(hf, "max_seq_len", 2048),
+            n_embd=E, n_layer=L, n_head=H, positional="alibi",
+            activation="gelu",
+            # HF honors attn_config.softmax_scale when set
+            attn_scale=getattr(ac, "softmax_scale", None),
+            layer_norm_eps=getattr(hf, "layer_norm_epsilon", 1e-5),
+            tied_lm_head=bool(getattr(hf, "tie_word_embeddings", True)),
+            dtype=dtype)
+        tr = model.transformer if hasattr(model, "transformer") else model
+
+        def ln(mod):   # MPT LayerNorms typically carry no bias
+            return {"scale": _t2j(mod.weight, dtype),
+                    "bias": _bias_or_zeros(mod, (E,), dtype)}
+
+        params = {"wte": _t2j(tr.wte.weight, dtype),
+                  "ln_f": ln(tr.norm_f), "layers": []}
+        if not cfg.tied_lm_head:
+            params["lm_head"] = _linear_w(model.lm_head, dtype)
+        zeros3 = jnp.zeros((3 * E,), dtype)
+        for b in tr.blocks:
+            W = _linear_w(b.attn.Wqkv, dtype)           # [E, 3E] blocks
+            wq, wk, wv, bq, bk, bv = _split_fused_stacked(
+                W, zeros3, E, H, D)
+            params["layers"].append({
+                "ln1": ln(b.norm_1), "ln2": ln(b.norm_2),
+                "attn": _attn_params(
+                    wq, wk, wv, bq, bk, bv,
+                    _linear_w(b.attn.out_proj, dtype).reshape(H, D, E),
+                    jnp.zeros((E,), dtype)),
+                "mlp": {"wi": _linear_w(b.ffn.up_proj, dtype),
+                        "bi": jnp.zeros((cfg.ffn,), dtype),
+                        "wo": _linear_w(b.ffn.down_proj, dtype),
+                        "bo": jnp.zeros((E,), dtype)}})
+        return cfg, params
+
+
+@register_policy
 class Starcoder2Policy(HFPolicy):
     """StarCoder2 (beyond the v0.8.0 snapshot): rotary + GQA with plain
     LayerNorms and a biased non-gated gelu_pytorch_tanh MLP — the

@@ -534,3 +534,16 @@ def test_starcoder2_parity():
     cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
     assert cfg.n_kv_head == 2 and cfg.norm_type == "layernorm"
     _check_causal(hf, _ids())
+
+
+def test_mpt_parity():
+    """MPT: ALiBi (BLOOM slope semantics at power-of-two heads), fused
+    [q|k|v] Wqkv, bias-less LayerNorms and MLP, exact-gelu."""
+    torch.manual_seed(14)
+    hf = transformers.MptForCausalLM(transformers.MptConfig(
+        vocab_size=V, d_model=32, n_layers=2, n_heads=4, max_seq_len=64,
+        attn_config={"attn_pdrop": 0.0}, emb_pdrop=0.0, resid_pdrop=0.0))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
+    assert cfg.positional == "alibi" and cfg.tied_lm_head
+    _check_causal(hf, _ids())
