@@ -315,3 +315,53 @@ def test_offload_auto_resolves_to_host_on_cpu_backend():
     (the parity test above already exercises it end to end)."""
     eng = _make_engine({"offload_optimizer": {"device": "cpu"}})
     assert eng.host_opt is not None and not eng._offload_stream
+
+
+def _make_gas_offload_engine(grad_acc=None, gas=4):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    cfg = GPT2Config(n_embd=64, n_layer=2, n_head=4, n_positions=128,
+                     vocab_size=256, dtype=jnp.bfloat16, remat=False)
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=32)
+    ds = {"train_micro_batch_size_per_gpu": 2,
+          "gradient_accumulation_steps": gas,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "bf16": {"enabled": True},
+          "zero_optimization": {"stage": 1,
+                                "offload_optimizer": {"device": "cpu"}}}
+    if grad_acc:
+        ds["data_types"] = {"grad_accum_dtype": grad_acc}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                            model_parameters=params,
+                                            config=ds)
+    return eng
+
+
+def test_offload_bf16_grad_accum_matches_fp32():
+    """native_acc_out: with data_types.grad_accum_dtype=bf16 the offload
+    path keeps grads bf16 end-to-end (no fp32 materialization of the
+    tree, halved D2H) — the knob that fits a ~1.2B llama offload step in
+    15.75G HBM (bench train-llama-1b). Loss trajectory must track the
+    fp32-carry default and the streamed host Adam must consume the bf16
+    leaves without drama."""
+    base = _losses(_make_gas_offload_engine(), 4)
+    b16 = _losses(_make_gas_offload_engine("bf16"), 4)
+    # random-token data sits at the ln(vocab) entropy floor, so the check
+    # is trajectory closeness, not descent (measured drift ~4e-5)
+    np.testing.assert_allclose(b16, base, rtol=5e-3, atol=5e-3)
+
+
+def test_offload_grad_fn_emits_native_acc_dtype():
+    """The compiled offload grad producer's output avals are bf16 when
+    grad_accum_dtype=bf16 (the memory/D2H saving is real, not a cast at
+    the boundary) and fp32 at the default."""
+    for acc, want in ((None, jnp.float32), ("bf16", jnp.bfloat16)):
+        eng = _make_gas_offload_engine(acc)
+        ids = jnp.zeros((eng.train_batch_size, 32), jnp.int32)
+        eng.train_batch({"input_ids": ids})
+        shapes = eng._offload_grad_fn.eval_shape(
+            eng.state.params, jnp.float32(1.0), {"input_ids": ids},
+            jax.random.PRNGKey(0))
+        leaves = jax.tree.leaves(shapes[0])
+        assert all(leaf.dtype == want for leaf in leaves), (acc, want)
