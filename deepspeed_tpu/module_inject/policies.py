@@ -844,17 +844,24 @@ class MptPolicy(HFPolicy):
         if getattr(ac, "clip_qkv", None):
             raise NotImplementedError("mpt attn_config.clip_qkv is not "
                                       "supported by the fused transformer")
+        tr = model.transformer if hasattr(model, "transformer") else model
         cfg = InferenceTransformerConfig(
             vocab_size=hf.vocab_size,
             n_positions=getattr(hf, "max_seq_len", 2048),
             n_embd=E, n_layer=L, n_head=H, positional="alibi",
+            # ffn width from the ACTUAL module, not hf.expansion_ratio:
+            # transformers (≤4.57 at least) hardcodes 4E in MptMLP and
+            # ignores the config field, so the weights are the only
+            # truth — sizing from them keeps the zero-filled biases
+            # matched to the kernel for any ratio any version builds
+            intermediate_size=int(
+                tr.blocks[0].ffn.up_proj.weight.shape[0]),
             activation="gelu",
             # HF honors attn_config.softmax_scale when set
             attn_scale=getattr(ac, "softmax_scale", None),
             layer_norm_eps=getattr(hf, "layer_norm_epsilon", 1e-5),
             tied_lm_head=bool(getattr(hf, "tie_word_embeddings", True)),
             dtype=dtype)
-        tr = model.transformer if hasattr(model, "transformer") else model
 
         def ln(mod):   # MPT LayerNorms typically carry no bias
             return {"scale": _t2j(mod.weight, dtype),

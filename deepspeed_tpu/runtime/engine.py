@@ -340,6 +340,7 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         self._train_mode = True
         self._last_skipped = None
+        self._warned_aux_dropped = False
         self._rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
@@ -814,12 +815,18 @@ class DeepSpeedEngine:
                 def scalar_loss(p):
                     out = loss_fn(p, mb, r)
                     if isinstance(out, tuple):
-                        raise TypeError(
-                            "loss_fn aux metrics ((loss, aux_dict) "
-                            "returns) are supported on the standard "
-                            "engine step only, not the 1-bit/sparse "
-                            "explicit-DP paths — return a bare scalar "
-                            "here")
+                        # aux metrics are a standard-step feature; here
+                        # they would ride the explicit all-gather — drop
+                        # them (once, loudly) instead of refusing so a
+                        # docs/training.md-style loss_fn still trains
+                        # with the 1-bit/sparse optimizers
+                        if not self._warned_aux_dropped:
+                            self._warned_aux_dropped = True
+                            logger.warning(
+                                "loss_fn aux metrics are ignored on the "
+                                "1-bit/sparse explicit-DP step (reported "
+                                "metrics carry loss/grad_norm/lr only)")
+                        out = _split_loss_out(out)[0]
                     return out.astype(jnp.float32)
                 loss, grads = jax.value_and_grad(scalar_loss)(params)
                 return loss, grads

@@ -124,3 +124,23 @@ def test_aux_metrics_on_backward_step_path():
     m = engine.step()
     assert m is not None and "z_loss" in m and "mse" in m
     assert np.isfinite(float(m["z_loss"]))
+
+
+def test_aux_dropped_not_refused_on_onebit_path():
+    """ADVICE r3: a docs/training.md-style loss_fn returning (loss, aux)
+    must still train with the 1-bit optimizers — aux is discarded with a
+    one-time warning on the explicit-DP path, not refused at trace
+    time."""
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh, \
+        set_global_mesh
+    set_global_mesh(build_mesh(MeshConfig()))  # data=8
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model_parameters=_mk(), loss_fn=_loss,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "OnebitAdam",
+                              "params": {"lr": 0.01, "freeze_step": 5}}})
+    assert engine._onebit_axes, "compressed DP path must engage"
+    m = engine.train_batch(_batch(engine.train_batch_size))
+    assert np.isfinite(float(m["loss"]))
+    assert "z_loss" not in m  # dropped, not silently wrong

@@ -162,3 +162,25 @@ class TestTiledLinear:
                                    out_splits=2)
         yn2, b2 = nb.apply(nb.init(jax.random.PRNGKey(4)), x)
         assert b2 is None
+
+
+def test_uneven_non_expert_tp_dim_warns_not_raises(caplog):
+    """ADVICE r3: GSPMD pads ragged shards of plain matmul/embedding
+    params, so an unpadded vocab dim on the tensor axis must warn (about
+    the padding waste), not refuse at engine init. The hard error stays
+    for expert dims (test_llama_moe pins it) where the dispatch
+    all-to-all genuinely needs equal shards."""
+    import logging
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    mesh = build_mesh(MeshConfig(data=4, tensor=2))
+    uneven = {"emb": jnp.zeros((251, 8))}  # 251 % 2 != 0
+    tp = {"emb": P("tensor", None)}
+    policy = ZeroShardingPolicy(1, mesh, tp_specs=tp)
+    ds_logger.propagate = True  # caplog listens on root
+    try:
+        with caplog.at_level(logging.WARNING):
+            sh = policy.param_sharding(uneven)
+    finally:
+        ds_logger.propagate = False
+    assert sh["emb"].spec == P("tensor", None)
+    assert any("not divisible" in r.getMessage() for r in caplog.records)

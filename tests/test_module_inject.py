@@ -547,3 +547,21 @@ def test_mpt_parity():
     cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
     assert cfg.positional == "alibi" and cfg.tied_lm_head
     _check_causal(hf, _ids())
+
+
+def test_mpt_nondefault_expansion_ratio():
+    """ADVICE r3 follow-up: the converter sizes the MLP from the actual
+    up_proj weights, not hf.expansion_ratio — transformers (≤4.57)
+    hardcodes 4E in MptMLP and ignores the field, so weight shapes are
+    the only truth. A non-default ratio therefore still converts AND
+    still matches HF logits exactly (both follow the weights)."""
+    torch.manual_seed(15)
+    hf = transformers.MptForCausalLM(transformers.MptConfig(
+        vocab_size=V, d_model=32, n_layers=2, n_heads=4, max_seq_len=64,
+        expansion_ratio=2,
+        attn_config={"attn_pdrop": 0.0}, emb_pdrop=0.0, resid_pdrop=0.0))
+    from deepspeed_tpu.module_inject import convert_hf_model
+    cfg, _ = convert_hf_model(hf, dtype=jnp.float32)
+    up_out = hf.transformer.blocks[0].ffn.up_proj.weight.shape[0]
+    assert cfg.ffn == up_out  # follows the weights, whatever HF built
+    _check_causal(hf, _ids())
