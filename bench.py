@@ -528,7 +528,70 @@ def phase_flash_compile(args) -> dict:
         _ = float(jnp.sum(compiled(q, k, v).astype(jnp.float32)))
         lat.append((time.time() - t) * 1e3)
     out["fwd_ms_p50"] = round(sorted(lat)[len(lat) // 2], 2)
+
+    # sustained kernel throughput, RTT-immune: chain ITERS dependent fwd
+    # calls under ONE jit (output feeds the next query), sync once. This
+    # is the number the r4 kernel rework (diagonal-split masking, folded
+    # scale) is supposed to move — per-call p50 above is ~all relay RTT.
+    ITERS = 100
+
+    @jax.jit
+    def chained(q, k, v):
+        def body(_, qq):
+            return flash_attention(qq, k, v, causal=True)
+        return jax.lax.fori_loop(0, ITERS, body, q)
+
+    chained_c = chained.lower(q, k, v).compile()
+    _ = float(jnp.sum(chained_c(q, k, v).astype(jnp.float32)))  # warm
+    t = time.time()
+    _ = float(jnp.sum(chained_c(q, k, v).astype(jnp.float32)))
+    dt = time.time() - t
+    # causal fwd flops: qk + pv dots over the lower triangle
+    flops = ITERS * 4.0 * B * H * T * T * D * 0.5
+    out["fwd_sustained_tflops"] = round(flops / dt / 1e12, 2)
+    out["fwd_us_per_call"] = round(dt / ITERS * 1e6, 1)
+    log(f"flash fwd sustained: {out['fwd_sustained_tflops']} TF "
+        f"({out['fwd_us_per_call']} us/call)")
     return out
+
+
+def phase_mxu_peak(args) -> dict:
+    """Raw MXU ceiling: chained dependent bf16 matmuls (8192^3), one
+    sync. Calibrates what 'peak' means through this relay/chip so model
+    MFU numbers can be judged against the chip's ACHIEVABLE dense rate
+    rather than the 197-TF datasheet (VERDICT r3: is 83 TF a model
+    problem or the sustained ceiling?). Trivial XLA compile, no Mosaic —
+    safe to run first in any window."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    log(f"backend={jax.default_backend()} devices={jax.device_count()}")
+    N, iters = 8192, 50
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.normal(size=(N, N)) * 0.05, jnp.bfloat16)
+    # unit-ish spectral scale keeps the chained products finite in bf16
+    b = jnp.asarray(rng.normal(size=(N, N)) / np.sqrt(N), jnp.bfloat16)
+
+    @jax.jit
+    def chained(x, w):
+        def body(_, xx):
+            return jax.lax.dot(xx, w,
+                               preferred_element_type=jnp.bfloat16)
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    c = chained.lower(a, b).compile()
+    _ = float(jnp.sum(c(a, b).astype(jnp.float32)))  # warm
+    best = None
+    for _ in range(3):
+        t = time.time()
+        _ = float(jnp.sum(c(a, b).astype(jnp.float32)))
+        dt = time.time() - t
+        best = dt if best is None else min(best, dt)
+    tf = iters * 2.0 * N ** 3 / best / 1e12
+    log(f"mxu sustained: {tf:.1f} TF over {iters} chained {N}^3 matmuls")
+    return {"phase": "mxu-peak", "n": N, "iters": iters,
+            "sustained_tflops": round(tf, 1),
+            "pct_of_datasheet_peak": round(tf / V5E_PEAK_TFLOPS * 100, 1)}
 
 
 PHASES = {
@@ -544,6 +607,10 @@ PHASES = {
     "train-125m-micro": (["--preset", "gpt2-125m", "--seq", "256",
                           "--micro", "8", "--no-flash",
                           "--adaptive-steps"], 300),
+    # raw chip ceiling (see phase_mxu_peak): right after the cheapest
+    # phase so any healthy window captures the calibration the model
+    # numbers are judged against — trivial XLA compile, no Mosaic
+    "mxu-peak": ([], 300),
     # the north-star config: BASELINE.md's metric is ZeRO-3 tokens/s/chip
     # on GPT-2 **1.3B** (+offload_optimizer; fp32 master+moments don't fit
     # a single chip's HBM). gas=64 amortizes the ~15.6 GB/step optimizer
@@ -627,6 +694,13 @@ PHASES = {
                             "--micro", "2", "--gas", "64",
                             "--grad-acc-dtype", "bf16", "--steps", "2"],
                            900),
+    # micro 4 becomes affordable once the fp32 grad tree is gone (bf16
+    # carry ~2.6G vs 10.4G): bigger per-dot batch for the MXU — the r3
+    # micro-4 attempt OOMed purely on the fp32 carry
+    "train-1.3b-bf16acc-mb4": (["--preset", "gpt2-1.3b", "--offload",
+                                "--micro", "4", "--gas", "32",
+                                "--grad-acc-dtype", "bf16",
+                                "--steps", "2"], 900),
     # MoE GPT training (Megatron-MoE recipe: experts every other layer,
     # top-2): ~352M params / ~168M active — evidence the MoE subsystem
     # trains at speed, not just gates correctly. Throughput counts ACTIVE
@@ -925,6 +999,7 @@ def main() -> None:
         fn = (phase_infer if args.phase == "inference" else
               phase_train_bert if args.phase == "train-bert-large" else
               phase_flash_compile if args.phase == "flash-compile" else
+              phase_mxu_peak if args.phase == "mxu-peak" else
               phase_train)
         print(json.dumps(fn(args)), flush=True)
         return

@@ -50,39 +50,54 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     # upfront fp32 cast would quarter the matmul throughput
     q = q_ref[0]  # [BQ, D]
     bq, d = q.shape
+    # fold the softmax scale into q ONCE ([BQ, D] mul) instead of into
+    # every [BQ, BK] score block: the kernel is VPU-bound at small D (the
+    # dots are tiny, the elementwise passes over the score block are not),
+    # so every saved pass over [BQ, BK] is wall-clock
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
 
     m = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
 
     if causal:
-        # only K blocks at or before this Q block's diagonal
-        num_kb = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+        # K blocks strictly below the diagonal are FULLY visible — only
+        # the ≤ cdiv(bq, bk) diagonal blocks pay the iota/compare/select
+        # masking passes (for kb < diag_start: (kb+1)·bk ≤ qi·bq, i.e.
+        # every column precedes every row of this q block)
+        diag_start = (qi * block_q) // block_k
+        num_kb = diag_start + pl.cdiv(block_q, block_k)
     else:
-        num_kb = seq_len // block_k
+        diag_start = num_kb = seq_len // block_k
 
-    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    def make_body(masked):
+        def body(kb, carry):
+            m, l, acc = carry
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if masked:
+                row = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                col = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 1)
+                s = jnp.where(row >= col, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            col = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(row >= col, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+    carry = jax.lax.fori_loop(0, diag_start, make_body(False), (m, l, acc))
+    if causal:
+        carry = jax.lax.fori_loop(diag_start, num_kb, make_body(True),
+                                  carry)
+    m, l, acc = carry
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)  # [BQ, 1]
 
@@ -140,30 +155,40 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     delta = delta_ref[0]  # [BQ, 1]
     bq, d = q.shape
     dq = jnp.zeros((bq, d), jnp.float32)
+    # scale folded into q for the score dot (see _fwd_kernel); the dq
+    # accumulation uses raw k and applies scale once at the end, as before
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
 
     if causal:
-        num_kb = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+        diag_start = (qi * block_q) // block_k
+        num_kb = diag_start + pl.cdiv(block_q, block_k)
     else:
-        num_kb = seq_len // block_k
-    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        diag_start = num_kb = seq_len // block_k
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            col = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(row >= col, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q.dtype)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+    def make_body(masked):
+        def body(kb, dq):
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if masked:
+                row = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                col = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 1)
+                s = jnp.where(row >= col, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(q.dtype)
+            return dq + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return body
 
-    dq = jax.lax.fori_loop(0, num_kb, body, dq)
+    dq = jax.lax.fori_loop(0, diag_start, make_body(False), dq)
+    if causal:
+        dq = jax.lax.fori_loop(diag_start, num_kb, make_body(True), dq)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -189,36 +214,53 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros((bk, d), jnp.float32)
 
     num_qb = seq_len // block_q
-    first_qb = (ki * block_k) // block_q if causal else 0
-    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    if causal:
+        # q blocks split three ways around this k block: before first_qb
+        # nothing is visible (skipped), [first_qb, diag_end) touches the
+        # diagonal (masked), [diag_end, num_qb) is fully visible — the
+        # iota/compare/select passes run on ≤ cdiv(bk, bq) blocks only
+        first_qb = (ki * block_k) // block_q
+        diag_end = -(-((ki + 1) * block_k - 1) // block_q)  # ceil div
+    else:
+        first_qb = diag_end = 0
+    # scale folded into the resident k for the score dot (see
+    # _fwd_kernel); dk accumulates against raw q, scaled once at flush
+    ks = (k.astype(jnp.float32) * scale).astype(k.dtype)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]  # [BQ, 1]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            row = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            s = jnp.where(row >= col, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        p16 = p.astype(k.dtype)
-        dv_new = dv + jax.lax.dot_general(
-            p16, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(k.dtype)
-        dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    def make_body(masked):
+        def body(qb, carry):
+            dk, dv = carry
+            q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+            do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+            lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]  # [BQ, 1]
+            delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+            s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if masked:
+                row = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 0)
+                col = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 1)
+                s = jnp.where(row >= col, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            p16 = p.astype(k.dtype)
+            dv_new = dv + jax.lax.dot_general(
+                p16, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(k.dtype)
+            dk_new = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+        return body
 
-    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body,
-                               (dk_acc[...], dv_acc[...]))
+    carry = (dk_acc[...], dv_acc[...])
+    if causal:
+        carry = jax.lax.fori_loop(first_qb, diag_end, make_body(True),
+                                  carry)
+    dk, dv = jax.lax.fori_loop(diag_end, num_qb, make_body(False), carry)
     dk_acc[...] = dk
     dv_acc[...] = dv
 

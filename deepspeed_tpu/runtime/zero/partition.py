@@ -113,6 +113,7 @@ class ZeroShardingPolicy:
         self.mesh = mesh
         self.tp_specs = tp_specs
         self.threshold = param_persistence_threshold
+        self._warned_uneven: set = set()
 
     def _tp_spec_for(self, path):
         if self.tp_specs is None:
@@ -176,12 +177,16 @@ class ZeroShardingPolicy:
                         f"The expert dispatch all-to-all needs equal "
                         f"shards — make num_experts a multiple of the "
                         f"data*fsdp extent (or shrink the mesh).")
-                logger.warning(
-                    "param %r dim %d (size %d) is not divisible by mesh "
-                    "axes %s (product %d); GSPMD pads the ragged shard — "
-                    "fine, but padding the dim to a multiple avoids the "
-                    "wasted memory/compute", name, i, shape[i],
-                    tuple(axes), div)
+                # _map runs once per placement (param/grad/opt-state) —
+                # dedup so one ragged leaf warns once per engine init
+                if (name, i) not in self._warned_uneven:
+                    self._warned_uneven.add((name, i))
+                    logger.warning(
+                        "param %r dim %d (size %d) is not divisible by "
+                        "mesh axes %s (product %d); GSPMD pads the ragged "
+                        "shard — fine, but padding the dim to a multiple "
+                        "avoids the wasted memory/compute", name, i,
+                        shape[i], tuple(axes), div)
 
     # -- the three placements ------------------------------------------------
 

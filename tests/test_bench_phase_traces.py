@@ -44,3 +44,43 @@ def test_train_350m_flash_seq8k_traces():
     from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
     cfg = config_for("gpt2-350m", n_positions=8192, dtype=jnp.bfloat16)
     _trace_train(GPT2LMModel(cfg), global_batch=1, seq=8192)
+
+
+def test_bench_phase_argv_all_declared():
+    """Every flag a PHASES entry passes must be declared by bench's
+    argparser — a typo'd flag would otherwise burn a hardware window
+    with an argparse crash inside the child."""
+    import re
+    import bench
+    src = open(bench.__file__).read()
+    declared = set(re.findall(r'add_argument\("(--[a-z0-9-]+)"', src))
+    for name, (extra, _cap) in bench.PHASES.items():
+        for tok in extra:
+            if tok.startswith("--"):
+                assert tok in declared, \
+                    f"phase {name} uses undeclared flag {tok}"
+
+
+def test_mxu_peak_and_chained_flash_trace():
+    """mxu-peak + the flash-compile sustained-throughput loop trace on
+    CPU (eval_shape only — interpret-mode pallas inside a 100-iter
+    fori_loop would crawl)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    B, T, H, D = 2, 256, 4, 64
+    q = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+
+    def chained(q, k, v):
+        def body(_, qq):
+            return flash_attention(qq, k, v, causal=True)
+        return jax.lax.fori_loop(0, 3, body, q)
+
+    out = jax.eval_shape(chained, q, q, q)
+    assert out.shape == (B, T, H, D)
+
+    def mm(x, w):
+        def body(_, xx):
+            return jax.lax.dot(xx, w, preferred_element_type=jnp.bfloat16)
+        return jax.lax.fori_loop(0, 3, body, x)
+
+    a = jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)
+    assert jax.eval_shape(mm, a, a).shape == (512, 512)
