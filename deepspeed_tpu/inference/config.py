@@ -10,7 +10,7 @@ compatibility.
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Literal, Optional, Union
 
 from pydantic import Field
 
@@ -73,8 +73,11 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
     # generation workspace: max tokens the KV cache is sized for
     # (reference sizes its Context workspace from free HBM,
-    # inference_context.h:124-161; here it is explicit + static for jit)
-    max_out_tokens: int = Field(default=1024, alias="max_tokens")
+    # inference_context.h:124-161; here explicit + static for jit, or
+    # "auto" to size from the accelerator's free memory at generate time
+    # (kv_cache.auto_max_tokens) — the reference's behavior)
+    max_out_tokens: Union[int, Literal["auto"]] = Field(
+        default=1024, alias="max_tokens")
     min_out_tokens: int = 1
     max_batch_size: int = 8
     # long-context serving: shard the KV cache sequence dim over a `seq`

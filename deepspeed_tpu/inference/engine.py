@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import dataclasses
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
-from deepspeed_tpu.inference.kv_cache import KVCache, init_cache
+from deepspeed_tpu.inference.kv_cache import (KVCache, auto_max_tokens,
+                                              init_cache)
 from deepspeed_tpu.model_implementations.transformer import (
     InferenceTransformerConfig, causal_forward, decode_step, encoder_forward,
     init_params, prefill, tp_param_specs)
@@ -178,9 +179,13 @@ class InferenceEngine:
             # AFTER the activation-dtype cast so scales stay f32
             from deepspeed_tpu.module_inject.quantize import GroupQuantizer
             wq = self.config.quant.weight
+            # w8a8 compute flips to per-output-channel scales so the
+            # ATTENTION projections take the true-int8 MXU dot as well
+            # (row-group scales straddle output heads and force dequant)
             params = GroupQuantizer(
-                num_bits=wq.num_bits,
-                group_size=wq.group_size).quantize_tree(params)
+                num_bits=wq.num_bits, group_size=wq.group_size,
+                out_mode=self.model_config.int8_compute
+                ).quantize_tree(params)
         if self.mesh is None:
             return params
         specs = tp_param_specs(params)
@@ -194,6 +199,32 @@ class InferenceEngine:
             lambda x, sp: jax.device_put(
                 x, NamedSharding(self.mesh, filter_spec(sp))),
             params, specs)
+
+    def _max_out_budget(self, batch: int) -> int:
+        """KV-token budget per sequence: explicit max_out_tokens, or —
+        with max_out_tokens='auto' — sized from the accelerator's free
+        memory at call time (kv_cache.auto_max_tokens, the reference's
+        inference_context.h free-HBM workspace behavior). Falls back to
+        the 1024 default when the backend reports no memory stats."""
+        mo = self.config.max_out_tokens
+        if mo != "auto":
+            return _round_up(int(mo), 128)
+        cfg = self.model_config
+        # per-device cache bytes shrink by the model-parallel factor
+        # (_make_cache shards kv-heads over `tensor`, S over `seq`)
+        shard = 1
+        if self.mesh is not None:
+            ax = self.mesh.shape
+            if "seq" in ax:
+                shard *= ax["seq"]
+            if "tensor" in ax and cfg.kv_heads % ax["tensor"] == 0:
+                shard *= ax["tensor"]
+        auto = auto_max_tokens(cfg.n_layer, batch, cfg.kv_heads,
+                               cfg.head_dim, dtype=self._act_dtype,
+                               shard_factor=shard)
+        if auto is None:
+            return _round_up(1024, 128)
+        return auto
 
     def _make_cache(self, batch: int, max_seq: int) -> KVCache:
         cache = init_cache(self.model_config.n_layer, batch, max_seq,
@@ -308,12 +339,15 @@ class InferenceEngine:
                 f"min_out_tokens={self.config.min_out_tokens} (reference "
                 "inference/engine.py rejects un-schedulable budgets)")
         max_seq = _round_up(int(lengths.max()) + max_new_tokens, 128)
-        if max_seq > _round_up(self.config.max_out_tokens, 128):
+        budget = self._max_out_budget(B * max(num_beams, 1))
+        if max_seq > budget:
             raise ValueError(
                 f"prompt + max_new_tokens needs a {max_seq}-token KV cache "
-                f"but config.max_out_tokens={self.config.max_out_tokens} "
-                "(the reference sizes its workspace from free HBM, "
-                "inference_context.h:124; here the budget is explicit)")
+                f"but the budget is {budget} tokens "
+                f"(max_out_tokens={self.config.max_out_tokens!r}; the "
+                "reference sizes its workspace from free HBM, "
+                "inference_context.h:124 — set max_out_tokens='auto' for "
+                "the same behavior here)")
         if num_beams > 1:
             if float(temperature) > 0.0 or top_k or top_p:
                 raise ValueError(

@@ -33,6 +33,35 @@ class KVCache:
         return self.k.shape[0]
 
 
+def auto_max_tokens(num_layers: int, batch: int, num_kv_heads: int,
+                    head_dim: int, dtype=jnp.bfloat16,
+                    reserve_fraction: float = 0.1,
+                    shard_factor: int = 1):
+    """HBM-aware KV budget — the reference's free-memory workspace sizing
+    (``inference_context.h:124-161``: workspace = free GPU memory at first
+    forward × memory_gb knob) translated to the static-shape world: how
+    many cache tokens per sequence fit the accelerator's CURRENTLY free
+    memory, minus a safety reserve for activations/compile workspace.
+    Returns ``None`` when the backend reports no memory stats (CPU tests,
+    interpret mode) — callers fall back to the explicit default.
+
+    ``shard_factor``: how many ways the cache's sharded dims (kv-heads
+    over ``tensor``, S over ``seq``) divide across devices — each device
+    holds ``1/shard_factor`` of the per-token bytes, so the budget grows
+    by that factor under model parallelism."""
+    from deepspeed_tpu.accelerator import get_accelerator
+    stats = get_accelerator().memory_stats()
+    limit = int(stats.get("bytes_limit", 0))
+    if limit <= 0:
+        return None
+    free = max(0, limit - int(stats.get("bytes_in_use", 0)))
+    per_token = (num_layers * 2 * num_kv_heads * head_dim
+                 * jnp.dtype(dtype).itemsize * batch
+                 ) // max(int(shard_factor), 1)
+    tokens = int(free * (1.0 - reserve_fraction)) // max(per_token, 1)
+    return max(128, (tokens // 128) * 128)
+
+
 def init_cache(num_layers: int, batch: int, max_seq: int, num_kv_heads: int,
                head_dim: int, dtype=jnp.bfloat16) -> KVCache:
     shape = (num_layers, batch, max_seq, num_kv_heads, head_dim)

@@ -27,6 +27,7 @@ Parameter schema (pytree of arrays)::
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional
 
@@ -36,7 +37,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.inference.kv_cache import (KVCache, advance, append_token,
                                               write_prompt)
-from deepspeed_tpu.ops.int8_gemm import maybe_int8_matmul
+from deepspeed_tpu.ops.int8_gemm import (maybe_int8_einsum,
+                                         maybe_int8_matmul)
 
 NEG_INF = -1e30
 
@@ -222,6 +224,19 @@ def tp_param_specs(params: Dict) -> Dict:
             # and come out replicated, which is already correct for them.
             base = tuple(spec_for(path[:-len(".scale")]))
             return P(*base[:-1], None) if base else P()
+        if path.endswith(".oscale"):
+            # per-output-channel scales (quantize_weight_out): size-1 on
+            # contraction dims, weight extent on output dims — follow the
+            # weight's OUTPUT sharding; row-parallel weights shard a
+            # contraction dim, so their scales replicate (the post-psum
+            # rescale is global)
+            wpath = path[: -len(".oscale")]
+            base = list(spec_for(wpath))
+            if wpath.endswith(("attn.wo", "mlp.wo")):
+                base = [None] * len(base)
+            elif wpath.endswith("experts.wo"):
+                base = ["expert", None, None]
+            return P(*base)
         if path.endswith(("attn.wq", "attn.wk", "attn.wv")):
             return P(None, "tensor", None)
         if path.endswith(("attn.bq", "attn.bk", "attn.bv")):
@@ -400,18 +415,19 @@ def _decode_attention(q, k_cache, v_cache, live,
     """One-token attention against the cache. q [B, H, D], cache
     [B, S, KH, D], ``live [B]`` = number of valid cache positions
     *including* the just-appended token → [B, H, D]. Pallas
-    ``softmax_context`` analog on TPU; XLA path for ALiBi / windowed /
-    GQA / CPU."""
+    ``softmax_context`` analog on TPU, cache-layout- and GQA-native;
+    XLA fallback for ALiBi / windowed / seq-sharded-KV / CPU."""
     B, H, D = q.shape
     KH = k_cache.shape[2]
     S = k_cache.shape[1]
     if cfg.positional != "alibi" and window is None \
-            and jax.default_backend() == "tpu" and H == KH \
+            and jax.default_backend() == "tpu" and H % KH == 0 \
             and not cfg.seq_shard_kv:
+        # cache-native + GQA-native kernel (r4): no per-step cache
+        # transpose, no _repeat_kv materialization — decode reads
+        # exactly the live cache bytes once
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
-        kc = jnp.swapaxes(k_cache, 1, 2)  # [B, KH, S, D]
-        vc = jnp.swapaxes(v_cache, 1, 2)
-        return decode_attention(q, kc, vc, live, scale=cfg.scale,
+        return decode_attention(q, k_cache, v_cache, live, scale=cfg.scale,
                                 block_k=128)
     s = jnp.einsum("bhd,bshd->bhs", q, _repeat_kv(k_cache, H // KH),
                    preferred_element_type=jnp.float32)
@@ -435,9 +451,12 @@ def _decode_attention(q, k_cache, v_cache, live,
 def _qkv(x, a, cfg, positions):
     """x [..., E] → q [..., H, D], k/v [..., KH, D] with rotary applied."""
     dt = x.dtype
-    q = jnp.einsum("...e,ehd->...hd", x, _w(a["wq"], dt)) + a["bq"]
-    k = jnp.einsum("...e,ehd->...hd", x, _w(a["wk"], dt)) + a["bk"]
-    v = jnp.einsum("...e,ehd->...hd", x, _w(a["wv"], dt)) + a["bv"]
+    proj = functools.partial(maybe_int8_einsum, "...e,ehd->...hd", x,
+                             dtype=dt, int8_compute=cfg.int8_compute,
+                             x_contract_ndim=1, w_out_ndim=2)
+    q = proj(w=a["wq"]) + a["bq"]
+    k = proj(w=a["wk"]) + a["bk"]
+    v = proj(w=a["wv"]) + a["bv"]
     if cfg.positional == "rotary":
         q = apply_rotary(q, positions, cfg.rotary_dim, cfg.rotary_base,
                          cfg.rotary_interleaved)
@@ -490,16 +509,21 @@ def _moe_mlp(x, moe, cfg, mesh=None):
     act = cfg.moe_activation or cfg.activation
     xin = jnp.einsum("sx,se->xse", sel, t)                # [X, S, E]
     xin = _maybe_expert_constrain(xin, mesh)
+    up_proj = functools.partial(maybe_int8_einsum, "xse,xef->xsf", xin,
+                                dtype=dt, int8_compute=cfg.int8_compute,
+                                x_contract_ndim=1, w_out_ndim=1)
     if "wg" in ex:
         # gated (Mixtral) experts: down(act(gate(x)) * up(x)), no biases
-        g = jnp.einsum("xse,xef->xsf", xin, _w(ex["wg"], dt))
-        u = jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt))
+        g = up_proj(w=ex["wg"])
+        u = up_proj(w=ex["wi"])
         h = (_act(g, act) * u).astype(dt)
-        out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt))
+        out = maybe_int8_einsum("xsf,xfe->xse", h, ex["wo"], dt,
+                                cfg.int8_compute, 1, 1)
     else:
-        h = _act(jnp.einsum("xse,xef->xsf", xin, _w(ex["wi"], dt)) +
-                 ex["bi"][:, None, :], act).astype(dt)
-        out = jnp.einsum("xsf,xfe->xse", h, _w(ex["wo"], dt)) + \
+        h = _act(up_proj(w=ex["wi"]) + ex["bi"][:, None, :],
+                 act).astype(dt)
+        out = maybe_int8_einsum("xsf,xfe->xse", h, ex["wo"], dt,
+                                cfg.int8_compute, 1, 1) + \
             ex["bo"][:, None, :]
     out = _maybe_expert_constrain(out, mesh)
     combined = jnp.einsum("sx,xse->se", dispatch, out)    # combine
@@ -542,8 +566,8 @@ def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
     window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
     attn = _prefill_attention(q, k, v, cfg, causal=causal, key_mask=key_mask,
                               window=window)
-    attn_out = jnp.einsum("...hd,hde->...e", attn,
-                          _w(a["wo"], x.dtype)) + a["bo"]
+    attn_out = maybe_int8_einsum("...hd,hde->...e", attn, a["wo"],
+                                 x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
     if cfg.parallel_attn_mlp:
         # GPT-J/NeoX: x + attn(ln1(x)) + mlp(ln(x)); GPT-J shares ln1
         ln2 = layer.get("ln2")
@@ -573,8 +597,8 @@ def _block_decode(x, layer, cfg, cache, layer_idx, mesh=None):
     window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
     attn = _decode_attention(q, cache.k[layer_idx], cache.v[layer_idx],
                              cache.lengths + 1, cfg, window=window)
-    attn_out = jnp.einsum("bhd,hde->be", attn,
-                          _w(a["wo"], x.dtype)) + a["bo"]
+    attn_out = maybe_int8_einsum("bhd,hde->be", attn, a["wo"],
+                                 x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
     if cfg.parallel_attn_mlp:
         ln2 = layer.get("ln2")
         mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)

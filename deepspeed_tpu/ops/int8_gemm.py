@@ -52,11 +52,64 @@ def int8_matmul(x: jax.Array, qw: dict, out_dtype=None) -> jax.Array:
     return (y.astype(jnp.float32) * sz).astype(out_dtype)
 
 
+def _squeeze_leading_ones(shape):
+    out = list(shape)
+    while len(out) > 1 and out[0] == 1:
+        out.pop(0)
+    return tuple(out)
+
+
+def int8_einsum(subscripts: str, x: jax.Array, qw: dict,
+                x_contract_ndim: int, w_out_ndim: int,
+                out_dtype) -> jax.Array:
+    """General w8a8 einsum for {"q": int8, "oscale"} leaves (per-output-
+    channel scales, quantize.py quantize_weight_out): one dynamic
+    per-token activation quant, int8×int8 dot on the MXU (int32
+    accumulator), one fp rescale of the output:
+
+        y = einsum(x, q·s_out) = einsum(x_q, q) · s_x · s_out
+
+    ``x_contract_ndim``: trailing dims of x the einsum contracts (1 for
+    [...,E]·[E,H,D]; 2 for [...,H,D]·[H,D,E]). ``w_out_ndim``: output
+    dims the weight contributes (sizes the rescale broadcast)."""
+    q, s = qw["q"], qw["oscale"]
+    xf = x.astype(jnp.float32)
+    red = tuple(range(x.ndim - x_contract_ndim, x.ndim))
+    amax = jnp.max(jnp.abs(xf), axis=red)
+    sx = jnp.where(amax > 0, amax / 127.0, 1.0)
+    sx_in = sx.reshape(sx.shape + (1,) * x_contract_ndim)
+    xq = jnp.clip(jnp.round(xf / sx_in), -127, 127).astype(jnp.int8)
+    y = jnp.einsum(subscripts, xq, q,
+                   preferred_element_type=jnp.int32)
+    # oscale carries 1s on the weight's contraction dims; squeeze the
+    # LEADING 1s so right-aligned broadcasting matches the output layout
+    # ([1,H,D]->[H,D] vs [...,H,D]; [X,1,F] stays, batching over X)
+    s = s.reshape(_squeeze_leading_ones(s.shape))
+    sx_out = sx.reshape(sx.shape + (1,) * w_out_ndim)
+    return (y.astype(jnp.float32) * sx_out
+            * s.astype(jnp.float32)).astype(out_dtype)
+
+
+def maybe_int8_einsum(subscripts: str, x: jax.Array, w: Any, dtype,
+                      int8_compute: bool, x_contract_ndim: int,
+                      w_out_ndim: int) -> jax.Array:
+    """Attention/expert projection seam: true-int8 einsum for oscale
+    leaves under w8a8; dequant einsum otherwise."""
+    if int8_compute and is_quantized(w) and "oscale" in w:
+        return int8_einsum(subscripts, x, w, x_contract_ndim,
+                           w_out_ndim, dtype)
+    from deepspeed_tpu.model_implementations.transformer import _w
+    return jnp.einsum(subscripts, x, _w(w, dtype)).astype(dtype)
+
+
 def maybe_int8_matmul(x: jax.Array, w: Any, dtype,
                       int8_compute: bool) -> jax.Array:
     """The fused transformer's 2-D GEMM seam: int8 dot when the leaf is
     quantized and the config opts in; bf16 dequant-matmul otherwise."""
-    if int8_compute and is_quantized(w) and w["q"].ndim == 2:
-        return int8_matmul(x, w, out_dtype=dtype)
+    if int8_compute and is_quantized(w):
+        if "oscale" in w:
+            return int8_einsum("...k,kn->...n", x, w, 1, 1, dtype)
+        if w["q"].ndim == 2:
+            return int8_matmul(x, w, out_dtype=dtype)
     from deepspeed_tpu.model_implementations.transformer import _w
     return (x @ _w(w, dtype)).astype(dtype)

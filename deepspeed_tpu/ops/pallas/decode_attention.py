@@ -4,14 +4,22 @@ TPU-native analog of the reference's fused ``softmax_context`` kernel
 (``csrc/transformer/inference/csrc/pt_binding.cpp:1701-1740`` /
 ``softmax.cu``), which attends one new token against the accumulated KV
 cache each generation step. The kernel streams K/V blocks for one
-(batch, head) through VMEM with the online-softmax recurrence and masks
-positions beyond the live cache length — no [S] probability vector ever
-round-trips HBM, and padding positions cost no exp/normalize work beyond
-the masked block.
+(batch, kv-head) through VMEM with the online-softmax recurrence and
+masks positions beyond the live cache length — no [S] probability vector
+ever round-trips HBM, and dead cache tail costs nothing (the loop bound
+comes from the scalar-prefetched lengths).
 
-Layout: q ``[B, H, D]`` (one query token per sequence), cache ``[B, H, S, D]``
-with per-sequence ``lengths [B]`` (scalar-prefetched so the loop bound is
-known before the body runs).
+Decode is KV-bandwidth-bound, so the kernel consumes the cache in its
+STORAGE layout ``[B, S, KH, D]`` (kv_cache.py) directly — r3 transposed
+to [B, KH, S, D] before every call, a full cache read+write per token
+per layer that roughly doubled decode HBM traffic. Grouped-query
+attention is native: the grid is (batch, kv-head) and each program
+attends that head's whole query group ``[R, D]`` against one K/V stream,
+so GQA's bandwidth saving survives into decode (r3 fell back to an XLA
+path that materialized the cache repeated to H heads).
+
+Layout: q ``[B, H, D]`` (one query token per sequence, H = KH·R),
+cache ``[B, S, KH, D]``, ``lengths [B]``.
 """
 from __future__ import annotations
 
@@ -27,25 +35,28 @@ DEFAULT_BLOCK_K = 256
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                   s_max: int, scale: float):
+                   scale: float):
     b = pl.program_id(0)
     length = len_ref[b]
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [1, D] (block (1,1,1,D))
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [R, D]
+    R = q.shape[0]
 
-    m = jnp.full((1, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((1, 1), jnp.float32)
-    acc = jnp.zeros((1, q.shape[-1]), jnp.float32)
+    m = jnp.full((R, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((R, 1), jnp.float32)
+    acc = jnp.zeros((R, q.shape[-1]), jnp.float32)
 
     num_kb = pl.cdiv(length, block_k)
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        # cache-native block [BK, D] (dim 2 of the [1, S, 1, D] ref is
+        # the kv-head singleton selected by the index map)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), 0, :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [1, BK]
+                                preferred_element_type=jnp.float32)  # [R,BK]
         col = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1)
+            jnp.int32, (R, block_k), 1)
         s = jnp.where(col < length, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -65,14 +76,18 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      block_k: int = DEFAULT_BLOCK_K,
                      scale: float | None = None,
                      interpret: bool | None = None) -> jax.Array:
-    """One-token attention against the KV cache.
+    """One-token attention against the cache, GQA-native.
 
-    q: ``[B, H, D]``; k_cache/v_cache: ``[B, H, S, D]``; lengths: ``[B]``
-    int32 live lengths (query attends cache positions ``< lengths[b]``).
+    q: ``[B, H, D]``; k_cache/v_cache: ``[B, S, KH, D]`` (the kv_cache.py
+    storage layout — no transpose) with ``H % KH == 0``; lengths: ``[B]``
+    int32 live lengths (query attends positions ``< lengths[b]``).
     Returns ``[B, H, D]``.
     """
     B, H, D = q.shape
-    S = k_cache.shape[2]
+    S, KH = k_cache.shape[1], k_cache.shape[2]
+    if H % KH:
+        raise ValueError(f"q heads {H} not divisible by kv heads {KH}")
+    R = H // KH
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     block_k = min(block_k, S)
@@ -81,36 +96,42 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    q4 = q[:, :, None, :]  # [B, H, 1, D]
-    kernel = functools.partial(_decode_kernel, block_k=block_k, s_max=S,
+    # [B, H, D] -> [B, KH, R, D]: group queries by the kv head they read
+    qg = q.reshape(B, KH, R, D)
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
                                scale=float(scale))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(B, H),
+        grid=(B, KH),
         in_specs=[
-            pl.BlockSpec((1, 1, 1, D), lambda b, h, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, R, D), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, S, 1, D), lambda b, h, lens: (b, 0, h, 0)),
+            pl.BlockSpec((1, S, 1, D), lambda b, h, lens: (b, 0, h, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, 1, D), lambda b, h, lens: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, R, D),
+                               lambda b, h, lens: (b, h, 0, 0)),
     )
-    o4 = pl.pallas_call(
+    og = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KH, R, D), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), q4, k_cache, v_cache)
-    return o4[:, :, 0, :]
+    )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
+    return og.reshape(B, H, D)
 
 
 def decode_attention_reference(q, k_cache, v_cache, lengths):
-    """Numerics oracle (pure jnp, XLA) — also the CPU fallback path."""
+    """Numerics oracle (pure jnp, XLA) — also the CPU fallback path.
+    Same layouts as :func:`decode_attention`."""
     B, H, D = q.shape
-    S = k_cache.shape[2]
-    s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
-                   k_cache.astype(jnp.float32)) / (D ** 0.5)
+    S, KH = k_cache.shape[1], k_cache.shape[2]
+    rep = H // KH
+    kc = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+    vc = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) / (D ** 0.5)
     mask = jnp.arange(S)[None, None, :] < lengths[:, None, None]
     s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhs,bhsd->bhd", p,
-                      v_cache.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bhs,bshd->bhd", p,
+                      vc.astype(jnp.float32)).astype(q.dtype)

@@ -539,6 +539,7 @@ def test_ddim_step_recovers_x0_at_full_denoise():
     np.testing.assert_allclose(np.asarray(out), np.asarray(x0), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_text_to_image_end_to_end_tiny():
     """Full serving loop on the tiny random UNet+VAE: noise -> DDIM ->
     VAE decode, with classifier-free guidance, under jit."""

@@ -72,6 +72,7 @@ def test_train_eval_mode_gates_dropout():
     assert len(vals) > 1, "train mode must consume fresh dropout rng"
 
 
+@pytest.mark.slow
 def test_was_step_applied_and_zero_grad():
     eng = _engine()
     assert eng.was_step_applied() is False   # nothing ran yet
@@ -84,6 +85,7 @@ def test_was_step_applied_and_zero_grad():
         eng.step()
 
 
+@pytest.mark.slow
 def test_module_state_dict_roundtrip():
     eng = _engine()
     eng.train_batch(_batch(eng))
@@ -109,6 +111,7 @@ def test_module_state_dict_roundtrip():
         eng2.load_module_state_dict({"nope": np.zeros(1)})
 
 
+@pytest.mark.slow
 def test_destroy_releases_compiled_state():
     eng = _engine()
     eng.train_batch(_batch(eng))

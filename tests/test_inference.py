@@ -186,8 +186,7 @@ def test_decode_kernel_mask_matches_model_semantics():
     vc = jax.random.normal(jax.random.PRNGKey(2), (B, S, Hh, D), jnp.float32)
     live = jnp.array([5, 17], jnp.int32)
     xla = _decode_attention(q, kc, vc, live, cfg)
-    pallas = decode_attention(q, jnp.swapaxes(kc, 1, 2),
-                              jnp.swapaxes(vc, 1, 2), live,
+    pallas = decode_attention(q, kc, vc, live,
                               scale=cfg.scale, block_k=64, interpret=True)
     np.testing.assert_allclose(np.asarray(xla), np.asarray(pallas),
                                rtol=2e-5, atol=2e-5)

@@ -147,8 +147,8 @@ class TestDecodeAttention:
         B, H, S, D = 3, 4, 512, 64
         key = jax.random.PRNGKey(1)
         q = jax.random.normal(jax.random.fold_in(key, 0), (B, H, D))
-        kc = jax.random.normal(jax.random.fold_in(key, 1), (B, H, S, D))
-        vc = jax.random.normal(jax.random.fold_in(key, 2), (B, H, S, D))
+        kc = jax.random.normal(jax.random.fold_in(key, 1), (B, S, H, D))
+        vc = jax.random.normal(jax.random.fold_in(key, 2), (B, S, H, D))
         lengths = jnp.asarray([1, 200, 512], jnp.int32)
         o = decode_attention(q, kc, vc, lengths)
         o_ref = decode_attention_reference(q, kc, vc, lengths)
@@ -160,12 +160,26 @@ class TestDecodeAttention:
         B, H, S, D = 2, 2, 256, 64
         key = jax.random.PRNGKey(2)
         q = jax.random.normal(jax.random.fold_in(key, 0), (B, H, D))
-        kc = jax.random.normal(jax.random.fold_in(key, 1), (B, H, S, D))
-        vc = jax.random.normal(jax.random.fold_in(key, 2), (B, H, S, D))
+        kc = jax.random.normal(jax.random.fold_in(key, 1), (B, S, H, D))
+        vc = jax.random.normal(jax.random.fold_in(key, 2), (B, S, H, D))
         lengths = jnp.ones((B,), jnp.int32)
         o = decode_attention(q, kc, vc, lengths)
-        np.testing.assert_allclose(np.asarray(o), np.asarray(vc[:, :, 0]),
+        np.testing.assert_allclose(np.asarray(o), np.asarray(vc[:, 0]),
                                    rtol=1e-5, atol=1e-5)
+
+    def test_gqa_native_groups(self):
+        # H=8 query heads over KH=2 kv heads: the kernel must match the
+        # expanded reference WITHOUT materializing repeated k/v
+        B, H, KH, S, D = 2, 8, 2, 256, 64
+        key = jax.random.PRNGKey(3)
+        q = jax.random.normal(jax.random.fold_in(key, 0), (B, H, D))
+        kc = jax.random.normal(jax.random.fold_in(key, 1), (B, S, KH, D))
+        vc = jax.random.normal(jax.random.fold_in(key, 2), (B, S, KH, D))
+        lengths = jnp.asarray([64, 256], jnp.int32)
+        o = decode_attention(q, kc, vc, lengths)
+        o_ref = decode_attention_reference(q, kc, vc, lengths)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-4, atol=2e-4)
 
 
 class TestFusedLayerNorm:
