@@ -379,15 +379,17 @@ def _prefill_attention(q, k, v, cfg: InferenceTransformerConfig,
     windowed, bidirectional, and CPU paths use the XLA einsum oracle.
     """
     B, T, H, D = q.shape
-    k = _repeat_kv(k, H // k.shape[2])
-    v = _repeat_kv(v, H // v.shape[2])
     use_flash = (causal and key_mask is None and window is None
                  and cfg.positional != "alibi"
                  and jax.default_backend() == "tpu" and T >= 128 and
-                 T % 128 == 0)
+                 T % 128 == 0 and H % k.shape[2] == 0)
     if use_flash:
+        # GQA stays unexpanded: the kernel streams each kv head once for
+        # its whole query group (flash_attention HKV|H contract)
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True, scale=cfg.scale)
+    k = _repeat_kv(k, H // k.shape[2])
+    v = _repeat_kv(v, H // v.shape[2])
     # bf16 dot inputs, fp32 accumulation — an upfront fp32 cast would
     # quarter the MXU rate (same fix as the Pallas kernels)
     att = jnp.einsum("bqhd,bkhd->bhqk", q, k,
