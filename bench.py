@@ -428,6 +428,9 @@ def phase_infer(args) -> dict:
 
     bench_decode(eng, "gpt", "gpt", want_p90=True)
     bench_batched(eng, "gpt", "gpt")
+    # salvage point: bf16 decode metrics survive a cap kill during the
+    # int8/w8a8 engine compiles below
+    print(json.dumps({**out, "partial": True}), flush=True)
 
     # --- same decode with int8 weights + w8a8 MLP GEMMs
     try:
@@ -454,6 +457,7 @@ def phase_infer(args) -> dict:
     except Exception as e:  # noqa: BLE001 — optional metric
         log(f"int8 decode phase skipped: {type(e).__name__}: "
             f"{str(e)[:120]}")
+    print(json.dumps({**out, "partial": True}), flush=True)  # salvage
 
     # --- BERT-large encoder forward latency (bert-bench.py conventions)
     bert_cfg = InferenceTransformerConfig(
@@ -631,11 +635,12 @@ def phase_mxu_peak(args) -> dict:
 
 PHASES = {
     # name -> (builder of extra argv, subprocess timeout seconds).
-    # ORDER MATTERS: killing a phase mid-Mosaic-compile wedges the axon
-    # relay — the server keeps compiling and every later phase blocks in
-    # device init (observed r02: inference emitted nothing for 420 s after
-    # the flash phase was killed). The Pallas-flash phase therefore runs
-    # LAST, where a hang can only lose itself.
+    # RUN ORDER lives in DEFAULT_ORDER (above), NOT in this dict — add
+    # new phases BOTH places (test_default_order_covers_all_phases pins
+    # the lockstep). The ordering invariant that matters: killing a
+    # phase mid-Mosaic-compile wedges the axon relay (observed r02:
+    # inference emitted nothing for 420 s after the flash phase was
+    # killed), so the isolation-compile phase goes LAST in the order.
     # phase 0: smallest possible compile (125m, seq 256), adaptive step
     # count sized off the warm step — designed so ANY healthy minute of
     # relay time yields a persisted number (VERDICT r2 #1a)
@@ -665,9 +670,10 @@ PHASES = {
                              480),
     # the reference's training-kernel headline: BERT-large (64 TFLOPS/GPU)
     "train-bert-large": (["--seq", "512", "--micro", "16"], 480),
-    # 900s: ends with the compile-cache-cold llama-1b decode engine (the
-    # phase prints a salvage line first, so a cap kill costs only llama)
-    "inference": ([], 900),
+    # 1200s: four engines (bf16/int8/w8a8/llama) x several loop-shape
+    # compiles; salvage lines after each engine family bound a cap
+    # kill's cost to the section in flight
+    "inference": ([], 1200),
     "train-125m": (["--preset", "gpt2-125m", "--no-flash"], 420),
     "train-350m-flash": (["--preset", "gpt2-350m"], 480),
     "train-350m-noflash": (["--preset", "gpt2-350m", "--no-flash"], 480),
@@ -744,6 +750,21 @@ PHASES = {
                            "--micro", "8"], 900),
 }
 
+
+# Default run order ≠ dict order: a short healthy window must spend its
+# budget by VALUE — cheapest-probe first, then the headline, then the
+# families with no fresh capture yet (VERDICT r3 #1), then variants/
+# ladder rungs, with the isolation-compile phase last (kill-mid-Mosaic
+# wedges the relay for everything after it).
+DEFAULT_ORDER = [
+    "train-125m-micro", "mxu-peak", "train-1.3b", "train-llama-1b",
+    "train-moe-125m-e8", "train-1.3b-bf16acc", "train-1.3b-bf16acc-mb4",
+    "train-350m-flash-mb8", "train-bert-large", "inference",
+    "train-350m-flash-seq4k", "train-350m-flash-seq8k",
+    "train-350m-flash-mb8-gas4", "train-1.3b-gas128", "train-125m",
+    "train-350m-flash", "train-350m-noflash", "train-350m-flash-noremat",
+    "train-350m-noremat", "train-350m-noflash-seq4k", "flash-compile",
+]
 
 INFRA = {"relay_probes_ok": 0, "relay_probes_failed": 0,
          "relay_dead_checks": 0}
@@ -1043,7 +1064,7 @@ def main() -> None:
     INFRA["relay_triage"] = diagnose_relay()
     log(f"relay triage: {json.dumps(INFRA['relay_triage'])}")
     order = ([p for p in args.phases.split(",") if p]
-             if args.phases is not None else list(PHASES))
+             if args.phases is not None else list(DEFAULT_ORDER))
     first_train = next((n for n in order if n.startswith("train")), None)
     for name in order:
         try:

@@ -89,20 +89,35 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
     def step_fn(carry, step):
         m, l, acc, k_cur, v_cur = carry
         src = (idx - step) % sp
-        s, mask = _block_scores(q5, k_cur, scale, q_start, src * Tl,
-                                causal)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        if mask is not None:
-            p = p * mask
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * jnp.moveaxis(alpha, 3, 1) + jnp.einsum(
-            "bhgqk,bkhd->bqhgd", p.astype(v_cur.dtype), v_cur,
-            preferred_element_type=jnp.float32)
+
+        def attend(mla):
+            m, l, acc = mla
+            s, mask = _block_scores(q5, k_cur, scale, q_start, src * Tl,
+                                    causal)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            if mask is not None:
+                p = p * mask
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * jnp.moveaxis(alpha, 3, 1) + jnp.einsum(
+                "bhgqk,bkhd->bqhgd", p.astype(v_cur.dtype), v_cur,
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        if causal:
+            # hops whose K/V block sits entirely ABOVE the diagonal
+            # (src > idx) contribute exactly nothing (p ≡ 0): skip the
+            # whole score/softmax/einsum — on average half the ring's
+            # attention FLOPs. The ppermutes stay unconditional (every
+            # device must participate in every hop's collective).
+            m, l, acc = jax.lax.cond(src <= idx, attend,
+                                     lambda mla: mla, (m, l, acc))
+        else:
+            m, l, acc = attend((m, l, acc))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l, acc, k_nxt, v_nxt), None
+        return (m, l, acc, k_nxt, v_nxt), None
 
     (m, l, acc, _, _), _ = jax.lax.scan(
         step_fn, (m, l, acc, k, v), jnp.arange(sp))
@@ -145,25 +160,37 @@ def _ring_bwd(axis_name, causal, scale, res, do):
     def step_fn(carry, step):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         src = (idx - step) % sp
-        s, mask = _block_scores(q5, k_cur, scale, q_start, src * Tl,
-                                causal)
-        p = jnp.exp(s - lse)
-        if mask is not None:
-            p = p * mask
-        # dv += p^T do ; ds = p*(dp - delta); dk += ds^T q ; dq += ds k
-        dv_cur = dv_cur + jnp.einsum(
-            "bhgqk,bqhgd->bkhd", p.astype(do.dtype), do5,
-            preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bqhgd,bkhd->bhgqk", do5, v_cur,
-                        preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        ds16 = ds.astype(q.dtype)
-        dk_cur = dk_cur + jnp.einsum(
-            "bhgqk,bqhgd->bkhd", ds16, q5,
-            preferred_element_type=jnp.float32) * scale
-        dq = dq + jnp.einsum(
-            "bhgqk,bkhd->bqhgd", ds16, k_cur,
-            preferred_element_type=jnp.float32) * scale
+
+        def attend(grads):
+            dq, dk_cur, dv_cur = grads
+            s, mask = _block_scores(q5, k_cur, scale, q_start, src * Tl,
+                                    causal)
+            p = jnp.exp(s - lse)
+            if mask is not None:
+                p = p * mask
+            # dv += p^T do ; ds = p*(dp - delta); dk += ds^T q ; dq += ds k
+            dv_new = dv_cur + jnp.einsum(
+                "bhgqk,bqhgd->bkhd", p.astype(do.dtype), do5,
+                preferred_element_type=jnp.float32)
+            dp = jnp.einsum("bqhgd,bkhd->bhgqk", do5, v_cur,
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            ds16 = ds.astype(q.dtype)
+            dk_new = dk_cur + jnp.einsum(
+                "bhgqk,bqhgd->bkhd", ds16, q5,
+                preferred_element_type=jnp.float32) * scale
+            dq_new = dq + jnp.einsum(
+                "bhgqk,bkhd->bqhgd", ds16, k_cur,
+                preferred_element_type=jnp.float32) * scale
+            return dq_new, dk_new, dv_new
+
+        if causal:
+            # fully-above-diagonal hops have p ≡ 0 ⇒ every grad term is
+            # zero: skip them (same skip as forward; collectives stay out)
+            dq, dk_cur, dv_cur = jax.lax.cond(
+                src <= idx, attend, lambda g: g, (dq, dk_cur, dv_cur))
+        else:
+            dq, dk_cur, dv_cur = attend((dq, dk_cur, dv_cur))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)

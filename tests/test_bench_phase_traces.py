@@ -84,3 +84,11 @@ def test_mxu_peak_and_chained_flash_trace():
 
     a = jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)
     assert jax.eval_shape(mm, a, a).shape == (512, 512)
+
+
+def test_default_order_covers_all_phases_exactly():
+    """DEFAULT_ORDER must stay in lockstep with PHASES — a phase missing
+    from the order silently never runs in driver windows."""
+    import bench
+    assert sorted(bench.DEFAULT_ORDER) == sorted(bench.PHASES)
+    assert bench.DEFAULT_ORDER[-1] == "flash-compile"  # wedge-risk last
