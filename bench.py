@@ -135,7 +135,8 @@ def train_phase_name(args, *, seq_suffix: bool = False,
             + ("-noflash" if args.no_flash else "")
             + ("-noremat" if args.no_remat else "")
             + ("-offload" if args.offload else "")
-            + (f"-{args.grad_acc_dtype}acc" if args.grad_acc_dtype else ""))
+            + (f"-{args.grad_acc_dtype}acc" if args.grad_acc_dtype else "")
+            + (f"-b{args.flash_block}" if args.flash_block else ""))
     if seq_suffix:
         name += f"-seq{args.seq}"
     if partial:
@@ -176,6 +177,8 @@ def _phase_train(args) -> dict:
     overrides = dict(n_positions=args.seq, dtype=jnp.bfloat16,
                      remat=not args.no_remat,
                      use_flash_attention=not args.no_flash)
+    if args.flash_block:
+        overrides["flash_block"] = args.flash_block
     if args.experts:
         # MoE FFN with each family's canonical layout: gpt2 = every other
         # layer (Megatron-MoE expert_interval=2), llama = every layer with
@@ -697,6 +700,11 @@ PHASES = {
                                 "--micro", "1"], 480),
     "train-350m-noflash-seq4k": (["--preset", "gpt2-350m", "--seq", "4096",
                                   "--micro", "1", "--no-flash"], 480),
+    # block-size A/B at long T (docs/mfu_analysis.md falsification plan:
+    # if the kernel rework doesn't move seq-4k, tile residency is next)
+    "train-350m-flash-seq4k-b512": (["--preset", "gpt2-350m", "--seq",
+                                     "4096", "--micro", "1",
+                                     "--flash-block", "512"], 480),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
     # score tensor per head)
@@ -763,7 +771,8 @@ DEFAULT_ORDER = [
     "train-350m-flash-seq4k", "train-350m-flash-seq8k",
     "train-350m-flash-mb8-gas4", "train-1.3b-gas128", "train-125m",
     "train-350m-flash", "train-350m-noflash", "train-350m-flash-noremat",
-    "train-350m-noremat", "train-350m-noflash-seq4k", "flash-compile",
+    "train-350m-noremat", "train-350m-noflash-seq4k",
+    "train-350m-flash-seq4k-b512", "flash-compile",
 ]
 
 INFRA = {"relay_probes_ok": 0, "relay_probes_failed": 0,
@@ -1025,6 +1034,21 @@ def main() -> None:
                     choices=["fp32", "fp16", "bf16"],
                     help="data_types.grad_accum_dtype; bf16 halves the GAS "
                          "carry + offload D2H grad stream")
+    def _flash_block(v: str) -> int:
+        n = int(v)
+        # fit() halves non-tiling requests toward 128; a non-power-of-two
+        # would silently land on a tile the user never asked for (or die
+        # at trace time after model init) — fail fast here instead
+        if n and (n < 128 or n & (n - 1)):
+            raise argparse.ArgumentTypeError(
+                f"--flash-block must be 0 or a power of two >= 128, "
+                f"got {n}")
+        return n
+
+    ap.add_argument("--flash-block", type=_flash_block, default=0,
+                    help="flash kernel tile override (0 = default 256) — "
+                         "the long-context block-size A/B knob; power of "
+                         "two >= 128")
     ap.add_argument("--adaptive-steps", action="store_true",
                     help="size the measurement loop off the warm step")
     ap.add_argument("--budget", type=float, default=float(

@@ -40,6 +40,9 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
+    # flash tile-size override (0 = kernel default 256): the long-context
+    # block-size A/B knob — bench --flash-block N
+    flash_block: int = 0
     # sequence/context parallelism over the seq mesh axis (capability
     # beyond the reference — SURVEY §5.7); requires dropout == 0 in the
     # attention core. sp_mode: "ring" (ppermute K/V ring, O(T/sp) memory)
@@ -141,14 +144,16 @@ class CausalSelfAttention(nn.Module):
                 # over head subsets; needs n_head % sp == 0
                 from deepspeed_tpu.ops.ulysses_attention import (
                     ulysses_self_attention)
-                y = ulysses_self_attention(q, k, v, get_global_mesh())
+                y = ulysses_self_attention(q, k, v, get_global_mesh(),
+                                           block=cfg.flash_block)
             else:
                 from deepspeed_tpu.ops.ring_attention import (
                     ring_self_attention)
                 y = ring_self_attention(q, k, v, get_global_mesh())
         elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.attention import causal_attention
-            y = causal_attention(q, k, v)
+            y = causal_attention(q, k, v, block_q=cfg.flash_block,
+                                 block_k=cfg.flash_block)
         else:
             scale = 1.0 / jnp.sqrt(C // H).astype(cfg.dtype)
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

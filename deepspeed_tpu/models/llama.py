@@ -47,6 +47,8 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
+    # flash tile-size override (0 = kernel default 256; bench --flash-block)
+    flash_block: int = 0
     sequence_parallel: bool = False
     sp_mode: str = "ring"
     # Mixtral-style MoE: num_experts > 0 replaces the SwiGLU FFN of the
@@ -178,14 +180,16 @@ class LlamaAttention(nn.Module):
             if cfg.sp_mode == "ulysses":
                 from deepspeed_tpu.ops.ulysses_attention import (
                     ulysses_self_attention)
-                y = ulysses_self_attention(q, k, v, get_global_mesh())
+                y = ulysses_self_attention(q, k, v, get_global_mesh(),
+                                           block=cfg.flash_block)
             else:
                 from deepspeed_tpu.ops.ring_attention import (
                     ring_self_attention)
                 y = ring_self_attention(q, k, v, get_global_mesh())
         elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.attention import causal_attention
-            y = causal_attention(q, k, v)
+            y = causal_attention(q, k, v, block_q=cfg.flash_block,
+                                 block_k=cfg.flash_block)
         else:
             from deepspeed_tpu.ops.attention import (
                 causal_attention_reference)

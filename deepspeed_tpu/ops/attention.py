@@ -54,10 +54,12 @@ def causal_attention_reference(q, k, v, scale=None, causal=True):
     return out.reshape(B, T, H, D)
 
 
-def causal_attention(q, k, v):
+def causal_attention(q, k, v, block_q: int = 0, block_k: int = 0):
     """Causal self-attention ``[B, T, H, D] -> [B, T, H, D]``; k/v may
     carry fewer heads (grouped-query attention — both the flash kernel
-    and the reference path consume unexpanded k/v).
+    and the reference path consume unexpanded k/v). ``block_q/block_k``
+    override the flash kernel's tile sizes (0 = kernel default) — the
+    long-context block-size A/B knob (docs/mfu_analysis.md).
 
     The flash output is tagged with ``checkpoint_name('flash_attn_out')``:
     under ``jax.checkpoint`` the dots-saveable remat policy cannot see
@@ -69,13 +71,17 @@ def causal_attention(q, k, v):
     """
     if _on_tpu() and q.shape[1] >= 256:
         try:
-            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention)
         except ImportError:
             from deepspeed_tpu.utils.logging import warning_once
             warning_once("pallas flash attention unavailable; falling back to "
                          "O(T^2) reference attention")
         else:
             from jax.ad_checkpoint import checkpoint_name
-            return checkpoint_name(flash_attention(q, k, v, causal=True),
-                                   "flash_attn_out")
+            return checkpoint_name(
+                flash_attention(q, k, v, causal=True,
+                                block_q=block_q or DEFAULT_BLOCK_Q,
+                                block_k=block_k or DEFAULT_BLOCK_K),
+                "flash_attn_out")
     return causal_attention_reference(q, k, v)

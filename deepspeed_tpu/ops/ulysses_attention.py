@@ -29,24 +29,26 @@ from deepspeed_tpu.comm.mesh import get_global_mesh
 SEQ_AXIS = "seq"
 
 
-def _dense_attention(q, k, v, causal, scale):
+def _dense_attention(q, k, v, causal, scale, block=0):
     """[B, T, h, D] full-sequence attention over the local head subset.
 
     The long-context point of Ulysses dies with an O(T²) score matrix, so
     the causal/default-scale case (what the gpt2 integration produces)
-    routes through ``causal_attention`` — the Pallas flash kernel on TPU.
-    Other cases fall back to the shared dense oracle."""
+    routes through ``causal_attention`` — the Pallas flash kernel on TPU
+    (``block`` = the flash tile override, cfg.flash_block). Other cases
+    fall back to the shared dense oracle."""
     from deepspeed_tpu.ops.attention import (causal_attention,
                                              causal_attention_reference)
     default_scale = 1.0 / (q.shape[-1] ** 0.5)
     if causal and abs(scale - default_scale) < 1e-12:
-        return causal_attention(q, k, v)
+        return causal_attention(q, k, v, block_q=block, block_k=block)
     return causal_attention_reference(q, k, v, scale=scale, causal=causal)
 
 
 def ulysses_attention_sharded(q, k, v, axis_name: str = SEQ_AXIS,
                               causal: bool = True,
-                              scale: Optional[float] = None):
+                              scale: Optional[float] = None,
+                              block: int = 0):
     """Call INSIDE a shard_map manual over ``axis_name``.
 
     q/k/v: per-device sequence shards ``[B, T/sp, H, D]`` with
@@ -79,13 +81,14 @@ def ulysses_attention_sharded(q, k, v, axis_name: str = SEQ_AXIS,
             f"expand k/v to the query head count first (jnp.repeat) or "
             f"use ring attention")
     qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
-    out = _dense_attention(qh, kh, vh, causal, float(scale))
+    out = _dense_attention(qh, kh, vh, causal, float(scale), block=block)
     return head_to_seq(out)
 
 
 def ulysses_self_attention(q, k, v, mesh: Optional[Mesh] = None,
                            causal: bool = True,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           block: int = 0):
     """Global-array entry point: shards [B, T, H, D] over the ``seq`` axis
     and runs the all-to-all pair. Works inside jit (other mesh axes stay
     automatic)."""
@@ -99,7 +102,7 @@ def ulysses_self_attention(q, k, v, mesh: Optional[Mesh] = None,
         raise ValueError(f"seq len {q.shape[1]} not divisible by seq "
                          f"axis {sp}")
     fn = functools.partial(ulysses_attention_sharded, causal=causal,
-                           scale=scale)
+                           scale=scale, block=block)
     spec = P(None, SEQ_AXIS, None, None)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={SEQ_AXIS})(q, k, v)

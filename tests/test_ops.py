@@ -32,6 +32,30 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
+    def test_block_512_parity(self):
+        """The bench --flash-block 512 A/B rung's tile config is
+        numerically identical to the default — fwd AND grad, since the
+        rung trains (the bwd kernels' diag bounds must hold at 512)."""
+        q, k, v = self._qkv(T=512)
+        o = flash_attention(q, k, v, causal=True, block_q=512, block_k=512)
+        o_ref = causal_attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=512, block_k=512) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(causal_attention_reference(q, k, v) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
     def test_noncausal_parity(self):
         q, k, v = self._qkv(T=128)
         o = flash_attention(q, k, v, causal=False)
