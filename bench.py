@@ -129,6 +129,11 @@ def train_phase_name(args, *, seq_suffix: bool = False,
                      partial: bool = False) -> str:
     """The one assembly point for train-phase record names — the salvage
     store and baseline matching key on these strings."""
+    # record the EFFECTIVE flash block, not the requested one: fit()
+    # clamps block > seq down, and the knob is dead under --no-flash —
+    # the label must describe what actually ran (salvage/baseline keys)
+    eff_block = (0 if args.no_flash or not args.flash_block
+                 else min(args.flash_block, args.seq))
     name = (f"train-{args.preset}"
             + (f"-moe{args.experts}" if args.experts else "")
             + ("-micro" if args.adaptive_steps else "")
@@ -136,7 +141,7 @@ def train_phase_name(args, *, seq_suffix: bool = False,
             + ("-noremat" if args.no_remat else "")
             + ("-offload" if args.offload else "")
             + (f"-{args.grad_acc_dtype}acc" if args.grad_acc_dtype else "")
-            + (f"-b{args.flash_block}" if args.flash_block else ""))
+            + (f"-b{eff_block}" if eff_block else ""))
     if seq_suffix:
         name += f"-seq{args.seq}"
     if partial:

@@ -36,8 +36,12 @@ class TestFlashAttention:
     def test_block_512_parity(self):
         """The bench --flash-block 512 A/B rung's tile config is
         numerically identical to the default — fwd AND grad, since the
-        rung trains (the bwd kernels' diag bounds must hold at 512)."""
-        q, k, v = self._qkv(T=512)
+        rung trains. T=1024 gives 2 blocks per axis so the causal bounds
+        (fwd diag_start/num_kb, bwd first_qb/diag_end) are exercised in
+        both the unmasked below-diagonal loop and the masked diagonal
+        loop at the non-default tile, not just the degenerate 1-block
+        case."""
+        q, k, v = self._qkv(T=1024)
         o = flash_attention(q, k, v, causal=True, block_q=512, block_k=512)
         o_ref = causal_attention_reference(q, k, v)
         np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
