@@ -174,3 +174,16 @@ def test_run_phase_streams_child_stderr_to_file(bench, monkeypatch,
     errpath = tmp_path / f"bench_phase_crash-test.{os.getpid()}.err"
     err = errpath.read_text(errors="replace")
     assert "no-such-preset" in err  # the child's ValueError traceback
+
+
+def test_relay_triage_structure(bench):
+    """diagnose_relay always yields a structured verdict with an explicit
+    repair record (VERDICT r3 #3) regardless of relay state."""
+    t = bench.diagnose_relay()
+    assert t["state_at_start"] in ("healthy", "wedged", "dead")
+    assert isinstance(t["relay_pids"], list)
+    rep = t["repair"]
+    assert {"attempted", "repaired"} <= set(rep)
+    if t["state_at_start"] != "healthy":
+        assert rep["possible_in_sandbox"] is False and rep["reason"]
+    assert isinstance(bench._relay_client_pids(), list)
