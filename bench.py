@@ -602,6 +602,54 @@ def phase_flash_compile(args) -> dict:
     return out
 
 
+def phase_profile(args) -> dict:
+    """Committed stall ranking (VERDICT r3 #2): capture an xprof trace of
+    the flagship 350m train step via scripts/profile_step.py and persist
+    the top device-op self-times into the salvage store, so ANY healthy
+    window yields the ranked-op artifact without manual driving."""
+    import shutil
+    trace_dir = os.path.join(tempfile.gettempdir(),
+                             f"dstpu_trace_{os.getpid()}")
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts", "profile_step.py"),
+           "--preset", "gpt2-350m", "--micro", "8", "--seq", "1024",
+           "--steps", "3", "--top", "12", "--trace-dir", trace_dir]
+    log("profile phase: " + " ".join(cmd[1:]))
+    # own timeout UNDER run_phase's (passed via env): if run_phase killed
+    # this child at the cap, the grandchild would orphan mid-compile
+    # against the relay — the wedge scenario
+    outer = float(os.environ.get("DSTPU_PHASE_TIMEOUT_S", "510"))
+    inner = max(60.0, min(480.0, outer - 30.0))
+    try:
+        # grandchild stderr inherits this child's stderr — run_phase
+        # streams it to the tail-able bench_phase_*.err file
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=None,
+                              timeout=inner)
+        if proc.returncode != 0:
+            return {"phase": "profile-350m",
+                    "error": f"capture rc={proc.returncode} (see phase "
+                             "stderr file)"}
+        # stdout = logger preamble (the package logger streams to
+        # stdout) + one indent=1 JSON blob at the end
+        raw = proc.stdout.decode(errors="replace")
+        start = raw.rfind("\n{\n")
+        rep = json.loads(raw[start + 1:] if start != -1 else raw)
+    except subprocess.TimeoutExpired:
+        return {"phase": "profile-350m",
+                "error": f"capture timeout ({inner:.0f}s)"}
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)  # traces are large
+    return {
+        "phase": "profile-350m",
+        "device_total_us": round(rep.get("device_total_us", 0.0), 1),
+        "by_category": rep.get("by_category", {}),
+        # full fusion names: truncation could collide two distinct ops
+        # and silently drop one from the ranked artifact
+        "top_ops": dict(list(rep.get("by_op", {}).items())[:12]),
+    }
+
+
 def phase_mxu_peak(args) -> dict:
     """Raw MXU ceiling: chained dependent bf16 matmuls (8192^3), one
     sync. Calibrates what 'peak' means through this relay/chip so model
@@ -710,6 +758,9 @@ PHASES = {
     "train-350m-flash-seq4k-b512": (["--preset", "gpt2-350m", "--seq",
                                      "4096", "--micro", "1",
                                      "--flash-block", "512"], 480),
+    # xprof stall ranking of the flagship step — the committed artifact
+    # VERDICT r3 #2 asks for, captured automatically in a healthy window
+    "profile-350m": ([], 600),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
     # score tensor per head)
@@ -774,7 +825,8 @@ DEFAULT_ORDER = [
     "train-moe-125m-e8", "train-1.3b-bf16acc", "train-1.3b-bf16acc-mb4",
     "train-350m-flash-mb8", "train-bert-large", "inference",
     "train-350m-flash-seq4k", "train-350m-flash-seq8k",
-    "train-350m-flash-mb8-gas4", "train-1.3b-gas128", "train-125m",
+    "train-350m-flash-mb8-gas4", "profile-350m", "train-1.3b-gas128",
+    "train-125m",
     "train-350m-flash", "train-350m-noflash", "train-350m-flash-noremat",
     "train-350m-noremat", "train-350m-noflash-seq4k",
     "train-350m-flash-seq4k-b512", "flash-compile",
@@ -990,8 +1042,13 @@ def run_phase(name: str, budget_left: float, adaptive: bool = False):
         except OSError:  # unwritable tempdir must not abort the phase
             errf = open(os.devnull, "wb")
         with errf:
-            proc = subprocess.run(cmd, stdout=subprocess.PIPE,
-                                  stderr=errf, timeout=timeout)
+            proc = subprocess.run(
+                cmd, stdout=subprocess.PIPE, stderr=errf, timeout=timeout,
+                # children that spawn their own workers (profile-350m)
+                # bound those UNDER this cap so a cap kill cannot orphan
+                # a grandchild mid-compile against the relay
+                env={**os.environ,
+                     "DSTPU_PHASE_TIMEOUT_S": str(int(timeout))})
     except subprocess.TimeoutExpired as e:
         sys.stderr.write(read_err())
         # the phase may have printed a '-partial' warm-step record before
@@ -1085,6 +1142,7 @@ def main() -> None:
               phase_train_bert if args.phase == "train-bert-large" else
               phase_flash_compile if args.phase == "flash-compile" else
               phase_mxu_peak if args.phase == "mxu-peak" else
+              phase_profile if args.phase == "profile-350m" else
               phase_train)
         print(json.dumps(fn(args)), flush=True)
         return
