@@ -129,11 +129,19 @@ def train_phase_name(args, *, seq_suffix: bool = False,
                      partial: bool = False) -> str:
     """The one assembly point for train-phase record names — the salvage
     store and baseline matching key on these strings."""
-    # record the EFFECTIVE flash block, not the requested one: fit()
-    # clamps block > seq down, and the knob is dead under --no-flash —
-    # the label must describe what actually ran (salvage/baseline keys)
-    eff_block = (0 if args.no_flash or not args.flash_block
-                 else min(args.flash_block, args.seq))
+    # record the EFFECTIVE flash block, not the requested one: the
+    # kernel shrinks to the largest power-of-two fraction >= 128 that
+    # tiles seq (not a plain min — block 512 at seq 768 actually runs
+    # 256), and the knob is dead under --no-flash — the label must
+    # describe what actually ran (salvage/baseline keys). Import is
+    # lazy: only phase children call this; the watcher parent stays
+    # jax-free for cheap relay polling.
+    if args.no_flash or not args.flash_block:
+        eff_block = 0
+    else:
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            effective_block)
+        eff_block = effective_block(args.flash_block, args.seq)
     name = (f"train-{args.preset}"
             + (f"-moe{args.experts}" if args.experts else "")
             + ("-micro" if args.adaptive_steps else "")

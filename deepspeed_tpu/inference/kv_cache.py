@@ -43,7 +43,10 @@ def auto_max_tokens(num_layers: int, batch: int, num_kv_heads: int,
     many cache tokens per sequence fit the accelerator's CURRENTLY free
     memory, minus a safety reserve for activations/compile workspace.
     Returns ``None`` when the backend reports no memory stats (CPU tests,
-    interpret mode) — callers fall back to the explicit default.
+    interpret mode) — callers fall back to the explicit default. Raises
+    when stats exist but free memory cannot hold even a 128-token cache:
+    silently clamping up would defer the failure to an opaque OOM at
+    cache allocation.
 
     ``shard_factor``: how many ways the cache's sharded dims (kv-heads
     over ``tensor``, S over ``seq``) divide across devices — each device
@@ -58,8 +61,19 @@ def auto_max_tokens(num_layers: int, batch: int, num_kv_heads: int,
     per_token = (num_layers * 2 * num_kv_heads * head_dim
                  * jnp.dtype(dtype).itemsize * batch
                  ) // max(int(shard_factor), 1)
-    tokens = int(free * (1.0 - reserve_fraction)) // max(per_token, 1)
-    return max(128, (tokens // 128) * 128)
+    tokens = (int(free * (1.0 - reserve_fraction)) // max(per_token, 1)
+              // 128) * 128
+    if tokens < 128:
+        # Clamping up to 128 here would pass the budget check and then
+        # die at cache allocation with an opaque OOM; the 'auto' path
+        # owes the caller the loud, knob-naming error instead.
+        raise RuntimeError(
+            "max_out_tokens='auto': free accelerator memory "
+            f"({free / 2**20:.0f} MiB of {limit / 2**20:.0f} MiB limit) "
+            f"cannot hold even a 128-token KV cache at {per_token} "
+            "bytes/token — reduce batch/model size, free memory, or set "
+            "max_out_tokens explicitly")
+    return tokens
 
 
 def init_cache(num_layers: int, batch: int, max_seq: int, num_kv_heads: int,

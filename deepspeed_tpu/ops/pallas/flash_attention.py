@@ -34,6 +34,20 @@ DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
 
+def effective_block(block: int, seq: int) -> int:
+    """Largest power-of-two fraction of the requested ``block`` >= 128
+    that tiles ``seq`` exactly (callers gate on seq % 128 == 0, so 128
+    always fits; the 256 default would otherwise reject seq = 384, 640,
+    ...). Never shrinks below 128 — smaller tiles don't fit the MXU; a
+    seq that defeats even 128 still errors in flash_attention, as
+    before. Pure int math, shared with bench.py's record labeling so
+    salvage/baseline keys always name the block that actually ran."""
+    b = min(block, seq)
+    while b > 128 and seq % b:
+        b //= 2
+    return b
+
+
 def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -389,19 +403,8 @@ def flash_attention(q, k, v, causal: bool = True,
     if H % HKV:
         raise ValueError(f"q heads {H} not divisible by kv heads {HKV}")
 
-    def fit(b: int) -> int:
-        # largest power-of-two fraction of the requested block ≥ 128 that
-        # tiles T exactly (callers gate on T % 128 == 0, so 128 always
-        # fits; the 256 default would otherwise reject T = 384, 640, ...).
-        # Never shrinks below 128 — smaller tiles don't fit the MXU; a T
-        # that defeats even 128 still errors below, as before.
-        b = min(b, T)
-        while b > 128 and T % b:
-            b //= 2
-        return b
-
-    block_q = fit(block_q)
-    block_k = fit(block_k)
+    block_q = effective_block(block_q, T)
+    block_k = effective_block(block_k, T)
     if T % block_q or T % block_k:
         raise ValueError(f"seq len {T} not divisible by blocks "
                          f"({block_q}, {block_k})")

@@ -40,6 +40,7 @@ from deepspeed_tpu.runtime.precision import (PRECISION_DTYPES, LossScaleState,
                                              cast_tree, grads_finite,
                                              make_loss_scale,
                                              update_loss_scale)
+from deepspeed_tpu.runtime.utils import clip_coef
 from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import ThroughputTimer
@@ -639,7 +640,7 @@ class DeepSpeedEngine:
                 gnorm = gnorm_raw * inv
                 factor = inv
                 if clip > 0.0:
-                    factor = inv * jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                    factor = inv * clip_coef(clip, gnorm)
                 grads = jax.tree.map(
                     lambda g: (g * factor).astype(g.dtype), grads)
                 return grads, mean_loss, aux_mean, gnorm, jnp.bool_(True)
@@ -654,7 +655,7 @@ class DeepSpeedEngine:
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads)))
             if clip > 0.0:
-                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                coef = clip_coef(clip, gnorm)
                 grads = jax.tree.map(lambda g: g * coef, grads)
             return grads, mean_loss, aux_mean, gnorm, finite
 
@@ -766,7 +767,7 @@ class DeepSpeedEngine:
             gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
                                  for g in jax.tree.leaves(grads)))
             if clip > 0.0:
-                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                coef = clip_coef(clip, gnorm)
                 grads = jax.tree.map(lambda g: g * coef, grads)
             new_state, lr = apply_update(state, grads)
             metrics = {"loss": jax.lax.pmean(mean_loss, axes),
@@ -952,7 +953,7 @@ class DeepSpeedEngine:
             gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
                                  for g in jax.tree.leaves(grads)))
             if clip > 0.0:
-                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                coef = clip_coef(clip, gnorm)
                 grads = jax.tree.map(lambda g: g * coef, grads)
             new_state, lr = apply_update(state, grads)
             metrics = {"loss": mean_loss, "grad_norm": gnorm, "lr": lr,
@@ -1438,7 +1439,7 @@ class DeepSpeedEngine:
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads)))
             if clip > 0.0:
-                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                coef = clip_coef(clip, gnorm)
                 grads = jax.tree.map(lambda g: g * coef, grads)
             lr = schedule(state.step)
             master = state.master if mixed else state.params

@@ -19,7 +19,7 @@ from deepspeed_tpu.parallel.pipe.module import (partition_balanced,
 from deepspeed_tpu.runtime.precision import grads_finite
 from deepspeed_tpu.utils.memory import see_memory_usage
 
-__all__ = ["clip_grad_norm_", "global_norm", "CheckOverflow",
+__all__ = ["clip_grad_norm_", "clip_coef", "global_norm", "CheckOverflow",
            "grads_finite", "partition_uniform", "partition_balanced",
            "see_memory_usage"]
 
@@ -40,8 +40,24 @@ def clip_grad_norm_(tree: Any, max_norm: float,
     """Pure analog of ``clip_grad_norm_`` (runtime/utils.py): returns
     ``(clipped_tree, pre_clip_norm)`` instead of mutating."""
     norm = global_norm(tree, norm_type)
-    coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    coef = clip_coef(max_norm, norm)
     return jax.tree.map(lambda g: (g * coef).astype(g.dtype), tree), norm
+
+
+def clip_coef(clip: float, gnorm: jax.Array) -> jax.Array:
+    """Global-norm clip coefficient, gated on the norm not being NaN: a
+    NaN grad leaf makes gnorm NaN, and an unguarded clip/(gnorm+eps)
+    would fold NaN into EVERY leaf of the grad tree — converting a
+    localized blow-up into a fully-poisoned update (and, on non-fp16
+    paths with no overflow skip, fully-NaN params). A NaN norm leaves
+    the grads unscaled so the damage stays localized and gnorm still
+    reports it. An INF norm (finite-but-huge grads) keeps the plain
+    formula: clip/inf -> coef 0 zeroes the update, the conservative
+    pre-existing behavior clipping exists to give. (ADVICE r4,
+    engine.py:645.)"""
+    return jnp.where(jnp.isnan(gnorm),
+                     jnp.float32(1.0),
+                     jnp.minimum(1.0, clip / (gnorm + 1e-6)))
 
 
 class CheckOverflow:

@@ -86,6 +86,28 @@ def test_mxu_peak_and_chained_flash_trace():
     assert jax.eval_shape(mm, a, a).shape == (512, 512)
 
 
+def test_train_phase_name_mirrors_flash_fit():
+    """ADVICE r4: flash fit() shrinks the block to the largest
+    power-of-two fraction >= 128 that tiles seq — NOT a plain min — so
+    the record label must apply the same halving loop, or block 512 at
+    seq 768 (actually running 256) would alias two tile configs under
+    one salvage/baseline key."""
+    import argparse
+    import bench
+
+    def mk(**kw):
+        base = dict(preset="gpt2-350m", experts=0, adaptive_steps=False,
+                    no_flash=False, no_remat=False, offload=False,
+                    grad_acc_dtype=None, flash_block=512, seq=768)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    assert bench.train_phase_name(mk()).endswith("-b256")      # 768 % 512
+    assert bench.train_phase_name(mk(seq=1024)).endswith("-b512")
+    assert bench.train_phase_name(mk(seq=256)).endswith("-b256")  # clamp
+    assert "-b" not in bench.train_phase_name(mk(no_flash=True))
+
+
 def test_default_order_covers_all_phases_exactly():
     """DEFAULT_ORDER must stay in lockstep with PHASES — a phase missing
     from the order silently never runs in driver windows."""

@@ -244,3 +244,16 @@ def test_auto_max_out_tokens_sizes_from_memory_stats(monkeypatch):
     assert abs(t - (expect // 128) * 128) <= 128
     # the engine budget now follows the (fake) free memory
     assert eng._max_out_budget(batch=1) > 1024
+
+    # ADVICE r4: when free memory can't hold even a 128-token cache the
+    # 'auto' path must fail loudly naming the knob, not clamp up to 128
+    # and die later in an opaque cache-allocation OOM
+    class TinyAcc:
+        def memory_stats(self, device_index=None):
+            return {"bytes_limit": 1024, "bytes_in_use": 0}
+
+    monkeypatch.setattr(ra, "get_accelerator", lambda: TinyAcc())
+    monkeypatch.setattr("deepspeed_tpu.accelerator.get_accelerator",
+                        lambda: TinyAcc())
+    with pytest.raises(RuntimeError, match="max_out_tokens"):
+        kvc.auto_max_tokens(2, 1, 2, 16, jnp.float32)

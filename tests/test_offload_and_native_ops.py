@@ -352,6 +352,40 @@ def test_offload_bf16_grad_accum_matches_fp32():
     np.testing.assert_allclose(b16, base, rtol=5e-3, atol=5e-3)
 
 
+def test_native_acc_clip_keeps_nonfinite_localized():
+    """ADVICE r4: a NaN grad leaf makes gnorm NaN, and the fused bf16
+    unscale+clip used to fold clip/(NaN+eps) into EVERY leaf before the
+    tree streamed to the host optimizer. Leaf "a" has a structurally
+    zero grad — it must stay exactly zero while "b" carries the
+    non-finite grad and gnorm reports it."""
+    import deepspeed_tpu
+
+    def loss_fn(params, batch, rng):
+        bad = jnp.sum(params["b"] * batch["x"] * jnp.inf)  # 0*inf -> NaN
+        return bad + 0.0 * jnp.sum(params["a"])
+
+    params = {"a": jnp.ones((4,), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+    ds = {"train_micro_batch_size_per_gpu": 2,
+          "gradient_accumulation_steps": 2,
+          "gradient_clipping": 1.0,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "bf16": {"enabled": True},
+          "data_types": {"grad_accum_dtype": "bf16"},
+          "zero_optimization": {"stage": 1,
+                                "offload_optimizer": {"device": "cpu"}}}
+    eng, _, _, _ = deepspeed_tpu.initialize(loss_fn=loss_fn,
+                                            model_parameters=params,
+                                            config=ds)
+    batch = {"x": jnp.zeros((eng.train_batch_size, 4), jnp.float32)}
+    eng._compile_offload_grad_fn(batch)
+    grads, metrics = eng._offload_grad_fn(
+        eng.state.params, jnp.float32(1.0), batch, jax.random.PRNGKey(0))
+    assert not np.isfinite(float(metrics["grad_norm"]))
+    ga = np.asarray(grads["a"], np.float32)
+    assert np.all(ga == 0.0), "global clip factor NaNed a finite leaf"
+
+
 def test_offload_grad_fn_emits_native_acc_dtype():
     """The compiled offload grad producer's output avals are bf16 when
     grad_accum_dtype=bf16 (the memory/D2H saving is real, not a cast at
