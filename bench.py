@@ -139,9 +139,15 @@ def train_phase_name(args, *, seq_suffix: bool = False,
     if args.no_flash or not args.flash_block:
         eff_block = 0
     else:
-        from deepspeed_tpu.ops.pallas.flash_attention import (
-            effective_block)
-        eff_block = effective_block(args.flash_block, args.seq)
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                effective_block)
+            eff_block = effective_block(args.flash_block, args.seq)
+        except ImportError:
+            # pallas unavailable: attention.py degrades to the reference
+            # path with the requested block a no-op — label with the
+            # clamped request rather than crash the (OOM-)record path
+            eff_block = min(args.flash_block, args.seq)
     name = (f"train-{args.preset}"
             + (f"-moe{args.experts}" if args.experts else "")
             + ("-micro" if args.adaptive_steps else "")

@@ -38,13 +38,19 @@ def effective_block(block: int, seq: int) -> int:
     """Largest power-of-two fraction of the requested ``block`` >= 128
     that tiles ``seq`` exactly (callers gate on seq % 128 == 0, so 128
     always fits; the 256 default would otherwise reject seq = 384, 640,
-    ...). Never shrinks below 128 — smaller tiles don't fit the MXU; a
-    seq that defeats even 128 still errors in flash_attention, as
-    before. Pure int math, shared with bench.py's record labeling so
-    salvage/baseline keys always name the block that actually ran."""
+    ...). A non-power-of-two request whose halvings never land on a
+    divisor of a 128-multiple seq (e.g. 384 into seq 512) snaps to 128 —
+    the MXU-minimum tile every such seq accepts — rather than returning
+    a sub-128 block the kernel can neither run nor should ever label a
+    record with. Ragged seqs (seq % 128 != 0) keep the non-dividing
+    block so flash_attention still rejects them loudly, as before. Pure
+    int math, shared with bench.py's record labeling so salvage/baseline
+    keys always name the block that actually ran."""
     b = min(block, seq)
     while b > 128 and seq % b:
         b //= 2
+    if seq % b and seq % 128 == 0:
+        b = 128
     return b
 
 

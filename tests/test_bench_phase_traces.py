@@ -106,6 +106,11 @@ def test_train_phase_name_mirrors_flash_fit():
     assert bench.train_phase_name(mk(seq=1024)).endswith("-b512")
     assert bench.train_phase_name(mk(seq=256)).endswith("-b256")  # clamp
     assert "-b" not in bench.train_phase_name(mk(no_flash=True))
+    # non-power-of-two request whose halvings miss every divisor snaps
+    # to the 128 floor (the block the kernel actually runs), never to a
+    # fictitious sub-128 tile
+    assert bench.train_phase_name(mk(flash_block=384,
+                                     seq=512)).endswith("-b128")
 
 
 def test_default_order_covers_all_phases_exactly():

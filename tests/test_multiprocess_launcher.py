@@ -28,7 +28,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch(num_procs: int, devs_per_proc: int, tensor: int = 1) -> dict:
+def _launch(num_procs: int, devs_per_proc: int, tensor: int = 1,
+            pipe: int = 0) -> dict:
     env = os.environ.copy()
     # the worker sets its own per-process device count; the pytest
     # conftest's 8-device flag must not leak in
@@ -43,6 +44,9 @@ def _launch(num_procs: int, devs_per_proc: int, tensor: int = 1) -> dict:
     env["PYTHONPATH"] = os.path.abspath(ROOT)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["DSTPU_WORKER_TENSOR"] = str(tensor)
+    env.pop("DSTPU_WORKER_PIPE", None)  # scrub stale leak like the rest
+    if pipe:
+        env["DSTPU_WORKER_PIPE"] = str(pipe)
     cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
            "--nnodes", "1", "--node_rank", "0",
            "--master_addr", "127.0.0.1",
@@ -92,3 +96,27 @@ def test_cross_process_tensor_parallel_matches_single_process():
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(multi["param_sq_norm"],
                                single["param_sq_norm"], rtol=1e-5)
+
+
+def test_cross_process_compiled_pipeline_matches_single_process():
+    """The compiled scan+ppermute pipeline (the multi-host production
+    path, parallel/pipe/pipeline.py) with the PIPE axis spanning two OS
+    processes: each stage handoff and its AD-transposed grad hop is a
+    real cross-process ppermute (VERDICT r4 #6; reference
+    runtime/pipe/engine.py:1359 drives the same schedule over NCCL
+    process groups). Asserts loss/param parity against the identical
+    4-stage pipeline packed into one process, that training descends,
+    and that a ms/step number is recorded."""
+    multi = _launch(num_procs=2, devs_per_proc=2, pipe=4)
+    single = _launch(num_procs=1, devs_per_proc=4, pipe=4)
+
+    assert multi["process_count"] == 2 and multi["device_count"] == 4
+    assert multi["pipe"] == 4
+    assert single["process_count"] == 1 and single["device_count"] == 4
+
+    np.testing.assert_allclose(multi["losses"], single["losses"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(multi["param_sq_norm"],
+                               single["param_sq_norm"], rtol=1e-5)
+    assert multi["losses"][-1] < multi["losses"][0]  # SGD descends
+    assert multi["ms_per_step"] > 0
