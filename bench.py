@@ -658,6 +658,9 @@ def phase_profile(args) -> dict:
         "phase": "profile-350m",
         "device_total_us": round(rep.get("device_total_us", 0.0), 1),
         "by_category": rep.get("by_category", {}),
+        # measured time per model block (r5: HLO-proto op_name join —
+        # the reference profiler's per-module attribution, from xprof)
+        "by_module": dict(list(rep.get("by_module", {}).items())[:16]),
         # full fusion names: truncation could collide two distinct ops
         # and silently drop one from the ranked artifact
         "top_ops": dict(list(rep.get("by_op", {}).items())[:12]),

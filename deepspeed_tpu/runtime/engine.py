@@ -358,6 +358,7 @@ class DeepSpeedEngine:
             from deepspeed_tpu.profiling import FlopsProfiler
             self.flops_profiler = FlopsProfiler(
                 self, profile_step=config.flops_profiler.profile_step,
+                detailed=config.flops_profiler.detailed,
                 output_file=config.flops_profiler.output_file)
         # MoQ: quantize-in-step (reference engine.py:1400 _configure_
         # quantization + :2078 quantizer.quantize in _take_model_step)
@@ -1197,8 +1198,24 @@ class DeepSpeedEngine:
                 cost = cost[0] if cost else {}
             n_params = sum(int(np.prod(p.shape))
                            for p in jax.tree.leaves(self.state.params))
+            breakdown = None
+            if self.flops_profiler.detailed:
+                # reference per-module tree (forward attribution via
+                # flax named_scope paths in the jaxpr); profiling must
+                # never kill a training step, hence the broad guard
+                try:
+                    from deepspeed_tpu.profiling.flops_profiler import (
+                        module_flops_breakdown)
+                    md = self.config.flops_profiler.module_depth
+                    breakdown = module_flops_breakdown(
+                        lambda p_: self.loss_fn(p_, batch, rng),
+                        self.state.params,
+                        depth=None if md < 0 else md)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(f"per-module breakdown failed: {e}")
             self.flops_profiler.stop_profile(
-                flops=float(cost.get("flops", 0.0)), params=n_params)
+                flops=float(cost.get("flops", 0.0)), params=n_params,
+                module_breakdown=breakdown)
             self.flops_profiler.print_model_profile()
         self.global_steps += 1
         self._micro_steps += self.gas

@@ -198,7 +198,21 @@ def generate() -> str:
     emit_model(buf, "amp", C.AMPConfig)
     emit_model(buf, "data_types", C.DataTypesConfig)
     emit_model(buf, "eigenvalue", C.EigenvalueConfig)
-    emit_model(buf, "flops_profiler", C.FlopsProfilerConfig)
+    emit_model(
+        buf, "flops_profiler", C.FlopsProfilerConfig,
+        note=("With `detailed: true` (the default) the profile step also "
+              "prints the reference-style **per-module table** (forward "
+              "FLOPs, share of total, params per module). The TPU-native "
+              "module boundary is the flax `named_scope` path in the "
+              "jaxpr — `module_flops_breakdown()` walks the jaxpr "
+              "(recursing through `pjit`/`remat`/`scan`, scaling scan "
+              "bodies by trip count) and groups analytic per-equation "
+              "FLOPs by module path; rows sum exactly to the printed "
+              "TOTAL. The same breakdown is available standalone via "
+              "`get_model_profile(..., per_module_depth=N)` → "
+              "`prof[\"module_breakdown\"]` / `prof[\"module_table\"]` "
+              "(`profiling/flops_profiler.py`; reference "
+              "`flops_profiler/profiler.py`'s torch-hook module tree)."))
     emit_model(buf, "comms_logger", C.CommsLoggerConfig)
     emit_model(buf, "tensorboard", C.TensorBoardConfig)
     emit_model(buf, "wandb", C.WandbConfig)

@@ -72,7 +72,11 @@ def test_int8_weight_quant_error_bound(rows, cols, group, seed):
     qw = quantize_weight(w, group_size=group)
     err = np.abs(np.asarray(dequantize_weight(qw)) - w)
     scale = np.asarray(qw["scale"])        # [rows, 1]
-    assert np.all(err <= scale / 2 + 1e-7)
+    # slack scales with |w|: q*scale and the absmax/127 division each
+    # round in fp32 (~eps*|w|), which at |w|~40 exceeds a fixed 1e-7
+    # (hypothesis found seed 180: violation 3e-7 at |w|=14 — rounding,
+    # not a quantizer bug)
+    assert np.all(err <= scale / 2 + 1e-6 * np.abs(w) + 1e-7)
 
 
 # ----------------------------------------------------- int8 gemm bound
