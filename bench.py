@@ -659,12 +659,117 @@ def phase_profile(args) -> dict:
         "device_total_us": round(rep.get("device_total_us", 0.0), 1),
         "by_category": rep.get("by_category", {}),
         # measured time per model block (r5: HLO-proto op_name join —
-        # the reference profiler's per-module attribution, from xprof)
-        "by_module": dict(list(rep.get("by_module", {}).items())[:16]),
+        # the reference profiler's per-module attribution, from xprof).
+        # NO cap: the flagship has 24 near-equal blocks and a truncated
+        # table would hide exactly the per-block imbalance it exists for
+        "by_module": rep.get("by_module", {}),
         # full fusion names: truncation could collide two distinct ops
         # and silently drop one from the ranked artifact
         "top_ops": dict(list(rep.get("by_op", {}).items())[:12]),
     }
+
+
+def phase_autotune(args) -> dict:
+    """VERDICT r4 #8: a REAL autotune session on hardware — search
+    micro-batch x flash-block on the flagship 350m preset at the
+    flagship's zero-3 (on the single bench chip the stage axis is
+    degenerate — dp=1 makes every stage the same sharding — and a stage
+    sweep would blow the phase budget; the stage axis is covered by
+    test_autotuner_picks_best), and persist the measured winner plus its
+    delta vs the hand-picked ``train-350m-flash-mb8`` config (micro 8,
+    block 256, zero-3), itself measured explicitly first so an arm-skip
+    can never drop the comparison point. The hand config is a grid
+    point, so the tuned result can only tie or beat it (up to step
+    noise). Reference bar: ``autotuning/README.md:404-415`` — 69.06
+    autotuned vs 56.80 hand-tuned samples/s."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
+
+    seq = 1024
+    n_chips = jax.device_count()
+    log(f"autotune: backend={jax.default_backend()} chips={n_chips}")
+
+    def engine_builder(ds_cfg, flash_block=256):
+        cfg = config_for("gpt2-350m", n_positions=seq,
+                         dtype=jnp.bfloat16, remat=True,
+                         use_flash_attention=True,
+                         flash_block=flash_block)
+        model = GPT2LMModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), batch_size=1,
+                            seq_len=128)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=ds_cfg)
+        return eng
+
+    data_rng = np.random.default_rng(0)
+
+    def batch_builder(global_bs):
+        return {"input_ids": jnp.asarray(
+            data_rng.integers(0, 50257, size=(global_bs, seq)),
+            jnp.int32)}
+
+    base = {"bf16": {"enabled": True},
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}}}
+    # stage fixed at the flagship's zero-3: the bench chip is single
+    # (dp=1 makes every stage the same sharding), and a (1,2,3) sweep
+    # would triple the grid past the phase's 1800s cap. The stage axis
+    # itself is exercised by test_autotuner_picks_best.
+    tuner = Autotuner(
+        engine_builder, batch_builder, base,
+        micro_batches=(4, 8, 16), zero_stages=(3,),
+        extra_dims={"flash_block": (256, 512)},
+        num_steps=3, warmup_steps=1)
+
+    # measure the hand-picked config FIRST and explicitly: inside the
+    # grid a micro-4 failure would arm-skip micro 8 and silently drop
+    # the phase's stated deliverable (delta vs train-350m-flash-mb8)
+    hand_cfg = tuner._trial_config(3, 8, None)
+    hand_metrics = tuner._run_trial(hand_cfg, {"flash_block": 256})
+    log(f"hand config (micro 8, b256, z3): {hand_metrics}")
+
+    out = tuner.tune()
+
+    fpt = GPT2LMModel(config_for(
+        "gpt2-350m", n_positions=seq)).flops_per_token()
+
+    def to_tf(rec):
+        # Autotuner throughput = sequences/s (global batch / step time)
+        return rec["throughput"] * seq / n_chips * fpt / 1e12
+
+    measured = [r for r in out["results"] if r.get("metrics")]
+    best_tf = to_tf(out["best_metrics"])
+    rec = {
+        "phase": "autotune-350m",
+        "best_label": {k: v for k, v in out["best_label"].items()
+                       if k != "mesh"},
+        "best_tflops_per_chip": round(best_tf, 2),
+        "best_tokens_per_sec_per_chip": round(
+            out["best_metrics"]["throughput"] * seq / n_chips, 1),
+        "trials_measured": len(measured),
+        "trials_failed": len([r for r in out["results"]
+                              if r.get("metrics") is None
+                              and "skipped" not in r]),
+        "trials_skipped": len([r for r in out["results"]
+                               if "skipped" in r]),
+        "trial_table": [
+            {"micro": r["micro_batch"], "flash_block": r["flash_block"],
+             "zero_stage": r["zero_stage"],
+             "tflops_per_chip": round(to_tf(r["metrics"]), 2)}
+            for r in measured],
+    }
+    if hand_metrics is not None:
+        hand_tf = to_tf(hand_metrics)
+        rec["hand_tflops_per_chip"] = round(hand_tf, 2)
+        rec["delta_vs_hand_pct"] = round(100 * (best_tf / hand_tf - 1), 2)
+    else:
+        rec["hand_config_failed"] = True  # comparison point itself OOMed
+    return rec
 
 
 def phase_mxu_peak(args) -> dict:
@@ -778,6 +883,10 @@ PHASES = {
     # xprof stall ranking of the flagship step — the committed artifact
     # VERDICT r3 #2 asks for, captured automatically in a healthy window
     "profile-350m": ([], 600),
+    # measured autotune session (VERDICT r4 #8): micro x flash-block
+    # grid on the flagship preset, winner + delta vs the hand config
+    # persisted. 6 trials x (compile + 3 steps) — late in the order
+    "autotune-350m": ([], 1800),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
     # score tensor per head)
@@ -846,7 +955,7 @@ DEFAULT_ORDER = [
     "train-125m",
     "train-350m-flash", "train-350m-noflash", "train-350m-flash-noremat",
     "train-350m-noremat", "train-350m-noflash-seq4k",
-    "train-350m-flash-seq4k-b512", "flash-compile",
+    "train-350m-flash-seq4k-b512", "autotune-350m", "flash-compile",
 ]
 
 INFRA = {"relay_probes_ok": 0, "relay_probes_failed": 0,
@@ -1160,6 +1269,7 @@ def main() -> None:
               phase_flash_compile if args.phase == "flash-compile" else
               phase_mxu_peak if args.phase == "mxu-peak" else
               phase_profile if args.phase == "profile-350m" else
+              phase_autotune if args.phase == "autotune-350m" else
               phase_train)
         print(json.dumps(fn(args)), flush=True)
         return

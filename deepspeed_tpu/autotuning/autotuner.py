@@ -107,13 +107,21 @@ class Autotuner:
                  tuner_type: str = "gridsearch",
                  max_trials: Optional[int] = None,
                  tuner_seed: int = 0,
-                 resource_manager=None):
+                 resource_manager=None,
+                 extra_dims: Optional[Dict[str, Tuple]] = None):
         """``engine_builder(config_dict) -> engine`` builds a fresh engine;
         ``batch_builder(global_batch_size) -> batch`` builds a matching
         input batch. ``mesh_shapes``: list of mesh-section dicts to search
         (None → micro/stage-only, the r1 behavior). ``model_info``:
         {param_count, seq_len, hidden, n_layers} enables memory pruning
-        against ``hbm_bytes`` per device."""
+        against ``hbm_bytes`` per device.
+
+        ``extra_dims``: extra MODEL-level search dimensions the ds-config
+        cannot express — e.g. ``{"flash_block": (256, 512)}`` — crossed
+        into the grid; when given, ``engine_builder(config_dict,
+        **extras)`` receives the trial's values (the reference's tuner
+        space is launcher/config-only, autotuner.py:38; kernel-tile
+        knobs are exactly what matters on TPU)."""
         self.engine_builder = engine_builder
         self.batch_builder = batch_builder
         self.base_config = base_config
@@ -133,6 +141,17 @@ class Autotuner:
         # process, isolating the tuner from OOM/compile crashes —
         # reference scheduler.py runs each experiment as a launcher job
         self.resource_manager = resource_manager
+        self.extra_dims = extra_dims or {}
+        if self.extra_dims and resource_manager is not None:
+            # the subprocess scheduler runs a config file; it cannot
+            # carry engine_builder(**extras) — running anyway would
+            # measure the SAME config under every extras label and
+            # report a searched dimension that was never applied
+            raise ValueError(
+                "extra_dims is not supported with resource_manager: "
+                "subprocess trials run from the config dict alone and "
+                "cannot apply model-level extras — run in-process, or "
+                "fold the knob into the config")
         self.results: List[Dict] = []
         self.pruned: List[Dict] = []
 
@@ -168,9 +187,11 @@ class Autotuner:
             mesh or {"data": 1})
         return need <= self.hbm_bytes
 
-    def _run_trial(self, cfg: Dict) -> Optional[Dict]:
+    def _run_trial(self, cfg: Dict,
+                   extras: Optional[Dict] = None) -> Optional[Dict]:
         try:
-            engine = self.engine_builder(cfg)
+            engine = (self.engine_builder(cfg, **extras) if extras
+                      else self.engine_builder(cfg))
             batch = self.batch_builder(engine.train_batch_size)
             for _ in range(self.warmup_steps):
                 engine.train_batch(batch)
@@ -195,19 +216,30 @@ class Autotuner:
         points, arm-ordered (small micro first) so grid search retains
         the OOM/knee early-stop structure."""
         meshes = self.mesh_shapes if self.mesh_shapes is not None else [None]
+        extra_points: List[Dict] = [{}]
+        for dim, values in self.extra_dims.items():
+            extra_points = [{**pt, dim: v}
+                            for pt in extra_points for v in values]
         labels, configs = [], []
         for mesh in meshes:
             for stage in self.zero_stages:
                 for micro in self.micro_batches:
-                    label = {"mesh": mesh, "zero_stage": stage,
-                             "micro_batch": micro}
+                    # fit is extras-independent: check once per point so
+                    # pruned/logs don't inflate with the extras grid.
+                    # Extras innermost keeps each (mesh, stage, extras)
+                    # arm's micro sweep ascending for the knee logic.
                     if not self._predict_fits(stage, micro, mesh):
+                        label = {"mesh": mesh, "zero_stage": stage,
+                                 "micro_batch": micro}
                         self.pruned.append(label)
                         logger.info(f"autotune pruned (memory model): "
                                     f"{label}")
                         continue
-                    labels.append(label)
-                    configs.append(self._trial_config(stage, micro, mesh))
+                    for extras in extra_points:
+                        labels.append({"mesh": mesh, "zero_stage": stage,
+                                       "micro_batch": micro, **extras})
+                        configs.append(
+                            self._trial_config(stage, micro, mesh))
         return labels, configs
 
     def tune(self) -> Dict:
@@ -230,7 +262,11 @@ class Autotuner:
             if i is None:
                 break
             label = labels[i]
-            arm = (repr(label["mesh"]), label["zero_stage"])
+            extras = {k: v for k, v in label.items()
+                      if k not in ("mesh", "zero_stage", "micro_batch")}
+            # the knee/fail sweep structure is per-(everything-but-micro)
+            arm = (repr(label["mesh"]), label["zero_stage"],
+                   tuple(sorted(extras.items())))
             micro = label["micro_batch"]
             if micro >= arm_fail.get(arm, float("inf")):
                 tuner.skip(i)   # budget-free: nothing was measured
@@ -245,7 +281,7 @@ class Autotuner:
             if self.resource_manager is not None:
                 metrics = self.resource_manager.run(configs[i], label)
             else:
-                metrics = self._run_trial(configs[i])
+                metrics = self._run_trial(configs[i], extras or None)
             score = self._score(metrics)
             self.results.append({**label, "metrics": metrics})
             tuner.update(i, score)
@@ -276,6 +312,7 @@ class Autotuner:
         cfg, metrics, label, _ = best
         logger.info(f"autotune best: {label} {metrics}")
         out = {"best_config": cfg, "best_metrics": metrics,
+               "best_label": label,   # incl. extra_dims winners
                "results": self.results, "pruned": self.pruned}
         if self.resource_manager is not None:
             self.resource_manager.write_summary(

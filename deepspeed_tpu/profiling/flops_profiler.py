@@ -36,17 +36,6 @@ def _params_count(tree) -> int:
                if hasattr(x, "shape"))
 
 
-def _cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
-    lowered = jax.jit(fn).lower(*args, **kwargs)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis() or {}
-    if isinstance(cost, (list, tuple)):   # older jax returns [dict]
-        cost = cost[0] if cost else {}
-    return {"flops": float(cost.get("flops", 0.0)),
-            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
-            "compiled": compiled}
-
-
 # ------------------------------------------------------- jaxpr walking
 # Analytic per-equation FLOP estimates. Matmuls/convs carry ~all model
 # FLOPs (the reference's profiler counts the same way: MACs of
@@ -160,7 +149,7 @@ def _walk_modules(jx, prefix: str, mult: float, acc: Dict[str, float]):
 
 
 def module_flops_breakdown(fn: Callable, *args, depth: Optional[int] = 2,
-                           **kwargs) -> Dict[str, float]:
+                           jaxpr=None, **kwargs) -> Dict[str, float]:
     """Per-module analytic FLOPs for one call of ``fn`` — the TPU-native
     analog of the reference profiler's per-module tree
     (``flops_profiler/profiler.py``, torch module hooks): flax's
@@ -169,8 +158,10 @@ def module_flops_breakdown(fn: Callable, *args, depth: Optional[int] = 2,
     ``depth`` collapses paths to their first N segments (``None`` keeps
     full paths). Values sum EXACTLY to the ``""``-keyed aggregate (ops
     outside any named module are keyed by their call-site path, at
-    minimum the empty root)."""
-    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    minimum the empty root). Pass ``jaxpr`` (a ClosedJaxpr, e.g. from
+    ``jax.jit(fn).trace(...).jaxpr``) to reuse an existing trace."""
+    if jaxpr is None:
+        jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
     acc: Dict[str, float] = {}
     _walk_modules(jaxpr.jaxpr, "", 1.0, acc)
     if depth is not None:
@@ -242,8 +233,21 @@ def get_model_profile(fn: Callable, args: Tuple = (), kwargs: Dict = None,
     per-module breakdown table (``per_module_depth=None`` disables;
     reference analog: the profiler's aggregated module tree)."""
     kwargs = kwargs or {}
-    cost = _cost_analysis(fn, *args, **kwargs)
-    compiled = cost.pop("compiled")
+    # ONE trace serves both the compiled cost analysis and the module
+    # walk (jit(fn).trace exposes the jaxpr and lowers from it); older
+    # jax without .trace falls back to the lower-only path
+    closed = None
+    try:
+        traced = jax.jit(fn).trace(*args, **kwargs)
+        closed = traced.jaxpr
+        compiled = traced.lower().compile()
+    except AttributeError:
+        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    c = compiled.cost_analysis() or {}
+    if isinstance(c, (list, tuple)):   # older jax returns [dict]
+        c = c[0] if c else {}
+    cost = {"flops": float(c.get("flops", 0.0)),
+            "bytes_accessed": float(c.get("bytes accessed", 0.0))}
     breakdown = None
     if per_module_depth is not None:
         # never let attribution break the aggregate profile (a custom
@@ -251,8 +255,10 @@ def get_model_profile(fn: Callable, args: Tuple = (), kwargs: Dict = None,
         # jax version drifting a param key) — omit the breakdown instead
         try:
             breakdown = module_flops_breakdown(
-                fn, *args, depth=per_module_depth, **kwargs)
-        except Exception:  # noqa: BLE001
+                fn, *args, depth=per_module_depth, jaxpr=closed, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(f"per-module breakdown failed: {e}")
             breakdown = None
     for _ in range(max(warm_up, 1)):
         out = compiled(*args, **kwargs)

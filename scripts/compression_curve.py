@@ -1,0 +1,271 @@
+"""Measured accuracy-vs-compression curve on real text (VERDICT r4 weak
+#7: compression is breadth-complete but had never been exercised against
+a real workload).
+
+Trains the byte-level GPT-2 of tests/test_real_text_convergence.py on
+the vendored 63 KB English corpus through the full engine stack, then
+measures HELD-OUT eval loss under:
+
+* post-training weight quantization (8/6/4/3/2 bits, groupwise
+  fake-quant — compression/compress.py weight_quantization),
+* magnitude (sparse) pruning at several dense ratios,
+* structured row pruning + ``redundancy_clean`` (physical param drop),
+* one QAT recovery run: continue training WITH 4-bit fake-quant in the
+  loss (straight-through gradients), then eval the quantized view.
+
+Reference analog: the compression suite's accuracy-vs-ratio tables
+(``deepspeed/compression/``; DeepSpeed-Compression blog). Emits one JSON
+line on stdout and (with --write-doc) docs/compression_curve.md.
+
+Usage:  python scripts/compression_curve.py [--steps 300] [--qat-steps 120]
+            [--write-doc]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deepspeed_tpu.testing import pin_platform  # noqa: E402
+
+SEQ = 128
+
+
+def log(msg):
+    print(f"[curve {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def quant_cfg(bits, groups=64):
+    return {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"q": {"params": {
+            "start_bits": bits, "target_bits": bits,
+            "quantize_groups": groups}, "modules": ["*"]}}}}
+
+
+def prune_cfg(kind, dense_ratio):
+    return {kind: {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"p": {"params": {"dense_ratio": dense_ratio},
+                                   "modules": ["attn", "mlp"]}}}}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--qat-steps", type=int, default=120)
+    ap.add_argument("--write-doc", action="store_true")
+    args = ap.parse_args()
+
+    pin_platform(os.environ.get("DSTPU_PLATFORM", "cpu"))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.compression import (apply_compression,
+                                           init_compression,
+                                           redundancy_clean)
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+
+    # ---- data: 90/10 contiguous split of the vendored corpus
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "tests", "data", "real_text.txt")
+    data = np.frombuffer(open(path, "rb").read(), np.uint8).astype(np.int32)
+    n_slices = (len(data) - 1) // SEQ
+    split = int(n_slices * 0.9)
+    train_ix = np.arange(split)
+    eval_ix = np.arange(split, n_slices)
+
+    def batch_of(ix):
+        return {"input_ids": jnp.asarray(
+            np.stack([data[i * SEQ:(i + 1) * SEQ] for i in ix]))}
+
+    model = GPT2LMModel(GPT2Config(
+        n_layer=2, n_embd=128, n_head=4, vocab_size=256, n_positions=SEQ,
+        use_flash_attention=False, remat=False, vocab_pad_multiple=128))
+    params = model.init(jax.random.PRNGKey(0))
+    micro = 16
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": micro,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                "scheduler": {"type": "WarmupLR", "params": {
+                    "warmup_num_steps": 30}},
+                "zero_optimization": {"stage": 0}})
+    gb = eng.train_batch_size
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for step in range(args.steps):
+        ix = rng.choice(train_ix, gb, replace=False)
+        loss = eng.train_batch(batch_of(ix))["loss"]
+        if step % 50 == 0:
+            log(f"train step {step}: loss {float(loss):.3f}")
+    log(f"trained {args.steps} steps in {time.time() - t0:.0f}s")
+    trained = eng.state.params
+
+    # ---- held-out eval under a params view
+    eval_batches = [batch_of(eval_ix[i:i + gb])
+                    for i in range(0, len(eval_ix) - gb + 1, gb)]
+
+    @jax.jit
+    def eval_loss_fn(p, b):
+        return model.loss_fn(p, b, jax.random.PRNGKey(0))
+
+    def eval_loss(p):
+        return float(np.mean([float(eval_loss_fn(p, b))
+                              for b in eval_batches]))
+
+    base = eval_loss(trained)
+    log(f"baseline eval loss {base:.4f} "
+        f"({len(eval_batches)} held-out batches)")
+    curve = {"baseline_eval_loss": round(base, 4),
+             "train_steps": args.steps,
+             "eval_batches": len(eval_batches),
+             "platform": jax.default_backend(),
+             "ptq_bits": {}, "sparse_pruning": {}, "row_pruning": {},
+             "qat": {}}
+
+    # ---- post-training quantization sweep
+    for bits in (8, 6, 4, 3, 2):
+        spec = init_compression(trained, quant_cfg(bits))
+        loss_q = eval_loss(apply_compression(trained, spec, step=0))
+        curve["ptq_bits"][str(bits)] = round(loss_q, 4)
+        log(f"PTQ {bits}-bit: eval {loss_q:.4f} (delta "
+            f"{loss_q - base:+.4f})")
+
+    # ---- magnitude pruning sweep
+    for ratio in (0.8, 0.5, 0.3):
+        spec = init_compression(trained,
+                                prune_cfg("sparse_pruning", ratio))
+        loss_p = eval_loss(apply_compression(trained, spec, step=0))
+        curve["sparse_pruning"][str(ratio)] = round(loss_p, 4)
+        log(f"prune dense={ratio}: eval {loss_p:.4f} (delta "
+            f"{loss_p - base:+.4f})")
+
+    # ---- structured row pruning + physical clean
+    spec = init_compression(trained, prune_cfg("row_pruning", 0.5))
+    masked = apply_compression(trained, spec, step=0)
+    loss_r = eval_loss(masked)
+    cleaned = redundancy_clean(trained, spec)
+    count = lambda t: sum(int(np.prod(x.shape))  # noqa: E731
+                          for x in jax.tree.leaves(t)
+                          if hasattr(x, "shape"))
+    curve["row_pruning"] = {
+        "dense_ratio": 0.5, "eval_loss": round(loss_r, 4),
+        "params_before": count(trained), "params_after": count(cleaned)}
+    log(f"row-prune 0.5: eval {loss_r:.4f}, params "
+        f"{count(trained)} -> {count(cleaned)}")
+
+    # ---- QAT recovery at 4 bits: train WITH the quantized view in the
+    # loss (straight-through), then eval the quantized view
+    qat_bits = 4
+    spec4 = init_compression(trained, quant_cfg(qat_bits))
+
+    def qat_loss(p, b, r):
+        return model.loss_fn(apply_compression(p, spec4, step=0), b, r)
+
+    qeng, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=qat_loss, model_parameters=trained,
+        config={"train_micro_batch_size_per_gpu": micro,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}})
+    for step in range(args.qat_steps):
+        ix = rng.choice(train_ix, gb, replace=False)
+        qeng.train_batch(batch_of(ix))
+    qat_eval = eval_loss(apply_compression(qeng.state.params, spec4,
+                                           step=0))
+    curve["qat"] = {"bits": qat_bits, "steps": args.qat_steps,
+                    "eval_loss": round(qat_eval, 4),
+                    "ptq_same_bits": curve["ptq_bits"][str(qat_bits)]}
+    log(f"QAT {qat_bits}-bit ({args.qat_steps} steps): eval "
+        f"{qat_eval:.4f} vs PTQ {curve['ptq_bits'][str(qat_bits)]:.4f}")
+
+    print(json.dumps(curve), flush=True)
+    if args.write_doc:
+        write_doc(curve)
+
+
+def fq(c, bits):
+    return c["ptq_bits"][str(bits)]
+
+
+def write_doc(c, out_path=None):
+    base = c["baseline_eval_loss"]
+    rows_q = "\n".join(
+        f"| {b} | {v:.4f} | {v - base:+.4f} |"
+        for b, v in c["ptq_bits"].items())
+    rows_p = "\n".join(
+        f"| {r} | {v:.4f} | {v - base:+.4f} |"
+        for r, v in c["sparse_pruning"].items())
+    rp = c["row_pruning"]
+    q = c["qat"]
+    doc = f"""# Compression accuracy-vs-ratio curve (measured)
+
+Generated by `scripts/compression_curve.py` — byte-level GPT-2 (2L/128d)
+trained {c['train_steps']} steps on the vendored real-English corpus
+(tests/data/real_text.txt, 90/10 split), evaluated on {c['eval_batches']}
+held-out batches. Platform: `{c['platform']}` (the techniques are tree
+transforms — identical numerics on TPU up to dtype). Reference analog:
+the accuracy tables DeepSpeed-Compression reports for its layer zoo.
+
+Baseline held-out eval loss: **{base:.4f}** (uniform-byte floor ≈ 5.545).
+
+## Post-training weight quantization (groupwise fake-quant)
+
+| bits | eval loss | Δ vs fp32 |
+|---|---|---|
+{rows_q}
+
+## Magnitude (sparse) pruning
+
+| dense ratio | eval loss | Δ |
+|---|---|---|
+{rows_p}
+
+## Structured row pruning + `redundancy_clean`
+
+Dense ratio 0.5 on attn/mlp matrices: eval loss {rp['eval_loss']:.4f};
+`redundancy_clean` physically shrinks {rp['params_before']:,} →
+{rp['params_after']:,} params.
+
+## QAT recovery
+
+{q['steps']} extra steps with {q['bits']}-bit fake-quant in the loss
+(straight-through gradients): eval **{q['eval_loss']:.4f}** vs
+{q['ptq_same_bits']:.4f} for PTQ at the same width — QAT recovers
+{(q['ptq_same_bits'] - q['eval_loss']) / max(q['ptq_same_bits'] - base, 1e-9) * 100:.0f}%
+of the quantization damage in {q['steps']} steps (longer schedules
+recover more — the point of the reference's annealed QAT).
+
+## Reading the curve
+
+8/6-bit PTQ is free at this scale ({fq(c, 8)} / {fq(c, 6)} vs {base:.4f});
+4-bit costs {fq(c, 4) - base:+.4f} and QAT wins back
+{(fq(c, 4) - q['eval_loss']) / max(fq(c, 4) - base, 1e-9) * 100:.0f}% of
+that; 3-bit and below need QAT (or MoQ's eigenvalue-guided schedule,
+`runtime/quantize.py`) to stay usable. Unstructured pruning at 80% dense
+is nearly free ({c['sparse_pruning']['0.8'] - base:+.4f}); 50% costs
+{c['sparse_pruning']['0.5'] - base:+.4f} without fine-tuning. Structured
+row pruning without recovery training is destructive at this scale —
+pair it with post-prune fine-tuning (the reference does the same).
+"""
+    out = out_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "docs",
+        "compression_curve.md")
+    with open(out, "w") as f:
+        f.write(doc)
+    log(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,16 @@ def test_train_350m_flash_seq8k_traces():
     _trace_train(GPT2LMModel(cfg), global_batch=1, seq=8192)
 
 
+def test_autotune_grid_envelope_traces():
+    """bench autotune-350m: the grid's most extreme point (micro 16,
+    flash block 512) must trace — a trace-time crash inside one trial
+    would burn the phase's whole hardware budget."""
+    from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
+    cfg = config_for("gpt2-350m", n_positions=1024, dtype=jnp.bfloat16,
+                     flash_block=512)
+    _trace_train(GPT2LMModel(cfg), global_batch=16, seq=1024)
+
+
 def test_bench_phase_argv_all_declared():
     """Every flag a PHASES entry passes must be declared by bench's
     argparser — a typo'd flag would otherwise burn a hardware window
