@@ -492,22 +492,34 @@ def phase_infer(args) -> dict:
         fp = init_params(jax.random.PRNGKey(0), q_cfg)
         qp = GroupQuantizer(q_int8=True).quantize_tree(fp)
         # w8a8 with per-output-channel scales (quantize_weight_out):
-        # EVERY projection, attention included, on the int8 MXU dot
-        qp_out = GroupQuantizer(q_int8=True, out_mode=True).quantize_tree(
-            fp)
+        # EVERY projection, attention included, on the int8 MXU dot.
+        # Guarded separately: a w8a8 quantize failure must not cost the
+        # plain-int8 benches below.
+        qp_out = None
+        try:
+            qp_out = GroupQuantizer(
+                q_int8=True, out_mode=True).quantize_tree(fp)
+        except Exception as e:  # noqa: BLE001 — optional metric
+            log(f"w8a8 quantize skipped: {type(e).__name__}: "
+                f"{str(e)[:80]}")
         del fp
         qeng = InferenceEngine((q_cfg, qp), DeepSpeedInferenceConfig(
             max_out_tokens=1024))
         del qp
-        bench_decode(qeng, f"{scale_tag} int8", "gpt_int8")
+        bench_decode(qeng, f"{scale_tag} int8", "gpt_int8", want_p90=True)
         bench_batched(qeng, f"{scale_tag} int8", "gpt_int8")
         del qeng  # free before the w8a8 engine (1.3b HBM headroom)
-        qeng_out = InferenceEngine((q_cfg, qp_out),
-                                   DeepSpeedInferenceConfig(
-                                       max_out_tokens=1024))
-        del qp_out
-        bench_decode(qeng_out, f"{scale_tag} w8a8-out", "gpt_w8a8")
-        bench_batched(qeng_out, f"{scale_tag} w8a8-out", "gpt_w8a8")
+        # salvage point: int8 metrics survive a cap kill during the w8a8
+        # engine compile
+        print(json.dumps({**out, "partial": True}), flush=True)
+        if qp_out is not None:
+            qeng_out = InferenceEngine((q_cfg, qp_out),
+                                       DeepSpeedInferenceConfig(
+                                           max_out_tokens=1024))
+            del qp_out
+            bench_decode(qeng_out, f"{scale_tag} w8a8-out", "gpt_w8a8",
+                         want_p90=True)
+            bench_batched(qeng_out, f"{scale_tag} w8a8-out", "gpt_w8a8")
     except Exception as e:  # noqa: BLE001 — optional metric
         log(f"int8 decode phase skipped: {type(e).__name__}: "
             f"{str(e)[:120]}")
@@ -877,9 +889,10 @@ PHASES = {
     # after the micro phase so the headline is always the SECOND number
     # captured in a healthy window. 10 steps (VERDICT r4 weak #3: the
     # headline must not rest on 2 steps of a 12-s step): ~125s of steps
-    # after the warm step's early salvage record, inside the 1200s cap.
+    # after the warm step's early salvage record. Cap 1800s: the r5
+    # window showed phase setup over a slow relay can eat most of 1200.
     "train-1.3b": (["--preset", "gpt2-1.3b", "--offload",
-                    "--micro", "2", "--gas", "64", "--steps", "10"], 1200),
+                    "--micro", "2", "--gas", "64", "--steps", "10"], 1800),
     # flagship 350m at its measured sweet spot: flash + micro 8 = 83.1 TF
     # / 42.2% MFU captured (micro 12 regresses to 74.6 under memory
     # pressure, micro 16 OOMs by 372M; naive attention gains nothing from

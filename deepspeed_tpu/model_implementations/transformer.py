@@ -133,7 +133,22 @@ class InferenceTransformerConfig:
 
 def init_params(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
     """Random init (tests / set_empty_params); policies overwrite with HF
-    weights (module_inject analog, deepspeed_tpu/module_inject/)."""
+    weights (module_inject analog, deepspeed_tpu/module_inject/).
+
+    Jitted wholesale: one device-side executable instead of one dispatch
+    round trip per tensor — material over a high-RTT device tunnel at
+    serving-scale layer counts (see models/gpt2.py init)."""
+    return _jit_init_for(cfg)(rng)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_init_for(cfg: InferenceTransformerConfig):
+    # one jit wrapper per (frozen, hashable) config: repeated inits of the
+    # same geometry reuse the traced executable instead of re-compiling
+    return jax.jit(lambda r: _init_params_impl(r, cfg))
+
+
+def _init_params_impl(rng: jax.Array, cfg: InferenceTransformerConfig) -> Dict:
     E, H, D, F = cfg.n_embd, cfg.n_head, cfg.head_dim, cfg.ffn
     KH = cfg.kv_heads
     keys = iter(jax.random.split(rng, 4 + 8 * cfg.n_layer))

@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
                                            DeepSpeedTransformerLayer,
                                            layer_norm_fp32)
@@ -87,6 +88,11 @@ class BertPreTrainingModel:
 
     # -- init --------------------------------------------------------------
     def init(self, rng, **_) -> Dict[str, Any]:
+        # one compiled executable, wrapper cached on the instance
+        # (utils/jit.py): no per-tensor dispatch round trips at init
+        return instance_cached_jit(self, self._build_params)(rng)
+
+    def _build_params(self, rng) -> Dict[str, Any]:
         cfg = self.config
         E = cfg.hidden_size
         k = iter(jax.random.split(rng, 6 + cfg.num_hidden_layers))

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
 from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
+from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
 
@@ -379,7 +380,11 @@ class GPT2LMModel:
         prev = self._fetch_table.get("active", False)
         self._fetch_table["active"] = False
         try:
-            variables = self.module.init(rng, ids)
+            # one compiled executable, wrapper cached on the instance:
+            # params materialize device-side in a single execution
+            # instead of per-op dispatch round trips (utils/jit.py)
+            variables = instance_cached_jit(self, self.module.init)(
+                rng, ids)
         finally:
             self._fetch_table["active"] = prev
         return variables["params"]

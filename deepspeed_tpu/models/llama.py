@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES
 from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
+from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
 
@@ -308,7 +309,10 @@ class LlamaLMModel:
             ids = example_batch["input_ids"]
         else:
             ids = jnp.zeros((batch_size, seq_len), jnp.int32)
-        return self.module.init(rng, ids)["params"]
+        # one compiled executable, wrapper cached on the instance
+        # (utils/jit.py): no per-op dispatch round trips at init
+        return instance_cached_jit(self, self.module.init)(
+            rng, ids)["params"]
 
     def apply(self, params, input_ids, deterministic=True, rngs=None):
         """Returns logits; with MoE layers, ``(logits, l_aux_total)``."""

@@ -1,0 +1,25 @@
+"""Small jit-caching helpers.
+
+Param init must run as ONE compiled executable: unjitted init dispatches
+each RNG/initializer op individually, which over a high-RTT device
+tunnel turns a 1.3B-model init into >20 min of round trips (observed:
+the r5 train-1.3b bench phase died inside init). But ``jax.jit``'s trace
+cache is keyed per wrapper object, so wrapping at every call would
+re-trace and re-compile each time — the wrapper itself must be cached.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def instance_cached_jit(obj, fn, key: str = "_jit_init"):
+    """Return ``jax.jit(fn)`` memoized in ``obj.__dict__[key]``.
+
+    Repeated calls on the same instance reuse one traced executable.
+    ``__dict__`` is used directly so the helper stays safe on classes
+    with custom ``__getattr__``.
+    """
+    wrapper = obj.__dict__.get(key)
+    if wrapper is None:
+        wrapper = obj.__dict__[key] = jax.jit(fn)
+    return wrapper
