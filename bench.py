@@ -1377,6 +1377,17 @@ def main() -> None:
                 pick["stale"] = True
         merged[name] = pick
 
+    # MFU calibration (VERDICT r4 weak #6): the datasheet 197-TF peak is
+    # not sustainable — mxu-peak measures the chip's real dense ceiling
+    # (144.1 TF captured r5), so every throughput record also reports %
+    # of the MEASURED ceiling, the number optimization decisions key on
+    sustained = (merged.get("mxu-peak") or {}).get("sustained_tflops")
+    if sustained:
+        for r in merged.values():
+            if isinstance(r, dict) and "tflops_per_chip" in r:
+                r["pct_of_sustained"] = round(
+                    100.0 * r["tflops_per_chip"] / sustained, 1)
+
     # headline preference: the north-star config (gpt2-1.3b ZeRO-3
     # +offload — BASELINE.md's literal metric), then flagship 350m, then
     # the fallbacks; vs_baseline is TFLOPS-based so comparable across all
@@ -1437,8 +1448,9 @@ def main() -> None:
         "unit": "tokens/s/chip",
         "vs_baseline": round(tps / baseline_tps, 4),
         "detail": {**{k: best[k] for k in
-                      ("tflops_per_chip", "chips", "global_batch",
-                       "ms_per_step", "loss") if k in best},
+                      ("tflops_per_chip", "pct_of_sustained", "chips",
+                       "global_batch", "ms_per_step", "loss")
+                      if k in best},
                    "mfu_pct_v5e": best.get("mfu_pct_v5e"), **detail}}
     if best.get("stale"):
         out["stale"] = True  # captured in an earlier healthy window

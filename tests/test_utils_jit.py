@@ -1,0 +1,34 @@
+"""utils/jit.py: the jit wrapper must be created once per instance so
+repeated inits reuse one traced executable (re-wrapping per call would
+re-trace and re-compile every time — the cost the cache exists to kill)."""
+import jax
+
+from deepspeed_tpu.utils.jit import instance_cached_jit
+
+
+class _Obj:
+    pass
+
+
+def test_wrapper_cached_per_instance_and_key():
+    calls = []
+
+    def f(x):
+        calls.append(1)
+        return x * 2
+
+    o = _Obj()
+    w1 = instance_cached_jit(o, f)
+    w2 = instance_cached_jit(o, f)
+    assert w1 is w2
+    assert float(w1(jax.numpy.float32(3.0))) == 6.0
+    assert len(calls) == 1  # traced once
+    float(w2(jax.numpy.float32(4.0)))
+    assert len(calls) == 1  # cache hit, no retrace
+
+    o2 = _Obj()
+    assert instance_cached_jit(o2, f) is not w1  # per-instance
+
+    w3 = instance_cached_jit(o, lambda x: x + 1, key="_other")
+    assert w3 is not w1
+    assert o.__dict__["_other"] is w3
