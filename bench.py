@@ -90,8 +90,13 @@ def _phase_quality(rec: dict):
 def save_partial(name: str, rec: dict) -> None:
     store = load_partials()
     old = store.get(name)
-    if old is not None and _phase_quality(old) >= _phase_quality(rec):
-        return
+    # calibration phases replace on quality TIE: a re-measurement must
+    # refresh captured_unix or the freshness skip dies after its window
+    # (and the store would freeze on the first-ever chip reading)
+    if old is not None:
+        qo, qr = _phase_quality(old), _phase_quality(rec)
+        if qo > qr or (qo == qr and name not in CALIBRATION_PHASES):
+            return
     store[name] = {**rec, "captured_unix": round(time.time(), 1),
                    "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                 time.gmtime())}
@@ -974,7 +979,7 @@ PHASES = {
     # and a kill mid-Mosaic-compile wedges the relay (see ORDER note)
     "train-llama-1b": (["--preset", "llama-1b", "--seq", "2048",
                         "--micro", "2", "--gas", "16", "--offload",
-                        "--grad-acc-dtype", "bf16", "--steps", "2"], 900),
+                        "--grad-acc-dtype", "bf16", "--steps", "5"], 900),
     # north-star variant: bf16 grad accumulation halves the per-step D2H
     # grad stream (5.2G -> 2.6G) on top of the gas-64 amortization —
     # projects above the 83.3-TF fp32-carry number
@@ -1022,6 +1027,12 @@ DEFAULT_ORDER = [
     "train-350m-noremat", "train-350m-noflash-seq4k",
     "train-350m-flash-seq4k-b512", "autotune-350m", "flash-compile",
 ]
+
+# chip-property calibrations whose value does not change with framework
+# code: skipped in a window when the store already has a capture younger
+# than CALIBRATION_FRESH_S (the merge still surfaces the stored record)
+CALIBRATION_PHASES = {"mxu-peak"}
+CALIBRATION_FRESH_S = 48 * 3600.0
 
 INFRA = {"relay_probes_ok": 0, "relay_probes_failed": 0,
          "relay_dead_checks": 0}
@@ -1352,6 +1363,30 @@ def main() -> None:
     first_train = next((n for n in order if n.startswith("train")), None)
     for name in order:
         try:
+            if name in CALIBRATION_PHASES and args.phases is None:
+                # default-order windows only: an EXPLICIT --phases
+                # request always re-measures (chip reassignment inside
+                # the freshness window must be forceable without
+                # hand-editing the store)
+                st = load_partials().get(name)
+                if not isinstance(st, dict):  # corrupt-store-is-not-fatal
+                    st = {}
+                cap = st.get("captured_unix", 0)
+                age = (time.time() - cap if isinstance(cap, (int, float))
+                       else float("inf"))  # corrupt field -> re-measure
+                # only a REAL capture defers a re-measurement: a salvaged
+                # failure record (oom/partial, no sustained_tflops) must
+                # not block calibration for the freshness window
+                real = (isinstance(st.get("sustained_tflops"),
+                                   (int, float))
+                        and not st.get("partial"))
+                if real and age < CALIBRATION_FRESH_S:
+                    # chip-property calibration, not framework perf: a
+                    # recent capture is still valid and re-measuring it
+                    # would spend ~4 min of a ~17-min driver window
+                    log(f"phase {name}: SKIPPED (calibration fresh, "
+                        f"{age/3600:.1f}h old; merge uses the store)")
+                    continue
             left = args.budget - (time.time() - T0)
             r = run_phase(name, left, adaptive=(name == first_train))
             if r is not None:
@@ -1373,7 +1408,10 @@ def main() -> None:
             pick = dict(st)
             # 1s slack: captured_unix is rounded, and a record written in
             # the first moments of THIS run must not be flagged stale
-            if st.get("captured_unix", 0) < T0 - 1.0:
+            cap = st.get("captured_unix", 0)
+            if not isinstance(cap, (int, float)):
+                cap = 0  # corrupt field -> treat as ancient, flag stale
+            if cap < T0 - 1.0:
                 pick["stale"] = True
         merged[name] = pick
 
@@ -1381,8 +1419,12 @@ def main() -> None:
     # not sustainable — mxu-peak measures the chip's real dense ceiling
     # (144.1 TF captured r5), so every throughput record also reports %
     # of the MEASURED ceiling, the number optimization decisions key on
-    sustained = (merged.get("mxu-peak") or {}).get("sustained_tflops")
-    if sustained:
+    mx_rec = merged.get("mxu-peak")
+    sustained = (mx_rec or {}).get("sustained_tflops") if isinstance(
+        mx_rec, dict) else None
+    # type-guarded like the rest of the store handling: a hand-edited or
+    # corrupt field must not crash main() before the one JSON line
+    if isinstance(sustained, (int, float)) and sustained > 0:
         for r in merged.values():
             if isinstance(r, dict) and "tflops_per_chip" in r:
                 r["pct_of_sustained"] = round(

@@ -82,21 +82,26 @@ def test_corrupt_store_is_not_fatal(bench, tmp_path):
     assert bench.load_partials()["p"]["tokens_per_sec_per_chip"] == 1.0
 
 
-def _orchestrate_with_store(tmp_path, store: dict, timeout=120):
-    """Run the bench orchestrator with NO live phases (empty --phases) and
-    a pre-seeded store — exactly the wedged-relay-window scenario."""
+def _orchestrate_with_store(tmp_path, store: dict, timeout=120,
+                            phases="", return_proc=False):
+    """Run the bench orchestrator with NO live phases (empty --phases by
+    default) and a pre-seeded store — the wedged-relay-window scenario."""
     ppath = tmp_path / "BENCH_PARTIAL.json"
     ppath.write_text(json.dumps({"phases": store}))
     env = dict(os.environ, DSTPU_BENCH_PARTIAL=str(ppath),
                DSTPU_BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join(ROOT, "bench.py"),
+           "--budget", "30"]
+    if phases is not None:
+        cmd += ["--phases", phases]
     p = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "bench.py"),
-         "--phases", "", "--budget", "30"],
+        cmd,
         capture_output=True, timeout=timeout, env=env)
     assert p.returncode == 0, p.stderr.decode()[-2000:]
     lines = [ln for ln in p.stdout.decode().splitlines() if ln.strip()]
     assert len(lines) == 1, "bench must print exactly one JSON line"
-    return json.loads(lines[0])
+    out = json.loads(lines[0])
+    return (out, p) if return_proc else out
 
 
 def test_wedged_window_reports_stale_best_known(tmp_path):
@@ -234,3 +239,93 @@ def test_sustained_ceiling_calibration_join(tmp_path):
     assert out["detail"]["pct_of_sustained"] == rec["pct_of_sustained"]
     # the calibration record itself is not annotated (no tflops_per_chip)
     assert "pct_of_sustained" not in out["detail"]["phases"]["mxu-peak"]
+
+
+def test_fresh_calibration_phase_skipped_but_merged(tmp_path):
+    """mxu-peak measures a chip property, not framework perf: with a
+    young capture in the store the orchestrator must not spend window
+    budget re-measuring it, and the merge must still surface the stored
+    record (plus its calibration join)."""
+    import time as _time
+    out = _orchestrate_with_store(tmp_path, {
+        "mxu-peak": {"phase": "mxu-peak", "sustained_tflops": 144.1,
+                     "captured_unix": _time.time() - 3600.0},
+        "train-125m-micro": {"preset": "gpt2-125m", "seq": 256,
+                             "tokens_per_sec_per_chip": 90000.0,
+                             "tflops_per_chip": 66.8,
+                             "flops_per_token": 7.4e8,
+                             "captured_unix": 1.0}},
+        phases=None, return_proc=True)  # default order: skip applies
+    out, proc = out
+    # the CALIBRATION skip fired (not merely the low-budget gate)
+    assert b"calibration fresh" in proc.stderr
+    mx = out["detail"]["phases"]["mxu-peak"]
+    assert mx["sustained_tflops"] == 144.1
+    # skipped-not-rerun: the record is the stored one (an hour old, so
+    # the merge flags it stale like any other store carry-over)
+    assert mx.get("stale") is True
+    assert out["detail"]["phases"]["train-125m-micro"][
+        "pct_of_sustained"] == round(100 * 66.8 / 144.1, 1)
+
+
+def test_calibration_remeasure_refreshes_store_on_tie(bench, monkeypatch):
+    """A re-measured mxu-peak always ties _phase_quality (same metric
+    count) with the stored one; the store must take the new record so
+    captured_unix refreshes and the freshness skip keeps working past
+    its 48h window."""
+    bench.save_partial("mxu-peak", {"phase": "mxu-peak",
+                                    "sustained_tflops": 144.1})
+    first = bench.load_partials()["mxu-peak"]["captured_unix"]
+    monkeypatch.setattr(bench.time, "time", lambda: first + 7200.0)
+    bench.save_partial("mxu-peak", {"phase": "mxu-peak",
+                                    "sustained_tflops": 143.0})
+    rec = bench.load_partials()["mxu-peak"]
+    assert rec["sustained_tflops"] == 143.0
+    assert rec["captured_unix"] == first + 7200.0
+    # non-calibration phases keep discard-on-tie (stored wins)
+    bench.save_partial("inference", {"a": 1, "b": 2})
+    bench.save_partial("inference", {"c": 3, "d": 4})
+    assert bench.load_partials()["inference"]["a"] == 1
+
+
+def test_failure_record_does_not_defer_calibration(tmp_path):
+    """A salvaged mxu-peak FAILURE record (no sustained_tflops) must not
+    satisfy the freshness skip — the next window re-measures."""
+    import time as _time
+    out, proc = _orchestrate_with_store(tmp_path, {
+        "mxu-peak": {"phase": "mxu-peak", "oom_hbm": True,
+                     "partial": True,
+                     "captured_unix": _time.time() - 60.0}},
+        phases=None, return_proc=True)  # default order: skip eligible
+    assert b"calibration fresh" not in proc.stderr
+
+
+def test_explicit_phase_request_forces_recalibration(tmp_path):
+    """`--phases mxu-peak` must re-measure even inside the freshness
+    window (chip reassignment recovery without hand-editing the store)."""
+    import time as _time
+    out, proc = _orchestrate_with_store(tmp_path, {
+        "mxu-peak": {"phase": "mxu-peak", "sustained_tflops": 144.1,
+                     "captured_unix": _time.time() - 60.0}},
+        phases="mxu-peak", return_proc=True)
+    assert b"calibration fresh" not in proc.stderr
+
+
+def test_corrupt_calibration_fields_are_not_fatal(tmp_path):
+    """Non-numeric sustained_tflops / captured_unix in the store must
+    neither crash the one-JSON-line contract nor defer re-measurement."""
+    out, proc = _orchestrate_with_store(tmp_path, {
+        "mxu-peak": {"phase": "mxu-peak",
+                     "sustained_tflops": "144.1-tf",
+                     "captured_unix": "yesterday"},
+        "train-125m-micro": {"preset": "gpt2-125m", "seq": 256,
+                             "tokens_per_sec_per_chip": 90000.0,
+                             "tflops_per_chip": 66.8,
+                             "flops_per_token": 7.4e8,
+                             "captured_unix": 1.0}},
+        phases=None, return_proc=True)
+    assert b"calibration fresh" not in proc.stderr  # corrupt -> re-measure
+    assert b"orchestrator error" not in proc.stderr
+    assert out["value"] == 90000.0  # headline survives
+    assert "pct_of_sustained" not in out["detail"]["phases"][
+        "train-125m-micro"]  # no join against a corrupt ceiling
