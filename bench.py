@@ -666,6 +666,49 @@ def phase_flash_compile(args) -> dict:
     out["fwd_us_per_call"] = round(dt / ITERS * 1e6, 1)
     log(f"flash fwd sustained: {out['fwd_sustained_tflops']} TF "
         f"({out['fwd_us_per_call']} us/call)")
+    print(json.dumps({**out, "partial": True}), flush=True)  # salvage
+
+    # bwd sustained: training wall is ~2/3 backward (two kernels, ~3.5x
+    # the fwd matmul work) — without this number a slow train step can't
+    # be attributed between the fwd and bwd kernels. Chain dependent
+    # grad calls (dq feeds the next query), sync once.
+    BITERS = 30
+
+    @jax.jit
+    def chained_bwd(q, k, v):
+        def body(_, qq):
+            dq, dk, dv = jax.grad(fwd_loss, argnums=(0, 1, 2))(qq, k, v)
+            # consume dk/dv with a numerically-negligible contribution:
+            # the dkv kernel is a separate pallas_call, and discarding
+            # its outputs would let DCE remove it from the timed loop
+            # entirely (bf16 carries fp32's exponent range, so 1e-30
+            # scales without flushing to zero)
+            return dq + (jnp.sum(dk) + jnp.sum(dv)).astype(dq.dtype) * \
+                jnp.asarray(1e-30, dq.dtype)
+        return jax.lax.fori_loop(0, BITERS, body, q)
+
+    bwd_c = chained_bwd.lower(q, k, v).compile()
+    _ = float(jnp.sum(bwd_c(q, k, v).astype(jnp.float32)))  # warm
+    t = time.time()
+    _ = float(jnp.sum(bwd_c(q, k, v).astype(jnp.float32)))
+    dt = time.time() - t
+    # each grad call runs fwd (custom_vjp residual pass: 2 triangle
+    # matmuls) + dq kernel (3) + dkv kernel (4) = 9 units, where one
+    # unit = 2*B*H*T^2*D flops halved for causal visibility
+    unit = 2.0 * B * H * T * T * D * 0.5
+    grad_us = dt / BITERS * 1e6
+    out["grad_sustained_tflops"] = round(BITERS * 9.0 * unit / dt / 1e12,
+                                         2)
+    out["grad_us_per_call"] = round(grad_us, 1)
+    # bwd-only attribution: subtract the separately-measured fwd time
+    bwd_us = grad_us - out["fwd_us_per_call"]
+    if bwd_us > 0:
+        out["bwd_sustained_tflops"] = round(
+            7.0 * unit / (bwd_us * 1e-6) / 1e12, 2)
+        out["bwd_us_per_call"] = round(bwd_us, 1)
+    log(f"flash grad sustained: {out['grad_sustained_tflops']} TF "
+        f"({out['grad_us_per_call']} us/call; bwd-only "
+        f"{out.get('bwd_sustained_tflops')} TF)")
     return out
 
 
@@ -1420,8 +1463,8 @@ def main() -> None:
     # (144.1 TF captured r5), so every throughput record also reports %
     # of the MEASURED ceiling, the number optimization decisions key on
     mx_rec = merged.get("mxu-peak")
-    sustained = (mx_rec or {}).get("sustained_tflops") if isinstance(
-        mx_rec, dict) else None
+    sustained = (mx_rec.get("sustained_tflops")
+                 if isinstance(mx_rec, dict) else None)
     # type-guarded like the rest of the store handling: a hand-edited or
     # corrupt field must not crash main() before the one JSON line
     if isinstance(sustained, (int, float)) and sustained > 0:

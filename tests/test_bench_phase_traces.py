@@ -87,6 +87,24 @@ def test_mxu_peak_and_chained_flash_trace():
     out = jax.eval_shape(chained, q, q, q)
     assert out.shape == (B, T, H, D)
 
+    # the chained-grad (bwd sustained) loop traces too: dq feeds the
+    # next query through jax.grad over the custom-vjp kernel
+    def floss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    def chained_bwd(q, k, v):
+        def body(_, qq):
+            dq, dk, dv = jax.grad(floss, argnums=(0, 1, 2))(qq, k, v)
+            # mirror bench.py: dk/dv consumed so the dkv kernel can't be
+            # DCE'd out of the timed loop
+            return dq + (jnp.sum(dk) + jnp.sum(dv)).astype(dq.dtype) * \
+                jnp.asarray(1e-30, dq.dtype)
+        return jax.lax.fori_loop(0, 2, body, q)
+
+    out = jax.eval_shape(chained_bwd, q, q, q)
+    assert out.shape == (B, T, H, D)
+
     def mm(x, w):
         def body(_, xx):
             return jax.lax.dot(xx, w, preferred_element_type=jnp.bfloat16)
