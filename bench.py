@@ -163,6 +163,7 @@ def train_phase_name(args, *, seq_suffix: bool = False,
             + ("-micro" if args.adaptive_steps else "")
             + ("-noflash" if args.no_flash else "")
             + ("-noremat" if args.no_remat else "")
+            + ("-int8" if getattr(args, "int8_training", False) else "")
             + ("-offload" if args.offload else "")
             + (f"-{args.grad_acc_dtype}acc" if args.grad_acc_dtype else "")
             + (f"-b{eff_block}" if eff_block else ""))
@@ -208,6 +209,10 @@ def _phase_train(args) -> dict:
                      use_flash_attention=not args.no_flash)
     if args.flash_block:
         overrides["flash_block"] = args.flash_block
+    if getattr(args, "int8_training", False):
+        # SwitchBack int8 projections (ops/int8_training.py) — gpt2
+        # family only; config_for rejects the field elsewhere, loudly
+        overrides["int8_training"] = True
     if args.experts:
         # MoE FFN with each family's canonical layout: gpt2 = every other
         # layer (Megatron-MoE expert_interval=2), llama = every layer with
@@ -948,6 +953,18 @@ PHASES = {
     # it).
     "train-350m-flash-mb8": (["--preset", "gpt2-350m", "--micro", "8"],
                              480),
+    # SwitchBack int8 training (ops/int8_training.py): fwd + dx
+    # projection GEMMs on the int8 MXU (394 TOPS vs 197 bf16 TFLOPS) —
+    # direct A/B against train-350m-flash-mb8; a win here is a training
+    # capability the reference's GPU compression stack does not have
+    "train-350m-int8": (["--preset", "gpt2-350m", "--micro", "8",
+                         "--int8-training"], 480),
+    # north-star geometry on the int8 path (bf16acc keeps the carry
+    # small): A/B against train-1.3b-bf16acc
+    "train-1.3b-int8": (["--preset", "gpt2-1.3b", "--offload",
+                         "--micro", "2", "--gas", "64",
+                         "--grad-acc-dtype", "bf16", "--int8-training",
+                         "--steps", "5"], 900),
     # the reference's training-kernel headline: BERT-large (64 TFLOPS/GPU)
     "train-bert-large": (["--seq", "512", "--micro", "16"], 480),
     # 1200s: four engines (bf16/int8/w8a8/llama) x several loop-shape
@@ -1061,8 +1078,9 @@ DEFAULT_ORDER = [
     # wedge risk (flash-compile, autotune's fresh grid) stays last.
     "train-125m-micro", "mxu-peak", "train-1.3b", "train-llama-1b",
     "train-moe-125m-e8", "inference", "profile-350m",
-    "train-350m-flash-mb8", "train-bert-large", "inference-1.3b",
-    "train-1.3b-bf16acc", "train-1.3b-bf16acc-mb4",
+    "train-350m-flash-mb8", "train-350m-int8", "train-bert-large",
+    "inference-1.3b",
+    "train-1.3b-bf16acc", "train-1.3b-int8", "train-1.3b-bf16acc-mb4",
     "train-350m-flash-seq4k", "train-350m-flash-seq8k",
     "train-350m-flash-mb8-gas4", "train-1.3b-gas128",
     "train-125m",
@@ -1341,6 +1359,10 @@ def main() -> None:
                          "layer; llama: every layer, Mixtral layout)")
     ap.add_argument("--offload", action="store_true",
                     help="ZeRO-3 + cpu offload_optimizer (north-star cfg)")
+    ap.add_argument("--int8-training", dest="int8_training",
+                    action="store_true",
+                    help="SwitchBack int8 projections: fwd+dx GEMMs on "
+                         "the int8 MXU at 2x the bf16 rate (gpt2 family)")
     ap.add_argument("--grad-acc-dtype", default=None,
                     choices=["fp32", "fp16", "bf16"],
                     help="data_types.grad_accum_dtype; bf16 halves the GAS "

@@ -70,6 +70,11 @@ class GPT2Config:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # SwitchBack int8 training (ops/int8_training.py): the projection
+    # GEMMs (fwd + dx) run int8 x int8 on the MXU at twice the bf16 rate;
+    # dw stays full precision. Experimental, opt-in; composes with
+    # ZeRO/offload unchanged (params stay bf16).
+    int8_training: bool = False
 
     def __post_init__(self):
         if self.sp_mode not in ("ring", "ulysses"):
@@ -124,6 +129,15 @@ def config_for(name: str, **overrides) -> GPT2Config:
     return GPT2Config(**{**PRESETS[name], **overrides})
 
 
+def _proj_dot(cfg: GPT2Config):
+    """Projection dot_general: the SwitchBack int8 seam when the config
+    opts in, flax's stock dot otherwise (None)."""
+    if not cfg.int8_training:
+        return None
+    from deepspeed_tpu.ops.int8_training import switchback_dot_general
+    return switchback_dot_general
+
+
 class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
@@ -132,7 +146,8 @@ class CausalSelfAttention(nn.Module):
         cfg = self.config
         B, T, C = x.shape
         H = cfg.n_head
-        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn")(x)
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn",
+                       dot_general=_proj_dot(cfg))(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
@@ -165,7 +180,8 @@ class CausalSelfAttention(nn.Module):
                 att = nn.Dropout(cfg.dropout)(att, deterministic=False)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         y = y.reshape(B, T, C)
-        y = nn.Dense(C, dtype=cfg.dtype, name="c_proj")(y)
+        y = nn.Dense(C, dtype=cfg.dtype, name="c_proj",
+                     dot_general=_proj_dot(cfg))(y)
         if cfg.dropout > 0.0 and not deterministic:
             y = nn.Dropout(cfg.dropout)(y, deterministic=False)
         return y
@@ -178,9 +194,11 @@ class MLP(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         C = x.shape[-1]
-        h = nn.Dense(4 * C, dtype=cfg.dtype, name="c_fc")(x)
+        h = nn.Dense(4 * C, dtype=cfg.dtype, name="c_fc",
+                     dot_general=_proj_dot(cfg))(x)
         h = jax.nn.gelu(h, approximate=True)
-        h = nn.Dense(C, dtype=cfg.dtype, name="c_proj")(h)
+        h = nn.Dense(C, dtype=cfg.dtype, name="c_proj",
+                     dot_general=_proj_dot(cfg))(h)
         if cfg.dropout > 0.0 and not deterministic:
             h = nn.Dropout(cfg.dropout)(h, deterministic=False)
         return h

@@ -1,0 +1,111 @@
+"""SwitchBack-style int8 training linear for the TPU MXU.
+
+The v5e MXU multiplies int8×int8 at twice the bf16 rate (394 TOPS vs
+197 TFLOPS), so running the training GEMMs on int8 operands raises the
+compute ceiling — a TPU-native capability beyond the reference, whose
+compression stack quantizes only for memory/serving (MoQ,
+``deepspeed/compression/basic_layer.py``; our serving analog is
+``ops/int8_gemm.py``). This op brings the same w8a8 arithmetic to the
+TRAINING step with straight-through gradients (public technique:
+"SwitchBack" — Wortsman et al., Stable and low-precision training for
+large-scale vision-language models, 2023):
+
+* forward:  ``y = (q(x) @ q(w)) * sx * sw`` — per-token activation
+  scales, per-output-channel weight scales, int8 dot with an int32
+  accumulator (exact), one fp rescale.
+* ``dx = (q(dy) @ q(wᵀ)) * sdy * swt`` — the second-largest GEMM also
+  rides the int8 MXU path (per-token dy scales; per-TENSOR weight scale
+  for the transpose, whose per-column grid does not transpose).
+* ``dw = xᵀ @ dy`` stays full precision (fp32 accumulation): weight
+  gradients feed the optimizer and are the accuracy-critical third.
+
+Two of the three step GEMMs run at the doubled int8 rate; master
+weights, optimizer state, and everything outside the projections are
+untouched, so the mode composes with ZeRO/offload/precision unchanged.
+Opt-in via ``int8_training=True`` on the model config; fake-quant noise
+acts like QAT (see tests/test_int8_training.py for the convergence
+parity evidence).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _quant(x: jax.Array, axis):
+    """Symmetric int8 along ``axis`` (None = one scale for the whole
+    tensor): returns (q int8, scale f32 broadcastable against x). One
+    definition of the clip/round/zero-amax pattern for this module; the
+    serving-side twin lives in ops/int8_gemm.py (separate on purpose —
+    it quantizes against STORED {"q","oscale"} trees, not live bf16)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis,
+                   keepdims=axis is not None)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _quant_lastdim(x: jax.Array):
+    """Per row/token: q, scale [..., 1]."""
+    return _quant(x, -1)
+
+
+def _quant_cols(w: jax.Array):
+    """Per output column of ``w [K, N]``: q, scale [1, N]."""
+    return _quant(w, 0)
+
+
+def _quant_tensor(w: jax.Array):
+    """ONE scale (for the bwd transpose)."""
+    return _quant(w, None)
+
+
+def _int8_dot_last(x_q: jax.Array, w_q: jax.Array) -> jax.Array:
+    """``[..., K]int8 @ [K, N]int8 -> [..., N]int32`` on the MXU."""
+    return jax.lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+@jax.custom_vjp
+def switchback_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """``x [..., K] @ w [K, N]`` with int8 fwd/dx and fp32-accum dw."""
+    qx, sx = _quant_lastdim(x)
+    qw, sw = _quant_cols(w)
+    y = _int8_dot_last(qx, qw).astype(jnp.float32) * sx * sw
+    return y.astype(x.dtype)
+
+
+def _switchback_fwd(x, w):
+    return switchback_matmul(x, w), (x, w)
+
+
+def _switchback_bwd(res, dy):
+    x, w = res
+    # dx = dy @ w.T on the int8 MXU (per-token dy scale, per-tensor w)
+    qdy, sdy = _quant_lastdim(dy)
+    qwt, swt = _quant_tensor(jnp.swapaxes(w.astype(jnp.float32), 0, 1))
+    dx = _int8_dot_last(qdy, qwt).astype(jnp.float32) * sdy * swt
+    # dw = x.T @ dy full precision: contract every leading dim
+    K, N = w.shape
+    x2 = x.reshape(-1, K).astype(jnp.float32)
+    dy2 = dy.reshape(-1, N).astype(jnp.float32)
+    dw = jax.lax.dot_general(x2, dy2, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+switchback_matmul.defvjp(_switchback_fwd, _switchback_bwd)
+
+
+def switchback_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                           preferred_element_type=None):
+    """``flax.linen.Dense(dot_general=...)`` seam: route the Dense
+    pattern (last-dim × dim-0 contraction, no batch dims) through the
+    int8 training matmul; anything else falls back to the stock dot."""
+    expected = (((lhs.ndim - 1,), (0,)), ((), ()))
+    if dimension_numbers == expected and rhs.ndim == 2:
+        return switchback_matmul(lhs, rhs)
+    return jax.lax.dot_general(lhs, rhs, dimension_numbers, precision,
+                               preferred_element_type)

@@ -39,6 +39,16 @@ def test_train_llama_1b_traces():
     _trace_train(LlamaLMModel(cfg), global_batch=4, seq=2048)
 
 
+def test_train_350m_int8_traces():
+    """bench train-350m-int8: SwitchBack projections + flash + remat at
+    the exact phase shapes (custom-VJP int8 dot inside remat is the
+    trace hazard this guards)."""
+    from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
+    cfg = config_for("gpt2-350m", n_positions=1024, dtype=jnp.bfloat16,
+                     int8_training=True)
+    _trace_train(GPT2LMModel(cfg), global_batch=8, seq=1024)
+
+
 def test_train_350m_flash_seq8k_traces():
     """bench train-350m-flash-seq8k (long-context rung 2)."""
     from deepspeed_tpu.models.gpt2 import GPT2LMModel, config_for
