@@ -210,8 +210,8 @@ def _phase_train(args) -> dict:
     if args.flash_block:
         overrides["flash_block"] = args.flash_block
     if getattr(args, "int8_training", False):
-        # SwitchBack int8 projections (ops/int8_training.py) — gpt2
-        # family only; config_for rejects the field elsewhere, loudly
+        # SwitchBack int8 projections (ops/int8_training.py) — gpt2 and
+        # llama families both take the config field
         overrides["int8_training"] = True
     if args.experts:
         # MoE FFN with each family's canonical layout: gpt2 = every other
@@ -965,6 +965,11 @@ PHASES = {
                          "--micro", "2", "--gas", "64",
                          "--grad-acc-dtype", "bf16", "--int8-training",
                          "--steps", "5"], 900),
+    # modern-decoder family on the int8 MXU: A/B against train-llama-1b
+    "train-llama-1b-int8": (["--preset", "llama-1b", "--seq", "2048",
+                             "--micro", "2", "--gas", "16", "--offload",
+                             "--grad-acc-dtype", "bf16",
+                             "--int8-training", "--steps", "5"], 900),
     # the reference's training-kernel headline: BERT-large (64 TFLOPS/GPU)
     "train-bert-large": (["--seq", "512", "--micro", "16"], 480),
     # 1200s: four engines (bf16/int8/w8a8/llama) x several loop-shape
@@ -1080,7 +1085,8 @@ DEFAULT_ORDER = [
     "train-moe-125m-e8", "inference", "profile-350m",
     "train-350m-flash-mb8", "train-350m-int8", "train-bert-large",
     "inference-1.3b",
-    "train-1.3b-bf16acc", "train-1.3b-int8", "train-1.3b-bf16acc-mb4",
+    "train-1.3b-bf16acc", "train-1.3b-int8", "train-llama-1b-int8",
+    "train-1.3b-bf16acc-mb4",
     "train-350m-flash-seq4k", "train-350m-flash-seq8k",
     "train-350m-flash-mb8-gas4", "train-1.3b-gas128",
     "train-125m",
@@ -1362,7 +1368,8 @@ def main() -> None:
     ap.add_argument("--int8-training", dest="int8_training",
                     action="store_true",
                     help="SwitchBack int8 projections: fwd+dx GEMMs on "
-                         "the int8 MXU at 2x the bf16 rate (gpt2 family)")
+                         "the int8 MXU at 2x the bf16 rate (gpt2 + "
+                         "llama families; rejects MoE)")
     ap.add_argument("--grad-acc-dtype", default=None,
                     choices=["fp32", "fp16", "bf16"],
                     help="data_types.grad_accum_dtype; bf16 halves the GAS "

@@ -77,6 +77,12 @@ class GPT2Config:
     int8_training: bool = False
 
     def __post_init__(self):
+        if self.int8_training and self.num_experts > 0:
+            raise ValueError(
+                "int8_training with num_experts > 0 is unsupported: the "
+                "expert FFN einsums (moe/layer.py) do not route through "
+                "the SwitchBack seam, so the dominant GEMMs would stay "
+                "bf16 under an '-int8' label")
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_mode must be 'ring' or 'ulysses', got "
@@ -131,11 +137,10 @@ def config_for(name: str, **overrides) -> GPT2Config:
 
 def _proj_dot(cfg: GPT2Config):
     """Projection dot_general: the SwitchBack int8 seam when the config
-    opts in, flax's stock dot otherwise (None)."""
-    if not cfg.int8_training:
-        return None
-    from deepspeed_tpu.ops.int8_training import switchback_dot_general
-    return switchback_dot_general
+    opts in, flax's stock dot otherwise (None). Import stays lazy so the
+    stock path never touches the op module."""
+    from deepspeed_tpu.ops.int8_training import maybe_switchback
+    return maybe_switchback(cfg.int8_training)
 
 
 class CausalSelfAttention(nn.Module):

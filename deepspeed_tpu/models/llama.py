@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES
 from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
+from deepspeed_tpu.ops.int8_training import maybe_switchback
 from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
@@ -61,8 +62,16 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # SwitchBack int8 projections (ops/int8_training.py; see GPT2Config)
+    int8_training: bool = False
 
     def __post_init__(self):
+        if self.int8_training and self.num_experts > 0:
+            raise ValueError(
+                "int8_training with num_experts > 0 is unsupported: the "
+                "expert FFN einsums (moe/layer.py) do not route through "
+                "the SwitchBack seam, so the dominant GEMMs would stay "
+                "bf16 under an '-int8' label")
         if self.n_head % self.n_kv_head:
             raise ValueError(f"n_head={self.n_head} must be divisible by "
                              f"n_kv_head={self.n_kv_head}")
@@ -160,7 +169,8 @@ class LlamaAttention(nn.Module):
         B, T, C = x.shape
         H, HKV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
         dense = lambda feat, name: nn.Dense(  # noqa: E731
-            feat, use_bias=False, dtype=cfg.dtype, name=name)
+            feat, use_bias=False, dtype=cfg.dtype, name=name,
+            dot_general=maybe_switchback(cfg.int8_training))
         q = dense(H * D, "wq")(x).reshape(B, T, H, D)
         k = dense(HKV * D, "wk")(x).reshape(B, T, HKV, D)
         v = dense(HKV * D, "wv")(x).reshape(B, T, HKV, D)
@@ -205,7 +215,8 @@ class LlamaMLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = lambda feat, name: nn.Dense(  # noqa: E731
-            feat, use_bias=False, dtype=cfg.dtype, name=name)
+            feat, use_bias=False, dtype=cfg.dtype, name=name,
+            dot_general=maybe_switchback(cfg.int8_training))
         g = dense(cfg.intermediate_size, "gate")(x)
         u = dense(cfg.intermediate_size, "up")(x)
         return dense(cfg.n_embd, "down")(jax.nn.silu(g) * u)

@@ -99,6 +99,13 @@ def _switchback_bwd(res, dy):
 switchback_matmul.defvjp(_switchback_fwd, _switchback_bwd)
 
 
+def maybe_switchback(enabled: bool):
+    """``flax.linen.Dense(dot_general=...)`` value for a model config:
+    the SwitchBack seam when int8 training is enabled, ``None`` (flax's
+    stock ``lax.dot_general``) otherwise."""
+    return switchback_dot_general if enabled else None
+
+
 def switchback_dot_general(lhs, rhs, dimension_numbers, precision=None,
                            preferred_element_type=None):
     """``flax.linen.Dense(dot_general=...)`` seam: route the Dense

@@ -117,3 +117,32 @@ def test_int8_training_converges_on_real_text():
         loss = engine.train_batch()["loss"]
     final = float(loss)
     assert final < 2.9, f"int8 training lost accuracy: step-200 {final}"
+
+
+def test_llama_trains_with_int8_training():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaLMModel, config_for
+    model = LlamaLMModel(config_for("llama-tiny", n_positions=64,
+                                    int8_training=True))
+    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, 512, (engine.train_batch_size, 64)), jnp.int32)}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_int8_training_rejects_moe():
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.llama import LlamaConfig
+    with pytest.raises(ValueError, match="int8_training"):
+        GPT2Config(num_experts=4, int8_training=True)
+    with pytest.raises(ValueError, match="int8_training"):
+        LlamaConfig(num_experts=4, int8_training=True)
