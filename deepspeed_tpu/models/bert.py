@@ -46,6 +46,9 @@ class BertConfig:
     pre_layer_norm: bool = True      # reference default (preln modeling)
     with_nsp: bool = True
     dtype: Any = jnp.bfloat16
+    # SwitchBack int8 projections in every encoder layer (see
+    # ops/int8_training.py; the MLM/NSP heads stay full precision)
+    int8_training: bool = False
 
 
 PRESETS: Dict[str, dict] = {
@@ -82,6 +85,7 @@ class BertPreTrainingModel:
             layer_norm_eps=config.layer_norm_eps,
             pre_layer_norm=config.pre_layer_norm,
             fp16=config.dtype == jnp.bfloat16,
+            int8_training=config.int8_training,
             training=True)
         self.layers = [DeepSpeedTransformerLayer(layer_cfg)
                        for _ in range(config.num_hidden_layers)]

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
 from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
+from deepspeed_tpu.ops.int8_training import maybe_switchback
 from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
@@ -135,14 +136,6 @@ def config_for(name: str, **overrides) -> GPT2Config:
     return GPT2Config(**{**PRESETS[name], **overrides})
 
 
-def _proj_dot(cfg: GPT2Config):
-    """Projection dot_general: the SwitchBack int8 seam when the config
-    opts in, flax's stock dot otherwise (None). Import stays lazy so the
-    stock path never touches the op module."""
-    from deepspeed_tpu.ops.int8_training import maybe_switchback
-    return maybe_switchback(cfg.int8_training)
-
-
 class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
@@ -152,7 +145,7 @@ class CausalSelfAttention(nn.Module):
         B, T, C = x.shape
         H = cfg.n_head
         qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn",
-                       dot_general=_proj_dot(cfg))(x)
+                       dot_general=maybe_switchback(cfg.int8_training))(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
@@ -186,7 +179,7 @@ class CausalSelfAttention(nn.Module):
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=cfg.dtype, name="c_proj",
-                     dot_general=_proj_dot(cfg))(y)
+                     dot_general=maybe_switchback(cfg.int8_training))(y)
         if cfg.dropout > 0.0 and not deterministic:
             y = nn.Dropout(cfg.dropout)(y, deterministic=False)
         return y
@@ -200,10 +193,10 @@ class MLP(nn.Module):
         cfg = self.config
         C = x.shape[-1]
         h = nn.Dense(4 * C, dtype=cfg.dtype, name="c_fc",
-                     dot_general=_proj_dot(cfg))(x)
+                     dot_general=maybe_switchback(cfg.int8_training))(x)
         h = jax.nn.gelu(h, approximate=True)
         h = nn.Dense(C, dtype=cfg.dtype, name="c_proj",
-                     dot_general=_proj_dot(cfg))(h)
+                     dot_general=maybe_switchback(cfg.int8_training))(h)
         if cfg.dropout > 0.0 and not deterministic:
             h = nn.Dropout(cfg.dropout)(h, deterministic=False)
         return h

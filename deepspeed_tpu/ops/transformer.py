@@ -69,6 +69,9 @@ class DeepSpeedTransformerConfig:
     stochastic_mode: bool = False         # no-op: XLA is deterministic
     return_tuple: bool = False
     training: bool = True
+    # SwitchBack int8 projections (ops/int8_training.py): qkv/attn-out/
+    # FFN GEMMs run int8 x int8 on the MXU; dw stays full precision
+    int8_training: bool = False
 
     @property
     def ffn(self) -> int:
@@ -139,6 +142,14 @@ class DeepSpeedTransformerLayer:
     def _ln(self, x, w, b):
         return layer_norm_fp32(x, w, b, self.config.layer_norm_eps)
 
+    def _mm(self, x, w):
+        """Projection GEMM seam: SwitchBack int8 dot when the config
+        opts in (ops/int8_training.py), plain bf16 matmul otherwise."""
+        if self.config.int8_training:
+            from deepspeed_tpu.ops.int8_training import switchback_matmul
+            return switchback_matmul(x, w)
+        return x @ w
+
     def _dropout(self, x, rate, rng, deterministic):
         if deterministic or rate <= 0.0 or rng is None:
             return x, rng
@@ -150,7 +161,7 @@ class DeepSpeedTransformerLayer:
         cfg = self.config
         B, T, E = x.shape
         H, D = cfg.heads, E // cfg.heads
-        qkv = x @ params["attn_qkvw"] + params["attn_qkvb"]
+        qkv = self._mm(x, params["attn_qkvw"]) + params["attn_qkvb"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
@@ -179,7 +190,8 @@ class DeepSpeedTransformerLayer:
                 att, rng = self._dropout(att, cfg.attn_dropout_ratio, rng,
                                          deterministic)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
-        y = y.reshape(B, T, E) @ params["attn_ow"] + params["attn_ob"]
+        y = self._mm(y.reshape(B, T, E), params["attn_ow"]) \
+            + params["attn_ob"]
         return y, rng
 
     def apply(self, params: Dict[str, Any], x,
@@ -198,9 +210,9 @@ class DeepSpeedTransformerLayer:
             x = x + attn
             h = self._ln(x, params["norm_w"], params["norm_b"])
             ffn = jax.nn.gelu(
-                (h @ params["inter_w"] + params["inter_b"]
+                (self._mm(h, params["inter_w"]) + params["inter_b"]
                  ).astype(jnp.float32), approximate=False).astype(cfg.dtype)
-            ffn = ffn @ params["output_w"] + params["output_b"]
+            ffn = self._mm(ffn, params["output_w"]) + params["output_b"]
             ffn, rng = self._dropout(ffn, cfg.hidden_dropout_ratio, rng, det)
             out = x + ffn
         else:  # post-LN (original BERT)
@@ -209,9 +221,9 @@ class DeepSpeedTransformerLayer:
                                       det)
             x = self._ln(x + attn, params["attn_nw"], params["attn_nb"])
             ffn = jax.nn.gelu(
-                (x @ params["inter_w"] + params["inter_b"]
+                (self._mm(x, params["inter_w"]) + params["inter_b"]
                  ).astype(jnp.float32), approximate=False).astype(cfg.dtype)
-            ffn = ffn @ params["output_w"] + params["output_b"]
+            ffn = self._mm(ffn, params["output_w"]) + params["output_b"]
             ffn, rng = self._dropout(ffn, cfg.hidden_dropout_ratio, rng, det)
             out = self._ln(x + ffn, params["norm_w"], params["norm_b"])
         if cfg.return_tuple:

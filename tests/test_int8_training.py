@@ -146,3 +146,32 @@ def test_int8_training_rejects_moe():
         GPT2Config(num_experts=4, int8_training=True)
     with pytest.raises(ValueError, match="int8_training"):
         LlamaConfig(num_experts=4, int8_training=True)
+
+
+def test_bert_layer_int8_forward_and_grads_finite():
+    from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                               DeepSpeedTransformerLayer)
+    cfg = DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                     attn_dropout_ratio=0.0,
+                                     hidden_dropout_ratio=0.0, fp16=True,
+                                     int8_training=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = _rand((2, 128, 64), 7).astype(jnp.bfloat16)
+
+    def loss(p):
+        return jnp.sum(layer.apply(p, x).astype(jnp.float32))
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+               for g in leaves)
+    # int8 output tracks the bf16 layer closely (same params)
+    import dataclasses
+    ref = DeepSpeedTransformerLayer(
+        dataclasses.replace(cfg, int8_training=False)).apply(params, x)
+    out = layer.apply(params, x)
+    rel = float(jnp.linalg.norm((out - ref).astype(jnp.float32))
+                / jnp.linalg.norm(ref.astype(jnp.float32)))
+    assert rel < 0.05, rel
