@@ -29,7 +29,8 @@ from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.kv_cache import (KVCache, auto_max_tokens,
                                               init_cache)
 from deepspeed_tpu.model_implementations.transformer import (
-    InferenceTransformerConfig, causal_forward, decode_step, encoder_forward,
+    InferenceTransformerConfig, causal_forward, decode_chunk, decode_step,
+    encoder_forward,
     init_params, prefill, tp_param_specs)
 
 
@@ -424,6 +425,197 @@ class InferenceEngine:
             self._model_times.append(_time.perf_counter() - t0)
         return [np.asarray(ids[b, :lengths[b]]).tolist()
                 + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
+
+    def generate_speculative(self, input_ids, draft: "InferenceEngine",
+                             max_new_tokens: int = 32,
+                             draft_tokens: int = 4,
+                             eos_token_id: Optional[int] = None,
+                             attention_mask=None) -> list:
+        """Greedy speculative decoding with a smaller draft engine:
+        IDENTICAL output to ``generate`` (greedy acceptance is exact),
+        fewer target-model steps. Each round the draft proposes
+        ``draft_tokens - 1`` tokens sequentially; the target scores the
+        whole candidate chunk in ONE ``decode_chunk`` forward and commits
+        the longest agreeing prefix plus its own correction token — 1 to
+        ``draft_tokens`` tokens per target forward.
+
+        TPU-native shape: the whole accept/rollback loop is one jitted
+        ``lax.while_loop`` (one host sync per generation); rollback is
+        free because the static KV cache masks by per-row ``lengths``, so
+        rejected positions are simply never advanced over. Beyond the
+        reference (strictly one-token decode); greedy only.
+        """
+        import time as _time
+        t0 = (_time.perf_counter()
+              if getattr(self, "model_profile_enabled", False) else None)
+        if draft_tokens < 2:
+            raise ValueError(f"draft_tokens must be >= 2, got "
+                             f"{draft_tokens} (1 draft proposal minimum)")
+        if self.model_config.head == "none" or \
+                draft.model_config.head == "none":
+            raise ValueError("speculative decoding needs LM heads on "
+                             "both engines")
+        if self.model_config.vocab_size != draft.model_config.vocab_size:
+            raise ValueError(
+                f"target/draft vocab sizes differ "
+                f"({self.model_config.vocab_size} vs "
+                f"{draft.model_config.vocab_size}) — token ids must be "
+                "interchangeable")
+        ids, lengths = _pad_batch(input_ids, attention_mask)
+        B, T = ids.shape
+        if max_new_tokens <= 0:
+            if t0 is not None:
+                self._model_times.append(_time.perf_counter() - t0)
+            return [np.asarray(ids[b, :lengths[b]]).tolist()
+                    for b in range(B)]
+        # same schedulability contract as generate()
+        if "max_batch_size" in self.config.model_fields_set and \
+                B > self.config.max_batch_size:
+            raise ValueError(
+                f"batch {B} exceeds the configured max_batch_size="
+                f"{self.config.max_batch_size}")
+        if max_new_tokens < self.config.min_out_tokens:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} is below "
+                f"min_out_tokens={self.config.min_out_tokens} (reference "
+                "inference/engine.py rejects un-schedulable budgets)")
+        K = int(draft_tokens)
+        # margin: the draft runs K appends past the last committed token,
+        # and the final round may overshoot max_new by up to K
+        max_seq = _round_up(int(lengths.max()) + max_new_tokens + 2 * K,
+                            128)
+        for eng in (self, draft):
+            budget = eng._max_out_budget(B)
+            if max_seq > budget:
+                raise ValueError(
+                    f"prompt + max_new_tokens + draft margin needs a "
+                    f"{max_seq}-token KV cache but the "
+                    f"{'draft' if eng is draft else 'target'} budget is "
+                    f"{budget} tokens (max_out_tokens="
+                    f"{eng.config.max_out_tokens!r})")
+        cache_t = self._make_cache(B, max_seq)
+        cache_d = draft._make_cache(B, max_seq)
+        logits_t, cache_t = self._prefill_jit(
+            self.params, input_ids=jnp.asarray(ids),
+            lengths=jnp.asarray(lengths), cache=cache_t)
+        _, cache_d = draft._prefill_jit(
+            draft.params, input_ids=jnp.asarray(ids),
+            lengths=jnp.asarray(lengths), cache=cache_d)
+        loop = self._speculative_loop(draft, max_new_tokens, K)
+        out_buf, n_gen, rounds, _, _ = loop(
+            self.params, draft.params, logits_t, cache_t, cache_d,
+            jnp.int32(-1 if eos_token_id is None else eos_token_id))
+        out_np = np.asarray(out_buf)[:, :max_new_tokens]
+        n_np = np.minimum(np.asarray(n_gen), max_new_tokens)
+        # acceptance telemetry: tokens-per-target-forward is THE number
+        # that decides whether a draft pays off (rounds counts verify
+        # forwards; +1 for the prefill token)
+        total = int(n_np.sum())
+        self.last_speculative_stats = {
+            "rounds": int(rounds), "tokens": total,
+            "tokens_per_round": round(total / max(int(rounds), 1), 3)}
+        if t0 is not None:
+            self._model_times.append(_time.perf_counter() - t0)
+        return [np.asarray(ids[b, :lengths[b]]).tolist()
+                + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
+
+    def _speculative_loop(self, draft: "InferenceEngine",
+                          max_new_tokens: int, K: int):
+        """Jitted draft→verify→commit loop (see generate_speculative)."""
+        key = ("spec", id(draft), max_new_tokens, K)
+        # the cache entry holds a strong reference to the draft: id() is
+        # only unique while the object lives, so a GC'd draft's reused id
+        # must not serve a stale loop closed over its config/mesh
+        hit = self._gen_loops.get(key)
+        if hit is not None:
+            return hit[0]
+        cfg_t, cfg_d = self.model_config, draft.model_config
+        mesh_t, mesh_d = self.mesh, draft.mesh
+
+        def run(params_t, params_d, logits_t, cache_t, cache_d, eos):
+            B = logits_t.shape[0]
+            cur = jnp.argmax(logits_t, -1).astype(jnp.int32)  # token 0
+            out = jnp.zeros((B, max_new_tokens + K), jnp.int32)
+            out = out.at[:, 0].set(cur)
+            n_gen = jnp.ones((B,), jnp.int32)
+            done = cur == eos
+
+            def cond(c):
+                done, n_gen = c[3], c[4]
+                return jnp.any(~done & (n_gen < max_new_tokens))
+
+            def body(c):
+                cur, cache_t, cache_d, done, n_gen, out, rounds = c
+                base_t = cache_t.lengths   # committed context length
+                base_d = cache_d.lengths
+
+                # 1) draft proposes K-1 tokens; the K-th step only backfills
+                # d_{K-1}'s k/v so a full accept leaves no cache hole
+                def dstep(carry, _):
+                    tok, cd = carry
+                    lg, cd = decode_step(params_d, cfg_d, tok, cd,
+                                         mesh=mesh_d)
+                    nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                    return (nxt, cd), nxt
+
+                (_, cache_d), drafts = jax.lax.scan(
+                    dstep, (cur, cache_d), None, length=K)
+                drafts = jnp.swapaxes(drafts, 0, 1)      # [B, K] d1..dK
+
+                # 2) target verifies [cur, d1..d_{K-1}] in one forward
+                chunk = jnp.concatenate([cur[:, None], drafts[:, :K - 1]],
+                                        axis=1)          # [B, K]
+                lg_t, cache_t = decode_chunk(params_t, cfg_t, chunk,
+                                             cache_t, mesh=mesh_t)
+                t_toks = jnp.argmax(lg_t, -1).astype(jnp.int32)  # [B, K]
+
+                # 3) longest agreeing prefix: m = #accepted drafts (0..K-1)
+                matches = drafts[:, :K - 1] == t_toks[:, :K - 1]
+                m = jnp.argmin(
+                    jnp.concatenate(
+                        [matches, jnp.zeros((B, 1), bool)], 1).astype(
+                            jnp.int32), axis=1)          # first mismatch
+                # committed tokens: d1..dm then the correction t_m
+                iota = jnp.arange(K)[None, :]
+                correction = jnp.take_along_axis(t_toks, m[:, None], 1)
+                committed = jnp.where(iota < m[:, None], drafts,
+                                      correction)        # [B, K]
+                active = ~done
+                commit_mask = (iota <= m[:, None]) & active[:, None]
+                # tokens after an in-block EOS must not count as output
+                is_eos = (committed == eos) & commit_mask
+                after_eos = (jnp.cumsum(is_eos.astype(jnp.int32), 1)
+                             - is_eos.astype(jnp.int32)) > 0
+                emit = commit_mask & ~after_eos
+                rows = jnp.arange(B)[:, None]
+                cols = jnp.clip(n_gen[:, None] + iota, 0,
+                                max_new_tokens + K - 1)
+                gathered = out[rows, cols]
+                out = out.at[rows, cols].set(
+                    jnp.where(emit, committed, gathered))
+                n_gen = n_gen + jnp.sum(emit.astype(jnp.int32), 1)
+                done = done | jnp.any(is_eos, 1) | (n_gen >= max_new_tokens)
+
+                # 4) cache bookkeeping: context gains [cur, d1..dm] on
+                # active rows (the correction becomes the next `cur`);
+                # draft rolls back from its K appends to the same point
+                adv = jnp.where(active, m + 1, 0)
+                cache_t = cache_t.replace(lengths=base_t + adv)
+                cache_d = cache_d.replace(lengths=base_d + adv)
+                cur = jnp.where(active, correction[:, 0], cur)
+                return cur, cache_t, cache_d, done, n_gen, out, rounds + 1
+
+            carry = (cur, cache_t, cache_d, done, n_gen, out,
+                     jnp.int32(0))
+            carry = jax.lax.while_loop(cond, body, carry)
+            # final caches returned (and dropped by the caller) so the
+            # donated inputs can actually alias an output — same pattern
+            # as _generate_loop
+            return carry[5], carry[4], carry[6], carry[1], carry[2]
+
+        loop = jax.jit(run, donate_argnames=("cache_t", "cache_d"))
+        self._gen_loops[key] = (loop, draft)
+        return loop
 
     def _beam_loop(self, max_new_tokens: int, num_beams: int):
         """Jitted beam search (the reference serves beams through HF's

@@ -119,5 +119,27 @@ def append_token(cache: KVCache, layer: int, k: jnp.ndarray,
     return cache.replace(k=newk, v=newv)
 
 
+def write_chunk(cache: KVCache, layer: int, k: jnp.ndarray,
+                v: jnp.ndarray) -> KVCache:
+    """Speculative verify: write a K-token chunk's ``[B, K, H, D]`` k/v
+    at positions ``lengths[b] .. lengths[b]+K-1`` per row.
+
+    Lengths are NOT advanced — the caller commits only the accepted
+    prefix (rejected draft positions stay as garbage beyond ``lengths``,
+    which attention masks and later writes overwrite, exactly like
+    right-padding after :func:`write_prompt`)."""
+    def upd(cache_layer, x, i):
+        # cache_layer [S, H, D], x [K, H, D]
+        return jax.lax.dynamic_update_slice(cache_layer, x, (i, 0, 0))
+
+    newk_l = jax.vmap(upd)(cache.k[layer], k.astype(cache.k.dtype),
+                           cache.lengths)
+    newv_l = jax.vmap(upd)(cache.v[layer], v.astype(cache.v.dtype),
+                           cache.lengths)
+    newk = jax.lax.dynamic_update_index_in_dim(cache.k, newk_l, layer, 0)
+    newv = jax.lax.dynamic_update_index_in_dim(cache.v, newv_l, layer, 0)
+    return cache.replace(k=newk, v=newv)
+
+
 def advance(cache: KVCache, n: int = 1) -> KVCache:
     return cache.replace(lengths=cache.lengths + n)

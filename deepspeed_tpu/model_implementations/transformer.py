@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.inference.kv_cache import (KVCache, advance, append_token,
-                                              write_prompt)
+                                              write_chunk, write_prompt)
 from deepspeed_tpu.ops.int8_gemm import (maybe_int8_einsum,
                                          maybe_int8_matmul)
 
@@ -463,6 +463,37 @@ def _decode_attention(q, k_cache, v_cache, live,
                       ).astype(q.dtype)
 
 
+def _chunk_attention(q, k_cache, v_cache, lengths,
+                     cfg: InferenceTransformerConfig, window=None):
+    """Speculative-verify attention: ``q [B, K, H, D]`` for K tokens at
+    positions ``lengths[b]..lengths[b]+K-1``, against a cache that
+    already holds the chunk's own k/v at those positions
+    (:func:`deepspeed_tpu.inference.kv_cache.write_chunk`). Per-query
+    causal bound: key position s is visible to chunk query i iff
+    ``s < lengths[b] + i + 1``. K is small (the draft window), so the
+    XLA einsum path is the right tool — no Pallas kernel needed."""
+    B, K, H, D = q.shape
+    KH = k_cache.shape[2]
+    S = k_cache.shape[1]
+    s = jnp.einsum("bkhd,bshd->bhks", q, _repeat_kv(k_cache, H // KH),
+                   preferred_element_type=jnp.float32)
+    s = s * cfg.scale
+    pos = jnp.arange(S)[None, None, None, :]            # [1,1,1,S]
+    qpos = (lengths[:, None] + jnp.arange(K)[None, :])  # [B,K]
+    if cfg.positional == "alibi":
+        slopes = alibi_slopes(H) * cfg.alibi_scale
+        s = s + slopes[None, :, None, None] * (
+            pos - qpos[:, None, :, None])
+    live = (qpos + 1)[:, None, :, None]                 # [B,1,K,1]
+    s = jnp.where(pos < live, s, NEG_INF)
+    if window is not None:
+        s = jnp.where(pos > (qpos[:, None, :, None] - window), s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhks,bshd->bkhd", p,
+                      _repeat_kv(v_cache, H // KH).astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
 # ---------------------------------------------------------------- blocks
 
 def _qkv(x, a, cfg, positions):
@@ -571,6 +602,26 @@ def _ffn(x, layer, cfg, mesh=None):
     return _mlp(x, layer["mlp"], cfg)
 
 
+def _post_attn(x, ln1_out, attn_out, layer, cfg, mesh=None):
+    """Shared residual/LN trident after attention (parallel-attn-mlp /
+    pre-LN / post-LN) — ONE definition for _block_seq, _block_decode and
+    _block_chunk so the prefill, decode and verify paths cannot
+    diverge."""
+    if cfg.parallel_attn_mlp:
+        # GPT-J/NeoX: x + attn(ln1(x)) + mlp(ln(x)); GPT-J shares ln1
+        ln2 = layer.get("ln2")
+        mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)
+                  if ln2 is not None else ln1_out)
+        return x + attn_out + _ffn(mlp_in, layer, cfg, mesh)
+    if cfg.pre_layer_norm:
+        x = x + attn_out
+        return x + _ffn(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
+                        layer, cfg, mesh)
+    x = _layer_norm(x + attn_out, layer["ln1"], cfg.layer_norm_eps)
+    return _layer_norm(x + _ffn(x, layer, cfg, mesh), layer["ln2"],
+                       cfg.layer_norm_eps)
+
+
 def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
                causal=True, key_mask=None, mesh=None):
     """Full-sequence block (prefill / encoder). x [B, T, E]."""
@@ -585,22 +636,7 @@ def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
                               window=window)
     attn_out = maybe_int8_einsum("...hd,hde->...e", attn, a["wo"],
                                  x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
-    if cfg.parallel_attn_mlp:
-        # GPT-J/NeoX: x + attn(ln1(x)) + mlp(ln(x)); GPT-J shares ln1
-        ln2 = layer.get("ln2")
-        mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)
-                  if ln2 is not None else ln1_out)
-        out = x + attn_out + _ffn(mlp_in, layer, cfg, mesh)
-        return out, cache
-    if cfg.pre_layer_norm:
-        x = x + attn_out
-        out = x + _ffn(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
-                       layer, cfg, mesh)
-    else:  # BERT post-LN
-        x = _layer_norm(x + attn_out, layer["ln1"], cfg.layer_norm_eps)
-        out = _layer_norm(x + _ffn(x, layer, cfg, mesh),
-                          layer["ln2"], cfg.layer_norm_eps)
-    return out, cache
+    return _post_attn(x, ln1_out, attn_out, layer, cfg, mesh), cache
 
 
 def _block_decode(x, layer, cfg, cache, layer_idx, mesh=None):
@@ -616,18 +652,48 @@ def _block_decode(x, layer, cfg, cache, layer_idx, mesh=None):
                              cache.lengths + 1, cfg, window=window)
     attn_out = maybe_int8_einsum("bhd,hde->be", attn, a["wo"],
                                  x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
-    if cfg.parallel_attn_mlp:
-        ln2 = layer.get("ln2")
-        mlp_in = (_layer_norm(x, ln2, cfg.layer_norm_eps)
-                  if ln2 is not None else ln1_out)
-        return x + attn_out + _ffn(mlp_in, layer, cfg, mesh), cache
-    if cfg.pre_layer_norm:
-        x = x + attn_out
-        return x + _ffn(_layer_norm(x, layer["ln2"], cfg.layer_norm_eps),
-                        layer, cfg, mesh), cache
-    x = _layer_norm(x + attn_out, layer["ln1"], cfg.layer_norm_eps)
-    return _layer_norm(x + _ffn(x, layer, cfg, mesh), layer["ln2"],
-                       cfg.layer_norm_eps), cache
+    return _post_attn(x, ln1_out, attn_out, layer, cfg, mesh), cache
+
+
+def _block_chunk(x, layer, cfg, cache, layer_idx, mesh=None):
+    """K-token verify block (speculative decoding). x [B, K, E]; writes
+    the chunk's k/v at per-row offsets without advancing lengths."""
+    a = layer["attn"]
+    ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
+    h = ln1_out if cfg.pre_layer_norm else x
+    K = x.shape[1]
+    positions = cache.lengths[:, None] + jnp.arange(K)[None, :]  # [B, K]
+    q, k, v = _qkv(h, a, cfg, positions)
+    cache = write_chunk(cache, layer_idx, k, v)
+    window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
+    attn = _chunk_attention(q, cache.k[layer_idx], cache.v[layer_idx],
+                            cache.lengths, cfg, window=window)
+    attn_out = maybe_int8_einsum("...hd,hde->...e", attn, a["wo"],
+                                 x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
+    return _post_attn(x, ln1_out, attn_out, layer, cfg, mesh), cache
+
+
+def decode_chunk(params, cfg: InferenceTransformerConfig, tokens,
+                 cache: KVCache, mesh=None):
+    """Speculative verify: score K candidate tokens ``[B, K]`` in ONE
+    forward at positions ``lengths[b]..lengths[b]+K-1`` → (logits
+    ``[B, K, V]``, cache). The chunk's k/v are written into the cache;
+    lengths are NOT advanced — the caller commits the accepted prefix by
+    advancing per-row (rejected positions remain masked garbage). This
+    is the target-model half of speculative decoding; there is no
+    reference analog (the reference's engine is strictly one-token
+    decode, csrc/transformer/inference)."""
+    if cfg.seq_shard_kv:
+        raise NotImplementedError(
+            "decode_chunk with seq-sharded KV is unsupported — run "
+            "speculative decoding without seq_shard_kv")
+    B, K = tokens.shape
+    positions = cache.lengths[:, None] + jnp.arange(K)[None, :]
+    x = _embed(params, cfg, tokens, positions)
+    for i, layer in enumerate(params["layers"]):
+        x, cache = _block_chunk(x, layer, cfg, cache, i, mesh)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    return _logits(params, cfg, x), cache
 
 
 # ---------------------------------------------------------------- model

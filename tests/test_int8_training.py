@@ -175,3 +175,32 @@ def test_bert_layer_int8_forward_and_grads_finite():
     rel = float(jnp.linalg.norm((out - ref).astype(jnp.float32))
                 / jnp.linalg.norm(ref.astype(jnp.float32)))
     assert rel < 0.05, rel
+
+
+def test_int8_training_composes_with_tensor_parallel():
+    """SwitchBack under TP: the per-column weight amax (axis 0 = the
+    contraction dim, local for column-parallel shards; cross-shard for
+    row-parallel, where GSPMD inserts the reduction) must compose with
+    the Megatron PartitionSpecs — the engine trains on a tensor=2 mesh
+    with finite, decreasing loss."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    mesh = build_mesh(MeshConfig(data=4, tensor=2))
+    model = GPT2LMModel(GPT2Config(
+        n_layer=2, n_embd=128, n_head=4, vocab_size=256, n_positions=64,
+        dtype=jnp.bfloat16, use_flash_attention=False, remat=False,
+        vocab_pad_multiple=128, int8_training=True))
+    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, 256, (engine.train_batch_size, 64)), jnp.int32)}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(5)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,160 @@
+"""Speculative decoding (engine.generate_speculative): greedy acceptance
+must produce IDENTICAL tokens to vanilla greedy generate — the draft can
+only change how many target forwards run, never the output. Also pins
+the decode_chunk primitive against sequential decode_step."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, decode_chunk, decode_step, init_params,
+    prefill)
+from deepspeed_tpu.inference.kv_cache import init_cache
+
+
+def _cfg(layers=2, embd=64, heads=4, vocab=128, **kw):
+    return InferenceTransformerConfig(
+        vocab_size=vocab, n_positions=256, n_embd=embd, n_layer=layers,
+        n_head=heads, dtype=jnp.float32, **kw)
+
+
+def _engine(cfg, seed):
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    return InferenceEngine((cfg, params),
+                           DeepSpeedInferenceConfig(max_out_tokens=512))
+
+
+def test_decode_chunk_matches_sequential_decode_steps():
+    """K tokens through decode_chunk == the same K tokens through K
+    decode_step calls: logits at every position match."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    B, T, K = 2, 7, 4
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, T)), jnp.int32)
+    lengths = jnp.asarray([T, T - 2], jnp.int32)
+    toks = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, K)), jnp.int32)
+
+    cache1 = init_cache(cfg.n_layer, B, 256, cfg.kv_heads, cfg.head_dim,
+                        jnp.float32)
+    _, cache1 = prefill(params, cfg, ids, lengths, cache1)
+    lg_chunk, _ = decode_chunk(params, cfg, toks, cache1)
+
+    cache2 = init_cache(cfg.n_layer, B, 256, cfg.kv_heads, cfg.head_dim,
+                        jnp.float32)
+    _, cache2 = prefill(params, cfg, ids, lengths, cache2)
+    seq_logits = []
+    for i in range(K):
+        lg, cache2 = decode_step(params, cfg, toks[:, i], cache2)
+        seq_logits.append(lg)
+    seq = jnp.stack(seq_logits, axis=1)  # [B, K, V]
+    np.testing.assert_allclose(np.asarray(lg_chunk), np.asarray(seq),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_chunk_does_not_advance_lengths():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cache = init_cache(cfg.n_layer, 1, 256, cfg.kv_heads, cfg.head_dim,
+                       jnp.float32)
+    ids = jnp.ones((1, 4), jnp.int32)
+    _, cache = prefill(params, cfg, ids, jnp.asarray([4]), cache)
+    _, cache2 = decode_chunk(params, cfg, jnp.ones((1, 3), jnp.int32),
+                             cache)
+    assert int(cache2.lengths[0]) == 4  # caller commits the accepted part
+
+
+def _assert_equal_up_to_ties(target, want_row, got_row, tol=0.05):
+    """Greedy speculative is exact w.r.t. the target's logits; the only
+    legitimate divergence from the vanilla loop is an argmax TIE between
+    the two numerically-equivalent decode paths (observed gaps ~1e-2 on
+    random weights). At the first mismatch, re-score the shared prefix
+    with the full-sequence oracle and require the two chosen tokens to
+    be within ``tol`` logits — any larger gap is a real bug."""
+    if want_row == got_row:
+        return
+    n = min(len(want_row), len(got_row))
+    i = next((i for i in range(n) if want_row[i] != got_row[i]), None)
+    assert i is not None, (
+        f"length mismatch with equal overlap ({len(want_row)} vs "
+        f"{len(got_row)}) — not explainable by an argmax tie")
+    prefix = want_row[:i]
+    lg = np.asarray(target.forward(jnp.asarray([prefix], jnp.int32))[0, -1])
+    gap = abs(float(lg[want_row[i]] - lg[got_row[i]]))
+    top = float(np.max(lg))
+    assert gap < tol and top - max(lg[want_row[i]], lg[got_row[i]]) < tol, (
+        f"non-tie divergence at {i}: want {want_row[i]} "
+        f"(logit {lg[want_row[i]]}) got {got_row[i]} "
+        f"(logit {lg[got_row[i]]}), top {top}")
+
+
+@pytest.mark.parametrize("draft_seed,label", [
+    (0, "self-draft (always accepts)"),
+    (1, "random draft (mostly rejects)"),
+])
+def test_speculative_matches_vanilla_greedy(draft_seed, label):
+    """Exactness: speculative output == vanilla greedy output token for
+    token (up to oracle-verified argmax ties), whether the draft agrees
+    (seed 0 = same params: every proposal accepted) or disagrees
+    (different params: constant rollback)."""
+    cfg_t = _cfg(layers=2, embd=64)
+    target = _engine(cfg_t, seed=0)
+    draft = _engine(_cfg(layers=1, embd=64), seed=draft_seed)
+
+    prompts = [[5, 9, 3, 17, 2], [11, 4]]
+    want = target.generate(prompts, max_new_tokens=24)
+    got = target.generate_speculative(prompts, draft, max_new_tokens=24,
+                                      draft_tokens=4)
+    for b in range(len(prompts)):
+        _assert_equal_up_to_ties(target, want[b], got[b])
+
+
+def test_speculative_respects_eos_and_budget():
+    cfg_t = _cfg()
+    target = _engine(cfg_t, seed=0)
+    draft = _engine(_cfg(layers=1), seed=0)
+    prompts = [[5, 9, 3]]
+    base = target.generate(prompts, max_new_tokens=16)
+    # pick the 3rd generated token as EOS: both paths must stop there
+    eos = base[0][len(prompts[0]) + 2]
+    want = target.generate(prompts, max_new_tokens=16, eos_token_id=eos)
+    got = target.generate_speculative(prompts, draft, max_new_tokens=16,
+                                      draft_tokens=4, eos_token_id=eos)
+    _assert_equal_up_to_ties(target, want[0], got[0])
+    # tiny budget: exactly max_new_tokens tokens, no overshoot
+    want1 = target.generate(prompts, max_new_tokens=3)
+    got1 = target.generate_speculative(prompts, draft, max_new_tokens=3,
+                                       draft_tokens=4)
+    assert len(got1[0]) == len(want1[0]) == 3 + 3
+    _assert_equal_up_to_ties(target, want1[0], got1[0])
+
+
+def test_speculative_validates_inputs():
+    target = _engine(_cfg(), seed=0)
+    draft_badvocab = _engine(_cfg(vocab=64), seed=0)
+    with pytest.raises(ValueError, match="vocab"):
+        target.generate_speculative([[1, 2]], draft_badvocab)
+    draft = _engine(_cfg(layers=1), seed=0)
+    with pytest.raises(ValueError, match="draft_tokens"):
+        target.generate_speculative([[1, 2]], draft, draft_tokens=1)
+
+
+def test_speculative_stats_telemetry():
+    """Self-draft (identical params) accepts every proposal: K tokens
+    per verify round, so rounds ≈ ceil((max_new-1)/K) and
+    tokens_per_round ≈ K (the draft can only make this smaller)."""
+    target = _engine(_cfg(layers=2), seed=0)
+    draft = _engine(_cfg(layers=2), seed=0)  # same params: full accept
+    got = target.generate_speculative([[5, 9, 3]], draft,
+                                      max_new_tokens=17, draft_tokens=4)
+    st = target.last_speculative_stats
+    assert st["tokens"] == 17 == len(got[0]) - 3
+    # 1 prefill token + rounds x up-to-4: full accept -> 4 rounds. A
+    # near-tie argmax flip between the decode paths (see
+    # _assert_equal_up_to_ties) may cost a round or two on other
+    # backends, but most proposals must land.
+    assert 4 <= st["rounds"] <= 6, st
+    assert st["tokens_per_round"] >= 2.5
