@@ -294,6 +294,28 @@ class InferenceEngine:
 
     __call__ = forward
 
+    def _check_schedulable(self, B: int, max_new_tokens: int) -> None:
+        """Shared generate/generate_speculative admission contract."""
+        if "max_batch_size" in self.config.model_fields_set and \
+                B > self.config.max_batch_size:
+            # enforced only when the USER set the knob — the default must
+            # not reject batches the per-call KV allocation handles fine
+            raise ValueError(
+                f"batch {B} exceeds the configured max_batch_size="
+                f"{self.config.max_batch_size}")
+        if max_new_tokens < self.config.min_out_tokens:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} is below "
+                f"min_out_tokens={self.config.min_out_tokens} (reference "
+                "inference/engine.py rejects un-schedulable budgets)")
+
+    @staticmethod
+    def _assemble_output(ids, lengths, out_np, n_np) -> list:
+        """Prompt + generated tokens per row, as lists."""
+        return [np.asarray(ids[b, :lengths[b]]).tolist()
+                + out_np[b, :int(n_np[b])].tolist()
+                for b in range(len(lengths))]
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, num_beams: int = 1,
@@ -327,18 +349,7 @@ class InferenceEngine:
                 self._model_times.append(_time.perf_counter() - t0)
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
-        if "max_batch_size" in self.config.model_fields_set and \
-                B > self.config.max_batch_size:
-            # enforced only when the USER set the knob — the default must
-            # not reject batches the per-call KV allocation handles fine
-            raise ValueError(
-                f"batch {B} exceeds the configured max_batch_size="
-                f"{self.config.max_batch_size}")
-        if max_new_tokens < self.config.min_out_tokens:
-            raise ValueError(
-                f"max_new_tokens={max_new_tokens} is below "
-                f"min_out_tokens={self.config.min_out_tokens} (reference "
-                "inference/engine.py rejects un-schedulable budgets)")
+        self._check_schedulable(B, max_new_tokens)
         max_seq = _round_up(int(lengths.max()) + max_new_tokens, 128)
         budget = self._max_out_budget(B * max(num_beams, 1))
         if max_seq > budget:
@@ -376,8 +387,7 @@ class InferenceEngine:
             n_np = np.asarray(n_gen)
             if t0 is not None:
                 self._model_times.append(_time.perf_counter() - t0)
-            return [np.asarray(ids[b, :lengths[b]]).tolist()
-                    + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
+            return self._assemble_output(ids, lengths, out_np, n_np)
         cache = self._make_cache(B, max_seq)
         logits, cache = self._prefill_jit(
             self.params, input_ids=jnp.asarray(ids),
@@ -423,27 +433,33 @@ class InferenceEngine:
         n_np = np.asarray(n_gen)
         if t0 is not None:
             self._model_times.append(_time.perf_counter() - t0)
-        return [np.asarray(ids[b, :lengths[b]]).tolist()
-                + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
+        return self._assemble_output(ids, lengths, out_np, n_np)
 
     def generate_speculative(self, input_ids, draft: "InferenceEngine",
                              max_new_tokens: int = 32,
                              draft_tokens: int = 4,
+                             temperature: float = 0.0,
                              eos_token_id: Optional[int] = None,
-                             attention_mask=None) -> list:
-        """Greedy speculative decoding with a smaller draft engine:
-        IDENTICAL output to ``generate`` (greedy acceptance is exact),
-        fewer target-model steps. Each round the draft proposes
-        ``draft_tokens - 1`` tokens sequentially; the target scores the
-        whole candidate chunk in ONE ``decode_chunk`` forward and commits
-        the longest agreeing prefix plus its own correction token — 1 to
-        ``draft_tokens`` tokens per target forward.
+                             attention_mask=None, seed: int = 0) -> list:
+        """Speculative decoding with a smaller draft engine. Each round
+        the draft proposes ``draft_tokens - 1`` tokens sequentially; the
+        target scores the whole candidate chunk in ONE ``decode_chunk``
+        forward and commits 1 to ``draft_tokens`` tokens per forward.
+
+        ``temperature == 0``: greedy acceptance — IDENTICAL output to
+        greedy ``generate``. ``temperature > 0``: rejection-sampling
+        acceptance (Leviathan et al. / Chen et al., public technique):
+        proposal ``d_i`` accepted with prob ``min(1, p_t(d_i)/p_d(d_i))``,
+        the first rejection resampled from ``norm(max(p_t - p_d, 0))`` —
+        the committed stream is distributed EXACTLY like sampling from
+        the target alone, at temperature ``temperature``. top-k/top-p
+        filters are not supported on the speculative path.
 
         TPU-native shape: the whole accept/rollback loop is one jitted
         ``lax.while_loop`` (one host sync per generation); rollback is
         free because the static KV cache masks by per-row ``lengths``, so
         rejected positions are simply never advanced over. Beyond the
-        reference (strictly one-token decode); greedy only.
+        reference (strictly one-token decode).
         """
         import time as _time
         t0 = (_time.perf_counter()
@@ -468,17 +484,7 @@ class InferenceEngine:
                 self._model_times.append(_time.perf_counter() - t0)
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
-        # same schedulability contract as generate()
-        if "max_batch_size" in self.config.model_fields_set and \
-                B > self.config.max_batch_size:
-            raise ValueError(
-                f"batch {B} exceeds the configured max_batch_size="
-                f"{self.config.max_batch_size}")
-        if max_new_tokens < self.config.min_out_tokens:
-            raise ValueError(
-                f"max_new_tokens={max_new_tokens} is below "
-                f"min_out_tokens={self.config.min_out_tokens} (reference "
-                "inference/engine.py rejects un-schedulable budgets)")
+        self._check_schedulable(B, max_new_tokens)   # same as generate
         K = int(draft_tokens)
         # margin: the draft runs K appends past the last committed token,
         # and the final round may overshoot max_new by up to K
@@ -501,10 +507,12 @@ class InferenceEngine:
         _, cache_d = draft._prefill_jit(
             draft.params, input_ids=jnp.asarray(ids),
             lengths=jnp.asarray(lengths), cache=cache_d)
-        loop = self._speculative_loop(draft, max_new_tokens, K)
+        loop = self._speculative_loop(draft, max_new_tokens, K,
+                                      sampled=float(temperature) > 0.0)
         out_buf, n_gen, rounds, _, _ = loop(
             self.params, draft.params, logits_t, cache_t, cache_d,
-            jnp.int32(-1 if eos_token_id is None else eos_token_id))
+            jnp.int32(-1 if eos_token_id is None else eos_token_id),
+            jax.random.PRNGKey(seed), jnp.float32(max(temperature, 1e-6)))
         out_np = np.asarray(out_buf)[:, :max_new_tokens]
         n_np = np.minimum(np.asarray(n_gen), max_new_tokens)
         # acceptance telemetry: tokens-per-target-forward is THE number
@@ -516,13 +524,13 @@ class InferenceEngine:
             "tokens_per_round": round(total / max(int(rounds), 1), 3)}
         if t0 is not None:
             self._model_times.append(_time.perf_counter() - t0)
-        return [np.asarray(ids[b, :lengths[b]]).tolist()
-                + out_np[b, :int(n_np[b])].tolist() for b in range(B)]
+        return self._assemble_output(ids, lengths, out_np, n_np)
 
     def _speculative_loop(self, draft: "InferenceEngine",
-                          max_new_tokens: int, K: int):
+                          max_new_tokens: int, K: int,
+                          sampled: bool = False):
         """Jitted draft→verify→commit loop (see generate_speculative)."""
-        key = ("spec", id(draft), max_new_tokens, K)
+        key = ("spec", id(draft), max_new_tokens, K, sampled)
         # the cache entry holds a strong reference to the draft: id() is
         # only unique while the object lives, so a GC'd draft's reused id
         # must not serve a stale loop closed over its config/mesh
@@ -532,9 +540,15 @@ class InferenceEngine:
         cfg_t, cfg_d = self.model_config, draft.model_config
         mesh_t, mesh_d = self.mesh, draft.mesh
 
-        def run(params_t, params_d, logits_t, cache_t, cache_d, eos):
+        def run(params_t, params_d, logits_t, cache_t, cache_d, eos, rng,
+                temp):
             B = logits_t.shape[0]
-            cur = jnp.argmax(logits_t, -1).astype(jnp.int32)  # token 0
+            rng, sub = jax.random.split(rng)
+            if sampled:   # token 0 from the prefill logits
+                cur = jax.random.categorical(
+                    sub, logits_t / temp, -1).astype(jnp.int32)
+            else:
+                cur = jnp.argmax(logits_t, -1).astype(jnp.int32)
             out = jnp.zeros((B, max_new_tokens + K), jnp.int32)
             out = out.at[:, 0].set(cur)
             n_gen = jnp.ones((B,), jnp.int32)
@@ -545,39 +559,79 @@ class InferenceEngine:
                 return jnp.any(~done & (n_gen < max_new_tokens))
 
             def body(c):
-                cur, cache_t, cache_d, done, n_gen, out, rounds = c
+                cur, cache_t, cache_d, done, n_gen, out, rounds, rng = c
                 base_t = cache_t.lengths   # committed context length
                 base_d = cache_d.lengths
 
                 # 1) draft proposes K-1 tokens; the K-th step only backfills
                 # d_{K-1}'s k/v so a full accept leaves no cache hole
                 def dstep(carry, _):
-                    tok, cd = carry
+                    tok, cd, r = carry
                     lg, cd = decode_step(params_d, cfg_d, tok, cd,
                                          mesh=mesh_d)
-                    nxt = jnp.argmax(lg, -1).astype(jnp.int32)
-                    return (nxt, cd), nxt
+                    r, s = jax.random.split(r)
+                    if sampled:
+                        nxt = jax.random.categorical(
+                            s, lg / temp, -1).astype(jnp.int32)
+                        pd = jax.nn.softmax(lg / temp, -1)
+                    else:
+                        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                        pd = jnp.zeros((B, 1), jnp.float32)  # unused
+                    return (nxt, cd, r), (nxt, pd)
 
-                (_, cache_d), drafts = jax.lax.scan(
-                    dstep, (cur, cache_d), None, length=K)
+                rng, sub = jax.random.split(rng)
+                (_, cache_d, _), (drafts, pd) = jax.lax.scan(
+                    dstep, (cur, cache_d, sub), None, length=K)
                 drafts = jnp.swapaxes(drafts, 0, 1)      # [B, K] d1..dK
+                pd = jnp.swapaxes(pd, 0, 1)              # [B, K, V|1]
 
                 # 2) target verifies [cur, d1..d_{K-1}] in one forward
                 chunk = jnp.concatenate([cur[:, None], drafts[:, :K - 1]],
                                         axis=1)          # [B, K]
                 lg_t, cache_t = decode_chunk(params_t, cfg_t, chunk,
                                              cache_t, mesh=mesh_t)
-                t_toks = jnp.argmax(lg_t, -1).astype(jnp.int32)  # [B, K]
-
-                # 3) longest agreeing prefix: m = #accepted drafts (0..K-1)
-                matches = drafts[:, :K - 1] == t_toks[:, :K - 1]
-                m = jnp.argmin(
-                    jnp.concatenate(
-                        [matches, jnp.zeros((B, 1), bool)], 1).astype(
-                            jnp.int32), axis=1)          # first mismatch
-                # committed tokens: d1..dm then the correction t_m
                 iota = jnp.arange(K)[None, :]
-                correction = jnp.take_along_axis(t_toks, m[:, None], 1)
+                if sampled:
+                    # rejection sampling (speculative-decoding paper):
+                    # position i's target dist pt_i pairs with proposal
+                    # d_{i+1} ~ pd_i; accept while
+                    # u_i < pt_i(d_{i+1}) / pd_i(d_{i+1})
+                    pt = jax.nn.softmax(lg_t / temp, -1)  # [B, K, V]
+                    props = drafts[:, :K - 1]             # [B, K-1]
+                    p_t_at = jnp.take_along_axis(
+                        pt[:, :K - 1], props[:, :, None], 2)[..., 0]
+                    p_d_at = jnp.take_along_axis(
+                        pd[:, :K - 1], props[:, :, None], 2)[..., 0]
+                    rng, sub = jax.random.split(rng)
+                    u = jax.random.uniform(sub, (B, K - 1))
+                    accept = u * jnp.maximum(p_d_at, 1e-30) < p_t_at
+                    m = jnp.argmin(
+                        jnp.concatenate(
+                            [accept, jnp.zeros((B, 1), bool)], 1).astype(
+                                jnp.int32), axis=1)      # 0..K-1
+                    # correction dist at position m: residual
+                    # norm(max(pt-pd, 0)) after a rejection; raw pt at
+                    # the bonus position (m == K-1, nothing rejected)
+                    resid = jnp.maximum(
+                        pt[:, :K - 1] - pd[:, :K - 1], 0.0)
+                    dists = jnp.concatenate(
+                        [resid, pt[:, K - 1:]], axis=1)   # [B, K, V]
+                    dist_m = jnp.take_along_axis(
+                        dists, m[:, None, None], 1)[:, 0]  # [B, V]
+                    rng, sub = jax.random.split(rng)
+                    correction = jax.random.categorical(
+                        sub, jnp.log(dist_m + 1e-30), -1).astype(
+                            jnp.int32)[:, None]
+                else:
+                    t_toks = jnp.argmax(lg_t, -1).astype(jnp.int32)
+                    # longest agreeing prefix: m = #accepted (0..K-1)
+                    matches = drafts[:, :K - 1] == t_toks[:, :K - 1]
+                    m = jnp.argmin(
+                        jnp.concatenate(
+                            [matches, jnp.zeros((B, 1), bool)], 1).astype(
+                                jnp.int32), axis=1)      # first mismatch
+                    correction = jnp.take_along_axis(t_toks, m[:, None], 1)
+                # committed tokens: d1..dm then the correction
                 committed = jnp.where(iota < m[:, None], drafts,
                                       correction)        # [B, K]
                 active = ~done
@@ -603,10 +657,11 @@ class InferenceEngine:
                 cache_t = cache_t.replace(lengths=base_t + adv)
                 cache_d = cache_d.replace(lengths=base_d + adv)
                 cur = jnp.where(active, correction[:, 0], cur)
-                return cur, cache_t, cache_d, done, n_gen, out, rounds + 1
+                return (cur, cache_t, cache_d, done, n_gen, out,
+                        rounds + 1, rng)
 
             carry = (cur, cache_t, cache_d, done, n_gen, out,
-                     jnp.int32(0))
+                     jnp.int32(0), rng)
             carry = jax.lax.while_loop(cond, body, carry)
             # final caches returned (and dropped by the caller) so the
             # donated inputs can actually alias an output — same pattern
@@ -614,6 +669,12 @@ class InferenceEngine:
             return carry[5], carry[4], carry[6], carry[1], carry[2]
 
         loop = jax.jit(run, donate_argnames=("cache_t", "cache_d"))
+        # one draft at a time: entries for other draft ids are evicted so
+        # a rotated-out draft (and its weights) can be garbage-collected
+        # instead of pinning device memory for the target's lifetime
+        for k in [k for k in self._gen_loops
+                  if k[0] == "spec" and k[1] != id(draft)]:
+            del self._gen_loops[k]
         self._gen_loops[key] = (loop, draft)
         return loop
 
