@@ -437,7 +437,7 @@ class InferenceEngine:
 
     def generate_speculative(self, input_ids, draft: "InferenceEngine",
                              max_new_tokens: int = 32,
-                             draft_tokens: int = 4,
+                             draft_tokens: int = 4, *,
                              temperature: float = 0.0,
                              eos_token_id: Optional[int] = None,
                              attention_mask=None, seed: int = 0) -> list:

@@ -2,6 +2,7 @@
 must produce IDENTICAL tokens to vanilla greedy generate — the draft can
 only change how many target forwards run, never the output. Also pins
 the decode_chunk primitive against sequential decode_step."""
+import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -199,3 +200,20 @@ def test_sampled_speculative_preserves_target_distribution():
     # sampling from the draft or an unnormalized residual shifts TV to
     # O(p_draft - p_target) >> 0.15
     assert tv < 0.15, f"total variation {tv:.3f}"
+
+
+def test_speculative_on_llama_layout():
+    """decode_chunk must honor rotary positions, GQA and RMSNorm: the
+    llama-layout target speculates exactly like it generates."""
+    cfg = InferenceTransformerConfig(
+        vocab_size=128, n_positions=256, n_embd=64, n_layer=2, n_head=4,
+        n_kv_head=2, positional="rotary", norm_type="rmsnorm",
+        gated_mlp=True, activation="silu", tied_lm_head=False,
+        pre_layer_norm=True, dtype=jnp.float32)
+    target = _engine(cfg, seed=0)
+    draft = _engine(dataclasses.replace(cfg, n_layer=1), seed=1)
+    prompts = [[5, 9, 3, 17]]
+    want = target.generate(prompts, max_new_tokens=16)
+    got = target.generate_speculative(prompts, draft, max_new_tokens=16,
+                                      draft_tokens=4)
+    _assert_equal_up_to_ties(target, want[0], got[0])
