@@ -323,7 +323,9 @@ class InferenceEngine:
                  repetition_penalty: float = 1.0,
                  min_new_tokens: int = 0,
                  eos_token_id: Optional[int] = None,
-                 attention_mask=None, seed: int = 0) -> list:
+                 attention_mask=None, seed: int = 0,
+                 assistant_model: Optional["InferenceEngine"] = None,
+                 ) -> list:
         """Greedy/sampled generation. ``input_ids``: a list of token lists
         (per-row lengths inferred), or a right-padded ``[B, T]`` array — in
         which case pass the HF-style ``attention_mask`` so pad columns are
@@ -337,6 +339,18 @@ class InferenceEngine:
                 "this model has no LM head (CLIP-style encoder) — use "
                 "forward() for hidden states; generate() needs vocabulary "
                 "logits")
+        if assistant_model is not None:
+            # HF assisted-generation spelling of the speculative path
+            if (top_k or top_p or num_beams > 1 or min_new_tokens or
+                    float(repetition_penalty) != 1.0):
+                raise ValueError(
+                    "assistant_model composes with plain greedy/sampled "
+                    "decoding only (no top-k/top-p/beams/penalties/"
+                    "min_new_tokens) — see generate_speculative")
+            return self.generate_speculative(
+                input_ids, assistant_model, max_new_tokens,
+                temperature=temperature, eos_token_id=eos_token_id,
+                attention_mask=attention_mask, seed=seed)
         import time as _time
         t0 = (_time.perf_counter()
               if getattr(self, "model_profile_enabled", False) else None)

@@ -217,3 +217,18 @@ def test_speculative_on_llama_layout():
     got = target.generate_speculative(prompts, draft, max_new_tokens=16,
                                       draft_tokens=4)
     _assert_equal_up_to_ties(target, want[0], got[0])
+
+
+def test_generate_assistant_model_alias():
+    """HF assisted-generation spelling: generate(assistant_model=draft)
+    routes to the speculative path; incompatible knobs reject loudly."""
+    target = _engine(_cfg(layers=2), seed=0)
+    draft = _engine(_cfg(layers=1), seed=0)
+    prompts = [[5, 9, 3]]
+    want = target.generate_speculative(prompts, draft, max_new_tokens=8)
+    got = target.generate(prompts, max_new_tokens=8,
+                          assistant_model=draft)
+    assert got == want
+    with pytest.raises(ValueError, match="assistant_model"):
+        target.generate(prompts, max_new_tokens=8, num_beams=2,
+                        assistant_model=draft)
