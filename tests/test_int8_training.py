@@ -204,3 +204,32 @@ def test_int8_training_composes_with_tensor_parallel():
     losses = [float(engine.train_batch(batch)["loss"]) for _ in range(5)]
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_int8_training_composes_with_offload_bf16acc():
+    """The exact train-1.3b-int8 phase composition at tiny scale:
+    SwitchBack projections + ZeRO-3 + streamed cpu optimizer offload +
+    bf16 grad accumulation + GAS."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    model = GPT2LMModel(GPT2Config(
+        n_layer=2, n_embd=128, n_head=4, vocab_size=256, n_positions=64,
+        dtype=jnp.bfloat16, use_flash_attention=False, remat=False,
+        vocab_pad_multiple=128, int8_training=True))
+    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "bf16": {"enabled": True},
+                "data_types": {"grad_accum_dtype": "bf16"},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_optimizer": {"device": "cpu"}}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, 256, (engine.train_batch_size, 64)), jnp.int32)}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(5)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
