@@ -64,14 +64,21 @@ def test_dot_general_seam_falls_back_off_pattern():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_engine_trains_with_int8_training():
-    import deepspeed_tpu
+def _tiny_int8_gpt2():
+    """Shared tiny int8-training model: one definition for the engine,
+    TP, and offload composition tests."""
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
     model = GPT2LMModel(GPT2Config(
         n_layer=2, n_embd=128, n_head=4, vocab_size=256, n_positions=64,
         dtype=jnp.bfloat16, use_flash_attention=False, remat=False,
         vocab_pad_multiple=128, int8_training=True))
     params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=64)
+    return model, params
+
+
+def test_engine_trains_with_int8_training():
+    import deepspeed_tpu
+    model, params = _tiny_int8_gpt2()
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={"train_micro_batch_size_per_gpu": 4,
@@ -185,13 +192,8 @@ def test_int8_training_composes_with_tensor_parallel():
     with finite, decreasing loss."""
     import deepspeed_tpu
     from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
-    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
     mesh = build_mesh(MeshConfig(data=4, tensor=2))
-    model = GPT2LMModel(GPT2Config(
-        n_layer=2, n_embd=128, n_head=4, vocab_size=256, n_positions=64,
-        dtype=jnp.bfloat16, use_flash_attention=False, remat=False,
-        vocab_pad_multiple=128, int8_training=True))
-    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=64)
+    model, params = _tiny_int8_gpt2()
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, mesh=mesh,
         config={"train_micro_batch_size_per_gpu": 2,
@@ -211,12 +213,7 @@ def test_int8_training_composes_with_offload_bf16acc():
     SwitchBack projections + ZeRO-3 + streamed cpu optimizer offload +
     bf16 grad accumulation + GAS."""
     import deepspeed_tpu
-    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMModel
-    model = GPT2LMModel(GPT2Config(
-        n_layer=2, n_embd=128, n_head=4, vocab_size=256, n_positions=64,
-        dtype=jnp.bfloat16, use_flash_attention=False, remat=False,
-        vocab_pad_multiple=128, int8_training=True))
-    params = model.init(jax.random.PRNGKey(0), batch_size=2, seq_len=64)
+    model, params = _tiny_int8_gpt2()
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={"train_micro_batch_size_per_gpu": 2,
