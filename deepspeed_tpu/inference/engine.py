@@ -34,6 +34,48 @@ from deepspeed_tpu.model_implementations.transformer import (
     init_params, prefill, tp_param_specs)
 
 
+def _greedy_accept(t_toks, props, K):
+    """Shared greedy acceptance: longest prefix of ``props [B, K-1]``
+    agreeing with the target's argmax ``t_toks [B, K]``; returns
+    ``(m, correction, committed)`` for _commit_speculative_block."""
+    B = t_toks.shape[0]
+    matches = props == t_toks[:, :K - 1]
+    m = jnp.argmin(
+        jnp.concatenate([matches, jnp.zeros((B, 1), bool)], 1).astype(
+            jnp.int32), axis=1)              # first mismatch = #accepted
+    correction = jnp.take_along_axis(t_toks, m[:, None], 1)
+    iota = jnp.arange(K)[None, :]
+    props_pad = jnp.concatenate([props, props[:, -1:]], 1)
+    committed = jnp.where(iota < m[:, None], props_pad, correction)
+    return m, correction, committed
+
+
+def _commit_speculative_block(committed, m, done, n_gen, out, eos, K,
+                              max_new_tokens):
+    """Shared verify→commit bookkeeping for the speculative loops:
+    scatter the accepted block into the out buffer, EOS/budget done
+    tracking, and the per-row context advance. Returns
+    ``(out, n_gen, done, adv, active)`` where ``adv`` is how many tokens
+    each row's caches/history gain this round."""
+    B = committed.shape[0]
+    iota = jnp.arange(K)[None, :]
+    active = ~done
+    commit_mask = (iota <= m[:, None]) & active[:, None]
+    # tokens after an in-block EOS must not count as output
+    is_eos = (committed == eos) & commit_mask
+    after_eos = (jnp.cumsum(is_eos.astype(jnp.int32), 1)
+                 - is_eos.astype(jnp.int32)) > 0
+    emit = commit_mask & ~after_eos
+    rows = jnp.arange(B)[:, None]
+    cols = jnp.clip(n_gen[:, None] + iota, 0, max_new_tokens + K - 1)
+    gathered = out[rows, cols]
+    out = out.at[rows, cols].set(jnp.where(emit, committed, gathered))
+    n_gen = n_gen + jnp.sum(emit.astype(jnp.int32), 1)
+    done = done | jnp.any(is_eos, 1) | (n_gen >= max_new_tokens)
+    adv = jnp.where(active, m + 1, 0)
+    return out, n_gen, done, adv, active
+
+
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
@@ -449,7 +491,8 @@ class InferenceEngine:
             self._model_times.append(_time.perf_counter() - t0)
         return self._assemble_output(ids, lengths, out_np, n_np)
 
-    def generate_speculative(self, input_ids, draft: "InferenceEngine",
+    def generate_speculative(self, input_ids,
+                             draft: Optional["InferenceEngine"] = None,
                              max_new_tokens: int = 32,
                              draft_tokens: int = 4, *,
                              temperature: float = 0.0,
@@ -469,6 +512,14 @@ class InferenceEngine:
         the target alone, at temperature ``temperature``. top-k/top-p
         filters are not supported on the speculative path.
 
+        ``draft=None``: PROMPT-LOOKUP decoding (draft-model-free, greedy
+        only) — proposals are the ``draft_tokens - 1`` tokens that
+        followed the most recent earlier occurrence of the current
+        bigram in the row's own prompt+generated history. Zero extra
+        model cost per proposal; repetitive continuations (code, quoted
+        spans, structured text) verify several tokens per target
+        forward, and the output is still exactly greedy.
+
         TPU-native shape: the whole accept/rollback loop is one jitted
         ``lax.while_loop`` (one host sync per generation); rollback is
         free because the static KV cache masks by per-row ``lengths``, so
@@ -481,11 +532,18 @@ class InferenceEngine:
         if draft_tokens < 2:
             raise ValueError(f"draft_tokens must be >= 2, got "
                              f"{draft_tokens} (1 draft proposal minimum)")
-        if self.model_config.head == "none" or \
-                draft.model_config.head == "none":
+        if self.model_config.head == "none" or (
+                draft is not None and draft.model_config.head == "none"):
             raise ValueError("speculative decoding needs LM heads on "
                              "both engines")
-        if self.model_config.vocab_size != draft.model_config.vocab_size:
+        if draft is None and float(temperature) > 0.0:
+            raise NotImplementedError(
+                "prompt-lookup speculative decoding (draft=None) is "
+                "greedy-only: its proposals are deterministic, so "
+                "rejection sampling degenerates — pass a draft engine "
+                "for sampled speculation")
+        if draft is not None and \
+                self.model_config.vocab_size != draft.model_config.vocab_size:
             raise ValueError(
                 f"target/draft vocab sizes differ "
                 f"({self.model_config.vocab_size} vs "
@@ -504,7 +562,7 @@ class InferenceEngine:
         # and the final round may overshoot max_new by up to K
         max_seq = _round_up(int(lengths.max()) + max_new_tokens + 2 * K,
                             128)
-        for eng in (self, draft):
+        for eng in ((self,) if draft is None else (self, draft)):
             budget = eng._max_out_budget(B)
             if max_seq > budget:
                 raise ValueError(
@@ -514,19 +572,30 @@ class InferenceEngine:
                     f"{budget} tokens (max_out_tokens="
                     f"{eng.config.max_out_tokens!r})")
         cache_t = self._make_cache(B, max_seq)
-        cache_d = draft._make_cache(B, max_seq)
         logits_t, cache_t = self._prefill_jit(
             self.params, input_ids=jnp.asarray(ids),
             lengths=jnp.asarray(lengths), cache=cache_t)
-        _, cache_d = draft._prefill_jit(
-            draft.params, input_ids=jnp.asarray(ids),
-            lengths=jnp.asarray(lengths), cache=cache_d)
-        loop = self._speculative_loop(draft, max_new_tokens, K,
-                                      sampled=float(temperature) > 0.0)
-        out_buf, n_gen, rounds, _, _ = loop(
-            self.params, draft.params, logits_t, cache_t, cache_d,
-            jnp.int32(-1 if eos_token_id is None else eos_token_id),
-            jax.random.PRNGKey(seed), jnp.float32(max(temperature, 1e-6)))
+        eos_arg = jnp.int32(-1 if eos_token_id is None else eos_token_id)
+        if draft is None:
+            # prompt-lookup: history buffer instead of a draft cache
+            hist = jnp.zeros((B, T + max_new_tokens + 2 * K), jnp.int32)
+            hist = hist.at[:, :T].set(jnp.asarray(ids))
+            loop = self._lookup_loop(max_new_tokens, K)
+            out_buf, n_gen, rounds, _ = loop(
+                self.params, logits_t, cache_t, hist,
+                jnp.asarray(lengths), eos_arg)
+        else:
+            cache_d = draft._make_cache(B, max_seq)
+            _, cache_d = draft._prefill_jit(
+                draft.params, input_ids=jnp.asarray(ids),
+                lengths=jnp.asarray(lengths), cache=cache_d)
+            loop = self._speculative_loop(
+                draft, max_new_tokens, K,
+                sampled=float(temperature) > 0.0)
+            out_buf, n_gen, rounds, _, _ = loop(
+                self.params, draft.params, logits_t, cache_t, cache_d,
+                eos_arg, jax.random.PRNGKey(seed),
+                jnp.float32(max(temperature, 1e-6)))
         out_np = np.asarray(out_buf)[:, :max_new_tokens]
         n_np = np.minimum(np.asarray(n_gen), max_new_tokens)
         # acceptance telemetry: tokens-per-target-forward is THE number
@@ -535,10 +604,91 @@ class InferenceEngine:
         total = int(n_np.sum())
         self.last_speculative_stats = {
             "rounds": int(rounds), "tokens": total,
+            "draft": "prompt-lookup" if draft is None else "model",
             "tokens_per_round": round(total / max(int(rounds), 1), 3)}
         if t0 is not None:
             self._model_times.append(_time.perf_counter() - t0)
         return self._assemble_output(ids, lengths, out_np, n_np)
+
+    def _lookup_loop(self, max_new_tokens: int, K: int):
+        """Jitted prompt-lookup speculative loop: proposals come from the
+        most recent earlier occurrence of the current BIGRAM in the
+        row's own history (prompt + generated), verified exactly like
+        draft proposals — greedy only, no second model, no draft cache."""
+        key = ("spec-lookup", max_new_tokens, K)
+        hit = self._gen_loops.get(key)
+        if hit is not None:
+            return hit
+        cfg_t, mesh_t = self.model_config, self.mesh
+
+        def run(params_t, logits_t, cache_t, hist, hlen, eos):
+            B, S = hist.shape
+            ar = jnp.arange(B)
+            cur = jnp.argmax(logits_t, -1).astype(jnp.int32)  # token 0
+            hist = hist.at[ar, hlen].set(cur)
+            hlen = hlen + 1
+            out = jnp.zeros((B, max_new_tokens + K), jnp.int32)
+            out = out.at[:, 0].set(cur)
+            n_gen = jnp.ones((B,), jnp.int32)
+            done = cur == eos
+
+            def cond(c):
+                done, n_gen = c[3], c[4]
+                return jnp.any(~done & (n_gen < max_new_tokens))
+
+            def body(c):
+                cur, cache_t, hist, done, n_gen, out, rounds, hlen = c
+                base_t = cache_t.lengths
+
+                # 1) propose: latest j with hist[j:j+2] == the current
+                # bigram (strictly before it), continuation as proposals
+                b0 = hist[ar, jnp.maximum(hlen - 2, 0)]
+                b1 = hist[ar, hlen - 1]
+                pos = jnp.arange(S)[None, :]
+                nxt = jnp.roll(hist, -1, axis=1)
+                match = ((hist == b0[:, None]) & (nxt == b1[:, None]) &
+                         (pos < (hlen - 2)[:, None]) & ((hlen >= 2)[:, None]))
+                found = jnp.any(match, 1)
+                jstar = jnp.max(jnp.where(match, pos, -1), 1)  # latest
+                iprop = jnp.arange(K - 1)[None, :]
+                pcols = jnp.clip(jstar[:, None] + 2 + iprop, 0, S - 1)
+                valid = (found[:, None] &
+                         (jstar[:, None] + 2 + iprop < hlen[:, None]))
+                props = jnp.where(valid, hist[ar[:, None], pcols],
+                                  cur[:, None])          # [B, K-1]
+
+                # 2) target verifies [cur, props] in one forward
+                chunk = jnp.concatenate([cur[:, None], props], axis=1)
+                lg_t, cache_t = decode_chunk(params_t, cfg_t, chunk,
+                                             cache_t, mesh=mesh_t)
+                t_toks = jnp.argmax(lg_t, -1).astype(jnp.int32)  # [B, K]
+                m, correction, committed = _greedy_accept(t_toks, props, K)
+                iota = jnp.arange(K)[None, :]
+
+                # 3) shared commit + history append (hist leads the cache
+                # by one pending token: it also receives the correction)
+                out, n_gen, done, adv, active = _commit_speculative_block(
+                    committed, m, done, n_gen, out, eos, K,
+                    max_new_tokens)
+                cache_t = cache_t.replace(lengths=base_t + adv)
+                hcols = jnp.clip(hlen[:, None] + iota, 0, S - 1)
+                hmask = (iota <= m[:, None]) & active[:, None]
+                hist = hist.at[ar[:, None], hcols].set(
+                    jnp.where(hmask, committed, hist[ar[:, None], hcols]))
+                hlen = hlen + adv
+                cur = jnp.where(active, correction[:, 0], cur)
+                return (cur, cache_t, hist, done, n_gen, out, rounds + 1,
+                        hlen)
+
+            carry = (cur, cache_t, hist, done, n_gen, out, jnp.int32(0),
+                     hlen)
+            carry = jax.lax.while_loop(cond, body, carry)
+            # final cache returned (and dropped) so donation can alias
+            return carry[5], carry[4], carry[6], carry[1]
+
+        loop = jax.jit(run, donate_argnames=("cache_t",))
+        self._gen_loops[key] = loop
+        return loop
 
     def _speculative_loop(self, draft: "InferenceEngine",
                           max_new_tokens: int, K: int,
@@ -638,36 +788,18 @@ class InferenceEngine:
                             jnp.int32)[:, None]
                 else:
                     t_toks = jnp.argmax(lg_t, -1).astype(jnp.int32)
-                    # longest agreeing prefix: m = #accepted (0..K-1)
-                    matches = drafts[:, :K - 1] == t_toks[:, :K - 1]
-                    m = jnp.argmin(
-                        jnp.concatenate(
-                            [matches, jnp.zeros((B, 1), bool)], 1).astype(
-                                jnp.int32), axis=1)      # first mismatch
-                    correction = jnp.take_along_axis(t_toks, m[:, None], 1)
-                # committed tokens: d1..dm then the correction
-                committed = jnp.where(iota < m[:, None], drafts,
-                                      correction)        # [B, K]
-                active = ~done
-                commit_mask = (iota <= m[:, None]) & active[:, None]
-                # tokens after an in-block EOS must not count as output
-                is_eos = (committed == eos) & commit_mask
-                after_eos = (jnp.cumsum(is_eos.astype(jnp.int32), 1)
-                             - is_eos.astype(jnp.int32)) > 0
-                emit = commit_mask & ~after_eos
-                rows = jnp.arange(B)[:, None]
-                cols = jnp.clip(n_gen[:, None] + iota, 0,
-                                max_new_tokens + K - 1)
-                gathered = out[rows, cols]
-                out = out.at[rows, cols].set(
-                    jnp.where(emit, committed, gathered))
-                n_gen = n_gen + jnp.sum(emit.astype(jnp.int32), 1)
-                done = done | jnp.any(is_eos, 1) | (n_gen >= max_new_tokens)
-
+                    m, correction, committed = _greedy_accept(
+                        t_toks, drafts[:, :K - 1], K)
+                if sampled:
+                    # committed tokens: d1..dm then the correction
+                    committed = jnp.where(iota < m[:, None], drafts,
+                                          correction)    # [B, K]
+                out, n_gen, done, adv, active = _commit_speculative_block(
+                    committed, m, done, n_gen, out, eos, K,
+                    max_new_tokens)
                 # 4) cache bookkeeping: context gains [cur, d1..dm] on
                 # active rows (the correction becomes the next `cur`);
                 # draft rolls back from its K appends to the same point
-                adv = jnp.where(active, m + 1, 0)
                 cache_t = cache_t.replace(lengths=base_t + adv)
                 cache_d = cache_d.replace(lengths=base_d + adv)
                 cur = jnp.where(active, correction[:, 0], cur)

@@ -232,3 +232,37 @@ def test_generate_assistant_model_alias():
     with pytest.raises(ValueError, match="assistant_model"):
         target.generate(prompts, max_new_tokens=8, num_beams=2,
                         assistant_model=draft)
+
+
+def test_prompt_lookup_matches_vanilla_greedy():
+    """draft=None (prompt-lookup): exactly greedy output with zero draft
+    model — proposals come from the sequence's own history."""
+    target = _engine(_cfg(layers=2, embd=64), seed=0)
+    prompts = [[5, 9, 3, 17, 2], [11, 4]]
+    want = target.generate(prompts, max_new_tokens=24)
+    got = target.generate_speculative(prompts, max_new_tokens=24,
+                                      draft_tokens=4)
+    for b in range(len(prompts)):
+        _assert_equal_up_to_ties(target, want[b], got[b])
+    st = target.last_speculative_stats
+    assert st["draft"] == "prompt-lookup"
+    # every round commits at least the correction token
+    assert st["tokens_per_round"] >= 1.0
+
+
+def test_prompt_lookup_accepts_on_repetitive_continuation():
+    """Random-weight models degenerate into repeated runs — exactly the
+    regime prompt-lookup exploits: total verify forwards must be fewer
+    than tokens (some proposals accepted)."""
+    target = _engine(_cfg(layers=2, embd=64), seed=0)
+    got = target.generate_speculative([[5, 9, 3, 17, 2]],
+                                      max_new_tokens=32, draft_tokens=4)
+    st = target.last_speculative_stats
+    assert st["tokens"] == 32 == len(got[0]) - 5
+    assert st["tokens_per_round"] > 1.05, st  # acceptance happened
+
+
+def test_prompt_lookup_rejects_sampling():
+    target = _engine(_cfg(), seed=0)
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        target.generate_speculative([[1, 2]], temperature=0.7)
