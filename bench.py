@@ -1148,6 +1148,11 @@ PHASES = {
     # flops (flops_per_token is MoE-aware).
     "train-moe-125m-e8": (["--preset", "gpt2-125m", "--experts", "8",
                            "--micro", "8"], 900),
+    # MoE on the int8 MXU: expert GEMMs through the batched SwitchBack
+    # seam — A/B against train-moe-125m-e8
+    "train-moe-125m-e8-int8": (["--preset", "gpt2-125m", "--experts",
+                                "8", "--micro", "8",
+                                "--int8-training"], 900),
 }
 
 
@@ -1169,7 +1174,7 @@ DEFAULT_ORDER = [
     "train-350m-flash-mb8", "train-350m-int8", "train-bert-large",
     "train-bert-large-int8", "inference-1.3b", "inference-spec",
     "train-1.3b-bf16acc", "train-1.3b-int8", "train-llama-1b-int8",
-    "train-1.3b-bf16acc-mb4",
+    "train-moe-125m-e8-int8", "train-1.3b-bf16acc-mb4",
     "train-350m-flash-seq4k", "train-350m-flash-seq8k",
     "train-350m-flash-mb8-gas4", "train-1.3b-gas128",
     "train-125m",
@@ -1451,8 +1456,8 @@ def main() -> None:
     ap.add_argument("--int8-training", dest="int8_training",
                     action="store_true",
                     help="SwitchBack int8 projections: fwd+dx GEMMs on "
-                         "the int8 MXU at 2x the bf16 rate (gpt2 + "
-                         "llama families; rejects MoE)")
+                         "the int8 MXU at 2x the bf16 rate (gpt2/llama/"
+                         "BERT families incl. MoE expert GEMMs)")
     ap.add_argument("--grad-acc-dtype", default=None,
                     choices=["fp32", "fp16", "bf16"],
                     help="data_types.grad_accum_dtype; bf16 halves the GAS "

@@ -78,12 +78,6 @@ class GPT2Config:
     int8_training: bool = False
 
     def __post_init__(self):
-        if self.int8_training and self.num_experts > 0:
-            raise ValueError(
-                "int8_training with num_experts > 0 is unsupported: the "
-                "expert FFN einsums (moe/layer.py) do not route through "
-                "the SwitchBack seam, so the dominant GEMMs would stay "
-                "bf16 under an '-int8' label")
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_mode must be 'ring' or 'ulysses', got "
@@ -230,6 +224,7 @@ class Block(nn.Module):
                               capacity_factor=cfg.moe_capacity_factor,
                               eval_capacity_factor=cfg.moe_capacity_factor,
                               min_capacity=4, dtype=cfg.dtype,
+                              int8_training=cfg.int8_training,
                               name="moe")(h.reshape(B * T, C),
                                           train=not deterministic)
             return x + y.reshape(B, T, C), l_aux

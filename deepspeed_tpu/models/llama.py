@@ -66,12 +66,6 @@ class LlamaConfig:
     int8_training: bool = False
 
     def __post_init__(self):
-        if self.int8_training and self.num_experts > 0:
-            raise ValueError(
-                "int8_training with num_experts > 0 is unsupported: the "
-                "expert FFN einsums (moe/layer.py) do not route through "
-                "the SwitchBack seam, so the dominant GEMMs would stay "
-                "bf16 under an '-int8' label")
         if self.n_head % self.n_kv_head:
             raise ValueError(f"n_head={self.n_head} must be divisible by "
                              f"n_kv_head={self.n_kv_head}")
@@ -250,6 +244,7 @@ class LlamaBlock(nn.Module):
                               eval_capacity_factor=cfg.moe_capacity_factor,
                               min_capacity=4, dtype=cfg.dtype,
                               activation=jax.nn.silu, gated_experts=True,
+                              int8_training=cfg.int8_training,
                               name="moe")(h.reshape(B * T, C), train=train)
             return x + y.reshape(B, T, C), l_aux
         return x + LlamaMLP(cfg, name="mlp")(h)

@@ -33,6 +33,17 @@ class Experts(nn.Module):
     dtype: Any = jnp.bfloat16
     activation: Callable = nn.gelu
     gated: bool = False
+    # SwitchBack int8 expert GEMMs (ops/int8_training.py batched twin):
+    # fwd + dx on the int8 MXU, dw full precision
+    int8_training: bool = False
+
+    def _bmm(self, x, w):
+        """[E, T, K] @ [E, K, N] expert matmul seam."""
+        if self.int8_training:
+            from deepspeed_tpu.ops.int8_training import (
+                switchback_batched_matmul)
+            return switchback_batched_matmul(x, w.astype(self.dtype))
+        return jnp.einsum("etk,ekn->etn", x, w.astype(self.dtype))
 
     @nn.compact
     def __call__(self, x):  # x: [E, T, M]
@@ -44,15 +55,15 @@ class Experts(nn.Module):
         if self.gated:
             wg = self.param("wg", nn.initializers.normal(0.02), (E, M, H),
                             jnp.float32)
-            g = jnp.einsum("etm,emh->eth", x, wg.astype(self.dtype))
-            u = jnp.einsum("etm,emh->eth", x, wi.astype(self.dtype))
+            g = self._bmm(x, wg)
+            u = self._bmm(x, wi)
             h = self.activation(g) * u
-            return jnp.einsum("eth,ehm->etm", h, wo.astype(self.dtype))
+            return self._bmm(h, wo)
         bi = self.param("bi", nn.initializers.zeros, (E, H), jnp.float32)
         bo = self.param("bo", nn.initializers.zeros, (E, M), jnp.float32)
-        h = jnp.einsum("etm,emh->eth", x, wi.astype(self.dtype))
+        h = self._bmm(x, wi)
         h = self.activation(h + bi.astype(self.dtype)[:, None])
-        y = jnp.einsum("eth,ehm->etm", h, wo.astype(self.dtype))
+        y = self._bmm(h, wo)
         return y + bo.astype(self.dtype)[:, None]
 
 
@@ -116,6 +127,7 @@ class MoE(nn.Module):
     dtype: Any = jnp.bfloat16
     activation: Callable = nn.gelu
     gated_experts: bool = False    # Mixtral-style SwiGLU experts
+    int8_training: bool = False    # SwitchBack expert GEMMs
 
     @nn.compact
     def __call__(self, x, train: bool = True, rng=None):
@@ -146,7 +158,9 @@ class MoE(nn.Module):
         experts = Experts(self.num_experts, self.hidden_size,
                           self.ffn_hidden_size or 4 * self.hidden_size,
                           dtype=self.dtype, activation=self.activation,
-                          gated=self.gated_experts, name="experts")
+                          gated=self.gated_experts,
+                          int8_training=self.int8_training,
+                          name="experts")
         y = moe_dispatch_combine(
             lambda _, d: experts(d), None, x.astype(self.dtype),
             combine, dispatch)

@@ -33,11 +33,12 @@ import jax.numpy as jnp
 
 
 def _quant(x: jax.Array, axis):
-    """Symmetric int8 along ``axis`` (None = one scale for the whole
-    tensor): returns (q int8, scale f32 broadcastable against x). One
-    definition of the clip/round/zero-amax pattern for this module; the
-    serving-side twin lives in ops/int8_gemm.py (separate on purpose —
-    it quantizes against STORED {"q","oscale"} trees, not live bf16)."""
+    """Symmetric int8 along ``axis`` (int, tuple, or None = one scale for
+    the whole tensor): returns (q int8, scale f32 broadcastable against
+    x). One definition of the clip/round/zero-amax pattern for this
+    module; the serving-side twin lives in ops/int8_gemm.py (separate on
+    purpose — it quantizes against STORED {"q","oscale"} trees, not live
+    bf16)."""
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=axis,
                    keepdims=axis is not None)
@@ -97,6 +98,16 @@ def _switchback_bwd(res, dy):
 
 
 switchback_matmul.defvjp(_switchback_fwd, _switchback_bwd)
+
+
+# Per-expert SwitchBack: ``x [E, T, K] @ w [E, K, N] -> [E, T, N]`` —
+# the stacked-expert twin of switchback_matmul (MoE FFNs run one batched
+# matmul over the expert dim, moe/layer.py Experts). vmapping the 2-D op
+# over the expert axis reproduces the exact per-expert scale semantics
+# (x per (expert, token); w per (expert, out-column); bwd w per-expert-
+# tensor; dw full precision) while keeping ONE quant/VJP implementation
+# — custom_vjp composes with vmap.
+switchback_batched_matmul = jax.vmap(switchback_matmul)
 
 
 def maybe_switchback(enabled: bool):
