@@ -46,8 +46,9 @@ class BertConfig:
     pre_layer_norm: bool = True      # reference default (preln modeling)
     with_nsp: bool = True
     dtype: Any = jnp.bfloat16
-    # SwitchBack int8 projections in every encoder layer (see
-    # ops/int8_training.py; the MLM/NSP heads stay full precision)
+    # SwitchBack int8 projections in every encoder layer + the MLM
+    # dense/unembedding GEMMs (see ops/int8_training.py; the tiny NSP
+    # head stays full precision)
     int8_training: bool = False
 
 
@@ -158,12 +159,19 @@ class BertPreTrainingModel:
                         batch.get("token_type_ids"), rng=rng,
                         deterministic=(not self.train) or rng is None)
         # MLM head over masked positions
-        h = x @ params["mlm_dense"]["w"] + params["mlm_dense"]["b"]
+        from deepspeed_tpu.ops.int8_training import (lm_logits,
+                                                     switchback_matmul)
+        int8 = self.config.int8_training
+        if int8:
+            h = switchback_matmul(x, params["mlm_dense"]["w"]) \
+                + params["mlm_dense"]["b"]
+        else:
+            h = x @ params["mlm_dense"]["w"] + params["mlm_dense"]["b"]
         h = jax.nn.gelu(h.astype(jnp.float32),
                         approximate=False).astype(x.dtype)
         h = self._ln(h, params["mlm_ln"])
-        logits = (h @ params["wte"].astype(h.dtype).T
-                  ).astype(jnp.float32) + params["mlm_bias"]
+        logits = lm_logits(h, params["wte"].astype(h.dtype),
+                           int8).astype(jnp.float32) + params["mlm_bias"]
         labels = batch["mlm_labels"]
         live = labels != -100
         safe = jnp.where(live, labels, 0)

@@ -338,7 +338,8 @@ class GPT2(nn.Module):
                     t, "ln_f", self.fetch_table),
                 trans_out_fn=lambda t: t, mutable=True, init=True)
         x = ln_f(dtype=cfg.dtype, name="ln_f")(x)
-        logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype))
+        from deepspeed_tpu.ops.int8_training import lm_logits
+        logits = lm_logits(x, wte.astype(cfg.dtype), cfg.int8_training)
         if moe_set:
             return logits, l_aux_total
         return logits

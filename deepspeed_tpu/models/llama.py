@@ -289,11 +289,13 @@ class Llama(nn.Module):
                           jnp.float32)
         x = _rms_norm(x, ln_f, cfg.rms_eps)
         if cfg.tie_embeddings:
-            logits = jnp.einsum("btc,vc->btv", x, embed.astype(cfg.dtype))
+            w_head = embed
         else:
-            head = self.param("lm_head", nn.initializers.normal(0.02),
-                              (cfg.vocab_size, cfg.n_embd), jnp.float32)
-            logits = jnp.einsum("btc,vc->btv", x, head.astype(cfg.dtype))
+            w_head = self.param("lm_head", nn.initializers.normal(0.02),
+                                (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        from deepspeed_tpu.ops.int8_training import lm_logits
+        logits = lm_logits(x, w_head.astype(cfg.dtype),
+                           cfg.int8_training)
         if moe_set:
             return logits, l_aux_total
         return logits

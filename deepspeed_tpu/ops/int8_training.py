@@ -110,6 +110,24 @@ switchback_matmul.defvjp(_switchback_fwd, _switchback_bwd)
 switchback_batched_matmul = jax.vmap(switchback_matmul)
 
 
+def switchback_logits(x: jax.Array, w_vc: jax.Array) -> jax.Array:
+    """``x [..., C] @ w_vc [V, C] -> [..., V]``: the LM-head/vocab
+    projection on the int8 MXU (the weight arrives in embedding layout;
+    the transpose is layout-assigned away by XLA). At small-model
+    geometry the vocab GEMM is ~15-25% of the step FLOPs — the last
+    large bf16 island once the block projections run int8."""
+    return switchback_matmul(x, jnp.swapaxes(w_vc, 0, 1))
+
+
+def lm_logits(x: jax.Array, w_vc: jax.Array, int8: bool) -> jax.Array:
+    """THE vocab-projection seam for the model families: SwitchBack when
+    int8 training is on, plain einsum otherwise — one place to change
+    the head's quantization policy for gpt2/llama/bert alike."""
+    if int8:
+        return switchback_logits(x, w_vc)
+    return jnp.einsum("...c,vc->...v", x, w_vc)
+
+
 def maybe_switchback(enabled: bool):
     """``flax.linen.Dense(dot_general=...)`` value for a model config:
     the SwitchBack seam when int8 training is enabled, ``None`` (flax's
