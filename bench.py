@@ -658,6 +658,33 @@ def phase_spec(args) -> dict:
     log(f"speculative: p50 {out['spec_token_p50_ms']} vs vanilla "
         f"{out['vanilla_token_p50_ms']} ms/token, "
         f"{out['spec_tokens_per_round']} tokens/verify")
+    print(json.dumps({**out, "partial": True}), flush=True)  # salvage
+
+    # prompt-lookup leg: draft-model-free (bigram-history proposals) —
+    # zero extra weights, so any acceptance is pure win
+    t = time.time()
+    lk = target.generate_speculative(prompt, max_new_tokens=n,
+                                     draft_tokens=4)
+    out["lookup_compile_s"] = round(time.time() - t, 1)
+    agree = next((i for i in range(min(len(lk[0]), len(base[0])))
+                  if lk[0][i] != base[0][i]), len(base[0]))
+    out["lookup_exact_match"] = bool(lk[0] == base[0])
+    out["lookup_agreement_prefix_tokens"] = agree - len(prompt[0])
+    lat = []
+    for _ in range(args.iters):
+        t = time.time()
+        target.generate_speculative(prompt, max_new_tokens=n,
+                                    draft_tokens=4)
+        lat.append((time.time() - t) / n * 1e3)
+    lat.sort()
+    out["lookup_token_p50_ms"] = round(lat[len(lat) // 2], 3)
+    out["lookup_tokens_per_round"] = target.last_speculative_stats[
+        "tokens_per_round"]
+    out["lookup_speedup"] = round(
+        out["vanilla_token_p50_ms"]
+        / max(out["lookup_token_p50_ms"], 1e-9), 3)
+    log(f"prompt-lookup: p50 {out['lookup_token_p50_ms']} ms/token, "
+        f"{out['lookup_tokens_per_round']} tokens/verify")
     return out
 
 

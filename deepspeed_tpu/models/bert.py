@@ -24,6 +24,8 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.ops.int8_training import (lm_logits,
+                                              switchback_matmul)
 from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
                                            DeepSpeedTransformerLayer,
@@ -159,8 +161,6 @@ class BertPreTrainingModel:
                         batch.get("token_type_ids"), rng=rng,
                         deterministic=(not self.train) or rng is None)
         # MLM head over masked positions
-        from deepspeed_tpu.ops.int8_training import (lm_logits,
-                                                     switchback_matmul)
         int8 = self.config.int8_training
         if int8:
             h = switchback_matmul(x, params["mlm_dense"]["w"]) \

@@ -26,7 +26,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES  # noqa: F401
 from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
-from deepspeed_tpu.ops.int8_training import maybe_switchback
+from deepspeed_tpu.ops.int8_training import (lm_logits,
+                                              maybe_switchback)
 from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
@@ -338,7 +339,6 @@ class GPT2(nn.Module):
                     t, "ln_f", self.fetch_table),
                 trans_out_fn=lambda t: t, mutable=True, init=True)
         x = ln_f(dtype=cfg.dtype, name="ln_f")(x)
-        from deepspeed_tpu.ops.int8_training import lm_logits
         logits = lm_logits(x, wte.astype(cfg.dtype), cfg.int8_training)
         if moe_set:
             return logits, l_aux_total

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.ops.int8_training import lm_logits
 from deepspeed_tpu.models.gpt2 import Block, GPT2Config, _maybe_constrain
 from deepspeed_tpu.parallel.pipe.pipeline import pipeline_apply
 
@@ -82,7 +83,8 @@ class GPT2PipeModel:
         x32 = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + 1e-5)
         x = (x32 * params["ln_f"]["scale"] +
              params["ln_f"]["bias"]).astype(cfg.dtype)
-        return jnp.einsum("btc,vc->btv", x, params["wte"].astype(cfg.dtype))
+        return lm_logits(x, params["wte"].astype(cfg.dtype),
+                         cfg.int8_training)
 
     def loss_fn(self, params, batch, rng=None):
         input_ids = batch["input_ids"]

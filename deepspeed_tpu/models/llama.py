@@ -29,7 +29,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import DATA_AXES
 from deepspeed_tpu.comm.mesh import seq_axis_active as _seq_axis_active
-from deepspeed_tpu.ops.int8_training import maybe_switchback
+from deepspeed_tpu.ops.int8_training import (lm_logits,
+                                              maybe_switchback)
 from deepspeed_tpu.utils.jit import instance_cached_jit
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
@@ -293,7 +294,6 @@ class Llama(nn.Module):
         else:
             w_head = self.param("lm_head", nn.initializers.normal(0.02),
                                 (cfg.vocab_size, cfg.n_embd), jnp.float32)
-        from deepspeed_tpu.ops.int8_training import lm_logits
         logits = lm_logits(x, w_head.astype(cfg.dtype),
                            cfg.int8_training)
         if moe_set:
