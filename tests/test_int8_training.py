@@ -270,3 +270,24 @@ def test_int8_training_composes_with_offload_bf16acc():
     losses = [float(engine.train_batch(batch)["loss"]) for _ in range(5)]
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_int8_pipe_model_traces():
+    """SwitchBack's custom VJP inside the compiled pipeline (scan +
+    remat + ppermute structure) is the riskiest composition — guard it
+    at trace level like the bench phase traces."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import GPT2PipeModel
+    cfg = GPT2Config(n_layer=4, n_embd=64, n_head=4, vocab_size=256,
+                     n_positions=64, dtype=jnp.bfloat16, remat=True,
+                     use_flash_attention=False, vocab_pad_multiple=64,
+                     int8_training=True)
+    model = GPT2PipeModel(cfg, num_microbatches=2)
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, batch_size=2, seq_len=32),
+        jax.random.PRNGKey(0))
+    batch = {"input_ids": jax.ShapeDtypeStruct((4, 32), jnp.int32)}
+    out = jax.eval_shape(
+        jax.value_and_grad(lambda p, b: model.loss_fn(p, b)),
+        shapes, batch)
+    assert out[0].shape == ()
