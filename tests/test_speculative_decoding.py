@@ -266,3 +266,42 @@ def test_prompt_lookup_rejects_sampling():
     target = _engine(_cfg(), seed=0)
     with pytest.raises(NotImplementedError, match="greedy-only"):
         target.generate_speculative([[1, 2]], temperature=0.7)
+
+
+def test_speculative_composes_with_w8a8_target():
+    """int8-compute target engine + prompt-lookup speculation: the
+    decode_chunk verify path runs the same w8a8 GEMM seams as
+    decode_step, so the combo must stay exactly greedy vs the same
+    engine's vanilla generate."""
+    from deepspeed_tpu.module_inject.quantize import GroupQuantizer
+    from deepspeed_tpu.model_implementations.transformer import (
+        init_params)
+    cfg = dataclasses.replace(_cfg(layers=2), int8_compute=True,
+                              dtype=jnp.bfloat16)
+    fp = init_params(jax.random.PRNGKey(0), dataclasses.replace(
+        cfg, int8_compute=False))
+    qp = GroupQuantizer(q_int8=True, out_mode=True).quantize_tree(fp)
+    target = InferenceEngine((cfg, qp),
+                             DeepSpeedInferenceConfig(max_out_tokens=512))
+    prompts = [[5, 9, 3, 17]]
+    want = target.generate(prompts, max_new_tokens=12)
+    got = target.generate_speculative(prompts, max_new_tokens=12,
+                                      draft_tokens=4)
+    _assert_equal_up_to_ties(target, want[0], got[0])
+
+
+def test_speculative_padded_array_input_with_attention_mask():
+    """HF-style [B, T] right-padded input + attention_mask drives the
+    same per-row-length machinery as list input."""
+    target = _engine(_cfg(layers=2), seed=0)
+    draft = _engine(_cfg(layers=1), seed=0)
+    prompts = [[5, 9, 3, 17, 2], [11, 4]]
+    ids = np.zeros((2, 5), np.int32)
+    mask = np.zeros((2, 5), np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, :len(p)] = p
+        mask[i, :len(p)] = 1
+    want = target.generate_speculative(prompts, draft, max_new_tokens=8)
+    got = target.generate_speculative(ids, draft, max_new_tokens=8,
+                                      attention_mask=mask)
+    assert got == want
