@@ -688,6 +688,141 @@ def phase_spec(args) -> dict:
     return out
 
 
+def phase_serve(args) -> dict:
+    """Continuous batching (ContinuousBatchingServer) vs one-shot
+    ``generate`` under a Poisson arrival trace: tokens/s, p50/p90
+    per-token latency, slot occupancy, and the head-of-line metric —
+    decode-step·slot units consumed to complete the SAME trace. Smoke
+    mode (CPU tier-1) shrinks the model and trace but exercises every
+    moving part: admission, recycling, parity, the one-trace bound."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    log(f"backend={jax.default_backend()} devices={jax.device_count()}")
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.server import ContinuousBatchingServer
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, init_params)
+
+    smoke = bool(getattr(args, "smoke", False)) or \
+        jax.default_backend() != "tpu"
+    if smoke:
+        mcfg = InferenceTransformerConfig(
+            vocab_size=256, n_positions=256, n_embd=64, n_layer=2,
+            n_head=4, dtype=jnp.float32)
+        scfg = DeepSpeedInferenceConfig(
+            dtype="float32", max_out_tokens=256, block_size=32,
+            num_slots=4)
+        n_req = min(int(getattr(args, "requests", 10) or 10), 12)
+        budgets, plens = [4, 16, 4], [3, 9, 5]
+    else:
+        mcfg = InferenceTransformerConfig(
+            vocab_size=50257, n_positions=1024, n_embd=768, n_layer=12,
+            n_head=12, dtype=jnp.bfloat16)
+        scfg = DeepSpeedInferenceConfig(max_out_tokens=1024,
+                                        block_size=128, num_slots=8)
+        n_req = int(getattr(args, "requests", 24) or 24)
+        budgets, plens = [16, 64, 16, 16], [64, 128, 32, 96]
+    params = init_params(jax.random.PRNGKey(0), mcfg)
+    eng = InferenceEngine((mcfg, params), scfg)
+    srv = ContinuousBatchingServer(eng)
+    out: dict = {"phase": "serve-continuous", "smoke": smoke,
+                 "num_slots": srv.num_slots,
+                 "block_size": srv.block_size, "requests": n_req}
+
+    # Poisson arrivals in decode-step time (wall-clock arrival replay
+    # would measure the host's sleep accuracy, not the scheduler): the
+    # i-th request becomes visible once `i arrivals <= rate * steps`
+    rate = float(getattr(args, "arrival_rate", 0.5) or 0.5)
+    rng = np.random.default_rng(0)
+    gaps = rng.exponential(1.0 / max(rate, 1e-6), size=n_req)
+    arrive_at = np.floor(np.cumsum(gaps)).astype(int)
+    reqs = []
+    for i in range(n_req):
+        prompt = [int(t) % mcfg.vocab_size for t in
+                  range(1, 1 + plens[i % len(plens)])]
+        reqs.append((prompt, budgets[i % len(budgets)]))
+
+    # warm the traces so the replay measures steady-state serving, not
+    # compiles (the one-shot leg below is warmed by its own first call)
+    srv.submit(reqs[0][0], max_new_tokens=2)
+    srv.drain()
+    steps0 = srv.stats["decode_steps"]
+    active0 = srv.stats["active_slot_steps"]
+
+    t_start = time.time()
+    submit_t, finish_t, ids = {}, {}, []
+    nxt = 0
+    vclock = 0   # decode-step time; jumps over idle gaps in the trace
+    while nxt < n_req or not srv.scheduler.idle:
+        while nxt < n_req and arrive_at[nxt] <= vclock:
+            rid = srv.submit(reqs[nxt][0], max_new_tokens=reqs[nxt][1])
+            ids.append(rid)
+            submit_t[rid] = time.time()
+            nxt += 1
+        if srv.scheduler.idle:
+            vclock = int(arrive_at[nxt])
+            continue
+        done = srv.step()
+        vclock += 1
+        now = time.time()
+        for rid in done:
+            finish_t[rid] = now
+    wall = time.time() - t_start
+    res = {rid: srv.result(rid) for rid in ids}
+    gen_lens = {rid: len(res[rid]) - len(req[0])
+                for rid, req in zip(ids, reqs)}
+    total_tokens = sum(gen_lens.values())
+    lat = sorted((finish_t[r] - submit_t[r]) / max(gen_lens[r], 1) * 1e3
+                 for r in ids)
+    steps = srv.stats["decode_steps"] - steps0
+    active = srv.stats["active_slot_steps"] - active0
+    units = steps * srv.num_slots
+    out.update({
+        "tokens_per_s": round(total_tokens / max(wall, 1e-9), 1),
+        "token_lat_p50_ms": round(lat[len(lat) // 2], 3),
+        "token_lat_p90_ms": round(lat[int(len(lat) * 0.9)], 3),
+        "slot_occupancy": round(active / max(units, 1), 3),
+        "units_continuous": units,
+        "decode_traces": srv.stats["decode_traces"],
+    })
+    print(json.dumps({**out, "partial": True}), flush=True)  # salvage
+
+    # one-shot comparator on the SAME trace: batches of num_slots in
+    # arrival order, each batch spinning until its slowest row's budget
+    # (what generate()'s single while_loop must do) — units counted from
+    # the actual generated lengths, wall measured for the A/B
+    units_oneshot = 0
+    t_one = time.time()
+    oneshot_out = {}
+    for i in range(0, n_req, srv.num_slots):
+        chunk = list(range(i, min(i + srv.num_slots, n_req)))
+        bmax = max(reqs[j][1] for j in chunk)
+        outs = eng.generate([reqs[j][0] for j in chunk],
+                            max_new_tokens=bmax)
+        for j, o in zip(chunk, outs):
+            oneshot_out[j] = o
+        units_oneshot += srv.num_slots * (
+            max(gen_lens[ids[j]] for j in chunk) - 1)
+    out["oneshot_wall_s"] = round(time.time() - t_one, 2)
+    out["units_oneshot"] = units_oneshot
+    out["units_ratio"] = round(
+        out["units_continuous"] / max(units_oneshot, 1), 3)
+    # parity: each request's served tokens == its one-shot greedy tokens
+    # up to the request's OWN budget (the batch comparator over-generates
+    # rows below the batch max)
+    exact = all(
+        res[ids[j]] == oneshot_out[j][:len(reqs[j][0]) + gen_lens[ids[j]]]
+        for j in range(n_req))
+    out["parity_exact"] = bool(exact)
+    log(f"serve-continuous: {out['tokens_per_s']} tok/s, occupancy "
+        f"{out['slot_occupancy']}, units {out['units_continuous']} vs "
+        f"one-shot {units_oneshot} ({out['units_ratio']}x), parity="
+        f"{exact}")
+    return out
+
+
 def phase_flash_compile(args) -> dict:
     """Mosaic compile of the Pallas flash kernel fwd+bwd in ISOLATION —
     the prime relay-wedge suspect since round 1 (a killed Mosaic compile
@@ -1124,6 +1259,10 @@ PHASES = {
     # speculative decoding vs vanilla greedy (beyond the reference):
     # w8a8 self-draft, exactness + acceptance telemetry + p50 A/B
     "inference-spec": (["--iters", "10"], 600),
+    # continuous batching vs one-shot under a Poisson arrival trace:
+    # tokens/s, p50/p90 per-token latency, slot occupancy, and the
+    # decode-step·slot-unit A/B (the head-of-line-blocking number)
+    "serve-continuous": (["--requests", "24"], 900),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
     # score tensor per head)
@@ -1200,7 +1339,7 @@ DEFAULT_ORDER = [
     "train-moe-125m-e8", "inference", "profile-350m",
     "train-350m-flash-mb8", "train-350m-int8", "train-bert-large",
     "train-bert-large-int8", "inference-1.3b", "inference-spec",
-    "train-1.3b-bf16acc", "train-1.3b-int8", "train-llama-1b-int8",
+    "serve-continuous", "train-1.3b-bf16acc", "train-1.3b-int8", "train-llama-1b-int8",
     "train-moe-125m-e8-int8", "train-1.3b-bf16acc-mb4",
     "train-350m-flash-seq4k", "train-350m-flash-seq8k",
     "train-350m-flash-mb8-gas4", "train-1.3b-gas128",
@@ -1506,6 +1645,14 @@ def main() -> None:
                          "two >= 128")
     ap.add_argument("--adaptive-steps", action="store_true",
                     help="size the measurement loop off the warm step")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="serve-continuous: arrival-trace length")
+    ap.add_argument("--arrival-rate", type=float, default=0.5,
+                    help="serve-continuous: Poisson arrivals per decode "
+                         "step")
+    ap.add_argument("--smoke", action="store_true",
+                    help="serve-continuous: tiny-model CPU smoke mode "
+                         "(auto when the backend is not TPU)")
     ap.add_argument("--budget", type=float, default=float(
         os.environ.get("DSTPU_BENCH_BUDGET_S", "1500")))
     ap.add_argument("--phases", default=None,
@@ -1537,6 +1684,7 @@ def main() -> None:
                   "train-bert-large") else
               phase_flash_compile if args.phase == "flash-compile" else
               phase_spec if args.phase == "inference-spec" else
+              phase_serve if args.phase == "serve-continuous" else
               phase_mxu_peak if args.phase == "mxu-peak" else
               phase_profile if args.phase == "profile-350m" else
               phase_autotune if args.phase == "autotune-350m" else

@@ -14,11 +14,18 @@ from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
                                             DeepSpeedTPConfig)
 
 __all__ = ["DeepSpeedInferenceConfig", "DeepSpeedTPConfig",
-           "DeepSpeedMoEConfig", "InferenceEngine", "KVCache", "init_cache"]
+           "DeepSpeedMoEConfig", "InferenceEngine", "KVCache", "init_cache",
+           "PagedKVCache", "init_paged_cache", "ContinuousBatchingServer",
+           "Request", "Scheduler"]
 
 _LAZY = {"InferenceEngine": "deepspeed_tpu.inference.engine",
          "KVCache": "deepspeed_tpu.inference.kv_cache",
-         "init_cache": "deepspeed_tpu.inference.kv_cache"}
+         "init_cache": "deepspeed_tpu.inference.kv_cache",
+         "PagedKVCache": "deepspeed_tpu.inference.kv_cache",
+         "init_paged_cache": "deepspeed_tpu.inference.kv_cache",
+         "ContinuousBatchingServer": "deepspeed_tpu.inference.server",
+         "Request": "deepspeed_tpu.inference.scheduler",
+         "Scheduler": "deepspeed_tpu.inference.scheduler"}
 
 
 def __getattr__(name):

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Any, Literal, Optional, Union
 
-from pydantic import Field
+from pydantic import Field, field_validator
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
 
@@ -80,6 +80,39 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
         default=1024, alias="max_tokens")
     min_out_tokens: int = 1
     max_batch_size: int = 8
+    # -------- continuous batching (ContinuousBatchingServer) knobs -----
+    # paged KV pool granularity: tokens per block. Smaller blocks waste
+    # less memory on short tails but grow the block tables and the
+    # per-step gather fan-in; must divide the 128-token prompt buckets.
+    block_size: int = 128
+    # resident sequences decoded per step (the static decode batch). The
+    # decode step is traced once per (num_slots, block_size) — raising
+    # this trades per-request latency for throughput.
+    num_slots: int = 8
+    # admission control: submit() refuses beyond this many queued-but-
+    # unscheduled requests instead of growing host memory unboundedly
+    max_queued_requests: int = 128
+
+    @field_validator("max_batch_size", "num_slots", "max_queued_requests")
+    @classmethod
+    def _positive(cls, v, info):
+        # construction-time validation: a non-positive bound would
+        # otherwise reject every batch at call time (or never be checked
+        # at all when the knob is left unset — see _check_schedulable)
+        if v <= 0:
+            raise ValueError(
+                f"{info.field_name} must be a positive integer, got {v}")
+        return v
+
+    @field_validator("block_size")
+    @classmethod
+    def _valid_block(cls, v):
+        if v < 16 or v > 1024 or (v & (v - 1)):
+            raise ValueError(
+                f"block_size must be a power of two in [16, 1024] (it "
+                f"must divide the 128-token prompt buckets and tile the "
+                f"TPU sublane dim), got {v}")
+        return v
     # long-context serving: shard the KV cache sequence dim over a `seq`
     # mesh axis of this extent (flash-decoding-style distributed softmax)
     seq_parallel_size: int = Field(default=1, alias="sp_size", ge=1)

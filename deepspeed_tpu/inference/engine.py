@@ -80,6 +80,40 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+def _bucket(n: int, base: int = 128) -> int:
+    """Geometric shape bucket: the smallest ``base * 2**k >= n``.
+
+    Raw ``_round_up(n, 128)`` gives every distinct 128-span of prompt/
+    budget lengths its own padded shape — and every distinct shape is a
+    fresh trace + compile of the prefill program and the whole decode
+    loop (the dominant serving cost after the first call). The ladder
+    caps the trace count at ``log2(longest/128) + 1`` shapes total
+    (128, 256, 512, ...). The price: up to 2x padding FLOPs on the
+    prefill (the decode hot path reads live lengths, so dead cache tail
+    costs no decode attention work), and the KV cache may allocate up to
+    2x the raw need in HBM — bounded by ``max_out_tokens``, which is
+    documented as the cache budget the caller has already signed up
+    for (``_fit_to_budget`` never exceeds it)."""
+    if n <= base:
+        return base
+    b = base
+    while b < n:
+        b *= 2
+    return b
+
+
+def _fit_to_budget(need: int, budget: int) -> int:
+    """Bucketed cache size for ``need`` tokens under ``budget``: the
+    geometric bucket, except a bucket that overshoots a budget the raw
+    need fits is clamped TO the budget (one extra 'ceiling' shape) so
+    bucketing never rejects a request the dense 128-rounding accepted.
+    Returns 0 when even the raw need exceeds the budget (caller raises
+    its budget error)."""
+    if _round_up(need, 128) > budget:
+        return 0
+    return min(_bucket(need), budget)
+
+
 class InferenceEngine:
     """Generation engine over the fused functional transformer.
 
@@ -406,11 +440,16 @@ class InferenceEngine:
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
         self._check_schedulable(B, max_new_tokens)
-        max_seq = _round_up(int(lengths.max()) + max_new_tokens, 128)
+        need = int(lengths.max()) + max_new_tokens
         budget = self._max_out_budget(B * max(num_beams, 1))
-        if max_seq > budget:
+        # geometric cache buckets (128·2^k, clamped to the budget): a
+        # spread of prompt lengths reuses O(log) decode-loop traces
+        # instead of one per distinct 128-span
+        max_seq = _fit_to_budget(need, budget)
+        if not max_seq:
             raise ValueError(
-                f"prompt + max_new_tokens needs a {max_seq}-token KV cache "
+                f"prompt + max_new_tokens needs a "
+                f"{_round_up(need, 128)}-token KV cache "
                 f"but the budget is {budget} tokens "
                 f"(max_out_tokens={self.config.max_out_tokens!r}; the "
                 "reference sizes its workspace from free HBM, "
@@ -560,17 +599,19 @@ class InferenceEngine:
         K = int(draft_tokens)
         # margin: the draft runs K appends past the last committed token,
         # and the final round may overshoot max_new by up to K
-        max_seq = _round_up(int(lengths.max()) + max_new_tokens + 2 * K,
-                            128)
+        need = int(lengths.max()) + max_new_tokens + 2 * K
+        max_seq = None
         for eng in ((self,) if draft is None else (self, draft)):
             budget = eng._max_out_budget(B)
-            if max_seq > budget:
+            fit = _fit_to_budget(need, budget)
+            if not fit:
                 raise ValueError(
                     f"prompt + max_new_tokens + draft margin needs a "
-                    f"{max_seq}-token KV cache but the "
+                    f"{_round_up(need, 128)}-token KV cache but the "
                     f"{'draft' if eng is draft else 'target'} budget is "
                     f"{budget} tokens (max_out_tokens="
                     f"{eng.config.max_out_tokens!r})")
+            max_seq = fit if max_seq is None else min(max_seq, fit)
         cache_t = self._make_cache(B, max_seq)
         logits_t, cache_t = self._prefill_jit(
             self.params, input_ids=jnp.asarray(ids),
@@ -1002,9 +1043,11 @@ class InferenceEngine:
 
 
 def _pad_batch(input_ids, attention_mask=None):
+    """Right-pad to a geometric bucket (``_bucket``): varying prompt
+    lengths land on O(log) prefill shapes instead of one per 128-span."""
     if isinstance(input_ids, (list, tuple)):
         lengths = np.asarray([len(r) for r in input_ids], np.int32)
-        T = _round_up(max(int(lengths.max()), 1), 128)
+        T = _bucket(max(int(lengths.max()), 1))
         ids = np.zeros((len(input_ids), T), np.int32)
         for i, row in enumerate(input_ids):
             ids[i, :len(row)] = row
@@ -1014,9 +1057,8 @@ def _pad_batch(input_ids, attention_mask=None):
         lengths = np.asarray(attention_mask).sum(-1).astype(np.int32)
     else:
         lengths = np.full((ids.shape[0],), ids.shape[1], np.int32)
-    if ids.shape[1] % 128:
-        padded = np.zeros((ids.shape[0], _round_up(ids.shape[1], 128)),
-                          np.int32)
+    if ids.shape[1] != _bucket(ids.shape[1]):
+        padded = np.zeros((ids.shape[0], _bucket(ids.shape[1])), np.int32)
         padded[:, :ids.shape[1]] = ids
         ids = padded
     return ids, lengths

@@ -143,3 +143,161 @@ def write_chunk(cache: KVCache, layer: int, k: jnp.ndarray,
 
 def advance(cache: KVCache, n: int = 1) -> KVCache:
     return cache.replace(lengths=cache.lengths + n)
+
+
+# ---------------------------------------------------------------- paged
+# vLLM-style PagedAttention, translated to the static-shape TPU world: one
+# global block pool ``[L, num_blocks, block_size, H, D]`` shared by every
+# live sequence, plus a per-SLOT int32 block table mapping logical cache
+# positions to pool blocks. All shapes are static, so the jitted decode
+# step is traced ONCE per (num_slots, block_size) configuration and
+# replayed for every request mix; allocation/recycling is host-side
+# free-list bookkeeping (BlockAllocator) that never touches the trace.
+#
+# Block 0 is a reserved NULL block: idle slots keep an all-zero block
+# table and length 0, so their (masked, discarded) appends land in block
+# 0 instead of corrupting a live sequence's memory. The allocator never
+# hands block 0 out.
+
+
+@struct.dataclass
+class PagedKVCache:
+    """Paged decode workspace over ``num_slots`` resident sequences.
+
+    k/v: ``[L, num_blocks, block_size, H, D]`` global pool.
+    block_tables: ``[num_slots, max_blocks]`` int32 — pool block ids per
+    slot, in logical order (entry j covers positions
+    ``j*block_size .. (j+1)*block_size-1``); unallocated entries are 0
+    (the null block).
+    lengths: ``[num_slots]`` int32 live context length per slot.
+    """
+    k: jnp.ndarray             # [L, NB, BS, H, D]
+    v: jnp.ndarray             # [L, NB, BS, H, D]
+    block_tables: jnp.ndarray  # [S, MB] int32
+    lengths: jnp.ndarray       # [S] int32
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def num_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.block_tables.shape[1]
+
+    @property
+    def max_context(self) -> int:
+        return self.max_blocks * self.block_size
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+
+def init_paged_cache(num_layers: int, num_slots: int, num_blocks: int,
+                     block_size: int, max_blocks_per_slot: int,
+                     num_kv_heads: int, head_dim: int,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    """``num_blocks`` INCLUDES the reserved null block 0, so the usable
+    pool is ``num_blocks - 1`` blocks."""
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        block_tables=jnp.zeros((num_slots, max_blocks_per_slot),
+                               jnp.int32),
+        lengths=jnp.zeros((num_slots,), jnp.int32))
+
+
+def paged_write_prompt(cache: PagedKVCache, layer: int, k: jnp.ndarray,
+                       v: jnp.ndarray, slot: jnp.ndarray) -> PagedKVCache:
+    """Prefill: scatter one prompt's ``[T, H, D]`` k/v into ``slot``'s
+    blocks at logical positions ``0..T-1`` (T divisible by block_size).
+
+    Positions beyond the live length hold right-pad garbage — exactly the
+    dense :func:`write_prompt` invariant: masked by attention, overwritten
+    by later appends. Lengths are NOT set here (all layers write the same
+    prompt); the caller pins ``lengths[slot]`` once."""
+    BS = cache.block_size
+    T = k.shape[0]
+    nb = T // BS
+    idx = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, 0
+                                       )[0, :nb]            # [nb]
+    newk = cache.k.at[layer, idx].set(
+        k.astype(cache.k.dtype).reshape(nb, BS, *k.shape[1:]))
+    newv = cache.v.at[layer, idx].set(
+        v.astype(cache.v.dtype).reshape(nb, BS, *v.shape[1:]))
+    return cache.replace(k=newk, v=newv)
+
+
+def paged_append_token(cache: PagedKVCache, layer: int, k: jnp.ndarray,
+                       v: jnp.ndarray) -> PagedKVCache:
+    """Decode: append one token's ``[S, H, D]`` k/v at ``lengths[s]`` for
+    every slot. Idle slots (all-zero table, length 0) write into the null
+    block. Lengths advance once per step via :func:`paged_advance`."""
+    BS = cache.block_size
+    pos = cache.lengths                      # [S]
+    blk = jnp.take_along_axis(cache.block_tables,
+                              (pos // BS)[:, None], axis=1)[:, 0]  # [S]
+    off = pos % BS
+    newk = cache.k.at[layer, blk, off].set(k.astype(cache.k.dtype))
+    newv = cache.v.at[layer, blk, off].set(v.astype(cache.v.dtype))
+    return cache.replace(k=newk, v=newv)
+
+
+def paged_gather_kv(cache: PagedKVCache, layer: int):
+    """Materialize per-slot caches ``[S, max_context, H, D]`` through the
+    block tables — the pure-JAX decode fallback (CPU / ALiBi / windowed).
+    Gathered position j is logical position j, so downstream masked
+    attention is bit-identical to the dense-cache path."""
+    S, MB = cache.block_tables.shape
+    k = cache.k[layer][cache.block_tables]   # [S, MB, BS, H, D]
+    v = cache.v[layer][cache.block_tables]
+    return (k.reshape(S, cache.max_context, *k.shape[3:]),
+            v.reshape(S, cache.max_context, *v.shape[3:]))
+
+
+def paged_advance(cache: PagedKVCache, active: jnp.ndarray) -> PagedKVCache:
+    """Advance live slots' lengths by one; idle slots stay pinned at 0 so
+    their appends keep landing in the null block."""
+    return cache.replace(
+        lengths=cache.lengths + active.astype(jnp.int32))
+
+
+class BlockAllocator:
+    """Host-side free-list over pool blocks 1..num_blocks-1 (block 0 is
+    the reserved null block). The analog of the reference's free-HBM
+    workspace bookkeeping (inference_context.h), except recycling is
+    per-block: an EOS'd sequence's blocks return here and are re-handed
+    to a queued request without any device reallocation or retrace."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 pool blocks (1 usable + the null block), "
+                f"got {num_blocks}")
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> low ids
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int):
+        """``n`` block ids, or None (caller queues) when short."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def release(self, blocks) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("block 0 is the reserved null block")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)

@@ -1,0 +1,144 @@
+"""Continuous-batching scheduler — host-side admission + slot recycling.
+
+The Orca-style control loop over the paged pool (kv_cache.PagedKVCache):
+requests queue FIFO, admission is block-budget aware (a request is
+admitted only when a slot is free AND the free list covers its whole
+prompt+budget block span, so a resident sequence can never be starved of
+its preallocated tail), and an EOS'd sequence's blocks return to the
+free list for the next queued request — all without touching the traced
+decode program.
+
+Design choices vs GPU vLLM, for the static-shape TPU world:
+
+* Blocks for the FULL ``prompt + max_new_tokens`` span are allocated at
+  admission, not on demand. On-demand growth would need per-step
+  host→device block-table updates on the decode hot path; up-front
+  allocation keeps the decode loop free of host traffic and makes
+  admission control exact (an admitted request can always finish). The
+  cost is reserving the tail of a sequence that EOSes early — those
+  blocks come back at completion, which is still per-request granularity
+  instead of the dense cache's per-BATCH granularity.
+* FIFO admission (head-of-line): a request that does not fit blocks
+  requests behind it even if they would fit. This is deliberate —
+  skip-ahead is a starvation policy decision that belongs to a future
+  priority scheduler, not the substrate.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from deepspeed_tpu.inference.kv_cache import BlockAllocator
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (token ids in, token ids out)."""
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int = 32
+    eos_token_id: Optional[int] = None
+
+    def blocks_needed(self, block_size: int) -> int:
+        span = len(self.prompt) + self.max_new_tokens
+        return -(-span // block_size)   # ceil
+
+
+@dataclasses.dataclass
+class SlotState:
+    """Host-side mirror of one resident sequence."""
+    request: Request
+    blocks: List[int]
+    generated: List[int] = dataclasses.field(default_factory=list)
+    pending: int = 0        # last committed token, next decode input
+    arrived_step: int = 0   # decode-step clock at admission (telemetry)
+
+
+class Scheduler:
+    """Queue + free-list + slot table. Pure host logic (numpy-free on the
+    hot path); the server owns the device arrays."""
+
+    def __init__(self, num_slots: int, num_blocks: int, block_size: int,
+                 max_blocks_per_slot: int, max_queued_requests: int):
+        self.num_slots = num_slots
+        self.block_size = block_size
+        self.max_blocks_per_slot = max_blocks_per_slot
+        self.max_queued_requests = max_queued_requests
+        self.allocator = BlockAllocator(num_blocks)
+        self.queue: Deque[Request] = deque()
+        self.slots: Dict[int, SlotState] = {}   # slot id -> state
+        self._free_slots = list(range(num_slots - 1, -1, -1))
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, req: Request) -> None:
+        """Admission control: reject loudly what can NEVER run (block
+        span beyond one slot's table) or what the queue bound refuses,
+        instead of deadlocking the drain loop later."""
+        nb = req.blocks_needed(self.block_size)
+        if nb > self.max_blocks_per_slot:
+            raise ValueError(
+                f"request {req.request_id}: prompt ({len(req.prompt)}) + "
+                f"max_new_tokens ({req.max_new_tokens}) spans {nb} blocks "
+                f"of {self.block_size} tokens, but a slot holds at most "
+                f"{self.max_blocks_per_slot} (raise max_out_tokens or "
+                "lower the request budget)")
+        if nb >= self.allocator.free_blocks + self._resident_blocks() + 1:
+            # block-budget admission: even a fully drained pool could not
+            # hold this request (the +1 excludes the null block the
+            # allocator never hands out)
+            raise ValueError(
+                f"request {req.request_id} needs {nb} blocks but the "
+                f"whole pool holds "
+                f"{self.allocator.free_blocks + self._resident_blocks()} "
+                "— raise max_out_tokens / num_slots sizing")
+        if len(self.queue) >= self.max_queued_requests:
+            raise RuntimeError(
+                f"request queue is full ({self.max_queued_requests}); "
+                "drain with step() before submitting more, or raise "
+                "max_queued_requests")
+        self.queue.append(req)
+
+    def _resident_blocks(self) -> int:
+        return sum(len(s.blocks) for s in self.slots.values())
+
+    # ------------------------------------------------------------ admit
+
+    def admit_next(self, step_clock: int = 0):
+        """Pop the FIFO head into a free slot when its whole block span
+        fits the free list. Returns ``(slot, SlotState)`` or None."""
+        if not self.queue or not self._free_slots:
+            return None
+        nb = self.queue[0].blocks_needed(self.block_size)
+        blocks = self.allocator.allocate(nb)
+        if blocks is None:
+            return None
+        req = self.queue.popleft()
+        slot = self._free_slots.pop()
+        state = SlotState(request=req, blocks=blocks,
+                          arrived_step=step_clock)
+        self.slots[slot] = state
+        return slot, state
+
+    # ------------------------------------------------------------ recycle
+
+    def release(self, slot: int) -> SlotState:
+        """Return a finished sequence's blocks to the pool and free its
+        slot for the next admission."""
+        state = self.slots.pop(slot)
+        self.allocator.release(state.blocks)
+        self._free_slots.append(slot)
+        return state
+
+    @property
+    def active_slots(self) -> int:
+        return len(self.slots)
+
+    @property
+    def pending_requests(self) -> int:
+        return len(self.queue)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.slots

@@ -1,0 +1,278 @@
+"""Continuous-batching server over the paged KV cache.
+
+The serving analog of vLLM's engine loop, built TPU-native: the decode
+hot path is ONE jitted program over ``num_slots`` resident sequences and
+a donated :class:`~deepspeed_tpu.inference.kv_cache.PagedKVCache` —
+traced once per ``(num_slots, block_size)`` configuration, never per
+request shape. Requests arrive asynchronously (``submit``), the host
+scheduler admits them into freed slots between decode steps (``step``),
+and an EOS'd sequence's blocks return to the pool immediately instead of
+spinning as dead weight until the batch's slowest row finishes (the
+one-shot ``generate`` head-of-line cost).
+
+Tradeoff vs ``InferenceEngine.generate``: generate compiles the WHOLE
+token loop as one ``lax.while_loop`` (one host sync per generation);
+continuous batching needs the host scheduler between steps, so it pays
+one small sync per decode step. That buys slot recycling + admission —
+the throughput lever under sustained multi-request traffic — while
+generate remains the latency king for a single fixed batch.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.inference.engine import InferenceEngine, _bucket
+from deepspeed_tpu.inference.kv_cache import (PagedKVCache,
+                                              init_paged_cache)
+from deepspeed_tpu.inference.scheduler import Request, Scheduler
+from deepspeed_tpu.model_implementations.transformer import (
+    paged_decode_step, paged_prefill)
+
+
+class ContinuousBatchingServer:
+    """``submit() / step() / drain()`` serving loop over an
+    :class:`InferenceEngine`'s weights.
+
+    Greedy decoding only (the mode with an exact one-shot oracle:
+    output is token-for-token identical to ``engine.generate``).
+    Sampling per-request is a scheduler-policy follow-up, not a
+    substrate change — temperatures would ride as a per-slot array.
+    """
+
+    def __init__(self, engine: InferenceEngine):
+        if engine.model_config.head == "none":
+            raise ValueError("continuous batching needs an LM head — "
+                             "encoder models have nothing to decode")
+        if engine.model_config.seq_shard_kv:
+            raise NotImplementedError(
+                "continuous batching with a seq-sharded KV cache is "
+                "unsupported — the paged pool is already the "
+                "long-context memory lever")
+        self.engine = engine
+        cfg = engine.config
+        mcfg = engine.model_config
+        self.block_size = cfg.block_size
+        self.num_slots = cfg.num_slots
+        # per-slot token budget reuses the engine's HBM accounting
+        # (explicit max_out_tokens, or 'auto' free-memory sizing at
+        # batch=num_slots — kv_cache.auto_max_tokens)
+        per_slot = engine._max_out_budget(self.num_slots)
+        if per_slot < self.block_size:
+            raise ValueError(
+                f"per-slot KV budget {per_slot} tokens is below one "
+                f"block ({self.block_size}) — raise max_out_tokens or "
+                "shrink block_size")
+        self.max_blocks_per_slot = per_slot // self.block_size
+        # +1: block 0 is the reserved null block idle slots write into
+        num_blocks = 1 + self.num_slots * self.max_blocks_per_slot
+        self.scheduler = Scheduler(
+            num_slots=self.num_slots, num_blocks=num_blocks,
+            block_size=self.block_size,
+            max_blocks_per_slot=self.max_blocks_per_slot,
+            max_queued_requests=cfg.max_queued_requests)
+        self._cache = self._make_pool(num_blocks)
+        self._prefill_jit = jax.jit(
+            functools.partial(self._prefill_fn, cfg=mcfg,
+                              mesh=engine.mesh),
+            static_argnames=(), donate_argnames=("cache",))
+        self._decode_jit = jax.jit(
+            functools.partial(self._decode_fn, cfg=mcfg,
+                              mesh=engine.mesh),
+            donate_argnames=("cache",))
+        self._results: Dict[int, List[int]] = {}
+        self._next_id = 0
+        self._step_clock = 0           # decode steps executed
+        self._active_slot_steps = 0    # sum of live slots per decode step
+        self._prefills = 0
+
+    # ------------------------------------------------------------ setup
+
+    @staticmethod
+    def _prefill_fn(params, ids, length, cache, slot, *, cfg, mesh):
+        logits, cache = paged_prefill(params, cfg, ids, length, cache,
+                                      slot, mesh=mesh)
+        return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+    @staticmethod
+    def _decode_fn(params, tokens, cache, active, *, cfg, mesh):
+        logits, cache = paged_decode_step(params, cfg, tokens, cache,
+                                          active, mesh=mesh)
+        return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+    def _make_pool(self, num_blocks: int) -> PagedKVCache:
+        mcfg = self.engine.model_config
+        cache = init_paged_cache(
+            mcfg.n_layer, self.num_slots, num_blocks, self.block_size,
+            self.max_blocks_per_slot, mcfg.kv_heads, mcfg.head_dim,
+            dtype=self.engine._act_dtype)
+        mesh = self.engine.mesh
+        if mesh is not None:
+            # kv heads shard over `tensor` exactly like the dense cache
+            # (engine._make_cache); the block dim stays replicated —
+            # every device owns the whole table, its heads of every block
+            sh = NamedSharding(mesh, P(None, None, None, "tensor", None))
+            cache = cache.replace(
+                k=jax.device_put(cache.k, sh),
+                v=jax.device_put(cache.v, sh))
+        return cache
+
+    # ------------------------------------------------------------ API
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[int] = None) -> int:
+        """Queue one request; returns its id. Raises when the request can
+        never be scheduled (block span beyond a slot) or the queue is
+        full — admission control instead of a silent deadlock."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        floor = max(1, self.engine.config.min_out_tokens)
+        if max_new_tokens < floor:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} is below the "
+                f"schedulable floor {floor} (min_out_tokens)")
+        if request_id is None:
+            request_id = self._next_id
+        elif (request_id in self._results
+              or any(s.request.request_id == request_id
+                     for s in self.scheduler.slots.values())
+              or any(r.request_id == request_id
+                     for r in self.scheduler.queue)):
+            raise ValueError(
+                f"request_id {request_id} is already queued, resident, "
+                "or finished — a duplicate would silently overwrite its "
+                "output")
+        self._next_id = max(self._next_id, request_id) + 1
+        self.scheduler.submit(Request(
+            request_id=request_id, prompt=list(prompt),
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id))
+        return request_id
+
+    def _admit(self, finished: list) -> None:
+        """Prefill queued requests into free slots until blocks or slots
+        run out. One trace per prompt BUCKET (128·2^k, floored at
+        block_size), shared by every slot — `slot` rides as a traced
+        scalar."""
+        while True:
+            adm = self.scheduler.admit_next(self._step_clock)
+            if adm is None:
+                return
+            slot, state = adm
+            req = state.request
+            # geometric bucket, floored at one block and clamped to the
+            # slot's whole block span (admission guarantees the prompt
+            # fits the span; the bucket may overshoot it — one ceiling
+            # shape, same move as engine._fit_to_budget)
+            T = min(max(_bucket(len(req.prompt)), self.block_size),
+                    self.max_blocks_per_slot * self.block_size)
+            ids = np.zeros((1, T), np.int32)
+            ids[0, :len(req.prompt)] = req.prompt
+            # block table first — the prefill scatter reads it. Entries
+            # beyond the allocated span stay 0 (null block), so bucket
+            # padding past the span spills harmlessly.
+            row = np.zeros((self.max_blocks_per_slot,), np.int32)
+            row[:len(state.blocks)] = state.blocks
+            self._cache = self._cache.replace(
+                block_tables=self._cache.block_tables.at[slot].set(
+                    jnp.asarray(row)))
+            tok0, self._cache = self._prefill_jit(
+                self.engine.params, jnp.asarray(ids),
+                jnp.asarray([len(req.prompt)], jnp.int32), self._cache,
+                jnp.int32(slot))
+            self._prefills += 1
+            tok0 = int(np.asarray(tok0)[0])
+            state.generated.append(tok0)
+            state.pending = tok0
+            if self._finished(state, tok0):
+                self._retire(slot, state, finished)
+
+    def _finished(self, state, tok: int) -> bool:
+        req = state.request
+        return (tok == req.eos_token_id
+                or len(state.generated) >= req.max_new_tokens)
+
+    def _retire(self, slot: int, state, finished: list) -> None:
+        req = state.request
+        out = list(req.prompt) + state.generated
+        self._results[req.request_id] = out
+        finished.append(req.request_id)
+        # slot + blocks recycle NOW: the freed span admits the next
+        # queued request on the same step, without touching the trace.
+        # The retired slot's length resets to 0 on the HOST array only —
+        # the device sees it at the next decode call's lengths input.
+        self.scheduler.release(slot)
+        self._cache = self._cache.replace(
+            lengths=self._cache.lengths.at[slot].set(0),
+            block_tables=self._cache.block_tables.at[slot].set(
+                jnp.zeros((self.max_blocks_per_slot,), jnp.int32)))
+
+    def step(self) -> List[int]:
+        """One scheduler round: admit from the queue into free slots,
+        then one decode step for all resident slots. Returns the request
+        ids finished this round (fetch outputs via ``result``/``drain``).
+        """
+        finished: List[int] = []
+        self._admit(finished)
+        if not self.scheduler.slots:
+            return finished
+        tokens = np.zeros((self.num_slots,), np.int32)
+        active = np.zeros((self.num_slots,), bool)
+        for slot, state in self.scheduler.slots.items():
+            tokens[slot] = state.pending
+            active[slot] = True
+        nxt, self._cache = self._decode_jit(
+            self.engine.params, jnp.asarray(tokens), self._cache,
+            jnp.asarray(active))
+        self._step_clock += 1
+        self._active_slot_steps += int(active.sum())
+        nxt = np.asarray(nxt)
+        for slot in list(self.scheduler.slots):   # _retire mutates
+            state = self.scheduler.slots[slot]
+            tok = int(nxt[slot])
+            state.generated.append(tok)
+            if self._finished(state, tok):
+                self._retire(slot, state, finished)
+            else:
+                state.pending = tok
+        return finished
+
+    def result(self, request_id: int) -> Optional[List[int]]:
+        """Finished output (prompt + generated, EOS included) or None."""
+        return self._results.get(request_id)
+
+    def drain(self) -> Dict[int, List[int]]:
+        """Run ``step`` until queue and slots are empty; returns all
+        finished outputs keyed by request id."""
+        while not self.scheduler.idle:
+            self.step()
+        return dict(self._results)
+
+    # ------------------------------------------------------------ stats
+
+    @property
+    def stats(self) -> dict:
+        """Serving telemetry. ``decode_step_slot_units`` is the honest
+        static-shape cost metric (every decode step computes all
+        num_slots rows, live or idle); ``slot_occupancy`` is the fraction
+        of those units that carried a live sequence — the number
+        continuous batching exists to push toward 1.0."""
+        units = self._step_clock * self.num_slots
+        return {
+            "decode_steps": self._step_clock,
+            "prefills": self._prefills,
+            "decode_step_slot_units": units,
+            "active_slot_steps": self._active_slot_steps,
+            "slot_occupancy": (self._active_slot_steps / units
+                               if units else 0.0),
+            "decode_traces": self._decode_jit._cache_size(),
+            "num_slots": self.num_slots,
+            "block_size": self.block_size,
+            "free_blocks": self.scheduler.allocator.free_blocks,
+            "queued": self.scheduler.pending_requests,
+        }

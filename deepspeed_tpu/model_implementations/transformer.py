@@ -35,8 +35,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.inference.kv_cache import (KVCache, advance, append_token,
-                                              write_chunk, write_prompt)
+from deepspeed_tpu.inference.kv_cache import (KVCache, PagedKVCache, advance,
+                                              append_token, paged_advance,
+                                              paged_append_token,
+                                              paged_gather_kv,
+                                              paged_write_prompt, write_chunk,
+                                              write_prompt)
 from deepspeed_tpu.ops.int8_gemm import (maybe_int8_einsum,
                                          maybe_int8_matmul)
 
@@ -463,6 +467,32 @@ def _decode_attention(q, k_cache, v_cache, live,
                       ).astype(q.dtype)
 
 
+def _paged_decode_attention(q, cache: PagedKVCache, layer_idx: int,
+                            cfg: InferenceTransformerConfig, live,
+                            window=None):
+    """One-token attention through the paged pool. q ``[S, H, D]``,
+    ``live [S]`` = valid positions including the just-appended token.
+    TPU fast path: the Pallas paged kernel gathers K/V blocks through the
+    scalar-prefetched block table (no per-slot contiguous cache is ever
+    materialized). Fallback (CPU / ALiBi / windowed): gather through the
+    block table with XLA, then reuse :func:`_decode_attention` — gathered
+    position j is logical position j, so the math (and every masked
+    softmax bit) is identical to the dense-cache path."""
+    S, H, D = q.shape
+    KH = cache.k.shape[3]
+    if cfg.positional != "alibi" and window is None \
+            and jax.default_backend() == "tpu" and H % KH == 0 \
+            and not cfg.seq_shard_kv:
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            paged_decode_attention
+        return paged_decode_attention(q, cache.k[layer_idx],
+                                      cache.v[layer_idx],
+                                      cache.block_tables, live,
+                                      scale=cfg.scale)
+    k_cache, v_cache = paged_gather_kv(cache, layer_idx)
+    return _decode_attention(q, k_cache, v_cache, live, cfg, window=window)
+
+
 def _chunk_attention(q, k_cache, v_cache, lengths,
                      cfg: InferenceTransformerConfig, window=None):
     """Speculative-verify attention: ``q [B, K, H, D]`` for K tokens at
@@ -623,13 +653,18 @@ def _post_attn(x, ln1_out, attn_out, layer, cfg, mesh=None):
 
 
 def _block_seq(x, layer, cfg, positions, lengths, cache, layer_idx,
-               causal=True, key_mask=None, mesh=None):
-    """Full-sequence block (prefill / encoder). x [B, T, E]."""
+               causal=True, key_mask=None, mesh=None, slot=None):
+    """Full-sequence block (prefill / encoder). x [B, T, E]. With a
+    :class:`PagedKVCache` (and ``slot``), the prompt's k/v scatter into
+    that slot's pool blocks instead of a dense row — the attention math
+    is untouched (prompt-internal attention never needs the pool)."""
     a = layer["attn"]
     ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
     h = ln1_out if cfg.pre_layer_norm else x
     q, k, v = _qkv(h, a, cfg, positions)
-    if cache is not None:
+    if isinstance(cache, PagedKVCache):
+        cache = paged_write_prompt(cache, layer_idx, k[0], v[0], slot)
+    elif cache is not None:
         cache = write_prompt(cache, layer_idx, k, v, lengths)
     window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
     attn = _prefill_attention(q, k, v, cfg, causal=causal, key_mask=key_mask,
@@ -722,7 +757,7 @@ def _logits(params, cfg, x):
 
 
 def _causal_trunk(params, cfg, input_ids, lengths, cache, key_mask=None,
-                  mesh=None):
+                  mesh=None, slot=None):
     """Shared causal forward trunk: embed → blocks → final LN. ``prefill``
     and ``causal_forward`` both run through here so full-sequence scoring
     can never diverge from generation."""
@@ -731,7 +766,8 @@ def _causal_trunk(params, cfg, input_ids, lengths, cache, key_mask=None,
     x = _embed(params, cfg, input_ids, positions)
     for i, layer in enumerate(params["layers"]):
         x, cache = _block_seq(x, layer, cfg, positions, lengths, cache, i,
-                              causal=True, key_mask=key_mask, mesh=mesh)
+                              causal=True, key_mask=key_mask, mesh=mesh,
+                              slot=slot)
     return _layer_norm(x, params["ln_f"], cfg.layer_norm_eps), cache
 
 
@@ -755,6 +791,60 @@ def decode_step(params, cfg: InferenceTransformerConfig, tokens,
         x, cache = _block_decode(x, layer, cfg, cache, i, mesh)
     x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
     return _logits(params, cfg, x), advance(cache)
+
+
+def _block_decode_paged(x, layer, cfg, cache: PagedKVCache, layer_idx,
+                        mesh=None):
+    """Single-token block over the paged pool. x [S, E] (one token per
+    SLOT); appends into each slot's current block."""
+    a = layer["attn"]
+    ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
+    h = ln1_out if cfg.pre_layer_norm else x
+    positions = cache.lengths
+    q, k, v = _qkv(h, a, cfg, positions)
+    cache = paged_append_token(cache, layer_idx, k, v)
+    window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
+    attn = _paged_decode_attention(q, cache, layer_idx, cfg,
+                                   cache.lengths + 1, window=window)
+    attn_out = maybe_int8_einsum("bhd,hde->be", attn, a["wo"],
+                                 x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
+    return _post_attn(x, ln1_out, attn_out, layer, cfg, mesh), cache
+
+
+def paged_prefill(params, cfg: InferenceTransformerConfig, input_ids,
+                  length, cache: PagedKVCache, slot, mesh=None):
+    """Admit one prompt into pool slot ``slot``: run the right-padded
+    ``[1, T]`` prompt through the trunk (prompt-internal attention needs
+    no pool), scattering each layer's k/v into the slot's blocks, and pin
+    ``lengths[slot]``. Returns (next-token logits ``[1, V]``, cache).
+
+    ``slot`` is a traced scalar, so one trace per prompt BUCKET serves
+    every slot; T must be a multiple of the pool block size."""
+    if cfg.seq_shard_kv:
+        raise NotImplementedError(
+            "paged serving with a seq-sharded KV pool is unsupported — "
+            "the block pool is already the long-context memory lever")
+    x, cache = _causal_trunk(params, cfg, input_ids, length, cache,
+                             mesh=mesh, slot=slot)
+    last = jnp.take_along_axis(x, (length - 1)[:, None, None], axis=1)[:, 0]
+    cache = cache.replace(
+        lengths=jax.lax.dynamic_update_index_in_dim(
+            cache.lengths, length[0].astype(jnp.int32), slot, 0))
+    return _logits(params, cfg, last), cache
+
+
+def paged_decode_step(params, cfg: InferenceTransformerConfig, tokens,
+                      cache: PagedKVCache, active, mesh=None):
+    """One generation step for ALL resident slots: ``tokens [S]`` int32 →
+    (logits ``[S, V]``, cache). Appends each slot's token at
+    ``lengths[s]`` and advances only ``active`` slots — idle slots stay
+    pinned at length 0, writing into the reserved null block, so one
+    traced program serves every request mix."""
+    x = _embed(params, cfg, tokens[:, None], cache.lengths[:, None])[:, 0]
+    for i, layer in enumerate(params["layers"]):
+        x, cache = _block_decode_paged(x, layer, cfg, cache, i, mesh)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    return _logits(params, cfg, x), paged_advance(cache, active)
 
 
 def causal_forward(params, cfg: InferenceTransformerConfig, input_ids,

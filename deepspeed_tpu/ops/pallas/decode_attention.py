@@ -120,6 +120,126 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return og.reshape(B, H, D)
 
 
+def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, block_size: int,
+                         scale: float):
+    """Grid (slot, kv-head, block-table entry). The index maps gather K/V
+    blocks straight out of the global pool through the scalar-prefetched
+    block table — the kernel body only ever sees one ``[BS, D]`` block at
+    logical position ``i*BS``, so no per-slot contiguous cache is ever
+    materialized in HBM. Online-softmax state carries across the block
+    dimension in VMEM scratch (the block axis is innermost, so one
+    (slot, head) program's blocks run back-to-back on the core)."""
+    s, i = pl.program_id(0), pl.program_id(2)
+    length = len_ref[s]
+    nb = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i * block_size < length)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [R, D]
+        R = q.shape[0]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)        # [BS, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        col = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (R, block_size), 1)
+        sc = jnp.where(col < length, sc, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nb - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, block_tables: jax.Array,
+                           lengths: jax.Array,
+                           scale: float | None = None,
+                           interpret: bool | None = None) -> jax.Array:
+    """One-token attention through a paged KV pool, GQA-native.
+
+    q: ``[S, H, D]`` (one query per slot); k_pool/v_pool:
+    ``[NB, BS, KH, D]`` (the PagedKVCache per-layer pool layout);
+    block_tables: ``[S, MB]`` int32 (entry j covers logical positions
+    ``j*BS..(j+1)*BS-1``; dead entries must be valid ids — the null
+    block); lengths: ``[S]`` int32 live lengths. Returns ``[S, H, D]``.
+
+    Entirely-dead blocks (``i*BS >= lengths[s]``) are skipped by a
+    ``pl.when`` guard, so an idle slot costs no VPU/MXU work beyond its
+    DMA stream.
+    """
+    S, H, D = q.shape
+    NB, BS, KH = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    MB = block_tables.shape[1]
+    if H % KH:
+        raise ValueError(f"q heads {H} not divisible by kv heads {KH}")
+    R = H // KH
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qg = q.reshape(S, KH, R, D)
+    kernel = functools.partial(_paged_decode_kernel, block_size=BS,
+                               scale=float(scale))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, KH, MB),
+        in_specs=[
+            pl.BlockSpec((1, 1, R, D), lambda s, h, i, lens, bt:
+                         (s, h, 0, 0)),
+            pl.BlockSpec((1, BS, 1, D), lambda s, h, i, lens, bt:
+                         (bt[s, i], 0, h, 0)),
+            pl.BlockSpec((1, BS, 1, D), lambda s, h, i, lens, bt:
+                         (bt[s, i], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, R, D), lambda s, h, i, lens, bt:
+                               (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, D), jnp.float32),
+        ],
+    )
+    og = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KH, R, D), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return og.reshape(S, H, D)
+
+
+def paged_decode_attention_reference(q, k_pool, v_pool, block_tables,
+                                     lengths):
+    """Numerics oracle: gather each slot's cache through its block table
+    (gathered position j IS logical position j), then run the dense
+    masked-softmax reference. Same layouts as
+    :func:`paged_decode_attention`."""
+    S, MB = block_tables.shape
+    BS = k_pool.shape[1]
+    kc = k_pool[block_tables].reshape(S, MB * BS, *k_pool.shape[2:])
+    vc = v_pool[block_tables].reshape(S, MB * BS, *v_pool.shape[2:])
+    return decode_attention_reference(q, kc, vc, lengths)
+
+
 def decode_attention_reference(q, k_cache, v_cache, lengths):
     """Numerics oracle (pure jnp, XLA) — also the CPU fallback path.
     Same layouts as :func:`decode_attention`."""

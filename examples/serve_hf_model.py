@@ -1,10 +1,39 @@
 #!/usr/bin/env python
 """Serve an HF checkpoint directory with TP / int8 / MoE knobs.
 
+One-shot generation:
+
   python examples/serve_hf_model.py /path/to/gpt2-checkpoint \
-      --dtype int8 --prompt "1 2 3 4"
+      --dtype int8 --prompt-ids "1,2,3,4"
+
+Continuous batching (asynchronous arrivals through the paged-KV
+ContinuousBatchingServer — docs/serving.md "Continuous batching"):
+
+  python examples/serve_hf_model.py /path/to/gpt2-checkpoint \
+      --continuous 12 --num-slots 4 --max-new-tokens 32
 """
 import argparse
+
+
+def run_continuous(eng, prompt, args):
+    """Replay --continuous staggered arrivals: submit a new request
+    every other scheduler step, drain, report per-request outputs and
+    the slot-recycling telemetry."""
+    from deepspeed_tpu.inference.server import ContinuousBatchingServer
+    srv = ContinuousBatchingServer(eng)
+    ids = []
+    for i in range(args.continuous):
+        # vary lengths/budgets so slots recycle at different times
+        ids.append(srv.submit(prompt[: 1 + i % len(prompt)],
+                              max_new_tokens=2 + args.max_new_tokens
+                              * (i % 3) // 2))
+        srv.step()   # arrivals interleave with decoding
+    out = srv.drain()
+    for rid in ids:
+        print(f"request {rid}: {out[rid]}")
+    st = srv.stats
+    print(f"decode steps {st['decode_steps']}, occupancy "
+          f"{st['slot_occupancy']:.2f}, traces {st['decode_traces']}")
 
 
 def main():
@@ -21,12 +50,28 @@ def main():
     ap.add_argument("--repetition-penalty", type=float, default=1.0)
     ap.add_argument("--prompt-ids", default="1,2,3,4",
                     help="comma-separated token ids (no tokenizer dep)")
+    ap.add_argument("--continuous", type=int, default=0, metavar="N",
+                    help="serve N staggered requests through the "
+                         "continuous-batching server instead of one "
+                         "one-shot generate (greedy)")
+    ap.add_argument("--num-slots", type=int, default=None,
+                    help="resident sequences per decode step "
+                         "(continuous mode)")
+    ap.add_argument("--block-size", type=int, default=None,
+                    help="paged KV pool block size (continuous mode)")
     args = ap.parse_args()
 
     import deepspeed_tpu
-    eng = deepspeed_tpu.init_inference(
-        args.path, dtype=args.dtype, tp={"tp_size": args.tp})
+    knobs = dict(dtype=args.dtype, tp={"tp_size": args.tp})
+    if args.num_slots:
+        knobs["num_slots"] = args.num_slots
+    if args.block_size:
+        knobs["block_size"] = args.block_size
+    eng = deepspeed_tpu.init_inference(args.path, **knobs)
     prompt = [int(t) for t in args.prompt_ids.split(",")]
+    if args.continuous:
+        run_continuous(eng, prompt, args)
+        return
     out = eng.generate([prompt], max_new_tokens=args.max_new_tokens,
                        num_beams=args.num_beams,
                        temperature=args.temperature, top_p=args.top_p,

@@ -478,6 +478,54 @@ def test_remaining_inference_config_knobs(tmp_path):
             dtype="float32", tm=False))
 
 
+def test_prompt_bucket_ladder_bounds_recompiles():
+    """Shape bucketing: a spread of prompt lengths must land on the
+    geometric 128·2^k ladder — O(log) distinct padded shapes (each a
+    prefill+decode-loop trace), not one per 128-span."""
+    from deepspeed_tpu.inference.engine import (_bucket, _fit_to_budget,
+                                                _pad_batch)
+    buckets = {_bucket(n) for n in range(1, 1025)}
+    assert buckets == {128, 256, 512, 1024}
+    # raw 128-rounding would have produced 8 shapes for the same spread
+    assert len({128 * ((n + 127) // 128) for n in range(1, 1025)}) == 8
+    # _pad_batch applies the ladder to the prompt width
+    widths = set()
+    for n in (1, 100, 129, 300, 500, 900):
+        ids, lengths = _pad_batch([list(range(1, n + 1))])
+        assert ids.shape[1] == _bucket(n) and int(lengths[0]) == n
+        widths.add(ids.shape[1])
+    assert widths == {128, 256, 512, 1024}
+    # budget clamp: a bucket overshooting a budget the raw need fits is
+    # clamped TO the budget (one ceiling shape), never rejected
+    assert _fit_to_budget(300, 1024) == 512
+    assert _fit_to_budget(600, 640) == 640     # bucket 1024 > budget
+    assert _fit_to_budget(700, 640) == 0       # genuinely over budget
+    # end-to-end: distinct prompt lengths inside one bucket share ONE
+    # compiled decode loop (the loop cache is keyed by structure only,
+    # but the cache SHAPE feeding it is the bucket)
+    cfg = small_cfg()
+    eng = InferenceEngine(cfg, DeepSpeedInferenceConfig(dtype="float32"))
+    eng.generate([[1, 2, 3]], max_new_tokens=4)
+    n_loops = len(eng._gen_loops)
+    eng.generate([[5] * 20], max_new_tokens=4)   # same 128 bucket
+    assert len(eng._gen_loops) == n_loops
+
+
+def test_max_batch_size_validated_at_construction():
+    """Non-positive max_batch_size (or num_slots) is a config bug — loud
+    at construction, not first-generate."""
+    with pytest.raises(ValueError, match="max_batch_size"):
+        DeepSpeedInferenceConfig(max_batch_size=0)
+    with pytest.raises(ValueError, match="max_batch_size"):
+        DeepSpeedInferenceConfig(max_batch_size=-4)
+    # the explicit-set knob still enforces at generate time
+    cfg = small_cfg()
+    eng = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+        dtype="float32", max_batch_size=1))
+    with pytest.raises(ValueError, match="max_batch_size"):
+        eng.generate([[1], [2]], max_new_tokens=1)
+
+
 def test_fp16_inference_dtype():
     """dtype='fp16' (the reference's torch.half default): decode stays
     consistent with prefill re-scoring at half precision."""

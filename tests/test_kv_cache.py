@@ -1,0 +1,143 @@
+"""KV-cache primitive contracts — dense and paged.
+
+The invariants every decode path leans on: (1) the chunked writer at
+K=1 is EXACTLY the single-token appender, (2) cache content beyond the
+live ``lengths`` is dead memory — any garbage there must be invisible
+to attention, (3) the paged pool + block table reproduces the dense
+cache bit-for-bit through the gather, and the null block isolates idle
+slots from live ones.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.kv_cache import (
+    BlockAllocator, advance, append_token, init_cache, init_paged_cache,
+    paged_append_token, paged_gather_kv, paged_write_prompt, write_chunk,
+    write_prompt)
+
+
+def _rand(key, shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_write_chunk_k1_equals_append_token(seed):
+    """write_chunk with a K=1 chunk must be byte-identical to
+    append_token at every layer — the speculative verify path and the
+    decode path share the cache layout only if this holds."""
+    L, B, S, H, D = 2, 3, 32, 2, 4
+    cache_a = init_cache(L, B, S, H, D, jnp.float32)
+    cache_b = init_cache(L, B, S, H, D, jnp.float32)
+    lengths = jnp.asarray([0, 5, 17], jnp.int32)
+    cache_a = cache_a.replace(lengths=lengths)
+    cache_b = cache_b.replace(lengths=lengths)
+    for layer in range(L):
+        k = _rand(seed * 10 + layer, (B, H, D))
+        v = _rand(seed * 10 + layer + 100, (B, H, D))
+        cache_a = append_token(cache_a, layer, k, v)
+        cache_b = write_chunk(cache_b, layer, k[:, None], v[:, None])
+    np.testing.assert_array_equal(np.asarray(cache_a.k),
+                                  np.asarray(cache_b.k))
+    np.testing.assert_array_equal(np.asarray(cache_a.v),
+                                  np.asarray(cache_b.v))
+
+
+def test_garbage_beyond_lengths_never_leaks():
+    """Mask invariance: filling every cache position >= lengths with
+    random garbage must not move decode logits by a single bit — that
+    dead tail is what speculative rollback and right-padding both rely
+    on being invisible."""
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, decode_step, init_params, prefill)
+    V, E, L, H, T, S = 64, 32, 2, 4, 8, 64
+    cfg = InferenceTransformerConfig(vocab_size=V, n_positions=128,
+                                     n_embd=E, n_layer=L, n_head=H,
+                                     dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, T), 0, V)
+    lengths = jnp.asarray([T, T - 3], jnp.int32)
+    cache = init_cache(L, 2, S, cfg.kv_heads, cfg.head_dim, jnp.float32)
+    _, cache = prefill(params, cfg, ids, lengths, cache)
+
+    tok = jnp.asarray([5, 9], jnp.int32)
+    logits_clean, _ = decode_step(params, cfg, tok, cache)
+
+    pos = jnp.arange(S)[None, None, :, None, None]
+    dead = pos >= cache.lengths[None, :, None, None, None]
+    garbage = _rand(7, cache.k.shape) * 100.0
+    cache_dirty = cache.replace(k=jnp.where(dead, garbage, cache.k),
+                                v=jnp.where(dead, garbage * 2, cache.v))
+    logits_dirty, _ = decode_step(params, cfg, tok, cache_dirty)
+    np.testing.assert_array_equal(np.asarray(logits_clean),
+                                  np.asarray(logits_dirty))
+
+
+def test_paged_write_prompt_matches_dense_through_gather():
+    """Scatter a prompt into pool blocks, gather it back through the
+    block table: logical positions must reproduce the dense
+    write_prompt layout exactly."""
+    L, T, H, D, BS = 2, 64, 2, 4, 16
+    k = _rand(0, (T, H, D))
+    v = _rand(1, (T, H, D))
+    cache = init_paged_cache(L, 2, 10, BS, 4, H, D, jnp.float32)
+    bt = np.zeros((2, 4), np.int32)
+    bt[1] = [3, 7, 2, 9]           # non-contiguous, out-of-order blocks
+    cache = cache.replace(block_tables=jnp.asarray(bt),
+                          lengths=jnp.asarray([0, 50], jnp.int32))
+    for layer in range(L):
+        cache = paged_write_prompt(cache, layer, k, v, jnp.int32(1))
+    for layer in range(L):
+        gk, gv = paged_gather_kv(cache, layer)
+        np.testing.assert_array_equal(np.asarray(gk[1]), np.asarray(k))
+        np.testing.assert_array_equal(np.asarray(gv[1]), np.asarray(v))
+
+
+def test_paged_append_isolates_idle_slots():
+    """Appends for an idle slot (length 0, all-zero table) land in the
+    reserved null block 0 and can never touch a live slot's blocks."""
+    L, H, D, BS = 1, 2, 4, 16
+    cache = init_paged_cache(L, 2, 6, BS, 2, H, D, jnp.float32)
+    bt = np.zeros((2, 2), np.int32)
+    bt[0] = [2, 4]                  # slot 0 live, slot 1 idle
+    cache = cache.replace(block_tables=jnp.asarray(bt),
+                          lengths=jnp.asarray([5, 0], jnp.int32))
+    k = _rand(3, (2, H, D))
+    cache = paged_append_token(cache, 0, k, k)
+    pool = np.asarray(cache.k[0])
+    # slot 0's token landed at block 2, offset 5
+    np.testing.assert_array_equal(pool[2, 5], np.asarray(k[0]))
+    # slot 1's (discarded) token landed in null block 0, nowhere else
+    np.testing.assert_array_equal(pool[0, 0], np.asarray(k[1]))
+    assert np.all(pool[[1, 3, 4, 5]] == 0)
+
+
+def test_block_allocator_free_list():
+    alloc = BlockAllocator(8)       # 7 usable, block 0 reserved
+    assert alloc.free_blocks == 7
+    got = alloc.allocate(3)
+    assert got is not None and 0 not in got and len(set(got)) == 3
+    assert alloc.allocate(5) is None          # 4 left
+    alloc.release(got)
+    assert alloc.free_blocks == 7
+    with pytest.raises(ValueError, match="double free"):
+        alloc.release([alloc.allocate(1)[0] + 0] * 2)
+    with pytest.raises(ValueError, match="null block"):
+        alloc.release([0])
+    with pytest.raises(ValueError, match="2 pool blocks"):
+        BlockAllocator(1)
+
+
+def test_dense_advance_and_prompt_roundtrip():
+    """write_prompt + advance bookkeeping sanity (the dense invariants
+    the paged tests mirror)."""
+    L, B, S, H, D = 1, 2, 32, 2, 4
+    cache = init_cache(L, B, S, H, D, jnp.float32)
+    k = _rand(0, (B, 8, H, D))
+    cache = write_prompt(cache, 0, k, k, jnp.asarray([8, 3], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(cache.lengths), [8, 3])
+    cache = advance(cache)
+    np.testing.assert_array_equal(np.asarray(cache.lengths), [9, 4])
+    np.testing.assert_array_equal(np.asarray(cache.k[0, 0, :8]),
+                                  np.asarray(k[0]))
