@@ -704,6 +704,7 @@ def phase_serve(args) -> dict:
     from deepspeed_tpu.inference.server import ContinuousBatchingServer
     from deepspeed_tpu.model_implementations.transformer import (
         InferenceTransformerConfig, init_params)
+    from deepspeed_tpu.telemetry import MetricRegistry
 
     smoke = bool(getattr(args, "smoke", False)) or \
         jax.default_backend() != "tpu"
@@ -726,7 +727,10 @@ def phase_serve(args) -> dict:
         budgets, plens = [16, 64, 16, 16], [64, 128, 32, 96]
     params = init_params(jax.random.PRNGKey(0), mcfg)
     eng = InferenceEngine((mcfg, params), scfg)
-    srv = ContinuousBatchingServer(eng)
+    # private registry: the record reflects THIS replay, not whatever
+    # else the process measured (warmup included — see steps0 handling)
+    telem = MetricRegistry()
+    srv = ContinuousBatchingServer(eng, registry=telem)
     out: dict = {"phase": "serve-continuous", "smoke": smoke,
                  "num_slots": srv.num_slots,
                  "block_size": srv.block_size, "requests": n_req}
@@ -787,6 +791,38 @@ def phase_serve(args) -> dict:
         "units_continuous": units,
         "decode_traces": srv.stats["decode_traces"],
     })
+
+    # registry-derived snapshot (docs/observability.md): the same run's
+    # TTFT / queue-wait / per-token distributions plus pool gauges, as a
+    # scraper would see them (warmup request included in the counts)
+    snap = telem.snapshot()
+
+    def _q(name, q, default=None):
+        fam = snap.get(name)
+        if not fam or not fam["series"] or not fam["series"][0]["count"]:
+            return default
+        v = fam["series"][0][q]
+        return round(v * 1e3, 3) if v is not None else default
+
+    def _g(name, default=None):
+        fam = snap.get(name)
+        return fam["series"][0]["value"] if fam and fam["series"] \
+            else default
+
+    out["telemetry"] = {
+        "ttft_p50_ms": _q("serve_ttft_seconds", "p50"),
+        "ttft_p90_ms": _q("serve_ttft_seconds", "p90"),
+        "queue_wait_p50_ms": _q("serve_queue_wait_seconds", "p50"),
+        "queue_wait_p90_ms": _q("serve_queue_wait_seconds", "p90"),
+        "decode_token_p50_ms": _q("serve_token_seconds", "p50"),
+        "decode_token_p90_ms": _q("serve_token_seconds", "p90"),
+        "request_p50_ms": _q("serve_request_seconds", "p50"),
+        "slot_occupancy_last": _g("serve_slot_occupancy"),
+        "kv_free_blocks": _g("serve_kv_free_blocks"),
+        "requests_finished":
+            snap["serve_requests_finished_total"]["series"][0]["value"],
+        "ttft_count": snap["serve_ttft_seconds"]["series"][0]["count"],
+    }
     print(json.dumps({**out, "partial": True}), flush=True)  # salvage
 
     # one-shot comparator on the SAME trace: batches of num_slots in

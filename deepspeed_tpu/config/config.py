@@ -16,6 +16,7 @@ from pydantic import ConfigDict, Field, model_validator
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.comm.mesh import MeshConfig
+from deepspeed_tpu.telemetry.config import TelemetryConfig
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -268,6 +269,9 @@ class DeepSpeedConfig:
         self.tensorboard = TensorBoardConfig(**pd.get("tensorboard", {}))
         self.wandb = WandbConfig(**pd.get("wandb", {}))
         self.csv_monitor = CSVConfig(**pd.get("csv_monitor", {}))
+        # metrics registry + optional scrape endpoint (shared schema with
+        # DeepSpeedInferenceConfig; docs/observability.md)
+        self.telemetry = TelemetryConfig(**pd.get("telemetry", {}))
         self.activation_checkpointing = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}))
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
@@ -355,7 +359,7 @@ class DeepSpeedConfig:
         "curriculum_learning", "aio", "sparse_attention",
         "zero_allow_untested_optimizer", "communication_data_type",
         "sparse_gradients", "amp", "pipeline", "inference", "data_types",
-        "eigenvalue", "progressive_layer_drop", "nebula",
+        "eigenvalue", "progressive_layer_drop", "nebula", "telemetry",
     })
 
     @classmethod

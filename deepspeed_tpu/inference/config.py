@@ -15,6 +15,7 @@ from typing import Any, Literal, Optional, Union
 from pydantic import Field, field_validator
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.telemetry.config import TelemetryConfig
 
 
 class DeepSpeedTPConfig(DeepSpeedConfigModel):
@@ -92,6 +93,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # admission control: submit() refuses beyond this many queued-but-
     # unscheduled requests instead of growing host memory unboundedly
     max_queued_requests: int = 128
+    # metrics registry + optional scrape endpoint (docs/observability.md);
+    # the shared section schema lives in telemetry/config.py
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
 
     @field_validator("max_batch_size", "num_slots", "max_queued_requests")
     @classmethod

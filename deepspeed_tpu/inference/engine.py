@@ -32,6 +32,7 @@ from deepspeed_tpu.model_implementations.transformer import (
     InferenceTransformerConfig, causal_forward, decode_chunk, decode_step,
     encoder_forward,
     init_params, prefill, tp_param_specs)
+from deepspeed_tpu.telemetry import MetricRegistry, get_registry
 
 
 def _greedy_accept(t_toks, props, K):
@@ -218,6 +219,37 @@ class InferenceEngine:
             functools.partial(causal_forward, cfg=self.model_config,
                               mesh=self.mesh))
         self._gen_loops: Dict[Any, Any] = {}
+        # process-wide registry (docs/observability.md); tests swap in a
+        # private MetricRegistry via this attribute. telemetry.enabled=
+        # false records into a private registry instead — same cost,
+        # nothing reaches the process scrape surface
+        tcfg = getattr(self.config, "telemetry", None)
+        self.telemetry = (get_registry() if tcfg is None or tcfg.enabled
+                          else MetricRegistry())
+
+    def _loop_cache_get(self, key):
+        """Decode-loop cache lookup with hit/miss telemetry: a rising
+        miss count under steady traffic means request shapes are
+        defeating the geometric buckets (the retrace regression)."""
+        hit = self._gen_loops.get(key)
+        self.telemetry.counter(
+            "inference_trace_cache_hits_total" if hit is not None
+            else "inference_trace_cache_misses_total",
+            help="decode-loop cache lookups (see docs/observability.md)"
+        ).inc()
+        return hit
+
+    def _record_generate(self, dt: float) -> None:
+        """Per-call latency into the registry (+ model_times when the
+        reference-parity profiler is enabled)."""
+        if getattr(self, "model_profile_enabled", False):
+            self._model_times.append(dt)   # keep model_times 1:1 w/ calls
+        self.telemetry.histogram(
+            "inference_generate_seconds",
+            help="generate()/generate_speculative() call wall time"
+        ).observe(dt)
+        self.telemetry.counter("inference_generate_calls_total",
+                               help="generation calls").inc()
 
     # ------------------------------------------------------------ setup
 
@@ -428,15 +460,13 @@ class InferenceEngine:
                 temperature=temperature, eos_token_id=eos_token_id,
                 attention_mask=attention_mask, seed=seed)
         import time as _time
-        t0 = (_time.perf_counter()
-              if getattr(self, "model_profile_enabled", False) else None)
+        t0 = _time.perf_counter()
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
         if max_new_tokens <= 0:
             # explicit no-op budget: prompts unchanged (exempt from the
             # schedulability checks below — nothing is being scheduled)
-            if t0 is not None:    # keep model_times 1:1 with calls
-                self._model_times.append(_time.perf_counter() - t0)
+            self._record_generate(_time.perf_counter() - t0)
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
         self._check_schedulable(B, max_new_tokens)
@@ -480,8 +510,7 @@ class InferenceEngine:
                 jnp.float32(length_penalty))
             out_np = np.asarray(out_buf)
             n_np = np.asarray(n_gen)
-            if t0 is not None:
-                self._model_times.append(_time.perf_counter() - t0)
+            self._record_generate(_time.perf_counter() - t0)
             return self._assemble_output(ids, lengths, out_np, n_np)
         cache = self._make_cache(B, max_seq)
         logits, cache = self._prefill_jit(
@@ -526,8 +555,7 @@ class InferenceEngine:
         # per-token RTT through a remote relay is the TPU analog).
         out_np = np.asarray(out_buf)
         n_np = np.asarray(n_gen)
-        if t0 is not None:
-            self._model_times.append(_time.perf_counter() - t0)
+        self._record_generate(_time.perf_counter() - t0)
         return self._assemble_output(ids, lengths, out_np, n_np)
 
     def generate_speculative(self, input_ids,
@@ -566,8 +594,7 @@ class InferenceEngine:
         reference (strictly one-token decode).
         """
         import time as _time
-        t0 = (_time.perf_counter()
-              if getattr(self, "model_profile_enabled", False) else None)
+        t0 = _time.perf_counter()
         if draft_tokens < 2:
             raise ValueError(f"draft_tokens must be >= 2, got "
                              f"{draft_tokens} (1 draft proposal minimum)")
@@ -591,8 +618,7 @@ class InferenceEngine:
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
         if max_new_tokens <= 0:
-            if t0 is not None:
-                self._model_times.append(_time.perf_counter() - t0)
+            self._record_generate(_time.perf_counter() - t0)
             return [np.asarray(ids[b, :lengths[b]]).tolist()
                     for b in range(B)]
         self._check_schedulable(B, max_new_tokens)   # same as generate
@@ -647,8 +673,7 @@ class InferenceEngine:
             "rounds": int(rounds), "tokens": total,
             "draft": "prompt-lookup" if draft is None else "model",
             "tokens_per_round": round(total / max(int(rounds), 1), 3)}
-        if t0 is not None:
-            self._model_times.append(_time.perf_counter() - t0)
+        self._record_generate(_time.perf_counter() - t0)
         return self._assemble_output(ids, lengths, out_np, n_np)
 
     def _lookup_loop(self, max_new_tokens: int, K: int):
@@ -657,7 +682,7 @@ class InferenceEngine:
         row's own history (prompt + generated), verified exactly like
         draft proposals — greedy only, no second model, no draft cache."""
         key = ("spec-lookup", max_new_tokens, K)
-        hit = self._gen_loops.get(key)
+        hit = self._loop_cache_get(key)
         if hit is not None:
             return hit
         cfg_t, mesh_t = self.model_config, self.mesh
@@ -739,7 +764,7 @@ class InferenceEngine:
         # the cache entry holds a strong reference to the draft: id() is
         # only unique while the object lives, so a GC'd draft's reused id
         # must not serve a stale loop closed over its config/mesh
-        hit = self._gen_loops.get(key)
+        hit = self._loop_cache_get(key)
         if hit is not None:
             return hit[0]
         cfg_t, cfg_d = self.model_config, draft.model_config
@@ -873,7 +898,7 @@ class InferenceEngine:
         whenever no beam ends before the token budget, and a documented
         simplification of the hypothesis pool when one does."""
         key = ("beam", max_new_tokens, num_beams)
-        loop = self._gen_loops.get(key)
+        loop = self._loop_cache_get(key)
         if loop is not None:
             return loop
         cfg = self.model_config
@@ -954,7 +979,7 @@ class InferenceEngine:
         sampled, top-k/top-p/repetition on/off); temperature/top_k/eos/
         penalties ride as traced scalars so sweeps don't recompile."""
         key = (max_new_tokens, sampled, top_k_on, top_p_on, rep_on)
-        loop = self._gen_loops.get(key)
+        loop = self._loop_cache_get(key)
         if loop is not None:
             return loop
         cfg = self.model_config

@@ -30,6 +30,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional
 
 from deepspeed_tpu.inference.kv_cache import BlockAllocator
+from deepspeed_tpu.telemetry import MetricRegistry, get_registry
 
 
 @dataclasses.dataclass
@@ -60,7 +61,8 @@ class Scheduler:
     hot path); the server owns the device arrays."""
 
     def __init__(self, num_slots: int, num_blocks: int, block_size: int,
-                 max_blocks_per_slot: int, max_queued_requests: int):
+                 max_blocks_per_slot: int, max_queued_requests: int,
+                 registry: Optional[MetricRegistry] = None):
         self.num_slots = num_slots
         self.block_size = block_size
         self.max_blocks_per_slot = max_blocks_per_slot
@@ -69,6 +71,31 @@ class Scheduler:
         self.queue: Deque[Request] = deque()
         self.slots: Dict[int, SlotState] = {}   # slot id -> state
         self._free_slots = list(range(num_slots - 1, -1, -1))
+        reg = registry or get_registry()
+        self.telemetry = reg
+        self._g_free = reg.gauge("serve_kv_free_blocks",
+                                 help="paged-pool free list size")
+        self._g_used = reg.gauge("serve_kv_used_blocks",
+                                 help="blocks held by resident sequences")
+        self._g_queue = reg.gauge("serve_queue_depth",
+                                  help="queued-but-unscheduled requests")
+        self._g_active = reg.gauge("serve_active_slots",
+                                   help="resident (live) sequences")
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        """Refresh level gauges at every admission-state transition —
+        pool pressure is readable between steps, not just at drain."""
+        self._g_free.set(self.allocator.free_blocks)
+        self._g_used.set(self._resident_blocks())
+        self._g_queue.set(len(self.queue))
+        self._g_active.set(len(self.slots))
+
+    def _reject(self, reason: str) -> None:
+        self.telemetry.counter(
+            "serve_admission_rejections_total",
+            help="refused submit() calls, by reason",
+            labels={"reason": reason}).inc()
 
     # ------------------------------------------------------------ submit
 
@@ -78,6 +105,7 @@ class Scheduler:
         instead of deadlocking the drain loop later."""
         nb = req.blocks_needed(self.block_size)
         if nb > self.max_blocks_per_slot:
+            self._reject("span")
             raise ValueError(
                 f"request {req.request_id}: prompt ({len(req.prompt)}) + "
                 f"max_new_tokens ({req.max_new_tokens}) spans {nb} blocks "
@@ -88,17 +116,20 @@ class Scheduler:
             # block-budget admission: even a fully drained pool could not
             # hold this request (the +1 excludes the null block the
             # allocator never hands out)
+            self._reject("pool")
             raise ValueError(
                 f"request {req.request_id} needs {nb} blocks but the "
                 f"whole pool holds "
                 f"{self.allocator.free_blocks + self._resident_blocks()} "
                 "— raise max_out_tokens / num_slots sizing")
         if len(self.queue) >= self.max_queued_requests:
+            self._reject("queue_full")
             raise RuntimeError(
                 f"request queue is full ({self.max_queued_requests}); "
                 "drain with step() before submitting more, or raise "
                 "max_queued_requests")
         self.queue.append(req)
+        self._g_queue.set(len(self.queue))
 
     def _resident_blocks(self) -> int:
         return sum(len(s.blocks) for s in self.slots.values())
@@ -119,6 +150,7 @@ class Scheduler:
         state = SlotState(request=req, blocks=blocks,
                           arrived_step=step_clock)
         self.slots[slot] = state
+        self._update_gauges()
         return slot, state
 
     # ------------------------------------------------------------ recycle
@@ -129,6 +161,7 @@ class Scheduler:
         state = self.slots.pop(slot)
         self.allocator.release(state.blocks)
         self._free_slots.append(slot)
+        self._update_gauges()
         return state
 
     @property

@@ -20,6 +20,7 @@ generate remains the latency king for a single fixed batch.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -33,6 +34,17 @@ from deepspeed_tpu.inference.kv_cache import (PagedKVCache,
 from deepspeed_tpu.inference.scheduler import Request, Scheduler
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill)
+from deepspeed_tpu.telemetry import (MetricRegistry, ProfilerCapture,
+                                     get_registry, start_http_server)
+
+
+def _safe_cache_size(fn) -> int:
+    """``_cache_size`` is private JAX API; a JAX upgrade must degrade the
+    trace-count stat (-1), never crash step telemetry."""
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — any private-API drift
+        return -1
 
 
 class ContinuousBatchingServer:
@@ -45,7 +57,8 @@ class ContinuousBatchingServer:
     substrate change — temperatures would ride as a per-slot array.
     """
 
-    def __init__(self, engine: InferenceEngine):
+    def __init__(self, engine: InferenceEngine,
+                 registry: Optional[MetricRegistry] = None):
         if engine.model_config.head == "none":
             raise ValueError("continuous batching needs an LM head — "
                              "encoder models have nothing to decode")
@@ -69,13 +82,56 @@ class ContinuousBatchingServer:
                 f"block ({self.block_size}) — raise max_out_tokens or "
                 "shrink block_size")
         self.max_blocks_per_slot = per_slot // self.block_size
+        # telemetry: registry recording is always on (dict lookup + float
+        # add per event); telemetry.enabled=False swaps in a private
+        # registry, so cost is identical but nothing reaches the process
+        # scrape surface. The HTTP endpoint is opt-in via config.
+        tcfg = getattr(cfg, "telemetry", None)
+        enabled = tcfg is None or tcfg.enabled
+        self.telemetry = registry or (get_registry() if enabled
+                                      else MetricRegistry())
+        self.http_server = None
+        if tcfg is not None and enabled and tcfg.http_port is not None:
+            self.http_server = start_http_server(
+                tcfg.http_port, host=tcfg.http_host,
+                registry=self.telemetry)
+        self.profiler_capture = ProfilerCapture()
+        reg = self.telemetry
+        self._h_queue_wait = reg.histogram(
+            "serve_queue_wait_seconds", help="submit() to slot admission")
+        self._h_ttft = reg.histogram(
+            "serve_ttft_seconds", help="submit() to first token committed")
+        self._h_request = reg.histogram(
+            "serve_request_seconds", help="submit() to finished, end to end")
+        self._h_decode_step = reg.histogram(
+            "serve_decode_step_seconds",
+            help="one decode step over all num_slots rows")
+        self._h_token = reg.histogram(
+            "serve_token_seconds",
+            help="per-token decode latency (one committed token per live "
+                 "slot per step)")
+        self._c_submitted = reg.counter("serve_requests_submitted_total",
+                                        help="accepted submit() calls")
+        self._c_finished = reg.counter("serve_requests_finished_total",
+                                       help="requests retired")
+        self._c_prefills = reg.counter("serve_prefills_total",
+                                       help="prefill programs executed")
+        self._c_decode_steps = reg.counter("serve_decode_steps_total",
+                                           help="decode steps executed")
+        self._c_tokens = reg.counter("serve_tokens_total",
+                                     help="generated tokens committed")
+        self._g_occupancy = reg.gauge(
+            "serve_slot_occupancy",
+            help="live/num_slots at the last decode step")
+        self._submit_ts: Dict[int, float] = {}
         # +1: block 0 is the reserved null block idle slots write into
         num_blocks = 1 + self.num_slots * self.max_blocks_per_slot
         self.scheduler = Scheduler(
             num_slots=self.num_slots, num_blocks=num_blocks,
             block_size=self.block_size,
             max_blocks_per_slot=self.max_blocks_per_slot,
-            max_queued_requests=cfg.max_queued_requests)
+            max_queued_requests=cfg.max_queued_requests,
+            registry=self.telemetry)
         self._cache = self._make_pool(num_blocks)
         self._prefill_jit = jax.jit(
             functools.partial(self._prefill_fn, cfg=mcfg,
@@ -131,9 +187,11 @@ class ContinuousBatchingServer:
         never be scheduled (block span beyond a slot) or the queue is
         full — admission control instead of a silent deadlock."""
         if not prompt:
+            self._count_rejection("empty_prompt")
             raise ValueError("empty prompt")
         floor = max(1, self.engine.config.min_out_tokens)
         if max_new_tokens < floor:
+            self._count_rejection("budget_floor")
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} is below the "
                 f"schedulable floor {floor} (min_out_tokens)")
@@ -144,6 +202,7 @@ class ContinuousBatchingServer:
                      for s in self.scheduler.slots.values())
               or any(r.request_id == request_id
                      for r in self.scheduler.queue)):
+            self._count_rejection("duplicate_id")
             raise ValueError(
                 f"request_id {request_id} is already queued, resident, "
                 "or finished — a duplicate would silently overwrite its "
@@ -152,7 +211,17 @@ class ContinuousBatchingServer:
         self.scheduler.submit(Request(
             request_id=request_id, prompt=list(prompt),
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id))
+        self._submit_ts[request_id] = time.perf_counter()
+        self._c_submitted.inc()
         return request_id
+
+    def _count_rejection(self, reason: str) -> None:
+        """Server-side refusals; the scheduler counts its own (span/pool/
+        queue_full) into the same family — one admission-failure metric."""
+        self.telemetry.counter(
+            "serve_admission_rejections_total",
+            help="refused submit() calls, by reason",
+            labels={"reason": reason}).inc()
 
     def _admit(self, finished: list) -> None:
         """Prefill queued requests into free slots until blocks or slots
@@ -165,6 +234,9 @@ class ContinuousBatchingServer:
                 return
             slot, state = adm
             req = state.request
+            t_admit = time.perf_counter()
+            self._h_queue_wait.observe(
+                t_admit - self._submit_ts.get(req.request_id, t_admit))
             # geometric bucket, floored at one block and clamped to the
             # slot's whole block span (admission guarantees the prompt
             # fits the span; the bucket may overshoot it — one ceiling
@@ -186,7 +258,19 @@ class ContinuousBatchingServer:
                 jnp.asarray([len(req.prompt)], jnp.int32), self._cache,
                 jnp.int32(slot))
             self._prefills += 1
-            tok0 = int(np.asarray(tok0)[0])
+            tok0 = int(np.asarray(tok0)[0])   # host sync: prefill done
+            now = time.perf_counter()
+            # prefill latency by PADDED bucket (the traced shape, not the
+            # raw prompt length — per-shape latency is what regressions
+            # in the prefill program show up against)
+            self.telemetry.histogram(
+                "serve_prefill_seconds",
+                help="prefill wall time, by padded prompt-bucket length",
+                labels={"bucket": str(T)}).observe(now - t_admit)
+            self._h_ttft.observe(
+                now - self._submit_ts.get(req.request_id, now))
+            self._c_prefills.inc()
+            self._c_tokens.inc()
             state.generated.append(tok0)
             state.pending = tok0
             if self._finished(state, tok0):
@@ -202,6 +286,10 @@ class ContinuousBatchingServer:
         out = list(req.prompt) + state.generated
         self._results[req.request_id] = out
         finished.append(req.request_id)
+        ts = self._submit_ts.pop(req.request_id, None)
+        if ts is not None:
+            self._h_request.observe(time.perf_counter() - ts)
+        self._c_finished.inc()
         # slot + blocks recycle NOW: the freed span admits the next
         # queued request on the same step, without touching the trace.
         # The retired slot's length resets to 0 on the HOST array only —
@@ -226,12 +314,24 @@ class ContinuousBatchingServer:
         for slot, state in self.scheduler.slots.items():
             tokens[slot] = state.pending
             active[slot] = True
+        self.profiler_capture.step_begin()
+        t0 = time.perf_counter()
         nxt, self._cache = self._decode_jit(
             self.engine.params, jnp.asarray(tokens), self._cache,
             jnp.asarray(active))
         self._step_clock += 1
-        self._active_slot_steps += int(active.sum())
-        nxt = np.asarray(nxt)
+        n_active = int(active.sum())
+        self._active_slot_steps += n_active
+        nxt = np.asarray(nxt)             # host sync: the step completed
+        dt = time.perf_counter() - t0
+        self.profiler_capture.step_end()
+        self._h_decode_step.observe(dt)
+        # every live slot committed one token this step, each costing one
+        # step of wall time — THE per-token serving latency
+        self._h_token.observe(dt)
+        self._c_decode_steps.inc()
+        self._c_tokens.inc(n_active)
+        self._g_occupancy.set(n_active / self.num_slots)
         for slot in list(self.scheduler.slots):   # _retire mutates
             state = self.scheduler.slots[slot]
             tok = int(nxt[slot])
@@ -253,6 +353,20 @@ class ContinuousBatchingServer:
             self.step()
         return dict(self._results)
 
+    def capture_decode_steps(self, num_steps: int, logdir: str) -> None:
+        """Arm an on-demand ``jax.profiler`` capture: the next
+        ``num_steps`` decode steps are traced to ``logdir`` (view with
+        TensorBoard's profile plugin or Perfetto). Host-side arming only
+        — until the next ``step()`` nothing changes, and the serving loop
+        never pays for an idle hook (see telemetry/capture.py)."""
+        self.profiler_capture.arm(num_steps, logdir)
+
+    def close(self) -> None:
+        """Release the scrape endpoint (if config opened one)."""
+        if self.http_server is not None:
+            self.http_server.close()
+            self.http_server = None
+
     # ------------------------------------------------------------ stats
 
     @property
@@ -270,7 +384,7 @@ class ContinuousBatchingServer:
             "active_slot_steps": self._active_slot_steps,
             "slot_occupancy": (self._active_slot_steps / units
                                if units else 0.0),
-            "decode_traces": self._decode_jit._cache_size(),
+            "decode_traces": _safe_cache_size(self._decode_jit),
             "num_slots": self.num_slots,
             "block_size": self.block_size,
             "free_blocks": self.scheduler.allocator.free_blocks,

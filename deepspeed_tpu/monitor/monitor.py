@@ -1,15 +1,20 @@
 """Monitoring fan-out — analog of ``deepspeed/monitor/monitor.py:24``
 (MonitorMaster → TensorBoard/WandB/CSV writers). Events are
 ``(name, value, global_sample_count)`` triples exactly as the engine emits
-them (runtime/engine.py:1946)."""
+them (runtime/engine.py:1946). The engine routes the same events through
+the telemetry registry (``RegistryMonitor``) so they are scrapeable even
+with every backend here disabled — MonitorMaster is one sink of several
+(docs/observability.md)."""
 from __future__ import annotations
 
 import csv
 import os
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 
+from deepspeed_tpu.telemetry.registry import (MetricRegistry, get_registry,
+                                              sanitize_metric_name)
 from deepspeed_tpu.utils.logging import logger
 
 Event = Tuple[str, float, int]
@@ -21,6 +26,10 @@ class Monitor:
 
     def write_events(self, event_list: List[Event]):
         raise NotImplementedError
+
+    def close(self):
+        """Release file handles / writers; safe to call twice. Backends
+        that hold nothing inherit the no-op."""
 
 
 class CsvMonitor(Monitor):
@@ -49,6 +58,13 @@ class CsvMonitor(Monitor):
             writer.writerow([step, value])
             f.flush()
 
+    def close(self):
+        # handles reopen on the next write (append mode), so close() at
+        # engine teardown cannot strand a later flush
+        for f, _ in self._files.values():
+            f.close()
+        self._files = {}
+
 
 class TensorBoardMonitor(Monitor):
     def __init__(self, tb_config):
@@ -71,10 +87,20 @@ class TensorBoardMonitor(Monitor):
             self.summary_writer.add_scalar(name, value, step)
         self.summary_writer.flush()
 
+    def close(self):
+        if self.summary_writer is not None:
+            try:
+                self.summary_writer.close()
+            except Exception as e:  # noqa: BLE001 — teardown must not raise
+                logger.warning(f"tensorboard close failed: {e}")
+            self.summary_writer = None
+            self.enabled = False
+
 
 class WandbMonitor(Monitor):
     def __init__(self, wandb_config):
         self.enabled = wandb_config.enabled and jax.process_index() == 0
+        self._wandb = None
         if self.enabled:
             try:
                 import wandb
@@ -91,16 +117,59 @@ class WandbMonitor(Monitor):
         for name, value, step in event_list:
             self._wandb.log({name: value}, step=step)
 
+    def close(self):
+        if self._wandb is not None:
+            try:
+                self._wandb.finish()
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"wandb finish failed: {e}")
+            self._wandb = None
+            self.enabled = False
+
+
+class RegistryMonitor(Monitor):
+    """Sink that lands monitor events in the telemetry registry: each
+    event name becomes a gauge (``Train/Samples/train_loss`` →
+    ``train_samples_train_loss``), the sample clock lands in
+    ``train_samples`` — so a scraper sees training step metrics with
+    zero backend configuration."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        self.registry = registry or get_registry()
+        self.enabled = True
+
+    def write_events(self, event_list: List[Event]):
+        for name, value, step in event_list:
+            self.registry.gauge(
+                sanitize_metric_name(name),
+                help=f"monitor event {name!r} (runtime/engine.py)"
+            ).set(float(value))
+            self.registry.gauge(
+                "train_samples",
+                help="global sample count at the last monitor event"
+            ).set(float(step))
+
 
 class MonitorMaster(Monitor):
     def __init__(self, ds_config):
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
         self.csv_monitor = CsvMonitor(ds_config.csv_monitor)
-        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
-                        or self.csv_monitor.enabled)
+        self.monitors = [self.tb_monitor, self.wandb_monitor,
+                         self.csv_monitor]
+        self.enabled = any(m.enabled for m in self.monitors)
 
     def write_events(self, event_list: List[Event]):
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for m in self.monitors:
             if m.enabled:
                 m.write_events(event_list)
+
+    def close(self):
+        for m in self.monitors:
+            m.close()
+
+    def __enter__(self) -> "MonitorMaster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

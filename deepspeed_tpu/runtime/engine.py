@@ -343,10 +343,34 @@ class DeepSpeedEngine:
         self._last_skipped = None
         self._warned_aux_dropped = False
         self._rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+        # telemetry registry (docs/observability.md): process-global, or
+        # — with telemetry.enabled=false — a private one, so recording
+        # cost stays identical while nothing reaches the scrape surface
+        from deepspeed_tpu.telemetry import MetricRegistry, get_registry
+        tcfg = getattr(config, "telemetry", None)
+        telemetry_on = tcfg is None or tcfg.enabled
+        self.telemetry = get_registry() if telemetry_on \
+            else MetricRegistry()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
-            steps_per_output=config.steps_per_print)
+            steps_per_output=config.steps_per_print,
+            registry=self.telemetry)
         self.monitor = self._build_monitor()
+        # step metrics route through the telemetry registry FIRST —
+        # MonitorMaster (tb/wandb/csv) is one sink of several, and the
+        # registry one is backend-free
+        from deepspeed_tpu.monitor.monitor import RegistryMonitor
+        self._registry_sink = RegistryMonitor(self.telemetry)
+        self._telemetry_http = None
+        if telemetry_on and tcfg is not None and \
+                tcfg.http_port is not None:
+            from deepspeed_tpu.telemetry import start_http_server
+            try:
+                self._telemetry_http = start_http_server(
+                    tcfg.http_port, host=tcfg.http_host,
+                    registry=self.telemetry)
+            except OSError as e:   # port taken must not kill training
+                logger.warning(f"telemetry endpoint unavailable: {e}")
         self.curriculum_scheduler = None
         if config.curriculum_learning.get("enabled", False):
             from deepspeed_tpu.runtime.data_pipeline import (
@@ -1087,8 +1111,7 @@ class DeepSpeedEngine:
         # user aux scalars computed by grad_fn ride through here too
         out.update({k: v for k, v in metrics.items()
                     if k not in ("loss", "grad_norm", "finite")})
-        if self.monitor is not None and self.monitor.enabled and \
-                self.global_steps % self.config.steps_per_print == 0:
+        if self.global_steps % self.config.steps_per_print == 0:
             self._write_monitor_events(out)
         return out
 
@@ -1224,9 +1247,8 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
         self.tput_timer.stop(global_step=self.global_steps,
                              report_speed=True)
-        if self.monitor is not None and self.monitor.enabled:
-            if self.global_steps % self.config.steps_per_print == 0:
-                self._write_monitor_events(metrics)
+        if self.global_steps % self.config.steps_per_print == 0:
+            self._write_monitor_events(metrics)
         return metrics
 
     # ------------------------------------------------------------------
@@ -1701,12 +1723,18 @@ class DeepSpeedEngine:
             collate_fn=collate_fn, seed=self.config.seed)
 
     def destroy(self) -> None:
-        """Release compiled executables and pending state (engine.destroy)."""
+        """Release compiled executables, pending state, monitor file
+        handles, and the telemetry endpoint (engine.destroy)."""
         self._step_fn = None
         self._grad_fn = None
         self._apply_fn = None
         self._offload_grad_fn = None
         self.zero_grad()
+        if self.monitor is not None:
+            self.monitor.close()
+        if self._telemetry_http is not None:
+            self._telemetry_http.close()
+            self._telemetry_http = None
 
     def fp32_master_params(self):
         """Consolidated fp32 weights (analog of
@@ -1794,7 +1822,10 @@ class DeepSpeedEngine:
 
     def _write_monitor_events(self, metrics):
         """Reference event parity (runtime/engine.py:1946-1954): loss, lr,
-        and — when present — the dynamic loss scale and global grad norm."""
+        and — when present — the dynamic loss scale and global grad norm.
+        Fans out to every live sink: the telemetry-registry sink (always,
+        unless telemetry.enabled=false) and MonitorMaster (when a backend
+        is configured)."""
         samples = self.global_steps * self.train_batch_size
         events = [("Train/Samples/train_loss", float(metrics["loss"]),
                    samples),
@@ -1805,7 +1836,9 @@ class DeepSpeedEngine:
         if "grad_norm" in metrics and metrics["grad_norm"] is not None:
             events.append(("Train/Samples/grad_norm",
                            float(metrics["grad_norm"]), samples))
-        self.monitor.write_events(events)
+        for sink in (self._registry_sink, self.monitor):
+            if sink is not None and sink.enabled:
+                sink.write_events(events)
 
 
 def initialize(args=None,

@@ -1,0 +1,38 @@
+"""Unified telemetry: metrics registry + spans + exposition + capture.
+
+The observability layer under both engines (ROADMAP: you cannot make the
+hot path faster than the hardware without measuring it first):
+
+* ``registry`` — process-wide counters/gauges/histograms (fixed
+  exponential buckets → p50/p90/p99 without stored samples)
+* ``spans`` — host spans that record into histograms AND the jax
+  profiler timeline via ``profiling/trace.py``
+* ``exporter`` — Prometheus-text / JSON scrape endpoint (stdlib
+  ``http.server``, config-gated, off by default)
+* ``capture`` — on-demand ``jax.profiler`` capture scoped in steps
+  ("trace the next N decode steps to this logdir")
+
+Everything here is host-pure except ``capture``'s default hooks; no
+module imports jax at import time, so the registry is usable from config
+parsing and test collection alike.
+"""
+from deepspeed_tpu.telemetry.capture import ProfilerCapture
+from deepspeed_tpu.telemetry.config import TelemetryConfig
+from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
+                                              start_http_server)
+from deepspeed_tpu.telemetry.registry import (DEFAULT_TIME_BUCKETS, Counter,
+                                              Gauge, Histogram,
+                                              MetricRegistry,
+                                              exponential_buckets,
+                                              get_registry,
+                                              sanitize_metric_name,
+                                              set_registry)
+from deepspeed_tpu.telemetry.spans import span, timed
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "DEFAULT_TIME_BUCKETS", "exponential_buckets", "get_registry",
+    "set_registry", "sanitize_metric_name", "span", "timed",
+    "TelemetryHTTPServer", "start_http_server", "ProfilerCapture",
+    "TelemetryConfig",
+]

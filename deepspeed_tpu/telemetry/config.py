@@ -1,0 +1,27 @@
+"""``telemetry`` config section, shared by the training JSON config
+(config/config.py) and ``DeepSpeedInferenceConfig`` (inference/config.py)
+— one schema, both engines."""
+from __future__ import annotations
+
+from typing import Optional
+
+from pydantic import field_validator
+
+from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+
+
+class TelemetryConfig(DeepSpeedConfigModel):
+    """Registry recording is on by default (dict-lookup + float-add cost);
+    the HTTP scrape endpoint is OFF by default and opens only when a port
+    is configured — a serving process must opt into listening."""
+    enabled: bool = True
+    # scrape endpoint: None = no listener; 0 = ephemeral port (tests)
+    http_port: Optional[int] = None
+    http_host: str = "127.0.0.1"
+
+    @field_validator("http_port")
+    @classmethod
+    def _valid_port(cls, v):
+        if v is not None and not 0 <= v <= 65535:
+            raise ValueError(f"http_port must be in [0, 65535], got {v}")
+        return v

@@ -1,0 +1,84 @@
+"""Scrape surface: stdlib ``http.server`` endpoint over the registry.
+
+Off by default and config-gated (``telemetry.http_port``) — a serving
+process must opt into opening a port. stdlib-only on purpose: the
+container bakes no prometheus_client, and the exposition format is
+simple enough that a renderer (registry.prometheus_text) plus a
+ThreadingHTTPServer IS the integration.
+
+Routes:
+  ``/metrics``       Prometheus text exposition (content-type 0.0.4)
+  ``/metrics.json``  JSON snapshot (registry.snapshot) — same instruments
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class TelemetryHTTPServer:
+    """Daemon-threaded scrape endpoint; ``close()`` (or context-manager
+    exit) releases the port."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[MetricRegistry] = None):
+        reg = registry or get_registry()
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = reg.prometheus_text().encode()
+                    ctype = PROMETHEUS_CONTENT_TYPE
+                elif path in ("/metrics.json", "/snapshot"):
+                    body = json.dumps(reg.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "unknown path "
+                                    "(try /metrics or /metrics.json)")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes must not spam stderr
+                pass
+
+        self.registry = reg
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="telemetry-scrape",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        """Bound port (useful with port=0 ephemeral binding in tests)."""
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "TelemetryHTTPServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_http_server(port: int, host: str = "127.0.0.1",
+                      registry: Optional[MetricRegistry] = None
+                      ) -> TelemetryHTTPServer:
+    """Convenience spelling mirroring prometheus_client's entry point."""
+    return TelemetryHTTPServer(port=port, host=host, registry=registry)

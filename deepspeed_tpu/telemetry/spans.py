@@ -1,0 +1,65 @@
+"""Host spans that land in BOTH views of the system.
+
+``profiling/trace.py`` ``annotate`` puts a named range into the xplane /
+Perfetto timeline (the deep per-capture view); the registry histograms
+are the always-on aggregate view. ``span`` is the one spelling that
+feeds both, so instrumenting a code path once buys the profiler range
+AND the p50/p90/p99 without a second decoration pass.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Callable, Dict, Optional
+
+from deepspeed_tpu.telemetry.registry import (MetricRegistry, get_registry,
+                                              sanitize_metric_name)
+
+SPAN_HISTOGRAM = "span_duration_seconds"
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricRegistry] = None,
+         labels: Optional[Dict[str, str]] = None):
+    """``with span("prefill"): ...`` — profiler annotation + histogram.
+
+    The profiler annotation is best-effort: span timing must survive
+    environments where jax (or its profiler) is unavailable, because the
+    histograms are the production signal and the trace is the debugging
+    one.
+    """
+    reg = registry or get_registry()
+    hist = reg.histogram(
+        SPAN_HISTOGRAM,
+        help="host span wall time, by span name (see telemetry.spans)",
+        labels={"span": name, **(labels or {})})
+    ctx = contextlib.nullcontext()
+    try:
+        from deepspeed_tpu.profiling.trace import annotate
+        ctx = annotate(name)
+    except Exception:  # noqa: BLE001 — profiler optional, histogram is not
+        pass
+    with ctx:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            hist.observe(time.perf_counter() - t0)
+
+
+def timed(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+          registry: Optional[MetricRegistry] = None):
+    """``@timed`` / ``@timed(name="phase")`` — function-scoped ``span``
+    (the ``instrument`` decorator's metrics-aware sibling)."""
+    def deco(f):
+        span_name = sanitize_metric_name(
+            name or getattr(f, "__qualname__", f.__name__))
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with span(span_name, registry=registry):
+                return f(*args, **kwargs)
+        return wrapper
+
+    return deco(fn) if fn is not None else deco

@@ -43,8 +43,15 @@ class _Timer:
     def stop(self, reset=False):
         assert self.started, f"{self.name} timer not started"
         _sync()
-        self.elapsed_ += time.time() - self.start_time
-        self.count += 1
+        interval = time.time() - self.start_time
+        if reset:
+            # reference semantics (utils/timer.py stop(reset=True)): this
+            # interval REPLACES the accumulated total instead of adding
+            self.elapsed_ = interval
+            self.count = 1
+        else:
+            self.elapsed_ += interval
+            self.count += 1
         self.started = False
 
     def reset(self):
@@ -89,7 +96,12 @@ class ThroughputTimer:
 
     def __init__(self, batch_size: int, start_step: int = 2,
                  steps_per_output: int = 50,
-                 monitor_memory: bool = False, logging_fn=None):
+                 monitor_memory: bool = False, logging_fn=None,
+                 registry=None):
+        # registry=None -> the process default; the engine passes its own
+        # so telemetry.enabled=false keeps throughput off the scrape
+        # surface (docs/observability.md)
+        self.registry = registry
         self.batch_size = max(batch_size, 1)
         self.start_step = start_step
         self.steps_per_output = max(steps_per_output, 1)
@@ -114,6 +126,14 @@ class ThroughputTimer:
             return  # skip warmup/compile steps
         duration = time.time() - self.start_time
         self.total_elapsed_time += duration
+        # scrapeable alongside the serving metrics (docs/observability.md)
+        if self.registry is None:
+            from deepspeed_tpu.telemetry import get_registry
+            self.registry = get_registry()
+        self.registry.gauge(
+            "train_samples_per_sec",
+            help="ThroughputTimer running average (warmup excluded)"
+        ).set(self.avg_samples_per_sec())
         if report_speed and \
                 self.global_step_count % self.steps_per_output == 0:
             msg = (f"step={self.global_step_count}, "

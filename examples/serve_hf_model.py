@@ -34,6 +34,18 @@ def run_continuous(eng, prompt, args):
     st = srv.stats
     print(f"decode steps {st['decode_steps']}, occupancy "
           f"{st['slot_occupancy']:.2f}, traces {st['decode_traces']}")
+    # registry view of the same run (docs/observability.md)
+    snap = srv.telemetry.snapshot()
+    for h in ("serve_ttft_seconds", "serve_queue_wait_seconds",
+              "serve_token_seconds"):
+        s = snap[h]["series"][0]
+        print(f"{h}: n={s['count']} p50={s['p50'] * 1e3:.2f}ms "
+              f"p90={s['p90'] * 1e3:.2f}ms")
+    if srv.http_server is not None:
+        port = srv.http_server.port
+        input(f"scrape endpoint live at http://127.0.0.1:{port}/metrics "
+              "— press Enter to exit")
+        srv.close()
 
 
 def main():
@@ -59,6 +71,9 @@ def main():
                          "(continuous mode)")
     ap.add_argument("--block-size", type=int, default=None,
                     help="paged KV pool block size (continuous mode)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="open a Prometheus/JSON scrape endpoint on this "
+                         "port (continuous mode; docs/observability.md)")
     args = ap.parse_args()
 
     import deepspeed_tpu
@@ -67,6 +82,8 @@ def main():
         knobs["num_slots"] = args.num_slots
     if args.block_size:
         knobs["block_size"] = args.block_size
+    if args.metrics_port is not None:
+        knobs["telemetry"] = {"http_port": args.metrics_port}
     eng = deepspeed_tpu.init_inference(args.path, **knobs)
     prompt = [int(t) for t in args.prompt_ids.split(",")]
     if args.continuous:

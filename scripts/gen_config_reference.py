@@ -68,6 +68,7 @@ ROOT_KEYS = {
     "eigenvalue": "section — see below",
     "progressive_layer_drop": "PLD schedule (runtime/progressive_layer_drop.py)",
     "nebula": "async checkpoint-engine alias (checkpoint.engine='async')",
+    "telemetry": "section — see below (metrics registry + scrape endpoint, docs/observability.md)",
 }
 
 
@@ -217,6 +218,12 @@ def generate() -> str:
     emit_model(buf, "tensorboard", C.TensorBoardConfig)
     emit_model(buf, "wandb", C.WandbConfig)
     emit_model(buf, "csv_monitor", C.CSVConfig)
+    emit_model(buf, "telemetry", C.TelemetryConfig,
+               note=("Shared with `DeepSpeedInferenceConfig.telemetry` "
+                     "(telemetry/config.py). The registry records "
+                     "regardless of any monitor backend; the scrape "
+                     "endpoint opens only when `http_port` is set. Full "
+                     "metric catalog: docs/observability.md."))
 
     buf.write(
         "## Subsystem configs documented elsewhere\n\n"

@@ -266,3 +266,19 @@ def test_bench_serve_continuous_smoke():
     assert 0.0 < rec["slot_occupancy"] <= 1.0
     for k in ("tokens_per_s", "token_lat_p50_ms", "token_lat_p90_ms"):
         assert k in rec
+    # telemetry snapshot embedded (docs/observability.md): histograms
+    # populated, quantiles ordered, pool gauges present
+    tm = rec["telemetry"]
+    for k in ("ttft_p50_ms", "ttft_p90_ms", "queue_wait_p50_ms",
+              "queue_wait_p90_ms", "decode_token_p50_ms",
+              "slot_occupancy_last", "kv_free_blocks"):
+        assert k in tm, k
+    assert tm["ttft_count"] >= rec["requests"]     # every request + warmup
+    assert tm["requests_finished"] >= rec["requests"]
+    assert tm["ttft_p50_ms"] > 0
+    assert tm["ttft_p50_ms"] <= tm["ttft_p90_ms"]
+    assert tm["queue_wait_p50_ms"] <= tm["queue_wait_p90_ms"]
+    assert tm["decode_token_p50_ms"] > 0
+    # the whole record (snapshot included) survives a JSON round-trip
+    import json
+    assert json.loads(json.dumps(rec))["telemetry"] == tm

@@ -1,0 +1,564 @@
+"""Unified telemetry: registry semantics, quantile bounds, Prometheus
+golden output, the serving-layer per-request metrics, the monitor/timer
+satellite fixes, and the bench snapshot contract.
+
+The serving oracle is unchanged by instrumentation: with telemetry
+enabled, greedy server output stays token-for-token identical to
+one-shot ``generate()`` (asserted here alongside the metric counts).
+"""
+import json
+import math
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.telemetry import (MetricRegistry, ProfilerCapture,
+                                     TelemetryConfig, exponential_buckets,
+                                     sanitize_metric_name, span,
+                                     start_http_server, timed)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_semantics():
+    reg = MetricRegistry()
+    c = reg.counter("reqs_total", help="requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match=">= 0"):
+        c.inc(-1)
+    g = reg.gauge("depth")
+    g.set(7)
+    g.inc(2)
+    g.dec(4)
+    assert g.value == 5.0
+    # same name + same labels → same instrument (process-wide aggregation)
+    assert reg.counter("reqs_total") is c
+    # label sets are distinct series under one family
+    a = reg.counter("by_reason_total", labels={"reason": "a"})
+    b = reg.counter("by_reason_total", labels={"reason": "b"})
+    a.inc()
+    assert b.value == 0.0
+
+
+def test_type_and_bucket_conflicts_rejected():
+    reg = MetricRegistry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.gauge("x_total")
+    reg.histogram("h_seconds", buckets=[1.0, 2.0])
+    with pytest.raises(ValueError, match="one geometry per name"):
+        reg.histogram("h_seconds", buckets=[1.0, 4.0])
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name")
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.counter("ok_total", labels={"bad-label": "v"})
+    with pytest.raises(ValueError, match="strictly increase"):
+        reg.histogram("d_seconds", buckets=[2.0, 1.0])
+    with pytest.raises(ValueError):
+        exponential_buckets(0.0, 2.0, 4)
+
+
+def test_sanitize_metric_name():
+    assert sanitize_metric_name("Train/Samples/train_loss") == \
+        "train_samples_train_loss"
+    assert sanitize_metric_name("9lives") == "_9lives"
+
+
+# ---------------------------------------------------------------------------
+# histogram quantiles
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantile_error_bounds():
+    """Rank interpolation inside exponential buckets: the estimate must
+    be within the bucket growth factor (×2) of the true sample quantile,
+    across a spread of scales."""
+    import random
+    rng = random.Random(0)
+    reg = MetricRegistry()
+    h = reg.histogram("lat_seconds")
+    vals = [rng.uniform(2e-4, 2.0) for _ in range(2000)]
+    for v in vals:
+        h.observe(v)
+    vals.sort()
+    for q in (0.5, 0.9, 0.99):
+        true = vals[min(int(q * len(vals)), len(vals) - 1)]
+        est = h.quantile(q)
+        assert true / 2.0 <= est <= true * 2.0, (q, true, est)
+    # monotone in q — the snapshot acceptance invariant
+    assert h.quantile(0.5) <= h.quantile(0.9) <= h.quantile(0.99)
+    assert h.count == 2000
+    assert h.sum == pytest.approx(sum(vals))
+
+
+def test_histogram_edges():
+    reg = MetricRegistry()
+    h = reg.histogram("e_seconds", buckets=[1.0, 2.0])
+    assert h.quantile(0.5) is None          # empty
+    h.observe(5.0)                          # overflow bucket
+    assert h.quantile(0.5) == 5.0           # clamps to observed max
+    h2 = reg.histogram("one_seconds", buckets=[10.0])
+    h2.observe(3.0)
+    # single sample: clamp to [min, max] pins the exact value
+    assert h2.quantile(0.5) == 3.0
+    with pytest.raises(ValueError, match="quantile"):
+        h2.quantile(1.5)
+
+
+# ---------------------------------------------------------------------------
+# exposition: Prometheus text + JSON snapshot
+# ---------------------------------------------------------------------------
+
+def test_prometheus_text_golden():
+    """Byte-exact exposition for a tiny registry — the scrape contract."""
+    reg = MetricRegistry()
+    reg.counter("reqs_total", help="total requests").inc(3)
+    reg.gauge("occupancy").set(0.5)
+    h = reg.histogram("lat_seconds", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(7.0)
+    assert reg.prometheus_text() == (
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 1\n'
+        'lat_seconds_bucket{le="1"} 2\n'
+        'lat_seconds_bucket{le="+Inf"} 3\n'
+        "lat_seconds_sum 7.55\n"
+        "lat_seconds_count 3\n"
+        "# TYPE occupancy gauge\n"
+        "occupancy 0.5\n"
+        "# HELP reqs_total total requests\n"
+        "# TYPE reqs_total counter\n"
+        "reqs_total 3\n")
+
+
+def test_label_escaping():
+    reg = MetricRegistry()
+    reg.counter("esc_total",
+                labels={"v": 'say "hi"\\now', "nl": "a\nb"}).inc()
+    text = reg.prometheus_text()
+    assert r'nl="a\nb"' in text
+    assert r'v="say \"hi\"\\now"' in text
+    # snapshot keeps the raw (unescaped) value
+    snap = reg.snapshot()
+    assert snap["esc_total"]["series"][0]["labels"]["nl"] == "a\nb"
+
+
+def test_snapshot_json_round_trip():
+    reg = MetricRegistry()
+    reg.counter("c_total").inc(2)
+    h = reg.histogram("h_seconds")
+    for v in (0.001, 0.01, 0.1):
+        h.observe(v)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["c_total"]["series"][0]["value"] == 2
+    s = snap["h_seconds"]["series"][0]
+    assert s["count"] == 3
+    assert s["p50"] <= s["p90"] <= s["p99"]
+    assert sum(c for _, c in s["buckets"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# spans, exporter, capture
+# ---------------------------------------------------------------------------
+
+def test_span_records_histogram_and_propagates():
+    reg = MetricRegistry()
+    with span("unit", registry=reg):
+        pass
+    with pytest.raises(RuntimeError, match="boom"):
+        with span("unit", registry=reg):
+            raise RuntimeError("boom")
+    h = reg.histogram("span_duration_seconds", labels={"span": "unit"})
+    assert h.count == 2          # the failing span still recorded
+
+    calls = []
+
+    @timed(name="fn_span", registry=reg)
+    def fn(x):
+        calls.append(x)
+        return x + 1
+
+    assert fn(1) == 2
+    assert reg.histogram("span_duration_seconds",
+                         labels={"span": "fn_span"}).count == 1
+
+
+def test_http_exporter_scrape():
+    reg = MetricRegistry()
+    reg.counter("scraped_total").inc(9)
+    with start_http_server(0, registry=reg) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "scraped_total 9" in text
+        snap = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read())
+        assert snap["scraped_total"]["series"][0]["value"] == 9
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope")
+
+
+def test_profiler_capture_state_machine():
+    events = []
+    cap = ProfilerCapture(start_fn=lambda d: events.append(("start", d)),
+                          stop_fn=lambda: events.append(("stop",)))
+    assert not cap.active
+    cap.step_begin()                 # unarmed: no-op
+    cap.step_end()
+    assert events == []
+    cap.arm(2, "/tmp/logs")
+    with pytest.raises(RuntimeError, match="already armed"):
+        cap.arm(1, "/tmp/other")
+    for _ in range(4):               # extra steps after capture: no-ops
+        cap.step_begin()
+        cap.step_end()
+    assert events == [("start", "/tmp/logs"), ("stop",)]
+    assert not cap.active
+    with pytest.raises(ValueError, match=">= 1"):
+        cap.arm(0, "/tmp/x")
+    # a start failure degrades (disarms), never raises into the loop
+    bad = ProfilerCapture(start_fn=lambda d: 1 / 0,
+                          stop_fn=lambda: events.append(("stop",)))
+    bad.arm(1, "/tmp/x")
+    bad.step_begin()
+    bad.step_end()
+    assert not bad.active
+
+
+def test_concurrent_new_series_vs_scrape():
+    """First-seen label sets (new prefill bucket, new rejection reason)
+    land while the scrape thread renders — series insertion must hold
+    the registry lock or iteration blows up mid-scrape."""
+    import threading
+    reg = MetricRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            reg.counter("churn_total", labels={"k": str(i)}).inc()
+            i += 1
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                reg.prometheus_text()
+                json.dumps(reg.snapshot())
+        except Exception as e:  # noqa: BLE001 — the failure under test
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=scraper)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+
+
+def test_telemetry_disabled_keeps_process_registry_clean():
+    """telemetry.enabled=false: engine + server still record (same cost)
+    but into private registries — nothing reaches the process scrape
+    surface."""
+    from deepspeed_tpu.telemetry import get_registry
+    before = get_registry().counter("inference_generate_calls_total").value
+    eng, srv = _make_server(None, telemetry={"enabled": False})
+    assert eng.telemetry is not get_registry()
+    assert srv.telemetry is not get_registry()
+    rid = srv.submit([1, 2, 3], max_new_tokens=3)
+    srv.drain()
+    eng.generate([[1, 2, 3]], max_new_tokens=3)
+    assert srv.result(rid) is not None
+    assert eng.telemetry.counter("inference_generate_calls_total").value \
+        == 1   # still recorded, privately
+    assert get_registry().counter(
+        "inference_generate_calls_total").value == before
+
+
+def test_telemetry_config_validation():
+    assert TelemetryConfig().http_port is None      # endpoint off by default
+    assert TelemetryConfig(http_port=0).http_port == 0
+    with pytest.raises(ValueError, match="http_port"):
+        TelemetryConfig(http_port=70000)
+
+
+# ---------------------------------------------------------------------------
+# serving-layer wiring
+# ---------------------------------------------------------------------------
+
+def _make_server(registry, **knobs):
+    import jax
+
+    from deepspeed_tpu.inference import (ContinuousBatchingServer,
+                                         DeepSpeedInferenceConfig,
+                                         InferenceEngine)
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, init_params)
+    cfg = InferenceTransformerConfig(vocab_size=128, n_positions=256,
+                                     n_embd=32, n_layer=2, n_head=4,
+                                     dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    scfg = dict(dtype="float32", max_out_tokens=256, block_size=32,
+                num_slots=4)
+    scfg.update(knobs)
+    eng = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(**scfg))
+    return eng, ContinuousBatchingServer(eng, registry=registry)
+
+
+PROMPTS = [[1, 2, 3, 4], [7, 8], [5, 6, 7, 8, 9, 10], [11, 12, 13],
+           [20, 21], [30], [40, 41, 42, 43, 44], [50, 51]]
+
+
+def test_server_per_request_metrics_staggered():
+    """TTFT and queue-wait recorded for EVERY request through a staggered
+    submit/step/drain run (8 requests through 4 slots forces queueing),
+    while greedy output stays identical to the one-shot oracle."""
+    reg = MetricRegistry()
+    eng, srv = _make_server(reg)
+    ids = [srv.submit(p, max_new_tokens=6) for p in PROMPTS[:3]]
+    for _ in range(2):
+        srv.step()
+    ids += [srv.submit(p, max_new_tokens=6) for p in PROMPTS[3:]]
+    out = srv.drain()
+    # oracle unchanged with telemetry enabled
+    assert [out[i] for i in ids] == eng.generate(PROMPTS, max_new_tokens=6)
+
+    n = len(PROMPTS)
+    assert reg.histogram("serve_ttft_seconds").count == n
+    assert reg.histogram("serve_queue_wait_seconds").count == n
+    assert reg.histogram("serve_request_seconds").count == n
+    assert reg.counter("serve_requests_submitted_total").value == n
+    assert reg.counter("serve_requests_finished_total").value == n
+    assert reg.counter("serve_prefills_total").value == n
+    steps = reg.counter("serve_decode_steps_total").value
+    assert steps == srv.stats["decode_steps"]
+    assert reg.histogram("serve_decode_step_seconds").count == steps
+    assert reg.histogram("serve_token_seconds").count == steps
+    tokens = reg.counter("serve_tokens_total").value
+    assert tokens == sum(len(out[i]) - len(p)
+                         for i, p in zip(ids, PROMPTS))
+    # pool gauges: drained server is all-free
+    total = srv.scheduler.allocator.free_blocks
+    assert reg.gauge("serve_kv_free_blocks").value == total
+    assert reg.gauge("serve_kv_used_blocks").value == 0
+    assert reg.gauge("serve_active_slots").value == 0
+    assert reg.gauge("serve_queue_depth").value == 0
+    # prefill histogram labeled by padded bucket length
+    snap = reg.snapshot()
+    pre = snap["serve_prefill_seconds"]["series"]
+    assert sum(s["count"] for s in pre) == n
+    assert all("bucket" in s["labels"] for s in pre)
+
+
+def test_server_exposition_acceptance():
+    """The acceptance run: staggered arrivals on CPU → Prometheus text
+    with non-zero TTFT/queue-wait/per-token histograms + KV gauges, and
+    a JSON snapshot that round-trips with p50 ≤ p90 everywhere."""
+    reg = MetricRegistry()
+    _, srv = _make_server(reg)
+    for i, p in enumerate(PROMPTS):
+        srv.submit(p, max_new_tokens=4 + (i % 3))
+        if i % 2:
+            srv.step()
+    srv.drain()
+    text = reg.prometheus_text()
+    for h in ("serve_ttft_seconds", "serve_queue_wait_seconds",
+              "serve_token_seconds"):
+        m = [ln for ln in text.splitlines()
+             if ln.startswith(f"{h}_count")]
+        assert m and int(m[0].split()[-1]) > 0, h
+    assert "serve_kv_free_blocks" in text
+    assert "serve_kv_used_blocks" in text
+    snap = json.loads(json.dumps(reg.snapshot()))
+    hists = [s for fam in snap.values() if fam["type"] == "histogram"
+             for s in fam["series"] if s["count"]]
+    assert hists
+    for s in hists:
+        assert s["p50"] <= s["p90"], s
+
+
+def test_server_admission_rejections_counted():
+    reg = MetricRegistry()
+    _, srv = _make_server(reg, max_out_tokens=128, max_queued_requests=2)
+
+    def reject(reason):
+        return reg.counter("serve_admission_rejections_total",
+                           labels={"reason": reason}).value
+
+    with pytest.raises(ValueError):
+        srv.submit([], max_new_tokens=4)
+    assert reject("empty_prompt") == 1
+    with pytest.raises(ValueError):
+        srv.submit([1, 2], max_new_tokens=0)
+    assert reject("budget_floor") == 1
+    with pytest.raises(ValueError):
+        srv.submit(list(range(1, 120)), max_new_tokens=64)   # span > slot
+    assert reject("span") == 1
+    srv.submit([1, 2], max_new_tokens=4, request_id=7)
+    with pytest.raises(ValueError):
+        srv.submit([3], max_new_tokens=4, request_id=7)
+    assert reject("duplicate_id") == 1
+    srv.submit([1, 2], max_new_tokens=4)
+    with pytest.raises(RuntimeError):
+        srv.submit([1, 2], max_new_tokens=4)                 # queue full
+    assert reject("queue_full") == 1
+    srv.drain()
+
+
+def test_server_stats_survive_private_jit_api_change():
+    """``_cache_size`` is private JAX API — stats must degrade (-1), not
+    crash step telemetry, when it disappears."""
+    reg = MetricRegistry()
+    _, srv = _make_server(reg)
+    srv.submit([1, 2, 3], max_new_tokens=3)
+    srv.drain()
+    assert srv.stats["decode_traces"] == 1
+    srv._decode_jit = object()       # simulate the API going away
+    st = srv.stats                   # must not raise
+    assert st["decode_traces"] == -1
+    assert st["prefills"] == 1
+
+
+def test_server_scrape_endpoint_config_gated():
+    reg = MetricRegistry()
+    _, srv = _make_server(reg, telemetry={"http_port": 0})
+    try:
+        assert srv.http_server is not None
+        srv.submit([1, 2, 3], max_new_tokens=3)
+        srv.drain()
+        url = f"http://127.0.0.1:{srv.http_server.port}/metrics"
+        text = urllib.request.urlopen(url).read().decode()
+        assert "serve_ttft_seconds_count 1" in text
+    finally:
+        srv.close()
+    # default: no listener
+    _, srv2 = _make_server(MetricRegistry())
+    assert srv2.http_server is None
+    srv2.close()
+
+
+def test_server_capture_decode_steps(tmp_path):
+    events = []
+    reg = MetricRegistry()
+    _, srv = _make_server(reg)
+    srv.profiler_capture = ProfilerCapture(
+        start_fn=lambda d: events.append(("start", d)),
+        stop_fn=lambda: events.append(("stop",)))
+    srv.capture_decode_steps(2, str(tmp_path))
+    srv.submit([1, 2, 3], max_new_tokens=6)
+    srv.drain()
+    assert events == [("start", str(tmp_path)), ("stop",)]
+
+
+# ---------------------------------------------------------------------------
+# one-shot engine wiring
+# ---------------------------------------------------------------------------
+
+def test_generate_records_latency_and_trace_cache():
+    import jax
+
+    from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
+                                         InferenceEngine)
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, init_params)
+    cfg = InferenceTransformerConfig(vocab_size=128, n_positions=256,
+                                     n_embd=32, n_layer=2, n_head=4,
+                                     dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=256))
+    reg = MetricRegistry()
+    eng.telemetry = reg
+    eng.generate([[1, 2, 3]], max_new_tokens=4)
+    assert reg.histogram("inference_generate_seconds").count == 1
+    assert reg.counter("inference_generate_calls_total").value == 1
+    misses = reg.counter("inference_trace_cache_misses_total").value
+    assert misses >= 1                       # first call traced the loop
+    eng.generate([[4, 5, 6]], max_new_tokens=4)   # same shapes → cache hit
+    assert reg.counter("inference_trace_cache_hits_total").value >= 1
+    assert reg.counter("inference_trace_cache_misses_total").value == misses
+    assert reg.histogram("inference_generate_seconds").count == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite fixes: timer reset, monitor close, registry sink
+# ---------------------------------------------------------------------------
+
+def test_timer_stop_honors_reset(monkeypatch):
+    import deepspeed_tpu.utils.timer as T
+    clock = iter([10.0, 13.0, 20.0, 21.0, 30.0, 35.0])
+    monkeypatch.setattr(T, "_sync", lambda: None)
+    monkeypatch.setattr(T.time, "time", lambda: next(clock))
+    t = T._Timer("x")
+    t.start()
+    t.stop()                       # +3s, count 1
+    t.start()
+    t.stop(reset=True)             # overwrite: 1s, count 1
+    assert t.elapsed_ == pytest.approx(1.0)
+    assert t.count == 1
+    t.start()
+    t.stop()                       # accumulate again: 1 + 5
+    assert t.elapsed_ == pytest.approx(6.0)
+    assert t.count == 2
+
+
+def test_csv_monitor_closes_files(tmp_path):
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.monitor.monitor import CsvMonitor
+    mon = CsvMonitor(SimpleNamespace(enabled=True,
+                                     output_path=str(tmp_path),
+                                     job_name="job"))
+    if not mon.enabled:
+        pytest.skip("not process 0")
+    mon.write_events([("Train/loss", 1.0, 1), ("Train/lr", 0.1, 1)])
+    handles = [f for f, _ in mon._files.values()]
+    assert len(handles) == 2 and not any(f.closed for f in handles)
+    mon.close()
+    assert all(f.closed for f in handles)
+    assert mon._files == {}
+    mon.write_events([("Train/loss", 2.0, 2)])     # reopen after close
+    mon.close()
+    rows = open(tmp_path / "job" / "Train_loss.csv").read().splitlines()
+    assert rows == ["1,1.0", "2,2.0"]
+
+
+def test_monitor_master_context_manager(tmp_path):
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.config.config import (CSVConfig, TensorBoardConfig,
+                                             WandbConfig)
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    cfg = SimpleNamespace(
+        tensorboard=TensorBoardConfig(),
+        wandb=WandbConfig(),
+        csv_monitor=CSVConfig(enabled=True, output_path=str(tmp_path),
+                              job_name="j"))
+    with MonitorMaster(cfg) as m:
+        if m.csv_monitor.enabled:
+            m.write_events([("a", 1.0, 1)])
+            handles = [f for f, _ in m.csv_monitor._files.values()]
+    assert all(f.closed for f in handles)
+
+
+def test_registry_monitor_sink():
+    """Monitor events fan out into the registry as gauges — the training
+    engine's step metrics become scrapeable without any backend."""
+    from deepspeed_tpu.monitor.monitor import RegistryMonitor
+    reg = MetricRegistry()
+    sink = RegistryMonitor(reg)
+    assert sink.enabled
+    sink.write_events([("Train/Samples/train_loss", 2.5, 128),
+                       ("Train/Samples/lr", 0.01, 128)])
+    assert reg.gauge("train_samples_train_loss").value == 2.5
+    assert reg.gauge("train_samples_lr").value == 0.01
+    assert reg.gauge("train_samples").value == 128
+    sink.close()                                   # no-op, but present
