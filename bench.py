@@ -823,6 +823,23 @@ def phase_serve(args) -> dict:
             snap["serve_requests_finished_total"]["series"][0]["value"],
         "ttft_count": snap["serve_ttft_seconds"]["series"][0]["count"],
     }
+    # flight recorder (docs/observability.md): the replay's compile
+    # story — how many executables the trace cost, how long the
+    # compiles took, and whether any retrace happened mid-replay (a
+    # nonzero retrace count under the bucketed trace is a regression)
+    out["flight_recorder"] = {
+        "prefill_traces": srv.stats["prefill_traces"],
+        "decode_traces": srv.stats["decode_traces"],
+        "retraces": srv.stats["retraces"],
+        "compile_seconds_total": round(sum(
+            rec.compile_seconds
+            for fn in (srv._prefill_jit, srv._decode_jit)
+            for rec in getattr(fn, "executables", ())), 3),
+        "prefill_hbm_bytes": max(
+            [rec.cost.get("hbm_bytes", 0.0)
+             for rec in getattr(srv._prefill_jit, "executables", ())]
+            or [0.0]),
+    }
     print(json.dumps({**out, "partial": True}), flush=True)  # salvage
 
     # one-shot comparator on the SAME trace: batches of num_slots in

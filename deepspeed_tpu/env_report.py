@@ -62,6 +62,48 @@ def device_info():
                 "devices": []}
 
 
+def telemetry_info():
+    """Telemetry/flight-recorder status: registry + event ring state,
+    which config-gated surfaces the defaults arm, and per-device HBM
+    totals when the backend reports them (docs/observability.md)."""
+    out = {}
+    try:
+        from deepspeed_tpu.telemetry import (TelemetryConfig,
+                                             get_event_ring, get_registry)
+        cfg = TelemetryConfig()
+        reg = get_registry()
+        ring = get_event_ring()
+        out["telemetry"] = ("on (registry default; "
+                            f"{len(reg.snapshot())} metric families)"
+                            if cfg.enabled else "off")
+        out["scrape_endpoint"] = (
+            f"port {cfg.http_port}" if cfg.http_port is not None
+            else "off (set telemetry.http_port)")
+        out["event_ring"] = f"{len(ring)}/{ring.capacity} events"
+        out["hang_watchdog"] = (
+            f"{cfg.watchdog_deadline_s}s deadline"
+            if cfg.watchdog_deadline_s is not None
+            else "off (set telemetry.watchdog_deadline_s)")
+    except Exception as e:  # pragma: no cover - env specific
+        out["telemetry"] = f"unavailable: {e}"
+        return out
+    try:
+        import jax
+        hbm = []
+        for d in jax.local_devices():
+            stats = dict(d.memory_stats() or {})
+            limit = int(stats.get("bytes_limit", 0))
+            used = int(stats.get("bytes_in_use", 0))
+            if limit:
+                hbm.append(f"{d.id}: {used / 2**30:.2f}/"
+                           f"{limit / 2**30:.2f} GiB")
+        out["device_hbm"] = "; ".join(hbm) if hbm \
+            else "no allocator stats (CPU backend?)"
+    except Exception:  # pragma: no cover - env specific
+        out["device_hbm"] = "unavailable"
+    return out
+
+
 def main(hide_operator_status=False, hide_errors_and_warnings=False):
     print("-" * 64)
     print("DeepSpeed-TPU C++/Pallas op report")
@@ -80,6 +122,10 @@ def main(hide_operator_status=False, hide_errors_and_warnings=False):
     for k, v in versions().items():
         print(f"{k:<24}{v}")
     for k, v in device_info().items():
+        print(f"{k:<24}{v}")
+    print("-" * 64)
+    print("DeepSpeed-TPU telemetry / flight recorder:")
+    for k, v in telemetry_info().items():
         print(f"{k:<24}{v}")
     print("-" * 64)
     return 0

@@ -32,7 +32,8 @@ from deepspeed_tpu.model_implementations.transformer import (
     InferenceTransformerConfig, causal_forward, decode_chunk, decode_step,
     encoder_forward,
     init_params, prefill, tp_param_specs)
-from deepspeed_tpu.telemetry import MetricRegistry, get_registry
+from deepspeed_tpu.telemetry import (MetricRegistry, get_registry,
+                                     watched_jit)
 
 
 def _greedy_accept(t_toks, props, K):
@@ -204,39 +205,54 @@ class InferenceEngine:
                     f"{self.model_config.n_head} and kv_heads="
                     f"{self.model_config.kv_heads}")
         self.params = self._place_params(params)
-        self._prefill_jit = jax.jit(
-            functools.partial(prefill, cfg=self.model_config,
-                              mesh=self.mesh),
-            donate_argnames=("cache",))
-        self._decode_jit = jax.jit(
-            functools.partial(decode_step, cfg=self.model_config,
-                              mesh=self.mesh),
-            donate_argnames=("cache",))
-        self._encoder_jit = jax.jit(
-            functools.partial(encoder_forward, cfg=self.model_config,
-                              mesh=self.mesh))
-        self._causal_fwd_jit = jax.jit(
-            functools.partial(causal_forward, cfg=self.model_config,
-                              mesh=self.mesh))
-        self._gen_loops: Dict[Any, Any] = {}
         # process-wide registry (docs/observability.md); tests swap in a
         # private MetricRegistry via this attribute. telemetry.enabled=
         # false records into a private registry instead — same cost,
-        # nothing reaches the process scrape surface
+        # nothing reaches the process scrape surface. (Resolved BEFORE
+        # the jit wrappers below: the compile watch records retraces and
+        # compile times into the same registry.)
         tcfg = getattr(self.config, "telemetry", None)
         self.telemetry = (get_registry() if tcfg is None or tcfg.enabled
                           else MetricRegistry())
+        # flight recorder (telemetry/compile_watch.py): every entry
+        # point is watched, so an unexpected prompt shape shows up as a
+        # `retrace` event naming the argument that changed, with the
+        # compile wall time and the executable's flops/HBM footprint
+        self._prefill_jit = watched_jit(
+            functools.partial(prefill, cfg=self.model_config,
+                              mesh=self.mesh),
+            name="infer_prefill", registry=self.telemetry,
+            donate_argnames=("cache",))
+        self._decode_jit = watched_jit(
+            functools.partial(decode_step, cfg=self.model_config,
+                              mesh=self.mesh),
+            name="infer_decode", registry=self.telemetry,
+            donate_argnames=("cache",))
+        self._encoder_jit = watched_jit(
+            functools.partial(encoder_forward, cfg=self.model_config,
+                              mesh=self.mesh),
+            name="infer_encoder_forward", registry=self.telemetry)
+        self._causal_fwd_jit = watched_jit(
+            functools.partial(causal_forward, cfg=self.model_config,
+                              mesh=self.mesh),
+            name="infer_causal_forward", registry=self.telemetry)
+        self._gen_loops: Dict[Any, Any] = {}
 
     def _loop_cache_get(self, key):
         """Decode-loop cache lookup with hit/miss telemetry: a rising
         miss count under steady traffic means request shapes are
         defeating the geometric buckets (the retrace regression)."""
         hit = self._gen_loops.get(key)
-        self.telemetry.counter(
-            "inference_trace_cache_hits_total" if hit is not None
-            else "inference_trace_cache_misses_total",
-            help="decode-loop cache lookups (see docs/observability.md)"
-        ).inc()
+        if hit is not None:
+            self.telemetry.counter(
+                "inference_trace_cache_hits_total",
+                help="decode-loop cache lookups (see "
+                     "docs/observability.md)").inc()
+        else:
+            self.telemetry.counter(
+                "inference_trace_cache_misses_total",
+                help="decode-loop cache lookups (see "
+                     "docs/observability.md)").inc()
         return hit
 
     def _record_generate(self, dt: float) -> None:
@@ -752,7 +768,9 @@ class InferenceEngine:
             # final cache returned (and dropped) so donation can alias
             return carry[5], carry[4], carry[6], carry[1]
 
-        loop = jax.jit(run, donate_argnames=("cache_t",))
+        loop = watched_jit(run, name="infer_lookup_loop",
+                           registry=self.telemetry,
+                           donate_argnames=("cache_t",))
         self._gen_loops[key] = loop
         return loop
 
@@ -880,7 +898,9 @@ class InferenceEngine:
             # as _generate_loop
             return carry[5], carry[4], carry[6], carry[1], carry[2]
 
-        loop = jax.jit(run, donate_argnames=("cache_t", "cache_d"))
+        loop = watched_jit(run, name="infer_speculative_loop",
+                           registry=self.telemetry,
+                           donate_argnames=("cache_t", "cache_d"))
         # one draft at a time: entries for other draft ids are evicted so
         # a rotated-out draft (and its weights) can be garbage-collected
         # instead of pinning device memory for the target's lifetime
@@ -965,7 +985,9 @@ class InferenceEngine:
             n_sel = jnp.take_along_axis(n_gen, best[:, None], axis=1)[:, 0]
             return sel, n_sel, cache
 
-        loop = jax.jit(run, donate_argnames=("cache",))
+        loop = watched_jit(run, name="infer_beam_loop",
+                           registry=self.telemetry,
+                           donate_argnames=("cache",))
         self._gen_loops[key] = loop
         return loop
 
@@ -1062,7 +1084,9 @@ class InferenceEngine:
             # the donated input cache can actually alias an output
             return carry[4], carry[5], carry[2]
 
-        loop = jax.jit(run, donate_argnames=("cache",))
+        loop = watched_jit(run, name="infer_generate_loop",
+                           registry=self.telemetry,
+                           donate_argnames=("cache",))
         self._gen_loops[key] = loop
         return loop
 

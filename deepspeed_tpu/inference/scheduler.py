@@ -96,6 +96,9 @@ class Scheduler:
             "serve_admission_rejections_total",
             help="refused submit() calls, by reason",
             labels={"reason": reason}).inc()
+        from deepspeed_tpu.telemetry.events import (ADMISSION_REJECT,
+                                                    record_event)
+        record_event(ADMISSION_REJECT, reason=reason, source="scheduler")
 
     # ------------------------------------------------------------ submit
 

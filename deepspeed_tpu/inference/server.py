@@ -35,7 +35,9 @@ from deepspeed_tpu.inference.scheduler import Request, Scheduler
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill)
 from deepspeed_tpu.telemetry import (MetricRegistry, ProfilerCapture,
-                                     get_registry, start_http_server)
+                                     get_event_ring, get_registry,
+                                     start_http_server, watched_jit)
+from deepspeed_tpu.telemetry import events as telemetry_events
 
 
 def _safe_cache_size(fn) -> int:
@@ -133,21 +135,60 @@ class ContinuousBatchingServer:
             max_queued_requests=cfg.max_queued_requests,
             registry=self.telemetry)
         self._cache = self._make_pool(num_blocks)
-        self._prefill_jit = jax.jit(
+        # flight recorder (telemetry/compile_watch.py): the serving jits
+        # are watched, so a prompt shape that defeats the geometric
+        # buckets shows up as a `retrace` event naming the argument that
+        # changed — with compile wall time and executable HBM footprint
+        self._prefill_jit = watched_jit(
             functools.partial(self._prefill_fn, cfg=mcfg,
                               mesh=engine.mesh),
+            name="serve_prefill", registry=self.telemetry,
             static_argnames=(), donate_argnames=("cache",))
-        self._decode_jit = jax.jit(
+        self._decode_jit = watched_jit(
             functools.partial(self._decode_fn, cfg=mcfg,
                               mesh=engine.mesh),
+            name="serve_decode", registry=self.telemetry,
             donate_argnames=("cache",))
         self._results: Dict[int, List[int]] = {}
         self._next_id = 0
         self._step_clock = 0           # decode steps executed
         self._active_slot_steps = 0    # sum of live slots per decode step
         self._prefills = 0
+        self._init_flight_recorder(tcfg)
 
     # ------------------------------------------------------------ setup
+
+    # decode-step ring events are SAMPLED (every Nth step + the first):
+    # a TPU decode loop runs thousands of steps per second, and per-step
+    # events would flush the compile/admission forensics out of the
+    # bounded ring in seconds
+    _EVENT_EVERY = 64
+
+    def _init_flight_recorder(self, tcfg) -> None:
+        """Arm the config-gated flight-recorder surfaces (see
+        docs/observability.md "Flight recorder") via the shared
+        telemetry helper. Components use a weak self-reference so a
+        dropped (but not close()d) server never leaks its arrays
+        through the process-wide monitor."""
+        import weakref
+
+        from deepspeed_tpu.telemetry.flight import arm_flight_recorder
+        ref = weakref.ref(self)
+
+        def _pool():
+            srv = ref()
+            return None if srv is None else (srv._cache.k, srv._cache.v)
+
+        def _params():
+            srv = ref()
+            return None if srv is None else srv.engine.params
+
+        # the pool and the weights are the serving process's two big
+        # HBM residents
+        self._flight = arm_flight_recorder(
+            tcfg, self.telemetry, "serve_watchdog",
+            [("kv_block_pool", _pool), ("params", _params)])
+        self.watchdog = self._flight.watchdog
 
     @staticmethod
     def _prefill_fn(params, ids, length, cache, slot, *, cfg, mesh):
@@ -222,6 +263,8 @@ class ContinuousBatchingServer:
             "serve_admission_rejections_total",
             help="refused submit() calls, by reason",
             labels={"reason": reason}).inc()
+        get_event_ring().record(telemetry_events.ADMISSION_REJECT,
+                                reason=reason, source="server")
 
     def _admit(self, finished: list) -> None:
         """Prefill queued requests into free slots until blocks or slots
@@ -271,6 +314,10 @@ class ContinuousBatchingServer:
                 now - self._submit_ts.get(req.request_id, now))
             self._c_prefills.inc()
             self._c_tokens.inc()
+            if self.watchdog is not None:
+                # a prefill IS progress — a long admission burst must
+                # not read as a decode stall
+                self.watchdog.notify_progress()
             state.generated.append(tok0)
             state.pending = tok0
             if self._finished(state, tok0):
@@ -308,6 +355,11 @@ class ContinuousBatchingServer:
         finished: List[int] = []
         self._admit(finished)
         if not self.scheduler.slots:
+            if self.watchdog is not None:
+                # an IDLE server being polled is alive, not stalled —
+                # without this heartbeat every traffic lull longer than
+                # the deadline fires a spurious dump
+                self.watchdog.notify_progress()
             return finished
         tokens = np.zeros((self.num_slots,), np.int32)
         active = np.zeros((self.num_slots,), bool)
@@ -332,6 +384,14 @@ class ContinuousBatchingServer:
         self._c_decode_steps.inc()
         self._c_tokens.inc(n_active)
         self._g_occupancy.set(n_active / self.num_slots)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()
+        if self._step_clock % self._EVENT_EVERY == 1:
+            get_event_ring().record(
+                telemetry_events.STEP_END, source="serve_decode",
+                step=self._step_clock, live=n_active,
+                seconds=round(dt, 6),
+                sampled_every=self._EVENT_EVERY)
         for slot in list(self.scheduler.slots):   # _retire mutates
             state = self.scheduler.slots[slot]
             tok = int(nxt[slot])
@@ -362,10 +422,13 @@ class ContinuousBatchingServer:
         self.profiler_capture.arm(num_steps, logdir)
 
     def close(self) -> None:
-        """Release the scrape endpoint (if config opened one)."""
+        """Release the scrape endpoint, the watchdog thread, and the
+        memory-monitor registrations (if config armed them)."""
         if self.http_server is not None:
             self.http_server.close()
             self.http_server = None
+        self._flight.close()
+        self.watchdog = None
 
     # ------------------------------------------------------------ stats
 
@@ -385,6 +448,10 @@ class ContinuousBatchingServer:
             "slot_occupancy": (self._active_slot_steps / units
                                if units else 0.0),
             "decode_traces": _safe_cache_size(self._decode_jit),
+            "prefill_traces": _safe_cache_size(self._prefill_jit),
+            "retraces": (
+                len(getattr(self._decode_jit, "retraces", ()))
+                + len(getattr(self._prefill_jit, "retraces", ()))),
             "num_slots": self.num_slots,
             "block_size": self.block_size,
             "free_blocks": self.scheduler.allocator.free_blocks,

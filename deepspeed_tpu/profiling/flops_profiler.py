@@ -243,11 +243,11 @@ def get_model_profile(fn: Callable, args: Tuple = (), kwargs: Dict = None,
         compiled = traced.lower().compile()
     except AttributeError:
         compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-    c = compiled.cost_analysis() or {}
-    if isinstance(c, (list, tuple)):   # older jax returns [dict]
-        c = c[0] if c else {}
-    cost = {"flops": float(c.get("flops", 0.0)),
-            "bytes_accessed": float(c.get("bytes accessed", 0.0))}
+    # one executable-stats plumbing for the whole codebase
+    # (telemetry/compile_watch.py) — the profiler and the compile watch
+    # can never report different numbers for the same executable
+    from deepspeed_tpu.telemetry.compile_watch import executable_cost
+    cost = executable_cost(compiled)
     breakdown = None
     if per_module_depth is not None:
         # never let attribution break the aggregate profile (a custom
@@ -274,6 +274,7 @@ def get_model_profile(fn: Callable, args: Tuple = (), kwargs: Dict = None,
     prof = {
         "flops": cost["flops"],
         "bytes_accessed": cost["bytes_accessed"],
+        "hbm_bytes": cost.get("hbm_bytes", 0.0),
         "params": _params_count(params if params is not None else args),
         "latency_s": latency,
         "flops_per_s": cost["flops"] / latency if latency > 0 else 0.0,
@@ -285,6 +286,7 @@ def get_model_profile(fn: Callable, args: Tuple = (), kwargs: Dict = None,
         prof = {
             "flops": number_to_string(prof["flops"]) + "FLOPs",
             "bytes_accessed": number_to_string(prof["bytes_accessed"]) + "B",
+            "hbm_bytes": number_to_string(prof["hbm_bytes"]) + "B",
             "params": number_to_string(prof["params"]),
             "latency_s": f"{latency * 1e3:.2f} ms",
             "flops_per_s": number_to_string(prof["flops_per_s"]) + "FLOPS",

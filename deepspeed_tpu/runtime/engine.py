@@ -371,6 +371,7 @@ class DeepSpeedEngine:
                     registry=self.telemetry)
             except OSError as e:   # port taken must not kill training
                 logger.warning(f"telemetry endpoint unavailable: {e}")
+        self._init_flight_recorder(tcfg)   # helper honors tcfg.enabled
         self.curriculum_scheduler = None
         if config.curriculum_learning.get("enabled", False):
             from deepspeed_tpu.runtime.data_pipeline import (
@@ -993,17 +994,48 @@ class DeepSpeedEngine:
             tuple(x.shape) for x in jax.tree.leaves(batch))
         return self._wrap_explicit_dp(local_step, batch)
 
+    def _init_flight_recorder(self, tcfg) -> None:
+        """Config-gated flight-recorder surfaces (docs/observability.md
+        "Flight recorder") via the shared telemetry helper; the
+        training HBM residents are params and optimizer state (fp32
+        master included). Weak self-reference so a dropped engine never
+        pins its arrays through the process-wide monitor."""
+        import weakref
+
+        from deepspeed_tpu.telemetry.flight import arm_flight_recorder
+        ref = weakref.ref(self)
+
+        def _params():
+            eng = ref()
+            return None if eng is None else eng.state.params
+
+        def _opt_state():
+            eng = ref()
+            if eng is None:
+                return None
+            # fp32 master weights are optimizer-owned memory too
+            return (eng.state.opt_state,
+                    getattr(eng.state, "master", None))
+
+        self._flight = arm_flight_recorder(
+            tcfg, self.telemetry, "train_watchdog",
+            [("params", _params), ("optimizer_state", _opt_state)])
+        self.watchdog = self._flight.watchdog
+
     def _compile_step(self, batch):
+        from deepspeed_tpu.telemetry import watched_jit
         if self._onebit_axes:
             self._eager_param_staging = False
-            self._step_fn = jax.jit(
+            self._step_fn = watched_jit(
                 self._make_compressed_step_fn(batch),
+                name="train_step", registry=self.telemetry,
                 donate_argnums=(0,))
             return
         if self._sparse_grad_axes:
             self._eager_param_staging = False
-            self._step_fn = jax.jit(
+            self._step_fn = watched_jit(
                 self._make_sparse_step_fn(batch),
+                name="train_step", registry=self.telemetry,
                 donate_argnums=(0,))
             return
         batch_sh = self._batch_sharding(batch)
@@ -1018,8 +1050,9 @@ class DeepSpeedEngine:
             in_sh = in_sh.replace(params=self._device_param_shardings)
             out_sh = out_sh.replace(params=self._device_param_shardings)
             self._eager_param_staging = True
-        self._step_fn = jax.jit(
+        self._step_fn = watched_jit(
             self._make_step_fn(),
+            name="train_step", registry=self.telemetry,
             in_shardings=(in_sh, batch_sh, None),
             out_shardings=(out_sh, None),
             donate_argnums=(0,))
@@ -1106,6 +1139,7 @@ class DeepSpeedEngine:
         self._micro_steps += self.gas
         self.tput_timer.stop(global_step=self.global_steps,
                              report_speed=True)
+        self._record_step_progress()
         out = {"loss": metrics["loss"], "grad_norm": metrics["grad_norm"],
                "lr": lr, "loss_scale": scale, "skipped": skipped}
         # user aux scalars computed by grad_fn ride through here too
@@ -1179,7 +1213,10 @@ class DeepSpeedEngine:
                     "flops_profiler.profile_step coincides with the first "
                     "(compiling) step; pre-compiling so reported latency "
                     "excludes compilation")
-                self._step_fn.lower(self.state, batch, rng).compile()
+                # warm() lands the executable in the compile watch's
+                # cache, so the dispatch below reuses it (one compile
+                # total) and cost analysis later is free
+                self._step_fn.warm(self.state, batch, rng)
             self.flops_profiler.start_profile()
         t_step = (time.perf_counter()
                   if self.config.wall_clock_breakdown else None)
@@ -1215,10 +1252,11 @@ class DeepSpeedEngine:
             jax.block_until_ready(metrics["loss"])
             float(metrics["loss"])   # host sync through remote relays
             self.flops_profiler.mark_step_done()  # latency frozen here
-            cost = self._step_fn.lower(
-                self.state, batch, rng).compile().cost_analysis() or {}
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
+            # the compile watch already holds this signature's
+            # executable (the step that just ran) — its normalized
+            # cost comes back without a second compile, and is BY
+            # CONSTRUCTION the same number compile_report() shows
+            cost = self._step_fn.cost(self.state, batch, rng)
             n_params = sum(int(np.prod(p.shape))
                            for p in jax.tree.leaves(self.state.params))
             breakdown = None
@@ -1247,9 +1285,20 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
         self.tput_timer.stop(global_step=self.global_steps,
                              report_speed=True)
+        self._record_step_progress()
         if self.global_steps % self.config.steps_per_print == 0:
             self._write_monitor_events(metrics)
         return metrics
+
+    def _record_step_progress(self) -> None:
+        """Flight-recorder step event + watchdog heartbeat — one host
+        append per optimizer step (training steps run at seconds
+        cadence, so unlike serving decode this is not sampled)."""
+        from deepspeed_tpu.telemetry import events as _ev
+        _ev.record_event(_ev.STEP_END, source="train",
+                         step=self.global_steps)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()
 
     # ------------------------------------------------------------------
     # MoQ (runtime/quantize.py; reference _take_model_step engine.py:2078)
@@ -1724,7 +1773,8 @@ class DeepSpeedEngine:
 
     def destroy(self) -> None:
         """Release compiled executables, pending state, monitor file
-        handles, and the telemetry endpoint (engine.destroy)."""
+        handles, the telemetry endpoint, and the flight-recorder
+        watchdog/memory registrations (engine.destroy)."""
         self._step_fn = None
         self._grad_fn = None
         self._apply_fn = None
@@ -1735,6 +1785,9 @@ class DeepSpeedEngine:
         if self._telemetry_http is not None:
             self._telemetry_http.close()
             self._telemetry_http = None
+        if getattr(self, "_flight", None) is not None:
+            self._flight.close()
+            self.watchdog = None
 
     def fp32_master_params(self):
         """Consolidated fp32 weights (analog of
@@ -1791,8 +1844,12 @@ class DeepSpeedEngine:
                 server_error=jax.tree.map(jnp.zeros_like,
                                           opt.server_error)))
         try:
-            return save_checkpoint(self, save_dir, tag=tag,
-                                   client_state=client_state or {})
+            out = save_checkpoint(self, save_dir, tag=tag,
+                                  client_state=client_state or {})
+            from deepspeed_tpu.telemetry import events as _ev
+            _ev.record_event(_ev.CHECKPOINT, dir=str(save_dir),
+                             tag=str(tag), step=self.global_steps)
+            return out
         finally:
             if prev_state is not None:
                 self.state = prev_state

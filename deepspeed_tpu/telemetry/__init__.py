@@ -17,9 +17,20 @@ module imports jax at import time, so the registry is usable from config
 parsing and test collection alike.
 """
 from deepspeed_tpu.telemetry.capture import ProfilerCapture
+from deepspeed_tpu.telemetry.compile_watch import (WatchedFunction,
+                                                   all_watched,
+                                                   compile_report,
+                                                   executable_cost,
+                                                   watched_jit)
 from deepspeed_tpu.telemetry.config import TelemetryConfig
+from deepspeed_tpu.telemetry.events import (EventRing, get_event_ring,
+                                            install_fault_dump,
+                                            record_event, set_event_ring)
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
                                               start_http_server)
+from deepspeed_tpu.telemetry.memory import (MemoryMonitor,
+                                            get_memory_monitor,
+                                            set_memory_monitor)
 from deepspeed_tpu.telemetry.registry import (DEFAULT_TIME_BUCKETS, Counter,
                                               Gauge, Histogram,
                                               MetricRegistry,
@@ -28,6 +39,7 @@ from deepspeed_tpu.telemetry.registry import (DEFAULT_TIME_BUCKETS, Counter,
                                               sanitize_metric_name,
                                               set_registry)
 from deepspeed_tpu.telemetry.spans import span, timed
+from deepspeed_tpu.telemetry.watchdog import Watchdog
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry",
@@ -35,4 +47,10 @@ __all__ = [
     "set_registry", "sanitize_metric_name", "span", "timed",
     "TelemetryHTTPServer", "start_http_server", "ProfilerCapture",
     "TelemetryConfig",
+    # flight recorder (events ring / compile watch / memory / watchdog)
+    "EventRing", "get_event_ring", "set_event_ring", "record_event",
+    "install_fault_dump", "WatchedFunction", "watched_jit",
+    "compile_report", "all_watched", "executable_cost",
+    "MemoryMonitor", "get_memory_monitor", "set_memory_monitor",
+    "Watchdog",
 ]

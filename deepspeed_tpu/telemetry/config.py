@@ -13,15 +13,48 @@ from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
 class TelemetryConfig(DeepSpeedConfigModel):
     """Registry recording is on by default (dict-lookup + float-add cost);
     the HTTP scrape endpoint is OFF by default and opens only when a port
-    is configured — a serving process must opt into listening."""
+    is configured — a serving process must opt into listening. The
+    flight-recorder surfaces (docs/observability.md "Flight recorder")
+    follow the same rule: the event ring and compile watch always record
+    (bounded memory), while the hang watchdog, periodic memory sampler,
+    and fault-dump file each arm only when their key is set."""
     enabled: bool = True
     # scrape endpoint: None = no listener; 0 = ephemeral port (tests)
     http_port: Optional[int] = None
     http_host: str = "127.0.0.1"
+    # flight-recorder event ring size (telemetry/events.py); the process
+    # ring is resized only when this is explicitly set
+    events_capacity: int = 512
+    # fault forensics: ring JSON written here on unhandled exception /
+    # exit (+ ``.stacks`` via faulthandler); None = no fault hooks
+    events_dump_path: Optional[str] = None
+    # hang watchdog (telemetry/watchdog.py): fire a ring+thread-stack
+    # dump after this many seconds without step/decode progress;
+    # None = watchdog off
+    watchdog_deadline_s: Optional[float] = None
+    # periodic jax.live_arrays() accounting (telemetry/memory.py):
+    # snapshot cadence in seconds; None = on-demand only (/debug/memory)
+    memory_interval_s: Optional[float] = None
 
     @field_validator("http_port")
     @classmethod
     def _valid_port(cls, v):
         if v is not None and not 0 <= v <= 65535:
             raise ValueError(f"http_port must be in [0, 65535], got {v}")
+        return v
+
+    @field_validator("events_capacity")
+    @classmethod
+    def _valid_capacity(cls, v):
+        if v < 1:
+            raise ValueError(f"events_capacity must be >= 1, got {v}")
+        return v
+
+    @field_validator("watchdog_deadline_s", "memory_interval_s")
+    @classmethod
+    def _valid_interval(cls, v, info):
+        if v is not None and v <= 0:
+            raise ValueError(
+                f"{info.field_name} must be > 0 seconds (or null to "
+                f"disable), got {v}")
         return v

@@ -9,6 +9,10 @@ ThreadingHTTPServer IS the integration.
 Routes:
   ``/metrics``       Prometheus text exposition (content-type 0.0.4)
   ``/metrics.json``  JSON snapshot (registry.snapshot) — same instruments
+  ``/debug/events``  flight-recorder event ring (telemetry/events.py)
+  ``/debug/memory``  live-array accounting by component
+                     (telemetry/memory.py; snapshots on request)
+  ``/debug/compile`` compile_report() text (telemetry/compile_watch.py)
 """
 from __future__ import annotations
 
@@ -27,7 +31,8 @@ class TelemetryHTTPServer:
     exit) releases the port."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: Optional[MetricRegistry] = None):
+                 registry: Optional[MetricRegistry] = None,
+                 event_ring=None, memory=None):
         reg = registry or get_registry()
 
         class _Handler(BaseHTTPRequestHandler):
@@ -39,9 +44,32 @@ class TelemetryHTTPServer:
                 elif path in ("/metrics.json", "/snapshot"):
                     body = json.dumps(reg.snapshot()).encode()
                     ctype = "application/json"
+                elif path == "/debug/events":
+                    # resolve the ring per request so set_event_ring
+                    # (tests) and config resizes are always visible
+                    from deepspeed_tpu.telemetry.events import \
+                        get_event_ring
+                    # None check, not `or`: an empty ring is falsy
+                    ring = (event_ring if event_ring is not None
+                            else get_event_ring())
+                    body = ring.to_json().encode()
+                    ctype = "application/json"
+                elif path == "/debug/memory":
+                    from deepspeed_tpu.telemetry.memory import \
+                        get_memory_monitor
+                    mon = memory or get_memory_monitor()
+                    body = json.dumps(mon.snapshot(registry=reg),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/debug/compile":
+                    from deepspeed_tpu.telemetry.compile_watch import \
+                        compile_report
+                    body = compile_report().encode()
+                    ctype = "text/plain; charset=utf-8"
                 else:
-                    self.send_error(404, "unknown path "
-                                    "(try /metrics or /metrics.json)")
+                    self.send_error(404, "unknown path (try /metrics, "
+                                    "/metrics.json, /debug/events, "
+                                    "/debug/memory, /debug/compile)")
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -78,7 +106,9 @@ class TelemetryHTTPServer:
 
 
 def start_http_server(port: int, host: str = "127.0.0.1",
-                      registry: Optional[MetricRegistry] = None
+                      registry: Optional[MetricRegistry] = None,
+                      event_ring=None, memory=None
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
-    return TelemetryHTTPServer(port=port, host=host, registry=registry)
+    return TelemetryHTTPServer(port=port, host=host, registry=registry,
+                               event_ring=event_ring, memory=memory)

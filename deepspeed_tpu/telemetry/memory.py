@@ -1,0 +1,214 @@
+"""Live HBM accounting, bucketed by component.
+
+The paged KV pool, the params, and the optimizer state compete for one
+fixed HBM budget; when the budget runs out the only question that
+matters is "who is holding it". ``jax.live_arrays()`` already knows
+every live buffer — this module buckets those buffers by registered
+component (the engines register their big trees: KV block pool, params,
+optimizer state) and publishes the totals as gauges plus a JSON view on
+the scrape endpoint (``/debug/memory``).
+
+Attribution is by ARRAY IDENTITY: a component registers a getter that
+returns its current pytree; at snapshot time the getter's leaves are
+matched against ``live_arrays()`` by ``id()``. Identity (not name)
+means a donated/replaced buffer automatically re-attributes on the next
+snapshot, and anything nobody claims lands in ``other`` — the bucket
+that grows when something leaks.
+
+Snapshots walk every live buffer (O(live arrays), host-only) — cheap at
+human cadence, not a per-decode-step operation. They run on demand from
+the ``/debug/memory`` route, or periodically from a daemon thread when
+``telemetry.memory_interval_s`` is configured.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
+
+
+class MemoryMonitor:
+    """Component registry + snapshot engine (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._components: Dict[str, Callable[[], object]] = {}
+        self._sampler: Optional[threading.Thread] = None
+        self._sampler_stop: Optional[threading.Event] = None
+
+    # -------------------------------------------------------- components
+
+    def register_component(self, name: str,
+                           getter: Callable[[], object]) -> None:
+        """Register (or replace) a named component. ``getter`` returns
+        the component's CURRENT pytree at snapshot time — pass a lambda
+        reading the live attribute, not a snapshot of today's arrays."""
+        with self._lock:
+            self._components[name] = getter
+
+    def unregister_component(self, name: str,
+                             getter: Optional[Callable] = None) -> None:
+        """Remove a component. Pass the ``getter`` you registered to
+        make the removal owner-safe: if another engine has since
+        re-registered the same name (two engines in one process both
+        claim ``params``), their registration is left alone."""
+        with self._lock:
+            if getter is not None and \
+                    self._components.get(name) is not getter:
+                return
+            self._components.pop(name, None)
+
+    @property
+    def components(self) -> List[str]:
+        with self._lock:
+            return sorted(self._components)
+
+    # ----------------------------------------------------------- snapshot
+
+    def snapshot(self, registry: Optional[MetricRegistry] = None) -> dict:
+        """Bucket every live jax array by component; update gauges in
+        ``registry`` (default: the process registry); return the JSON
+        view. Never raises — a backend without ``live_arrays`` degrades
+        to the device-stats section only."""
+        import jax
+        reg = registry or get_registry()
+        with self._lock:
+            getters = dict(self._components)
+        # leaf id -> component (first registration wins on overlap;
+        # overlap means two components share a buffer — counted once)
+        owner: Dict[int, str] = {}
+        for name, getter in getters.items():
+            try:
+                leaves = jax.tree_util.tree_leaves(getter())
+            except Exception:  # noqa: BLE001 — a dead getter ≠ no snapshot
+                continue
+            for leaf in leaves:
+                if hasattr(leaf, "nbytes"):
+                    owner.setdefault(id(leaf), name)
+        buckets: Dict[str, dict] = {
+            name: {"bytes": 0, "arrays": 0} for name in getters}
+        buckets["other"] = {"bytes": 0, "arrays": 0}
+        total_bytes, total_arrays = 0, 0
+        try:
+            live = jax.live_arrays()
+        except Exception:  # noqa: BLE001 — backend drift degrades
+            live = []
+        for arr in live:
+            try:
+                if getattr(arr, "is_deleted", lambda: False)():
+                    continue
+                nbytes = int(arr.nbytes)
+            except Exception:  # noqa: BLE001
+                continue
+            b = buckets[owner.get(id(arr), "other")]
+            b["bytes"] += nbytes
+            b["arrays"] += 1
+            total_bytes += nbytes
+            total_arrays += 1
+        for name, b in buckets.items():
+            reg.gauge(
+                "memory_component_bytes",
+                help="live jax array bytes by registered component "
+                     "(id-matched against jax.live_arrays)",
+                labels={"component": name}).set(b["bytes"])
+        reg.gauge("memory_live_bytes_total",
+                  help="total bytes across jax.live_arrays()"
+                  ).set(total_bytes)
+        reg.gauge("memory_live_arrays_total",
+                  help="count of live jax arrays").set(total_arrays)
+        out = {"components": buckets, "total_bytes": total_bytes,
+               "total_arrays": total_arrays,
+               "devices": self._device_stats(reg)}
+        return out
+
+    @staticmethod
+    def _device_stats(reg: MetricRegistry) -> List[dict]:
+        """Per-device allocator stats when the backend reports them
+        (TPU HBM; CPU backends usually return nothing)."""
+        out: List[dict] = []
+        try:
+            import jax
+            for d in jax.local_devices():
+                stats = {}
+                try:
+                    stats = dict(d.memory_stats() or {})
+                except Exception:  # noqa: BLE001
+                    pass
+                in_use = int(stats.get("bytes_in_use", 0))
+                limit = int(stats.get("bytes_limit", 0))
+                out.append({"device": str(d), "bytes_in_use": in_use,
+                            "bytes_limit": limit,
+                            "peak_bytes_in_use":
+                                int(stats.get("peak_bytes_in_use", 0))})
+            if out:
+                reg.gauge("memory_device_bytes_in_use",
+                          help="allocator bytes_in_use, device 0"
+                          ).set(out[0]["bytes_in_use"])
+                reg.gauge("memory_device_bytes_limit",
+                          help="allocator bytes_limit (HBM budget), "
+                               "device 0").set(out[0]["bytes_limit"])
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    # ----------------------------------------------------------- sampling
+
+    def start_sampling(self, interval_s: float,
+                       registry: Optional[MetricRegistry] = None):
+        """Daemon thread snapshotting every ``interval_s`` seconds so
+        the gauges stay fresh between scrapes. Restarting replaces the
+        previous sampler. Returns an OWNER TOKEN: pass it to
+        :meth:`stop_sampling` so only the current owner can stop the
+        shared sampler (two engines in one process must not kill each
+        other's cadence on close)."""
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.stop_sampling()
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.snapshot(registry)
+                except Exception:  # noqa: BLE001 — sampling never crashes
+                    pass
+
+        t = threading.Thread(target=loop, name="telemetry-memory",
+                             daemon=True)
+        with self._lock:
+            self._sampler, self._sampler_stop = t, stop
+        t.start()
+        return stop
+
+    def stop_sampling(self, token=None) -> None:
+        """Stop the sampler. With ``token`` (from :meth:`start_sampling`)
+        the stop is owner-matched: a no-op when a NEWER sampler has
+        since replaced the token's — so a closing engine cannot freeze
+        the sampler a surviving engine restarted. ``token=None`` is the
+        unconditional spelling (process teardown, tests)."""
+        with self._lock:
+            if token is not None and token is not self._sampler_stop:
+                return
+            t, stop = self._sampler, self._sampler_stop
+            self._sampler = self._sampler_stop = None
+        if stop is not None:
+            stop.set()
+        if t is not None:
+            t.join(timeout=5)
+
+
+_default_monitor = MemoryMonitor()
+
+
+def get_memory_monitor() -> MemoryMonitor:
+    """The process-wide monitor the engines register components on and
+    the ``/debug/memory`` route snapshots."""
+    return _default_monitor
+
+
+def set_memory_monitor(monitor: MemoryMonitor) -> MemoryMonitor:
+    """Swap the process default (tests); returns the previous one."""
+    global _default_monitor
+    prev, _default_monitor = _default_monitor, monitor
+    return prev

@@ -149,12 +149,21 @@ class Histogram:
         """Rank-interpolated quantile estimate; None when empty. Within
         the containing bucket the estimate is linear, so error is bounded
         by the bucket's geometric width; clamped to [min, max] observed
-        (a clamp by constants preserves monotonicity in ``q``)."""
+        (a clamp by constants preserves monotonicity in ``q``). The edges
+        are exact by definition, not interpolation: q=0 is the observed
+        minimum, q=1 the observed maximum (pinned in
+        tests/test_telemetry.py — rank arithmetic at the edges would
+        otherwise depend on which bucket the first/last sample landed
+        in)."""
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile must be in [0, 1], got {q}")
         with self._lock:
             if self.count == 0:
                 return None
+            if q == 0.0:
+                return self._min
+            if q == 1.0:
+                return self._max
             rank = q * self.count
             cum, lower = 0.0, 0.0
             est = None

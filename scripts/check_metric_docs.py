@@ -1,0 +1,136 @@
+"""Metric catalog drift check.
+
+Every metric name registered in the codebase must appear (backticked)
+in docs/observability.md, and every name listed in the doc's metric
+catalog tables must exist in code — otherwise the catalog silently rots
+and dashboards get built against metrics that no longer exist.
+
+Static by design: the check greps registration call sites
+(``.counter("name"``/``.gauge(``/``.histogram(``) instead of importing
+the package, so it runs in any environment (no jax needed) and sees
+names on code paths tests never execute. Names passed through simple
+module-level constants (``SPAN_HISTOGRAM = "span_duration_seconds"``)
+are resolved; fully dynamic names (``sanitize_metric_name(event)`` in
+the monitor sink) cannot be enumerated statically and are covered by
+the catalog's prose instead — they live in DYNAMIC_NAME_SITES so a new
+dynamic call site fails the check until it is acknowledged here.
+
+Usage: python scripts/check_metric_docs.py   (exit 1 on drift)
+Wired as tier-1 via tests/test_docs_consistency.py.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, Set, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "deepspeed_tpu")
+DOC = os.path.join(ROOT, "docs", "observability.md")
+
+# registration call with a literal or identifier first argument,
+# tolerating a newline between `(` and the argument
+_CALL_RE = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*(?:\"([a-zA-Z_][a-zA-Z0-9_]*)\""
+    r"|'([a-zA-Z_][a-zA-Z0-9_]*)'|([A-Za-z_][A-Za-z0-9_.]*)\s*[(,)])",
+    re.S)
+_CONST_RE = re.compile(
+    r"^([A-Z][A-Z0-9_]*)\s*=\s*[\"']([a-zA-Z_][a-zA-Z0-9_]*)[\"']",
+    re.M)
+
+# identifier-argument call sites whose names are computed at runtime —
+# each entry is (file suffix, identifier) and must be justified by
+# catalog prose in docs/observability.md. Adding a NEW dynamic site
+# requires adding it here (and documenting it), which is the point.
+DYNAMIC_NAME_SITES: Set[Tuple[str, str]] = {
+    # RegistryMonitor fans arbitrary monitor event names into gauges
+    # via sanitize_metric_name — documented in the Training section
+    ("monitor/monitor.py", "sanitize_metric_name"),
+}
+
+# registry-internal generic parameter names (registry.py's own API
+# definitions, not registrations)
+_API_FILES = ("telemetry/registry.py",)
+
+
+def collect_code_metrics() -> Dict[str, str]:
+    """name -> file of every statically-knowable metric registration."""
+    out: Dict[str, str] = {}
+    unresolved = []
+    for dirpath, _, files in os.walk(PKG):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, PKG).replace(os.sep, "/")
+            if rel in _API_FILES:
+                continue
+            src = open(path).read()
+            consts = dict(_CONST_RE.findall(src))
+            for m in _CALL_RE.finditer(src):
+                name = m.group(2) or m.group(3)
+                ident = m.group(4)
+                if name is None and ident is not None:
+                    if ident in consts:
+                        name = consts[ident]
+                    elif (rel, ident) in DYNAMIC_NAME_SITES:
+                        continue
+                    else:
+                        unresolved.append((rel, ident))
+                        continue
+                if name:
+                    out[name] = rel
+    if unresolved:
+        lines = "\n".join(f"  {f}: .{{counter,gauge,histogram}}({i}…)"
+                          for f, i in sorted(set(unresolved)))
+        raise SystemExit(
+            "check_metric_docs: metric registrations with dynamic names "
+            "the checker cannot resolve — add them to "
+            f"DYNAMIC_NAME_SITES (and document them):\n{lines}")
+    return out
+
+
+def collect_doc_metrics(text: str) -> Set[str]:
+    """First-column backticked names of every catalog table row."""
+    out = set()
+    for line in text.splitlines():
+        m = re.match(r"\|\s*`([a-zA-Z_][a-zA-Z0-9_]*)`\s*\|", line)
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def check() -> list:
+    """Returns a list of human-readable drift errors (empty = clean)."""
+    errors = []
+    code = collect_code_metrics()
+    text = open(DOC).read()
+    doc_tables = collect_doc_metrics(text)
+    backticked = set(re.findall(r"`([a-zA-Z_][a-zA-Z0-9_]*)`", text))
+    for name in sorted(code):
+        if name not in backticked:
+            errors.append(
+                f"metric {name!r} (registered in {code[name]}) is not in "
+                "docs/observability.md — add it to the catalog")
+    for name in sorted(doc_tables):
+        if name not in code:
+            errors.append(
+                f"docs/observability.md catalogs {name!r} but no code "
+                "registers it — stale row?")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        print("\n".join(errors), file=sys.stderr)
+        return 1
+    code = collect_code_metrics()
+    print(f"check_metric_docs: {len(code)} metric names in sync with "
+          "docs/observability.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

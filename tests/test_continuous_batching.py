@@ -279,6 +279,13 @@ def test_bench_serve_continuous_smoke():
     assert tm["ttft_p50_ms"] <= tm["ttft_p90_ms"]
     assert tm["queue_wait_p50_ms"] <= tm["queue_wait_p90_ms"]
     assert tm["decode_token_p50_ms"] > 0
+    # flight-recorder blob (docs/observability.md): one decode trace,
+    # no retraces mid-replay, compiles timed
+    fr = rec["flight_recorder"]
+    assert fr["decode_traces"] == 1
+    assert fr["retraces"] == 0
+    assert fr["prefill_traces"] >= 1
+    assert fr["compile_seconds_total"] > 0
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm

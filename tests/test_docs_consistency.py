@@ -42,6 +42,21 @@ def test_cited_paths_exist(doc):
     assert not missing, f"{doc} cites missing paths: {missing}"
 
 
+def test_metric_catalog_in_sync():
+    """Every metric name registered in the codebase appears in
+    docs/observability.md (and every catalog row exists in code) —
+    scripts/check_metric_docs.py as a tier-1 gate, so the catalog and
+    the instrumented code cannot drift apart."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_docs",
+        os.path.join(ROOT, "scripts", "check_metric_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    errors = mod.check()
+    assert not errors, "\n".join(errors)
+
+
 def test_config_reference_up_to_date():
     """docs/config.md is GENERATED from the pydantic config models
     (scripts/gen_config_reference.py); regeneration must be byte-identical,

@@ -1,0 +1,527 @@
+"""Flight recorder: event ring, retrace watch, HBM accounting, watchdog.
+
+The acceptance run is here: two distinct unbucketed prompt shapes
+through the served model yield a ``compile_report()`` naming both
+prefill executables with compile times and a retrace event attributing
+the shape change; ``/debug/events`` and ``/debug/memory`` return valid
+JSON on the scrape endpoint; a stalled fake clock makes the watchdog
+produce a dump containing the event ring — all CPU, no real sleeps.
+"""
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.telemetry import (EventRing, MemoryMonitor,
+                                     MetricRegistry, Watchdog,
+                                     compile_report, get_event_ring,
+                                     set_event_ring, watched_jit)
+from deepspeed_tpu.telemetry import events as EV
+
+
+# ---------------------------------------------------------------------------
+# event ring
+# ---------------------------------------------------------------------------
+
+def test_event_ring_bounded_and_ordered():
+    ring = EventRing(capacity=3)
+    for i in range(5):
+        ring.record("k", i=i)
+    snap = ring.snapshot()
+    assert [e["data"]["i"] for e in snap] == [2, 3, 4]   # newest window
+    assert len(ring) == 3
+    payload = json.loads(ring.to_json())
+    assert payload["capacity"] == 3
+    assert payload["total_recorded"] == 5
+    assert payload["dropped"] == 2
+    # timestamps monotone, kinds stringified
+    ts = [e["ts"] for e in payload["events"]]
+    assert ts == sorted(ts)
+    with pytest.raises(ValueError):
+        EventRing(capacity=0)
+
+
+def test_event_ring_resize_keeps_newest():
+    ring = EventRing(capacity=8)
+    for i in range(8):
+        ring.record("k", i=i)
+    ring.resize(4)
+    assert [e["data"]["i"] for e in ring.snapshot()] == [4, 5, 6, 7]
+    ring.resize(16)                       # grow keeps everything
+    ring.record("k", i=8)
+    assert len(ring) == 5
+
+
+def test_event_ring_json_survives_nonserializable():
+    ring = EventRing(4)
+    ring.record("weird", obj=object())    # stringified at dump, not raise
+    json.loads(ring.to_json())
+
+
+def test_process_ring_swap():
+    prev = set_event_ring(EventRing(4))
+    try:
+        EV.record_event("x", a=1)
+        assert get_event_ring().snapshot()[-1]["kind"] == "x"
+    finally:
+        set_event_ring(prev)
+
+
+def test_fault_dump_covers_thread_exceptions(tmp_path):
+    """An unhandled exception in a THREAD (serving loop, sampler,
+    watchdog) must reach the dump — threading.excepthook, not just
+    sys.excepthook."""
+    import threading
+    path = str(tmp_path / "flight.json")
+    prev_ring = set_event_ring(EventRing(16))
+    try:
+        EV.record_event("step_end", step=7)
+        EV.install_fault_dump(path)
+        EV._fault_state["prev_thread_hook"] = lambda a: None  # no stderr
+
+        def boom():
+            raise RuntimeError("thread-boom")
+
+        t = threading.Thread(target=boom, name="serving-loop")
+        t.start()
+        t.join(timeout=5)
+        payload = json.load(open(path))
+        assert payload["dump_reason"] == "unhandled_thread_exception"
+        assert payload["thread"] == "serving-loop"
+        assert "thread-boom" in payload["exception"]
+        assert payload["events"][-1]["kind"] == "step_end"
+    finally:
+        EV.uninstall_fault_dump()
+        set_event_ring(prev_ring)
+
+
+def test_memory_sampler_stop_is_owner_matched():
+    """A closing engine may only stop the sampler it owns: a stale
+    token (superseded by a newer start_sampling) must be a no-op, so
+    the surviving engine's cadence is untouched."""
+    mon = MemoryMonitor()
+    tok1 = mon.start_sampling(3600.0, registry=MetricRegistry())
+    tok2 = mon.start_sampling(3600.0, registry=MetricRegistry())
+    assert tok1 is not tok2
+    mon.stop_sampling(tok1)                  # stale owner: no-op
+    assert mon._sampler is not None          # tok2's sampler survives
+    mon.stop_sampling(tok2)                  # current owner: stops
+    assert mon._sampler is None
+    # unconditional spelling still works (process teardown)
+    tok3 = mon.start_sampling(3600.0)
+    del tok3
+    mon.stop_sampling()
+    assert mon._sampler is None
+
+
+def test_fault_dump_reinstall_moves_stacks_file(tmp_path):
+    """A second install must move BOTH files — the operator scrapes
+    `<path>.stacks` next to the configured dump path."""
+    import os
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    try:
+        EV.install_fault_dump(p1)
+        assert os.path.exists(p1 + ".stacks")
+        EV.install_fault_dump(p2)
+        assert os.path.exists(p2 + ".stacks")
+        assert EV._fault_state["path"] == p2
+    finally:
+        EV.uninstall_fault_dump()
+
+
+def test_memory_unregister_is_owner_safe():
+    """unregister_component(name, getter) must not remove a NEWER
+    claimant of the same name (two engines sharing `params`)."""
+    mon = MemoryMonitor()
+    a, b = (lambda: None), (lambda: None)
+    mon.register_component("params", a)
+    mon.register_component("params", b)      # second engine re-claims
+    mon.unregister_component("params", a)    # first engine's close()
+    assert "params" in mon.components        # b's registration survives
+    mon.unregister_component("params", b)
+    assert "params" not in mon.components
+    # legacy spelling (no getter) still force-removes
+    mon.register_component("params", a)
+    mon.unregister_component("params")
+    assert "params" not in mon.components
+
+
+def test_fault_dump_writes_ring(tmp_path):
+    path = str(tmp_path / "flight.json")
+    prev_ring = set_event_ring(EventRing(16))
+    try:
+        EV.record_event("compile_end", fn="step", seconds=1.5)
+        EV.install_fault_dump(path)
+        EV._fault_state["prev_hook"] = lambda *a: None   # keep stderr clean
+        EV._excepthook(ValueError, ValueError("boom"), None)
+        payload = json.load(open(path))
+        assert payload["dump_reason"] == "unhandled_exception"
+        assert "boom" in payload["exception"]
+        assert payload["events"][-1]["kind"] == "compile_end"
+        # atexit flush overwrites with the final window
+        EV.record_event("checkpoint", tag="t1")
+        EV._atexit_dump()
+        payload = json.load(open(path))
+        assert payload["dump_reason"] == "atexit"
+        assert payload["events"][-1]["kind"] == "checkpoint"
+    finally:
+        EV.uninstall_fault_dump()
+        set_event_ring(prev_ring)
+
+
+# ---------------------------------------------------------------------------
+# compile watch / retrace detection (satellite: exactly-one retrace with
+# correct argument attribution)
+# ---------------------------------------------------------------------------
+
+def test_retrace_detected_once_with_argument_attribution():
+    reg = MetricRegistry()
+    ring = EventRing(64)
+
+    def step(params, input_ids, cache):
+        return input_ids * 2 + params["w"].sum(), cache + 1.0
+
+    w = watched_jit(step, name="step", registry=reg, ring=ring)
+    p = {"w": jnp.ones((4,))}
+    cache = jnp.zeros((2, 2))
+    w(p, jnp.zeros((1, 8), jnp.int32), cache)
+    w(p, jnp.zeros((1, 8), jnp.int32), cache)      # same shape: no event
+    w(p, jnp.zeros((1, 16), jnp.int32), cache)     # retrace
+    assert len(w.retraces) == 1                    # exactly one
+    r = w.retraces[0]
+    assert r["args"] == ["input_ids"]              # correct attribution
+    assert r["changed"] == ["input_ids: i32[1,8] -> i32[1,16]"]
+    kinds = [e["kind"] for e in ring.snapshot()]
+    assert kinds.count("retrace") == 1
+    assert kinds.count("compile_begin") == 2       # two executables
+    assert kinds.count("compile_end") == 2
+    assert reg.counter("jit_retraces_total",
+                       labels={"fn": "step"}).value == 1
+    assert reg.counter("jit_compiles_total",
+                       labels={"fn": "step"}).value == 2
+    # compile times recorded and positive
+    h = reg.histogram("jit_compile_seconds", labels={"fn": "step"})
+    assert h.count == 2 and h.sum > 0
+    assert w._cache_size() == 2
+
+
+def test_watched_jit_numerics_and_cost():
+    """Watched dispatch is numerically identical to plain jit, and the
+    executable record carries cost/memory analysis."""
+    def f(a, b):
+        return a @ b + 1.0
+
+    w = watched_jit(f, name="mm", registry=MetricRegistry(),
+                    ring=EventRing(8))
+    a = jnp.arange(16.0).reshape(4, 4)
+    out = w(a, a)
+    assert jnp.allclose(out, jax.jit(f)(a, a))
+    rec = w.executables[0]
+    assert rec.compile_seconds > 0
+    assert rec.cost["flops"] > 0
+    assert rec.cost["hbm_bytes"] > 0
+    assert rec.calls == 1
+    # warm()/cost() reuse the executable — no third entry appears
+    assert w.cost(a, a)["flops"] == rec.cost["flops"]
+    assert w._cache_size() == 1
+
+
+def test_watched_jit_scalar_and_static_keys():
+    reg, ring = MetricRegistry(), EventRing(8)
+    w = watched_jit(lambda x, s: x * s, name="scale", registry=reg,
+                    ring=ring)
+    a = jnp.ones((3,))
+    w(a, 2.0)
+    w(a, 3.0)                       # python scalar value change: no retrace
+    assert w._cache_size() == 1 and not w.retraces
+    w2 = watched_jit(lambda x, k: x[:k], name="slice", registry=reg,
+                     ring=ring, static_argnums=(1,))
+    assert w2(jnp.arange(10), 3).shape == (3,)
+    assert w2(jnp.arange(10), 5).shape == (5,)    # static value → retrace
+    assert w2._cache_size() == 2
+    # static_argNAMES passed POSITIONALLY must be value-keyed too —
+    # colliding keys would silently return the wrong executable
+    w3 = watched_jit(lambda x, k: x[:k], name="slice_named", registry=reg,
+                     ring=ring, static_argnames=("k",))
+    assert w3(jnp.arange(10), 3).shape == (3,)
+    assert w3(jnp.arange(10), 5).shape == (5,)
+    assert w3._cache_size() == 2
+
+
+def test_compile_report_names_functions():
+    reg, ring = MetricRegistry(), EventRing(8)
+    w = watched_jit(lambda x: x + 1, name="report_probe", registry=reg,
+                    ring=ring)
+    w(jnp.ones((2,)))
+    text = compile_report()
+    assert "report_probe" in text
+    assert "compile" in text
+    assert "f32[2]" in w.report()
+
+
+# ---------------------------------------------------------------------------
+# memory accounting
+# ---------------------------------------------------------------------------
+
+def test_memory_monitor_buckets_by_component():
+    reg = MetricRegistry()
+    mon = MemoryMonitor()
+    kv = jnp.zeros((64, 64))
+    params = {"w": jnp.ones((32, 32)), "b": jnp.ones((32,))}
+    mon.register_component("kv_block_pool", lambda: kv)
+    mon.register_component("params", lambda: params)
+    snap = mon.snapshot(registry=reg)
+    assert snap["components"]["kv_block_pool"]["bytes"] == kv.nbytes
+    assert snap["components"]["kv_block_pool"]["arrays"] == 1
+    expect_params = sum(x.nbytes for x in jax.tree.leaves(params))
+    assert snap["components"]["params"]["bytes"] == expect_params
+    assert snap["total_bytes"] >= kv.nbytes + expect_params
+    assert reg.gauge("memory_component_bytes",
+                     labels={"component": "params"}).value == expect_params
+    assert reg.gauge("memory_live_bytes_total").value == \
+        snap["total_bytes"]
+    json.dumps(snap, default=str)           # JSON-able
+    # unclaimed arrays land in `other`
+    assert snap["components"]["other"]["bytes"] >= 0
+    mon.unregister_component("params")
+    snap2 = mon.snapshot(registry=reg)
+    assert "params" not in snap2["components"]
+    # a dead getter degrades, never raises
+    mon.register_component("bad", lambda: 1 / 0)
+    mon.snapshot(registry=reg)
+
+
+# ---------------------------------------------------------------------------
+# watchdog (fake clock — no real sleeps)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_stall_dump_contains_event_ring():
+    reg = MetricRegistry()
+    ring = EventRing(16)
+    ring.record("compile_end", fn="decode", seconds=2.0)
+    clock = [0.0]
+    dumps = []
+    wd = Watchdog(10.0, registry=reg, ring=ring, clock=lambda: clock[0],
+                  on_dump=dumps.append, name="test_wd")
+    wd.notify_progress()
+    clock[0] = 9.0
+    assert not wd.check()                    # inside deadline
+    clock[0] = 10.5
+    assert wd.check()                        # stalled → fires
+    assert not wd.check()                    # ONCE per stall
+    assert wd.stalls == 1
+    dump = dumps[0]
+    assert dump["idle_seconds"] == pytest.approx(10.5)
+    # the dump CONTAINS the event ring (acceptance criterion)...
+    kinds = [e["kind"] for e in dump["events"]["events"]]
+    assert "compile_end" in kinds
+    # ...plus every thread's stack
+    assert any("MainThread" in name for name in dump["threads"])
+    assert reg.counter("watchdog_stalls_total",
+                       labels={"watchdog": "test_wd"}).value == 1
+    # the firing itself is recorded as an event
+    assert ring.snapshot()[-1]["kind"] == "watchdog_dump"
+    # progress re-arms; a second stall fires again
+    wd.notify_progress()
+    clock[0] = 15.0
+    assert not wd.check()
+    clock[0] = 40.0
+    assert wd.check()
+    assert wd.stalls == 2
+    with pytest.raises(ValueError):
+        Watchdog(0.0)
+
+
+def test_watchdog_dump_file(tmp_path):
+    clock = [100.0]
+    path = str(tmp_path / "stall.json")
+    wd = Watchdog(1.0, registry=MetricRegistry(), ring=EventRing(4),
+                  clock=lambda: clock[0], dump_path=path)
+    clock[0] = 102.0
+    assert wd.check()
+    payload = json.load(open(path))
+    assert payload["deadline_seconds"] == 1.0
+    assert "threads" in payload and "events" in payload
+
+
+# ---------------------------------------------------------------------------
+# served-model acceptance: retrace attribution + /debug routes
+# ---------------------------------------------------------------------------
+
+def _make_server(registry, **knobs):
+    from deepspeed_tpu.inference import (ContinuousBatchingServer,
+                                         DeepSpeedInferenceConfig,
+                                         InferenceEngine)
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, init_params)
+    cfg = InferenceTransformerConfig(vocab_size=128, n_positions=512,
+                                     n_embd=32, n_layer=2, n_head=4,
+                                     dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    scfg = dict(dtype="float32", max_out_tokens=256, block_size=32,
+                num_slots=4)
+    scfg.update(knobs)
+    eng = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(**scfg))
+    return eng, ContinuousBatchingServer(eng, registry=registry)
+
+
+def test_served_two_shapes_report_and_debug_routes():
+    """THE acceptance demo: two unbucketed prompt shapes through the
+    server → compile_report names both prefill executables with compile
+    times and one retrace attributing the `ids` shape change; the
+    scrape endpoint serves valid JSON on /debug/events and
+    /debug/memory."""
+    prev_ring = set_event_ring(EventRing(256))
+    reg = MetricRegistry()
+    try:
+        eng, srv = _make_server(reg, telemetry={"http_port": 0})
+        try:
+            # prompt of 3 tokens pads to the 128 bucket; 130 tokens to
+            # 256 — two distinct prefill shapes through one server
+            srv.submit(list(range(1, 4)), max_new_tokens=3)
+            srv.drain()
+            srv.submit([1 + (i % 100) for i in range(130)],
+                       max_new_tokens=3)
+            srv.drain()
+
+            # --- compile_report names both executables + timings
+            assert srv._prefill_jit._cache_size() == 2
+            assert len(srv._prefill_jit.retraces) == 1
+            r = srv._prefill_jit.retraces[0]
+            assert r["args"] == ["ids"]
+            assert any("i32[1,128] -> i32[1,256]" in c
+                       for c in r["changed"])
+            text = compile_report()
+            assert "serve_prefill" in text and "serve_decode" in text
+            assert "i32[1,128]" in text and "i32[1,256]" in text
+            assert "compile" in text
+            for rec in srv._prefill_jit.executables:
+                assert rec.compile_seconds > 0
+            assert srv.stats["retraces"] == 1
+            assert srv.stats["prefill_traces"] == 2
+            # registry sees the same story
+            assert reg.counter("jit_retraces_total",
+                               labels={"fn": "serve_prefill"}).value == 1
+
+            # --- /debug/events: valid JSON holding the retrace
+            base = f"http://127.0.0.1:{srv.http_server.port}"
+            events = json.loads(urllib.request.urlopen(
+                f"{base}/debug/events").read())
+            retraces = [e for e in events["events"]
+                        if e["kind"] == "retrace"]
+            assert any(e["data"]["fn"] == "serve_prefill"
+                       for e in retraces)
+
+            # --- /debug/memory: valid JSON with the pool + params
+            mem = json.loads(urllib.request.urlopen(
+                f"{base}/debug/memory").read())
+            comp = mem["components"]
+            assert comp["kv_block_pool"]["bytes"] > 0
+            assert comp["params"]["bytes"] > 0
+            assert mem["total_bytes"] >= comp["params"]["bytes"]
+
+            # --- /debug/compile: the text report over HTTP
+            rep = urllib.request.urlopen(
+                f"{base}/debug/compile").read().decode()
+            assert "serve_prefill" in rep
+        finally:
+            srv.close()
+        # close() unregisters the components from the process monitor
+        from deepspeed_tpu.telemetry import get_memory_monitor
+        assert "kv_block_pool" not in get_memory_monitor().components
+    finally:
+        set_event_ring(prev_ring)
+
+
+def test_server_watchdog_config_gated():
+    prev_ring = set_event_ring(EventRing(64))
+    try:
+        _, srv = _make_server(MetricRegistry(),
+                              telemetry={"watchdog_deadline_s": 3600})
+        try:
+            assert srv.watchdog is not None
+            clock = [0.0]
+            srv.watchdog.stop()                  # drive it by hand
+            srv.watchdog._clock = lambda: clock[0]
+            srv.watchdog.notify_progress()
+            srv.submit([1, 2, 3], max_new_tokens=3)
+            srv.drain()                          # steps heartbeat it
+            clock[0] = 3599.0
+            assert not srv.watchdog.check()
+            clock[0] = 3601.0
+            assert srv.watchdog.check()          # genuine stall fires
+            # an IDLE server being polled is alive, not stalled: the
+            # empty-slots early return must heartbeat too
+            clock[0] = 9000.0
+            srv.step()                           # idle poll
+            assert not srv.watchdog.check()
+        finally:
+            srv.close()
+        assert srv.watchdog is None              # close() tears it down
+        # default config: no watchdog thread at all
+        _, srv2 = _make_server(MetricRegistry())
+        assert srv2.watchdog is None
+        srv2.close()
+    finally:
+        set_event_ring(prev_ring)
+
+
+def test_admission_rejects_land_in_event_ring():
+    prev_ring = set_event_ring(EventRing(64))
+    try:
+        _, srv = _make_server(MetricRegistry())
+        try:
+            with pytest.raises(ValueError):
+                srv.submit([], max_new_tokens=4)
+        finally:
+            srv.close()
+        rejects = [e for e in get_event_ring().snapshot()
+                   if e["kind"] == "admission_reject"]
+        assert rejects and rejects[-1]["data"]["reason"] == "empty_prompt"
+    finally:
+        set_event_ring(prev_ring)
+
+
+# ---------------------------------------------------------------------------
+# training engine wiring
+# ---------------------------------------------------------------------------
+
+def test_train_step_events_and_compile_watch(tmp_path):
+    import numpy as np
+
+    import deepspeed_tpu
+
+    prev_ring = set_event_ring(EventRing(128))
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model_parameters={"w": jnp.ones((16, 4), jnp.float32)},
+            loss_fn=lambda p, b, rng: jnp.mean((b["x"] @ p["w"]) ** 2),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "sgd", "params": {"lr": 0.01}},
+                    "telemetry": {"watchdog_deadline_s": 3600}})
+        rng = np.random.default_rng(0)
+        batch = {"x": jnp.asarray(
+            rng.normal(size=(engine.train_batch_size, 16)), jnp.float32)}
+        engine.train_batch(batch)
+        engine.train_batch(batch)
+        kinds = [e["kind"] for e in get_event_ring().snapshot()]
+        # the step fn compiled once (watched), then two step events
+        assert kinds.count("compile_end") >= 1
+        steps = [e for e in get_event_ring().snapshot()
+                 if e["kind"] == "step_end"
+                 and e["data"].get("source") == "train"]
+        assert len(steps) == 2
+        assert engine._step_fn._cache_size() == 1       # no retrace
+        assert engine.watchdog is not None
+        # checkpoint event rides along
+        engine.save_checkpoint(str(tmp_path))
+        assert any(e["kind"] == "checkpoint"
+                   for e in get_event_ring().snapshot())
+        engine.destroy()
+        assert engine.watchdog is None
+        from deepspeed_tpu.telemetry import get_memory_monitor
+        assert "optimizer_state" not in get_memory_monitor().components
+    finally:
+        set_event_ring(prev_ring)

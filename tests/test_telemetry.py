@@ -109,6 +109,28 @@ def test_histogram_edges():
         h2.quantile(1.5)
 
 
+def test_histogram_quantile_q0_q1_exact():
+    """q=0 / q=1 are DEFINED as the observed min/max (not bucket
+    interpolation) and empty histograms return None at every q — the
+    edge contract dashboards rely on."""
+    reg = MetricRegistry()
+    h = reg.histogram("edge_seconds", buckets=[0.01, 0.1, 1.0])
+    for q in (0.0, 0.5, 1.0):
+        assert h.quantile(q) is None        # empty: None at EVERY q
+    h.observe(0.004)
+    h.observe(0.03)
+    h.observe(7.0)                          # overflow bucket
+    assert h.quantile(0.0) == 0.004         # exact observed min
+    assert h.quantile(1.0) == 7.0           # exact observed max
+    # still monotone through the edges
+    qs = [h.quantile(q) for q in (0.0, 0.25, 0.5, 0.75, 1.0)]
+    assert qs == sorted(qs)
+    with pytest.raises(ValueError):
+        h.quantile(-0.01)
+    with pytest.raises(ValueError):
+        h.quantile(1.01)
+
+
 # ---------------------------------------------------------------------------
 # exposition: Prometheus text + JSON snapshot
 # ---------------------------------------------------------------------------
