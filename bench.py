@@ -174,6 +174,105 @@ def train_phase_name(args, *, seq_suffix: bool = False,
     return name
 
 
+def _train_observability_blobs(engine) -> dict:
+    """``numerics``/``goodput`` blobs for a train-phase record — the
+    tier-1 CPU smoke asserts these keys (docs/observability.md "Bench
+    integration")."""
+    ns = engine.numerics.snapshot()
+    gp = engine.goodput.snapshot()
+    snap = engine.telemetry.snapshot()
+
+    def _p50_ms(name):
+        fam = snap.get(name)
+        if not fam:
+            return None
+        for series in fam["series"]:
+            if series.get("count"):
+                p = series.get("p50")
+                return round(p * 1e3, 3) if p is not None else None
+        return None
+
+    last_nf = ns["nonfinite"]["last"] or {}
+    return {
+        "numerics": {
+            "enabled": bool(engine._numerics_on),
+            "blocks": len(ns["blocks"]),
+            "anomalies_total": ns["anomaly"]["total"],
+            "nonfinite_steps": ns["nonfinite"]["steps_total"],
+            "first_nonfinite_block": last_nf.get("block"),
+        },
+        "goodput": {
+            "enabled": gp["enabled"],
+            "steps": gp["steps"],
+            "fraction": round(gp["fraction"], 4),
+            "data_wait_p50_ms": _p50_ms("train_goodput_data_wait_seconds"),
+            "device_p50_ms": _p50_ms("train_goodput_device_seconds"),
+            "host_p50_ms": _p50_ms("train_goodput_host_seconds"),
+            "wall_p50_ms": _p50_ms("train_goodput_step_wall_seconds"),
+            "bucket_sum_s": round(gp["data_wait_s"] + gp["device_s"]
+                                  + gp["host_s"], 6),
+            "wall_sum_s": round(gp["wall_s"], 6),
+        },
+    }
+
+
+def _phase_train_smoke(args) -> dict:
+    """CPU tier-1 smoke for the train-phase observability blobs: a tiny
+    two-block model (no accelerator model stack) trained with numerics +
+    goodput armed from step one — so arming costs zero retraces — plus
+    one deliberately spiked batch so the loss-spike detector's output is
+    visible in the record."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+
+    rng = np.random.default_rng(0)
+    D, H, O = 16, 8, 4
+    params = {
+        "blk0": {"w": jnp.asarray(rng.normal(0, 0.1, (D, H)), jnp.float32)},
+        "blk1": {"w": jnp.asarray(rng.normal(0, 0.1, (H, O)), jnp.float32)},
+    }
+
+    def loss_fn(p, b, rng_):
+        h = jnp.tanh(b["x"] @ p["blk0"]["w"])
+        return jnp.mean((h @ p["blk1"]["w"] - b["y"]) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4, "steps_per_print": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "telemetry": {"numerics_enabled": True, "goodput": True,
+                              "numerics_spike_window": 8,
+                              "numerics_spike_threshold": 6.0}})
+    B = engine.train_batch_size
+
+    def mk(offset=0.0):
+        return {"x": jnp.asarray(rng.normal(size=(B, D)), jnp.float32),
+                "y": jnp.full((B, O), offset, jnp.float32)}
+
+    steps = max(int(getattr(args, "steps", 10) or 10), 10)
+    t0 = time.time()
+    m = None
+    for _ in range(steps):
+        m = engine.train_batch(mk())
+    # one deliberate spike: a shifted target blows the loss ~4 orders of
+    # magnitude past the rolling median+MAD band (same shapes — no
+    # retrace)
+    engine.train_batch(mk(offset=100.0))
+    dt = time.time() - t0
+    out = {"phase": "train-smoke", "smoke": True, "steps": steps + 1,
+           "ms_per_step": round(dt / (steps + 1) * 1e3, 2),
+           "loss": round(float(m["loss"]), 5)}
+    out.update(_train_observability_blobs(engine))
+    engine.destroy()
+    # no inline print: the --phase child dispatcher prints the returned
+    # record as THE one JSON line (a second copy would double-count in
+    # consumers that aggregate every parseable line)
+    return out
+
+
 def phase_train(args) -> dict:
     try:
         return _phase_train(args)
@@ -194,6 +293,22 @@ def _phase_train(args) -> dict:
     import jax.numpy as jnp
     import numpy as np
     log(f"backend={jax.default_backend()} devices={jax.device_count()}")
+    if getattr(args, "smoke", False) or jax.default_backend() != "tpu":
+        # tiny-model smoke (tier-1 CPU): the observability blobs with
+        # every moving part exercised, none of the accelerator model
+        # stack. An unknown preset must still crash loudly first — the
+        # salvage machinery's crash-path tests (and real typos) rely on
+        # argument errors surfacing, not being absorbed by the smoke.
+        preset = getattr(args, "preset", None)
+        if preset is not None:
+            from deepspeed_tpu.models.gpt2 import PRESETS as _GPT2_PRESETS
+            from deepspeed_tpu.models.llama import (
+                PRESETS as _LLAMA_PRESETS)
+            if preset not in _GPT2_PRESETS and preset not in _LLAMA_PRESETS:
+                raise ValueError(
+                    f"unknown preset {preset!r}: "
+                    f"{sorted(_GPT2_PRESETS) + sorted(_LLAMA_PRESETS)}")
+        return _phase_train_smoke(args)
     import deepspeed_tpu
 
     if args.preset.startswith(("llama", "mixtral")):
@@ -298,6 +413,17 @@ def _phase_train(args) -> dict:
     dt = time.time() - t0
     log(f"{steps} steps in {dt:.2f}s ({dt / steps * 1e3:.0f} ms/step)")
 
+    # post-measurement observability steps: goodput is host timers only
+    # (no retrace — the measured loop above stays fully async); the
+    # in-graph numerics observatory costs one retrace of the train step,
+    # so it is opt-in via --train-numerics
+    if getattr(args, "train_numerics", False):
+        engine.set_numerics_enabled(True)
+    engine.set_goodput_enabled(True)
+    for _ in range(3):
+        engine.train_batch(batch)
+    blobs = _train_observability_blobs(engine)
+
     tps_chip = tokens_per_step * steps / dt / n_chips
     tf_chip = tps_chip * fpt / 1e12
     return {
@@ -313,6 +439,7 @@ def _phase_train(args) -> dict:
         "ms_per_step": round(dt / steps * 1e3, 1),
         "steps": steps,
         "loss": round(final_loss, 4),
+        **blobs,
     }
 
 
@@ -1703,6 +1830,11 @@ def main() -> None:
     ap.add_argument("--arrival-rate", type=float, default=0.5,
                     help="serve-continuous: Poisson arrivals per decode "
                          "step")
+    ap.add_argument("--train-numerics", dest="train_numerics",
+                    action="store_true",
+                    help="train phases: arm the in-graph numerics "
+                         "observatory for the post-measurement "
+                         "instrumented steps (costs one retrace)")
     ap.add_argument("--smoke", action="store_true",
                     help="serve-continuous: tiny-model CPU smoke mode "
                          "(auto when the backend is not TPU)")

@@ -84,6 +84,18 @@ def telemetry_info():
             f"{cfg.watchdog_deadline_s}s deadline"
             if cfg.watchdog_deadline_s is not None
             else "off (set telemetry.watchdog_deadline_s)")
+        from deepspeed_tpu.telemetry import numerics_snapshot
+        watches = numerics_snapshot()
+        # registration is the /debug/numerics reporting hook and happens
+        # even with numerics off — report the enabled state separately
+        state = ("enabled by default config" if cfg.numerics_enabled
+                 else "off (set telemetry.numerics_enabled)")
+        if watches:
+            state += (f"; {len(watches)} watch(es) registered: "
+                      f"{sorted(watches)}")
+        out["numerics_watch"] = state
+        out["goodput"] = ("on by default config" if cfg.goodput
+                          else "off (set telemetry.goodput)")
     except Exception as e:  # pragma: no cover - env specific
         out["telemetry"] = f"unavailable: {e}"
         return out

@@ -132,11 +132,39 @@ class RegistryMonitor(Monitor):
     event name becomes a gauge (``Train/Samples/train_loss`` →
     ``train_samples_train_loss``), the sample clock lands in
     ``train_samples`` — so a scraper sees training step metrics with
-    zero backend configuration."""
+    zero backend configuration. The four core train-step scalars are
+    ALSO published under canonical short names (``train_loss``,
+    ``train_grad_norm``, ``train_lr``, ``train_loss_scale``) so
+    dashboards don't have to know the reference's ``Train/Samples/...``
+    event spelling."""
 
     def __init__(self, registry: Optional[MetricRegistry] = None):
         self.registry = registry or get_registry()
         self.enabled = True
+
+    def _canonical(self, name: str, value: float) -> None:
+        # spelled out per name (not a loop over a mapping) so the
+        # metric-catalog gate (scripts/check_metric_docs.py) can
+        # resolve every registration statically
+        if name == "Train/Samples/train_loss":
+            self.registry.gauge(
+                "train_loss",
+                help="mean loss of the last reported train step").set(value)
+        elif name == "Train/Samples/lr":
+            self.registry.gauge(
+                "train_lr",
+                help="learning rate at the last reported train step"
+            ).set(value)
+        elif name == "Train/Samples/loss_scale":
+            self.registry.gauge(
+                "train_loss_scale",
+                help="fp16 dynamic loss scale at the last reported "
+                     "train step").set(value)
+        elif name == "Train/Samples/grad_norm":
+            self.registry.gauge(
+                "train_grad_norm",
+                help="global (pre-clip) gradient norm of the last "
+                     "reported train step").set(value)
 
     def write_events(self, event_list: List[Event]):
         for name, value, step in event_list:
@@ -144,6 +172,7 @@ class RegistryMonitor(Monitor):
                 sanitize_metric_name(name),
                 help=f"monitor event {name!r} (runtime/engine.py)"
             ).set(float(value))
+            self._canonical(name, float(value))
             self.registry.gauge(
                 "train_samples",
                 help="global sample count at the last monitor event"

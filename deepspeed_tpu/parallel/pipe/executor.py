@@ -100,7 +100,8 @@ class PipelineEngine:
                  loss_fn: Optional[Callable] = None,
                  mesh: Optional[Mesh] = None,
                  zero_stage: int = 0,
-                 param_specs: Optional[Sequence[Any]] = None):
+                 param_specs: Optional[Sequence[Any]] = None,
+                 telemetry=None):
         mesh = mesh or get_global_mesh()
         if PIPE_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh has no {PIPE_AXIS!r} axis")
@@ -264,6 +265,22 @@ class PipelineEngine:
         self.max_live_buffers = [0] * self.num_stages
         self.residual_bytes_per_buffer = [0] * self.num_stages
         self.global_steps = 0
+        # telemetry (docs/observability.md): registry + goodput split.
+        # ``telemetry`` is the shared TelemetryConfig section (or None =
+        # defaults: registry on, goodput off); telemetry.enabled=false
+        # keeps recording cost identical while nothing reaches the
+        # process scrape surface.
+        from deepspeed_tpu.telemetry import MetricRegistry, get_registry
+        from deepspeed_tpu.telemetry.goodput import GoodputMeter
+        telemetry_on = telemetry is None or telemetry.enabled
+        self._telemetry_on = telemetry_on
+        self.telemetry = get_registry() if telemetry_on \
+            else MetricRegistry()
+        self.goodput = GoodputMeter(
+            registry=self.telemetry,
+            enabled=bool(telemetry_on and telemetry is not None and
+                         telemetry.goodput),
+            source="pipeline")
 
     # ------------------------------------------------------------------
     def _stage_apply(self, s: int, sp: tuple, h):
@@ -303,6 +320,8 @@ class PipelineEngine:
         """One optimizer step over ``micro_batches`` microbatches split from
         the leading dim of ``inputs``/``labels`` — the analog of
         ``PipelineEngine.train_batch`` (reference ``pipe/engine.py:294``)."""
+        import time
+        t_wall = time.perf_counter()
         M, S = self.micro_batches, self.num_stages
         mb_in = self._split_microbatches(inputs, M)
         mb_lab = self._split_microbatches(labels, M)
@@ -427,9 +446,28 @@ class PipelineEngine:
             self.max_live_buffers[s] = max(self.max_live_buffers[s],
                                            live_max[s])
         self.global_steps += 1
+        # the loss float is the step's host sync: everything the tick
+        # loop enqueued must finish before it resolves, so the interval
+        # from dispatch-done to here is the device tail the host was NOT
+        # overlapping (a lower bound on device time — host dispatch and
+        # device compute overlap by design in this executor)
+        t_sync = time.perf_counter()
         loss = float(jnp.mean(jnp.stack(
             [jax.device_put(l, self.stage_meshes[-1].devices.flat[0])
              for l in losses])))
+        self.goodput.record_step(time.perf_counter() - t_wall,
+                                 data_wait_s=0.0,
+                                 device_s=time.perf_counter() - t_sync)
+        self.telemetry.gauge(
+            "train_loss",
+            help="mean loss of the last reported train step",
+            labels={"engine": "pipeline"}).set(loss)
+        if self._telemetry_on:
+            # the event ring is process-global: a telemetry-disabled
+            # engine must not churn another engine's forensic window
+            from deepspeed_tpu.telemetry import events as _ev
+            _ev.record_event(_ev.STEP_END, source="pipeline",
+                             step=self.global_steps)
         return {"loss": loss, "micro_batches": M,
                 "max_live_buffers": list(self.max_live_buffers)}
 

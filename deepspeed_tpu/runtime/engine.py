@@ -75,7 +75,7 @@ def _split_loss_out(out):
             "loss_fn returning a tuple must be (loss, aux_dict); "
             f"got aux of type {type(aux).__name__}")
     reserved = {"loss", "grad_norm", "lr", "loss_scale", "skipped",
-                "finite"}
+                "finite", "_numerics"}
     bad = reserved & set(aux)
     if bad:
         raise ValueError(
@@ -372,6 +372,46 @@ class DeepSpeedEngine:
             except OSError as e:   # port taken must not kill training
                 logger.warning(f"telemetry endpoint unavailable: {e}")
         self._init_flight_recorder(tcfg)   # helper honors tcfg.enabled
+        # ---- numerics observatory + goodput accounting ----
+        # (telemetry/numerics.py, telemetry/goodput.py — the divergence
+        # and wall-time-split layer, docs/observability.md "Training
+        # numerics & goodput"). The block spec is built ONCE from the
+        # materialized param tree; the in-graph statistics ride the
+        # jitted step behind a static flag, so toggling at runtime
+        # (set_numerics_enabled) costs exactly one attributed retrace.
+        from deepspeed_tpu.telemetry.goodput import GoodputMeter
+        from deepspeed_tpu.telemetry.numerics import (
+            NumericsWatch, block_spec, register_numerics_watch)
+        self._telemetry_on = telemetry_on
+        self._numerics_spec = block_spec(
+            self.state.params,
+            depth=(tcfg.numerics_block_depth if tcfg is not None else 1))
+        self._numerics_on = bool(telemetry_on and tcfg is not None and
+                                 tcfg.numerics_enabled)
+        self.numerics = NumericsWatch(
+            self._numerics_spec.names, registry=self.telemetry,
+            window=(tcfg.numerics_spike_window if tcfg is not None
+                    else 64),
+            threshold=(tcfg.numerics_spike_threshold if tcfg is not None
+                       else 6.0),
+            source="train",
+            dump_path=(tcfg.events_dump_path if tcfg is not None
+                       else None))
+        if telemetry_on:
+            register_numerics_watch("train", self.numerics)
+        self.goodput = GoodputMeter(
+            registry=self.telemetry,
+            enabled=bool(telemetry_on and tcfg is not None and
+                         tcfg.goodput),
+            source="train")
+        if self._numerics_on and (self._onebit_axes or
+                                  self._sparse_grad_axes):
+            logger.warning(
+                "telemetry.numerics_enabled is not supported on the "
+                "explicit-DP (1-bit/sparse) shard_map steps — numerics "
+                "disabled for this engine")
+            self._numerics_on = False
+        self._last_grad_norm = None
         self.curriculum_scheduler = None
         if config.curriculum_learning.get("enabled", False):
             from deepspeed_tpu.runtime.data_pipeline import (
@@ -609,10 +649,17 @@ class DeepSpeedEngine:
             self._device_param_shardings) if coarse_fetch else None
 
         aux_keys_cache: dict = {"keys": None}
+        numerics_spec = self._numerics_spec
 
-        def grad_core(params, scale, batch, rng):
+        def grad_core(params, scale, batch, rng, want_numerics=False):
             """→ (grads fp32 clipped+unscaled, mean_loss, aux_mean dict,
-            gnorm, finite)."""
+            gnorm, finite, block_stats). ``block_stats`` is None unless
+            ``want_numerics`` (a trace-time python bool): then a dict of
+            per-layer-block arrays — ``grad_sq`` (unscaled, PRE-clip sum
+            of squares; the clip would smear one block's NaN over all of
+            them) and ``nonfinite`` counts (telemetry/numerics.py)."""
+            from deepspeed_tpu.telemetry.numerics import (
+                block_nonfinite_counts, block_sq_norms)
             if coarse_fetch:
                 params = jax.tree.map(jax.device_put, params, fetch_sh)
             if gas > 1:
@@ -664,17 +711,38 @@ class DeepSpeedEngine:
                     for g in jax.tree.leaves(grads)))
                 inv = jnp.float32(1.0) / scale
                 gnorm = gnorm_raw * inv
+                block_stats = None
+                if want_numerics:
+                    # unscaled squares: (g*inv)² = g²·inv² — one scalar
+                    # multiply instead of a second grad-tree pass
+                    block_stats = {
+                        "grad_sq": block_sq_norms(grads, numerics_spec)
+                        * (inv * inv),
+                        "nonfinite": block_nonfinite_counts(
+                            grads, numerics_spec)}
                 factor = inv
                 if clip > 0.0:
                     factor = inv * clip_coef(clip, gnorm)
                 grads = jax.tree.map(
                     lambda g: (g * factor).astype(g.dtype), grads)
-                return grads, mean_loss, aux_mean, gnorm, jnp.bool_(True)
+                return (grads, mean_loss, aux_mean, gnorm,
+                        jnp.bool_(True), block_stats)
 
             # unscale (fp16) — gas scaling already folded into the loss
             inv = 1.0 / scale
             grads = jax.tree.map(lambda g: g * inv, grads)
             finite = grads_finite(grads) if fp16 else jnp.bool_(True)
+
+            block_stats = None
+            if want_numerics:
+                # pre-clip on purpose: the global-norm clip multiplies
+                # EVERY leaf by a factor derived from the global norm,
+                # so one block's NaN would smear into all of them and
+                # destroy provenance
+                block_stats = {
+                    "grad_sq": block_sq_norms(grads, numerics_spec),
+                    "nonfinite": block_nonfinite_counts(
+                        grads, numerics_spec)}
 
             # global grad-norm clip (runtime/utils.py clip_grad_norm_ —
             # MP-awareness is free: grads are global arrays)
@@ -683,7 +751,7 @@ class DeepSpeedEngine:
             if clip > 0.0:
                 coef = clip_coef(clip, gnorm)
                 grads = jax.tree.map(lambda g: g * coef, grads)
-            return grads, mean_loss, aux_mean, gnorm, finite
+            return grads, mean_loss, aux_mean, gnorm, finite, block_stats
 
         return grad_core
 
@@ -694,6 +762,7 @@ class DeepSpeedEngine:
         fp16 = self.config.fp16.enabled
         grad_core = self._make_grad_core()
         stream = self._offload_stream
+        numerics_spec = self._numerics_spec
         if stream:
             # streamed offload: master/moments enter in pinned_host; move
             # each leaf into device space for the update and back after.
@@ -708,10 +777,16 @@ class DeepSpeedEngine:
             master_host_sh = self._state_shardings.master
             opt_host_sh = self._state_shardings.opt_state
 
-        def step_fn(state: TrainState, batch, rng):
+        def step_fn(state: TrainState, batch, rng, numerics_on=False):
+            # ``numerics_on`` is STATIC (jit static_argnums): off, the
+            # program is byte-identical to the un-instrumented step;
+            # toggling is one retrace the compile watch attributes as
+            # ``numerics_on: static:False -> static:True``.
+            from deepspeed_tpu.telemetry.numerics import block_sq_norms
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
-            grads, mean_loss, aux, gnorm, finite = grad_core(
-                state.params, scale, batch, rng)
+            grads, mean_loss, aux, gnorm, finite, bstats = grad_core(
+                state.params, scale, batch, rng,
+                want_numerics=numerics_on)
             lr = schedule(state.step)
             master = state.master if mixed else state.params
 
@@ -724,18 +799,22 @@ class DeepSpeedEngine:
                 updates, new_opt = optimizer.update(
                     grads_, opt_state_, master_, lr)
                 new_master = jax.tree.map(jnp.add, master_, updates)
-                return new_master, new_opt
+                upd_sq = (block_sq_norms(updates, numerics_spec)
+                          if numerics_on else ())
+                return new_master, new_opt, upd_sq
 
             def skip_update(operand):
                 _, master_, opt_state_ = operand
-                return master_, opt_state_
+                upd_sq = (jnp.zeros((len(numerics_spec.names),),
+                                    jnp.float32) if numerics_on else ())
+                return master_, opt_state_, upd_sq
 
             if fp16:
-                new_master, new_opt = jax.lax.cond(
+                new_master, new_opt, upd_sq = jax.lax.cond(
                     finite, do_update, skip_update,
                     (grads, master, state.opt_state))
             else:
-                new_master, new_opt = do_update(
+                new_master, new_opt, upd_sq = do_update(
                     (grads, master, state.opt_state))
 
             if mixed:
@@ -762,6 +841,20 @@ class DeepSpeedEngine:
                        "loss_scale": scale,
                        "skipped": jnp.logical_not(finite)}
             metrics.update(aux)   # user aux scalars (multi-output models)
+            if numerics_on:
+                # per-block observatory payload, popped by train_batch
+                # before metrics reach the caller. Param norms use the
+                # PRE-update master (fp32) — except under streamed
+                # offload, where the master lives in host memory and
+                # the bf16 compute params are the device-resident copy.
+                param_src = state.params if stream else master
+                metrics["_numerics"] = {
+                    "grad_norm": jnp.sqrt(bstats["grad_sq"]),
+                    "param_norm": jnp.sqrt(
+                        block_sq_norms(param_src, numerics_spec)),
+                    "update_norm": jnp.sqrt(upd_sq),
+                    "nonfinite": bstats["nonfinite"],
+                }
             return new_state, metrics
 
         return step_fn
@@ -1022,21 +1115,34 @@ class DeepSpeedEngine:
             [("params", _params), ("optimizer_state", _opt_state)])
         self.watchdog = self._flight.watchdog
 
+    @staticmethod
+    def _accept_numerics_flag(step3):
+        """Give a 3-arg step the fused step's 4-arg signature. The
+        explicit-DP (1-bit/sparse) steps do not support in-graph
+        numerics (their gradients are per-worker inside shard_map);
+        the flag is accepted — so every path shares one calling
+        convention — and ignored."""
+        def step_fn(state, batch, rng, numerics_on=False):
+            return step3(state, batch, rng)
+        return step_fn
+
     def _compile_step(self, batch):
         from deepspeed_tpu.telemetry import watched_jit
         if self._onebit_axes:
             self._eager_param_staging = False
             self._step_fn = watched_jit(
-                self._make_compressed_step_fn(batch),
+                self._accept_numerics_flag(
+                    self._make_compressed_step_fn(batch)),
                 name="train_step", registry=self.telemetry,
-                donate_argnums=(0,))
+                static_argnums=(3,), donate_argnums=(0,))
             return
         if self._sparse_grad_axes:
             self._eager_param_staging = False
             self._step_fn = watched_jit(
-                self._make_sparse_step_fn(batch),
+                self._accept_numerics_flag(
+                    self._make_sparse_step_fn(batch)),
                 name="train_step", registry=self.telemetry,
-                donate_argnums=(0,))
+                static_argnums=(3,), donate_argnums=(0,))
             return
         batch_sh = self._batch_sharding(batch)
         in_sh = self._state_shardings
@@ -1050,11 +1156,14 @@ class DeepSpeedEngine:
             in_sh = in_sh.replace(params=self._device_param_shardings)
             out_sh = out_sh.replace(params=self._device_param_shardings)
             self._eager_param_staging = True
+        # numerics_on is static (one retrace per toggle); in_shardings
+        # cover the three dynamic args only
         self._step_fn = watched_jit(
             self._make_step_fn(),
             name="train_step", registry=self.telemetry,
             in_shardings=(in_sh, batch_sh, None),
             out_shardings=(out_sh, None),
+            static_argnums=(3,),
             donate_argnums=(0,))
 
     # ------------------------------------------------------------------
@@ -1066,12 +1175,27 @@ class DeepSpeedEngine:
         # device in bf16 — halves grad HBM and the per-step D2H stream
         # (the host Adam upcasts per-leaf). No-op at the fp32 default.
         grad_core = self._make_grad_core(native_acc_out=True)
+        # numerics on this path is a closure constant, not a static arg
+        # (the grad program is plain jit); set_numerics_enabled drops
+        # the executable so the toggle rebuilds it. update_norm is not
+        # available here — the update happens in the host optimizer.
+        numerics_on = self._numerics_on
+        numerics_spec = self._numerics_spec
+        from deepspeed_tpu.telemetry.numerics import block_sq_norms
 
         def grad_fn(params, scale, batch, rng):
-            grads, loss, aux, gnorm, finite = grad_core(params, scale,
-                                                        batch, rng)
-            return grads, {"loss": loss, "grad_norm": gnorm,
-                           "finite": finite, **aux}
+            grads, loss, aux, gnorm, finite, bstats = grad_core(
+                params, scale, batch, rng, want_numerics=numerics_on)
+            out = {"loss": loss, "grad_norm": gnorm,
+                   "finite": finite, **aux}
+            if numerics_on:
+                out["_numerics"] = {
+                    "grad_norm": jnp.sqrt(bstats["grad_sq"]),
+                    "param_norm": jnp.sqrt(
+                        block_sq_norms(params, numerics_spec)),
+                    "nonfinite": bstats["nonfinite"],
+                }
+            return grads, out
 
         batch_sh = self._batch_sharding(batch)
         param_in_sh = self._state_shardings.params
@@ -1102,9 +1226,12 @@ class DeepSpeedEngine:
         if self._offload_grad_stage:
             params_in = jax.device_put(params_in,
                                        self._device_param_shardings)
+        t_disp = time.perf_counter()
         grads, metrics = self._offload_grad_fn(
             params_in, jnp.float32(scale), batch, rng)
-        finite = bool(metrics["finite"])
+        finite = bool(metrics["finite"])   # host sync — grads are ready
+        self._offload_device_s = time.perf_counter() - t_disp
+        numer = metrics.pop("_numerics", None)
         lr = float(self.lr_scheduler(self.state.step))
         skipped = fp16 and not finite
         if not skipped:
@@ -1134,9 +1261,13 @@ class DeepSpeedEngine:
             # exact same dynamics as the device path: reuse precision.py
             self._host_loss_scale = update_loss_scale(
                 self._host_loss_scale, jnp.bool_(finite))
-            self.skipped_steps += int(skipped)
+            if skipped:
+                self._count_overflow_skip()
         self.global_steps += 1
         self._micro_steps += self.gas
+        self._last_grad_norm = metrics.get("grad_norm")
+        if numer is not None:
+            self._observe_numerics(numer, metrics["loss"])
         self.tput_timer.stop(global_step=self.global_steps,
                              report_speed=True)
         self._record_step_progress()
@@ -1157,8 +1288,11 @@ class DeepSpeedEngine:
         ``train_batch_size`` (= micro * gas * dp). Returns metrics with the
         mean loss — the analog of forward/backward/step over ``gas``
         micro-batches (SURVEY §3.2)."""
+        t_wall = time.perf_counter()   # goodput: the step wall interval
+        data_wait = 0.0
         if batch is None:
             batch = next(self.training_dataloader)
+            data_wait = time.perf_counter() - t_wall
         batch = self._global_micro_batch(batch)
         leading = jax.tree.leaves(batch)[0].shape[0]
         expected = self.micro_batch_size * self.gas * \
@@ -1176,6 +1310,9 @@ class DeepSpeedEngine:
             out = self._offload_train_batch(batch)
             self._maybe_swap_params_out()
             self._last_skipped = out.get("skipped")
+            self.goodput.record_step(
+                time.perf_counter() - t_wall, data_wait,
+                getattr(self, "_offload_device_s", 0.0))
             return out
         if (self._sparse_grad_axes and self._step_fn is not None and
                 tuple(tuple(x.shape) for x in jax.tree.leaves(batch))
@@ -1216,11 +1353,20 @@ class DeepSpeedEngine:
                 # warm() lands the executable in the compile watch's
                 # cache, so the dispatch below reuses it (one compile
                 # total) and cost analysis later is free
-                self._step_fn.warm(self.state, batch, rng)
+                self._step_fn.warm(self.state, batch, rng,
+                                   self._numerics_on)
             self.flops_profiler.start_profile()
         t_step = (time.perf_counter()
                   if self.config.wall_clock_breakdown else None)
-        self.state, metrics = self._step_fn(self.state, batch, rng)
+        t_disp = time.perf_counter()
+        self.state, metrics = self._step_fn(self.state, batch, rng,
+                                            self._numerics_on)
+        device_s = 0.0
+        if self.goodput.enabled:
+            # the goodput device bucket IS this sync: dispatch → outputs
+            # ready (the documented cost of telemetry.goodput)
+            jax.block_until_ready(metrics)
+            device_s = time.perf_counter() - t_disp
         if t_step is not None and self.global_steps > 0 and \
                 (self.global_steps + 1) % self.config.steps_per_print == 0:
             # wall_clock_breakdown (reference EngineTimers): the fused
@@ -1256,7 +1402,8 @@ class DeepSpeedEngine:
             # executable (the step that just ran) — its normalized
             # cost comes back without a second compile, and is BY
             # CONSTRUCTION the same number compile_report() shows
-            cost = self._step_fn.cost(self.state, batch, rng)
+            cost = self._step_fn.cost(self.state, batch, rng,
+                                      self._numerics_on)
             n_params = sum(int(np.prod(p.shape))
                            for p in jax.tree.leaves(self.state.params))
             breakdown = None
@@ -1278,16 +1425,22 @@ class DeepSpeedEngine:
                 flops=float(cost.get("flops", 0.0)), params=n_params,
                 module_breakdown=breakdown)
             self.flops_profiler.print_model_profile()
+        numer = metrics.pop("_numerics", None)
         self.global_steps += 1
         self._micro_steps += self.gas
         self._last_skipped = metrics.get("skipped")
+        self._last_grad_norm = metrics.get("grad_norm")
         if self.config.fp16.enabled and bool(metrics["skipped"]):
-            self.skipped_steps += 1
+            self._count_overflow_skip()
+        if numer is not None:
+            self._observe_numerics(numer, metrics["loss"])
         self.tput_timer.stop(global_step=self.global_steps,
                              report_speed=True)
         self._record_step_progress()
         if self.global_steps % self.config.steps_per_print == 0:
             self._write_monitor_events(metrics)
+        self.goodput.record_step(time.perf_counter() - t_wall,
+                                 data_wait, device_s)
         return metrics
 
     def _record_step_progress(self) -> None:
@@ -1299,6 +1452,31 @@ class DeepSpeedEngine:
                          step=self.global_steps)
         if self.watchdog is not None:
             self.watchdog.notify_progress()
+
+    def _count_overflow_skip(self) -> None:
+        """The one registration site for the overflow-skip counter —
+        all three skip paths (fused, offload, micro-batch step) share
+        it so name/help cannot drift."""
+        self.skipped_steps += 1
+        self.telemetry.counter(
+            "train_overflow_skips_total",
+            help="fp16 overflow-skipped optimizer steps (dynamic loss "
+                 "scale backed off)").inc()
+
+    def _observe_numerics(self, numer, loss) -> None:
+        """Feed one step's in-graph block arrays to the numerics watch —
+        the single device→host transfer numerics costs per step (the
+        loss float doubles as the spike-detector sample). Guarded:
+        observability must never kill a training step."""
+        try:
+            self.numerics.observe(
+                step=self.global_steps, loss=float(loss),
+                grad_norms=numer.get("grad_norm"),
+                param_norms=numer.get("param_norm"),
+                update_norms=numer.get("update_norm"),
+                nonfinite=numer.get("nonfinite"))
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"numerics observe failed: {e}")
 
     # ------------------------------------------------------------------
     # MoQ (runtime/quantize.py; reference _take_model_step engine.py:2078)
@@ -1480,8 +1658,9 @@ class DeepSpeedEngine:
             self._moq_boundary(self._last_micro_batch, overflow=overflow)
         self.global_steps += 1
         self._last_skipped = metrics.get("skipped")
+        self._last_grad_norm = metrics.get("grad_norm")
         if self.config.fp16.enabled and bool(metrics["skipped"]):
-            self.skipped_steps += 1
+            self._count_overflow_skip()
         return metrics
 
     def _build_grad_fn(self):
@@ -1589,7 +1768,54 @@ class DeepSpeedEngine:
         return self.global_steps * self.train_batch_size
 
     def get_global_grad_norm(self):
-        return None  # populated from metrics by callers
+        """Global gradient norm of the most recent step, or ``None``
+        before the first one (reference ``engine.get_global_grad_norm``).
+
+        Contract: the return value is always a host ``float`` (or
+        ``None``) — never a device array. The device→host conversion
+        happens HERE, once, when the caller asks; handing back the raw
+        metrics array would instead trigger an implicit sync at whatever
+        logging/formatting site touches it first, which is exactly the
+        hidden-stall class the flight recorder exists to catch."""
+        g = self._last_grad_norm
+        if g is None:
+            return None
+        return float(g)
+
+    def set_numerics_enabled(self, enabled: bool) -> None:
+        """Toggle the in-graph numerics observatory at runtime
+        (``telemetry.numerics_enabled`` sets the initial state). The
+        flag is a static argument of the compiled step, so the toggle
+        costs exactly one retrace — attributed by the compile watch as
+        ``numerics_on: static:False -> static:True`` — and nothing when
+        toggled back (both executables stay cached). The ZeRO-Offload
+        gradient program bakes the flag as a closure constant instead
+        and is rebuilt on toggle."""
+        enabled = bool(enabled)
+        if enabled and not self._telemetry_on:
+            # telemetry.enabled=false isolates this engine from the
+            # process scrape surface; the watch would still write the
+            # process-global event ring and anomaly dump — refuse,
+            # mirroring the init-time gate
+            logger.warning(
+                "numerics requires telemetry.enabled — ignoring")
+            return
+        if enabled and (self._onebit_axes or self._sparse_grad_axes):
+            logger.warning(
+                "numerics is not supported on the explicit-DP "
+                "(1-bit/sparse) shard_map step — ignoring")
+            return
+        if enabled == self._numerics_on:
+            return
+        self._numerics_on = enabled
+        if getattr(self, "_offload_grad_fn", None) is not None:
+            self._offload_grad_fn = None
+
+    def set_goodput_enabled(self, enabled: bool) -> None:
+        """Toggle goodput accounting (host timers only — no retrace).
+        The device bucket costs one ``block_until_ready`` per step while
+        enabled (docs/observability.md)."""
+        self.goodput.enabled = bool(enabled)
 
     def zero_optimization_stage(self) -> int:
         return self.zero_stage
@@ -1788,6 +2014,10 @@ class DeepSpeedEngine:
         if getattr(self, "_flight", None) is not None:
             self._flight.close()
             self.watchdog = None
+        if getattr(self, "numerics", None) is not None:
+            from deepspeed_tpu.telemetry.numerics import (
+                unregister_numerics_watch)
+            unregister_numerics_watch("train", self.numerics)
 
     def fp32_master_params(self):
         """Consolidated fp32 weights (analog of
@@ -1970,7 +2200,8 @@ def initialize(args=None,
         engine = PipelineEngine(model, list(model_parameters), optimizer,
                                 micro_batches=micro, loss_fn=loss_fn,
                                 mesh=mesh,
-                                zero_stage=cfg.zero_config.stage)
+                                zero_stage=cfg.zero_config.stage,
+                                telemetry=getattr(cfg, "telemetry", None))
         return engine, optimizer, None, lr_scheduler
     if loss_fn is None:
         if model is None or not hasattr(model, "loss_fn"):

@@ -23,14 +23,22 @@ from deepspeed_tpu.telemetry.compile_watch import (WatchedFunction,
                                                    executable_cost,
                                                    watched_jit)
 from deepspeed_tpu.telemetry.config import TelemetryConfig
-from deepspeed_tpu.telemetry.events import (EventRing, get_event_ring,
+from deepspeed_tpu.telemetry.events import (EventRing, dump_ring,
+                                            get_event_ring,
                                             install_fault_dump,
                                             record_event, set_event_ring)
+from deepspeed_tpu.telemetry.goodput import GoodputMeter
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
                                               start_http_server)
 from deepspeed_tpu.telemetry.memory import (MemoryMonitor,
                                             get_memory_monitor,
                                             set_memory_monitor)
+from deepspeed_tpu.telemetry.numerics import (BlockSpec, NumericsWatch,
+                                              block_nonfinite_counts,
+                                              block_spec, block_sq_norms,
+                                              numerics_snapshot,
+                                              register_numerics_watch,
+                                              unregister_numerics_watch)
 from deepspeed_tpu.telemetry.registry import (DEFAULT_TIME_BUCKETS, Counter,
                                               Gauge, Histogram,
                                               MetricRegistry,
@@ -53,4 +61,9 @@ __all__ = [
     "compile_report", "all_watched", "executable_cost",
     "MemoryMonitor", "get_memory_monitor", "set_memory_monitor",
     "Watchdog",
+    # training numerics observatory + goodput accounting
+    "BlockSpec", "NumericsWatch", "block_spec", "block_sq_norms",
+    "block_nonfinite_counts", "numerics_snapshot",
+    "register_numerics_watch", "unregister_numerics_watch",
+    "GoodputMeter", "dump_ring",
 ]

@@ -223,7 +223,20 @@ class WatchedFunction:
         import jax
         flat, _ = jax.tree_util.tree_flatten_with_path(
             (args, dict(kwargs)))
-        return {self._path_str(p): _leaf_key(x) for p, x in flat}
+        out = {self._path_str(p): _leaf_key(x) for p, x in flat}
+        # static args are VALUE-keyed in the signature (_signature), so
+        # the retrace diff must see their values too — otherwise a
+        # static toggle (e.g. the engine's numerics flag) retraces with
+        # an empty attribution
+        for i in self._static_idx:
+            if i < len(args):
+                name = (self._arg_names[i] if i < len(self._arg_names)
+                        else f"args[{i}]")
+                out[name] = ("static", repr(args[i]))
+        for n in self._static_names:
+            if n in kwargs:
+                out[n] = ("static", repr(kwargs[n]))
+        return out
 
     def _summarize(self, args, kwargs) -> str:
         """Per-argument aval summary: small args spelled out, big trees
@@ -334,6 +347,20 @@ class WatchedFunction:
                     index=rec.index, degraded=degraded)
         return rec
 
+    def _dynamic_only(self, args, kwargs):
+        """Args/kwargs with the statics stripped — ``Compiled.__call__``
+        takes only the dynamic arguments (statics were burned into the
+        executable at lower time); passing them through raises a pytree
+        mismatch and would silently degrade the watch to plain-jit
+        dispatch (plus a second compile)."""
+        if not self._static_idx and not self._static_names:
+            return args, kwargs
+        dyn = tuple(a for i, a in enumerate(args)
+                    if i not in self._static_idx)
+        dkw = {k: v for k, v in kwargs.items()
+               if k not in self._static_names}
+        return dyn, dkw
+
     def __call__(self, *args, **kwargs):
         key = self._signature(args, kwargs)
         rec = self._execs.get(key)
@@ -344,8 +371,9 @@ class WatchedFunction:
                     rec = self._compile(key, args, kwargs)
         rec.calls += 1
         if rec.compiled is not None:
+            dyn_args, dyn_kwargs = self._dynamic_only(args, kwargs)
             try:
-                out = rec.compiled(*args, **kwargs)
+                out = rec.compiled(*dyn_args, **dyn_kwargs)
                 rec.succeeded = True
                 return out
             except Exception:  # noqa: BLE001 — see the gate below

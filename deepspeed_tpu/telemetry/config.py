@@ -35,6 +35,26 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # periodic jax.live_arrays() accounting (telemetry/memory.py):
     # snapshot cadence in seconds; None = on-demand only (/debug/memory)
     memory_interval_s: Optional[float] = None
+    # training numerics observatory (telemetry/numerics.py): in-graph
+    # per-layer-block grad/param/update norms + non-finite provenance +
+    # the loss-spike detector. Off by default: enabling adds the block
+    # reductions to the step program (one retrace to toggle) and one
+    # small device->host transfer per step.
+    numerics_enabled: bool = False
+    # path-prefix depth that defines one layer block (1 = each top-level
+    # param subtree; flax transformer trees usually want the depth that
+    # isolates one layer, e.g. 2 for params/h_0/...)
+    numerics_block_depth: int = 1
+    # loss-spike detector: rolling window length (median+MAD over the
+    # last N losses) and the MAD-multiple that counts as a spike;
+    # threshold null disables spike detection (provenance still runs)
+    numerics_spike_window: int = 64
+    numerics_spike_threshold: Optional[float] = 6.0
+    # goodput accounting (telemetry/goodput.py): split every train-step
+    # wall interval into data-wait / device / host buckets.
+    # Off by default: the device bucket costs one block_until_ready per
+    # step (trades async step pipelining for the honest split).
+    goodput: bool = False
 
     @field_validator("http_port")
     @classmethod
@@ -57,4 +77,30 @@ class TelemetryConfig(DeepSpeedConfigModel):
             raise ValueError(
                 f"{info.field_name} must be > 0 seconds (or null to "
                 f"disable), got {v}")
+        return v
+
+    @field_validator("numerics_block_depth")
+    @classmethod
+    def _valid_depth(cls, v):
+        if v < 1:
+            raise ValueError(
+                f"numerics_block_depth must be >= 1, got {v}")
+        return v
+
+    @field_validator("numerics_spike_window")
+    @classmethod
+    def _valid_window(cls, v):
+        if v < 8:
+            raise ValueError(
+                "numerics_spike_window must be >= 8 (median+MAD over "
+                f"fewer losses is noise), got {v}")
+        return v
+
+    @field_validator("numerics_spike_threshold")
+    @classmethod
+    def _valid_threshold(cls, v):
+        if v is not None and v <= 0:
+            raise ValueError(
+                "numerics_spike_threshold must be > 0 MAD-multiples "
+                f"(or null to disable spike detection), got {v}")
         return v

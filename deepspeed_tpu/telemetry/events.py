@@ -37,6 +37,8 @@ CHECKPOINT = "checkpoint"
 STEP_BEGIN = "step_begin"
 STEP_END = "step_end"
 WATCHDOG_DUMP = "watchdog_dump"
+NUMERICS_NONFINITE = "numerics_nonfinite"
+LOSS_SPIKE = "loss_spike"
 
 
 class EventRing:
@@ -123,6 +125,15 @@ def set_event_ring(ring: EventRing) -> EventRing:
 def record_event(kind: str, **data: Any) -> None:
     """Record into the process-wide ring."""
     _default_ring.record(kind, **data)
+
+
+def dump_ring(path: str, reason: str,
+              extra: Optional[Dict[str, Any]] = None) -> None:
+    """Write the process ring to ``path`` now — the on-demand sibling of
+    the fault hooks (the numerics watch freezes the event window that led
+    into a loss spike this way). Best-effort: a forensic dump must never
+    throw into a step path."""
+    _dump_to_path(get_event_ring(), path, reason, extra=extra)
 
 
 # --------------------------------------------------------------- fault dump

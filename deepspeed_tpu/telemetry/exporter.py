@@ -13,6 +13,9 @@ Routes:
   ``/debug/memory``  live-array accounting by component
                      (telemetry/memory.py; snapshots on request)
   ``/debug/compile`` compile_report() text (telemetry/compile_watch.py)
+  ``/debug/numerics`` training numerics watches — per-block norms,
+                     non-finite provenance, loss-spike state
+                     (telemetry/numerics.py)
 """
 from __future__ import annotations
 
@@ -66,10 +69,17 @@ class TelemetryHTTPServer:
                         compile_report
                     body = compile_report().encode()
                     ctype = "text/plain; charset=utf-8"
+                elif path == "/debug/numerics":
+                    from deepspeed_tpu.telemetry.numerics import \
+                        numerics_snapshot
+                    body = json.dumps(numerics_snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404, "unknown path (try /metrics, "
                                     "/metrics.json, /debug/events, "
-                                    "/debug/memory, /debug/compile)")
+                                    "/debug/memory, /debug/compile, "
+                                    "/debug/numerics)")
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)

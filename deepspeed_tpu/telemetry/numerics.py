@@ -1,0 +1,392 @@
+"""Training numerics observatory: in-graph per-block statistics + host watch.
+
+The training engine computes loss/grad_norm/loss_scale inside ONE jitted
+step — by the time a run has diverged, the only question that matters
+("which layer went NaN first?") is unanswerable from the scalars it
+surfaces. This module is the divergence-debugging layer
+(docs/observability.md "Training numerics & goodput"):
+
+* **In-graph block statistics** — the param tree is grouped into *layer
+  blocks* (path-prefix grouping, :func:`block_spec`), and the jitted
+  step — when ``telemetry.numerics_enabled`` arms it — also emits
+  per-block grad-norm / param-norm / update-norm and a **non-finite
+  provenance** count per block. Everything is computed inside the
+  existing step program: no per-tensor host round-trips, and toggling
+  costs exactly one retrace (a static argument flip the compile watch
+  attributes by name).
+* **Host watch** (:class:`NumericsWatch`) — consumes the per-step block
+  arrays (one small device→host transfer per step), publishes per-block
+  gauges, names the first block whose grads went NaN/Inf (event ring +
+  ``/debug/numerics``), and runs the **loss-spike / divergence
+  detector**: rolling median + MAD over recent losses; a loss outside
+  ``threshold × MAD`` (or a non-finite loss/grad) flips the
+  ``train_numerics_anomaly`` gauge and fires a flight-recorder event
+  dump instead of silently training into garbage.
+
+Import cost: jax is imported lazily inside the in-graph helpers, so the
+host watch (and ``/debug/numerics``) stay usable from config parsing and
+the scrape thread alike.
+"""
+from __future__ import annotations
+
+import statistics
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import deepspeed_tpu.telemetry.events as _ev
+from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------------
+# block grouping (host, trace-time)
+# ---------------------------------------------------------------------------
+
+class BlockSpec:
+    """Static grouping of a pytree's leaves into named layer blocks.
+
+    Built once per engine from the param tree structure (host side, at
+    trace time); the in-graph helpers below consume it as a compile-time
+    constant, so the grouping costs nothing on device.
+    """
+    __slots__ = ("names", "leaf_block")
+
+    def __init__(self, names: Tuple[str, ...], leaf_block: Tuple[int, ...]):
+        self.names = tuple(names)
+        self.leaf_block = tuple(leaf_block)
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def __repr__(self) -> str:
+        return (f"BlockSpec({len(self.names)} blocks over "
+                f"{len(self.leaf_block)} leaves)")
+
+
+def block_spec(tree, depth: int = 1) -> BlockSpec:
+    """Group ``tree``'s leaves by their first ``depth`` path components.
+
+    ``depth=1`` makes every top-level child one block (``{"blk0": ...,
+    "blk1": ...}`` → blocks ``blk0``, ``blk1``); deeper trees (flax
+    ``transformer/h_0/...`` layouts) pick the depth that isolates one
+    transformer layer per block via ``telemetry.numerics_block_depth``.
+    Leaves shallower than ``depth`` group under their full path.
+    """
+    if depth < 1:
+        raise ValueError(f"block depth must be >= 1, got {depth}")
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names: List[str] = []
+    index: Dict[str, int] = {}
+    leaf_block: List[int] = []
+    for path, _leaf in flat:
+        parts = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        name = "/".join(parts[:depth]) if parts else "<root>"
+        if name not in index:
+            index[name] = len(names)
+            names.append(name)
+        leaf_block.append(index[name])
+    return BlockSpec(tuple(names), tuple(leaf_block))
+
+
+def _check_leaves(spec: BlockSpec, leaves) -> None:
+    if len(leaves) != len(spec.leaf_block):
+        raise ValueError(
+            f"tree has {len(leaves)} leaves but the block spec was built "
+            f"over {len(spec.leaf_block)} — numerics must be computed on "
+            "the same tree structure the engine grouped")
+
+
+def block_sq_norms(tree, spec: BlockSpec):
+    """In-graph: per-block sum of squared elements (fp32) — ``[B]``.
+
+    Callers take ``sqrt`` once on the stacked vector; accumulating the
+    squares per block keeps this a pure reduction XLA fuses into the
+    surrounding step.
+    """
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(tree)
+    _check_leaves(spec, leaves)
+    sums = [jnp.float32(0.0)] * len(spec.names)
+    for b, leaf in zip(spec.leaf_block, leaves):
+        sums[b] = sums[b] + jnp.sum(
+            jnp.square(jnp.asarray(leaf).astype(jnp.float32)))
+    return jnp.stack(sums)
+
+
+def block_nonfinite_counts(tree, spec: BlockSpec):
+    """In-graph: per-block count of NaN/Inf elements — ``int32[B]``.
+
+    Run on the *pre-clip* gradients: a global-norm clip propagates one
+    block's NaN into every block, destroying provenance. Non-float
+    leaves (none in a param tree, but be safe) count zero.
+    """
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(tree)
+    _check_leaves(spec, leaves)
+    counts = [jnp.int32(0)] * len(spec.names)
+    for b, leaf in zip(spec.leaf_block, leaves):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            counts[b] = counts[b] + jnp.sum(
+                jnp.logical_not(jnp.isfinite(leaf))).astype(jnp.int32)
+    return jnp.stack(counts)
+
+
+# ---------------------------------------------------------------------------
+# host watch
+# ---------------------------------------------------------------------------
+
+class NumericsWatch:
+    """Per-step consumer of the in-graph block statistics.
+
+    One ``observe()`` per optimizer step (numerics-enabled engines only):
+    converts the stacked block arrays to numpy (the single device→host
+    transfer numerics costs per step), publishes per-block gauges,
+    attributes non-finite gradients to the first offending block, and
+    runs the rolling median+MAD loss-spike detector. Thread-safe: the
+    scrape endpoint snapshots while the training loop observes.
+    """
+
+    def __init__(self, block_names: Sequence[str],
+                 registry: Optional[MetricRegistry] = None,
+                 window: int = 64,
+                 threshold: Optional[float] = 6.0,
+                 source: str = "train",
+                 dump_path: Optional[str] = None):
+        self.block_names = tuple(str(n) for n in block_names)
+        self.registry = registry if registry is not None else get_registry()
+        self.window = max(int(window), 8)
+        self.threshold = (float(threshold)
+                          if threshold is not None and threshold > 0
+                          else None)
+        self.source = source
+        self.dump_path = dump_path
+        self._lock = threading.Lock()
+        self._losses: deque = deque(maxlen=self.window)
+        self.anomalies_total = 0
+        self.nonfinite_steps_total = 0
+        self._clean_steps = 0
+        self._anomaly_active = False
+        self._last: Optional[dict] = None
+        self._last_nonfinite: Optional[dict] = None
+        self._last_anomaly: Optional[dict] = None
+        self._anomaly_gauge().set(0.0)
+
+    # ------------------------------------------------------------ metrics
+
+    def _anomaly_gauge(self):
+        return self.registry.gauge(
+            "train_numerics_anomaly",
+            help="1 while the loss-spike/non-finite detector considers "
+                 "the run anomalous; re-arms to 0 after a full clean "
+                 "window (docs/observability.md)")
+
+    # ------------------------------------------------------------ observe
+
+    def observe(self, step: int, loss: float,
+                grad_norms=None, param_norms=None, update_norms=None,
+                nonfinite=None) -> Optional[str]:
+        """Record one step. Returns the anomaly reason (``"loss_spike"``,
+        ``"nonfinite_loss"``, ``"nonfinite_grads"``) or None."""
+        import numpy as np
+
+        def _host(x):
+            return None if x is None else np.asarray(x, np.float64)
+
+        g = _host(grad_norms)
+        p = _host(param_norms)
+        u = _host(update_norms)
+        nf = None if nonfinite is None else np.asarray(nonfinite, np.int64)
+        loss = float(loss)
+
+        blocks: List[dict] = []
+        for i, name in enumerate(self.block_names):
+            entry: dict = {"block": name}
+            if g is not None:
+                entry["grad_norm"] = float(g[i])
+                self.registry.gauge(
+                    "train_block_grad_norm",
+                    help="per-layer-block gradient norm (post-unscale, "
+                         "pre-clip) of the last numerics-enabled step",
+                    labels={"block": name}).set(float(g[i]))
+            if p is not None:
+                entry["param_norm"] = float(p[i])
+                self.registry.gauge(
+                    "train_block_param_norm",
+                    help="per-layer-block parameter norm (fp32 master) "
+                         "at the last numerics-enabled step",
+                    labels={"block": name}).set(float(p[i]))
+            if u is not None:
+                entry["update_norm"] = float(u[i])
+                ratio = (float(u[i]) / float(p[i])
+                         if p is not None and float(p[i]) > 0.0 else 0.0)
+                entry["update_ratio"] = ratio
+                self.registry.gauge(
+                    "train_block_update_ratio",
+                    help="per-layer-block optimizer-update norm / param "
+                         "norm (the lr-health signal) of the last "
+                         "numerics step",
+                    labels={"block": name}).set(ratio)
+            if nf is not None:
+                entry["nonfinite"] = int(nf[i])
+            blocks.append(entry)
+
+        reason: Optional[str] = None
+        first_bad: Optional[str] = None
+        if nf is not None:
+            bad = [i for i in range(len(self.block_names)) if nf[i] > 0]
+            self.registry.gauge(
+                "train_nonfinite_blocks",
+                help="blocks with NaN/Inf gradients at the last "
+                     "numerics-enabled step").set(float(len(bad)))
+            if bad:
+                first_bad = self.block_names[bad[0]]
+                reason = "nonfinite_grads"
+                with self._lock:
+                    self.nonfinite_steps_total += 1
+                    self._last_nonfinite = {
+                        "step": int(step), "block": first_bad,
+                        "blocks": {self.block_names[i]: int(nf[i])
+                                   for i in bad}}
+                self.registry.counter(
+                    "train_nonfinite_steps_total",
+                    help="steps whose gradients contained NaN/Inf "
+                         "(provenance in the event ring / "
+                         "/debug/numerics)").inc()
+                _ev.record_event(
+                    _ev.NUMERICS_NONFINITE, source=self.source,
+                    step=int(step), first_block=first_bad,
+                    blocks={self.block_names[i]: int(nf[i]) for i in bad})
+                logger.warning(
+                    "[numerics:%s] step %d: non-finite gradients first "
+                    "appear in block %r (%d block(s) affected)",
+                    self.source, step, first_bad, len(bad))
+
+        # ---- loss-spike / divergence detector (rolling median + MAD)
+        spike_stats: dict = {}
+        if not (loss == loss and abs(loss) != float("inf")):  # NaN/Inf
+            reason = reason or "nonfinite_loss"
+        else:
+            with self._lock:
+                hist = list(self._losses)
+            if self.threshold is not None and len(hist) >= 8:
+                med = statistics.median(hist)
+                mad = statistics.median([abs(h - med) for h in hist])
+                # 1.4826 ≈ MAD→σ for a normal window; the relative floor
+                # keeps a near-constant loss history from flagging float
+                # noise as divergence
+                scale = max(1.4826 * mad, 1e-3 * abs(med), 1e-12)
+                spike_stats = {"median": med, "mad": mad}
+                if abs(loss - med) > self.threshold * scale:
+                    reason = reason or "loss_spike"
+            with self._lock:
+                self._losses.append(loss)
+
+        if reason is not None:
+            with self._lock:
+                self.anomalies_total += 1
+                self._clean_steps = 0
+                self._anomaly_active = True
+                self._last_anomaly = {"step": int(step), "reason": reason,
+                                      "loss": loss, **spike_stats}
+            self._anomaly_gauge().set(1.0)
+            self.registry.counter(
+                "train_numerics_anomalies_total",
+                help="loss spikes + non-finite steps flagged by the "
+                     "numerics watch").inc()
+            if reason != "nonfinite_grads":   # grads already recorded
+                _ev.record_event(_ev.LOSS_SPIKE, source=self.source,
+                                 step=int(step), reason=reason, loss=loss,
+                                 **spike_stats)
+            # flight-recorder forensics: freeze the event window that led
+            # into the anomaly (next anomaly overwrites — newest wins)
+            if self.dump_path:
+                _ev.dump_ring(self.dump_path + ".anomaly",
+                              reason="numerics_" + reason,
+                              extra={"source": self.source,
+                                     "step": int(step), "loss": loss,
+                                     "first_block": first_bad,
+                                     **spike_stats})
+        else:
+            with self._lock:
+                self._clean_steps += 1
+                rearm = (self._anomaly_active and
+                         self._clean_steps >= self.window)
+                if rearm:
+                    self._anomaly_active = False
+            if rearm:
+                self._anomaly_gauge().set(0.0)
+
+        with self._lock:
+            self._last = {"step": int(step), "loss": loss,
+                          "blocks": blocks}
+        return reason
+
+    # ----------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """JSON-able state for ``/debug/numerics``."""
+        with self._lock:
+            hist = list(self._losses)
+            last = dict(self._last) if self._last else None
+            med = statistics.median(hist) if hist else None
+            out = {
+                "source": self.source,
+                "blocks": list(self.block_names),
+                "window": self.window,
+                "threshold": self.threshold,
+                "last": last,
+                "loss": {
+                    "n": len(hist),
+                    "median": med,
+                    "mad": (statistics.median(
+                        [abs(h - med) for h in hist]) if hist else None),
+                },
+                "anomaly": {
+                    # mirrors the train_numerics_anomaly gauge exactly:
+                    # set on anomaly, cleared only by a full clean window
+                    "active": int(self._anomaly_active),
+                    "total": self.anomalies_total,
+                    "last": self._last_anomaly,
+                },
+                "nonfinite": {
+                    "steps_total": self.nonfinite_steps_total,
+                    "last": self._last_nonfinite,
+                },
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# process-wide watch registry (the /debug/numerics surface)
+# ---------------------------------------------------------------------------
+
+_watch_lock = threading.Lock()
+_watches: Dict[str, NumericsWatch] = {}
+
+
+def register_numerics_watch(name: str, watch: NumericsWatch) -> None:
+    """Expose ``watch`` under ``name`` on ``/debug/numerics`` (newest
+    registration for a name wins — matches the memory monitor's
+    component semantics)."""
+    with _watch_lock:
+        _watches[name] = watch
+
+
+def unregister_numerics_watch(name: str, watch: NumericsWatch) -> None:
+    """Instance-matched removal: a newer engine's re-registration of the
+    same name survives an older engine's teardown."""
+    with _watch_lock:
+        if _watches.get(name) is watch:
+            del _watches[name]
+
+
+def numerics_snapshot() -> dict:
+    """All registered watches, by name — the ``/debug/numerics`` body."""
+    with _watch_lock:
+        items = list(_watches.items())
+    return {name: watch.snapshot() for name, watch in items}
