@@ -93,6 +93,19 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # admission control: submit() refuses beyond this many queued-but-
     # unscheduled requests instead of growing host memory unboundedly
     max_queued_requests: int = 128
+    # automatic prefix caching (vLLM-style): full block-aligned prompt
+    # prefixes are hash-indexed in the paged pool and reused across
+    # requests — a shared system/few-shot prompt prefills once. Implies
+    # chunked prefill (the tail prefill must start at the cached
+    # boundary); greedy outputs are token-identical either way.
+    enable_prefix_caching: bool = False
+    # Sarathi-style chunked prefill: prompts prefill in fixed chunks of
+    # this many tokens (one traced signature), interleaving ONE chunk
+    # with each decode step instead of stalling all resident slots for
+    # a long prompt. 0 = monolithic bucketed prefill (unless
+    # enable_prefix_caching, which defaults this to block_size). Must
+    # be a multiple of block_size.
+    prefill_chunk_tokens: int = 0
     # metrics registry + optional scrape endpoint (docs/observability.md);
     # the shared section schema lives in telemetry/config.py
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
@@ -135,6 +148,17 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     def model_post_init(self, _ctx) -> None:
         if self.mp_size != 1 and self.tensor_parallel.tp_size == 1:
             self.tensor_parallel.tp_size = self.mp_size
+        if self.prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0 (0 = monolithic "
+                f"prefill), got {self.prefill_chunk_tokens}")
+        if (self.prefill_chunk_tokens
+                and self.prefill_chunk_tokens % self.block_size):
+            # chunks scatter whole blocks through the table; a ragged
+            # chunk would straddle a block boundary mid-write
+            raise ValueError(
+                f"prefill_chunk_tokens ({self.prefill_chunk_tokens}) "
+                f"must be a multiple of block_size ({self.block_size})")
 
     @property
     def tp_size(self) -> int:

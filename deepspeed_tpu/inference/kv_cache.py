@@ -11,7 +11,9 @@ values plus per-sequence live ``lengths [B]``. Allocation is explicit
 """
 from __future__ import annotations
 
-from typing import Any
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
@@ -251,6 +253,66 @@ def paged_append_token(cache: PagedKVCache, layer: int, k: jnp.ndarray,
     return cache.replace(k=newk, v=newv)
 
 
+def paged_write_chunk(cache: PagedKVCache, layer: int, k: jnp.ndarray,
+                      v: jnp.ndarray, slot: jnp.ndarray,
+                      start: jnp.ndarray) -> PagedKVCache:
+    """Chunked prefill: scatter a C-token chunk's ``[C, H, D]`` k/v into
+    ``slot``'s blocks at logical positions ``start..start+C-1``. Both C
+    and ``start`` must be block-aligned (the chunk loop guarantees it:
+    chunks start at the block-aligned cached-prefix boundary and step by
+    a block-multiple chunk size), so the scatter is whole blocks — the
+    same shape contract as :func:`paged_write_prompt`, shifted by a
+    traced ``start``. Positions past the live prompt length hold
+    right-pad garbage (masked, later overwritten); table entries past
+    the allocated span are 0, so overshoot spills into the null block."""
+    BS = cache.block_size
+    C = k.shape[0]
+    nb = C // BS
+    row = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, 0)[0]
+    # pad with null-block entries so a chunk window running past the
+    # table tail spills into block 0 — dynamic_slice would otherwise
+    # CLAMP the start index and silently shift the write window onto
+    # earlier (possibly shared) blocks
+    row = jnp.concatenate([row, jnp.zeros((nb,), jnp.int32)])
+    idx = jax.lax.dynamic_slice_in_dim(row, start // BS, nb, 0)   # [nb]
+    newk = cache.k.at[layer, idx].set(
+        k.astype(cache.k.dtype).reshape(nb, BS, *k.shape[1:]))
+    newv = cache.v.at[layer, idx].set(
+        v.astype(cache.v.dtype).reshape(nb, BS, *v.shape[1:]))
+    return cache.replace(k=newk, v=newv)
+
+
+def paged_gather_slot_kv(cache: PagedKVCache, layer: int, slot: jnp.ndarray):
+    """Materialize ONE slot's cache ``[1, max_context, H, D]`` through
+    its block table — the chunk-attends-over-table gather (chunked
+    prefill needs only the prefilling slot's context, not the whole
+    pool's num_slots rows like :func:`paged_gather_kv`)."""
+    row = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, 0)[0]
+    k = cache.k[layer][row]        # [MB, BS, H, D]
+    v = cache.v[layer][row]
+    return (k.reshape(1, cache.max_context, *k.shape[2:]),
+            v.reshape(1, cache.max_context, *v.shape[2:]))
+
+
+def prefix_block_hashes(prompt, block_size: int) -> list:
+    """Chain hashes for every FULL block of a prompt: block i's hash is
+    ``sha256(hash_{i-1} || tokens[i*BS:(i+1)*BS])`` — a block matches
+    only under its entire preceding prefix, which is what makes reuse
+    position-safe (rotary k/v, learned positions and ALiBi all depend
+    on absolute position, and a chained full-prefix match pins it).
+    sha256 because a collision would silently serve another prompt's
+    context; the cost is a few microseconds per admission."""
+    n = len(prompt) // block_size
+    out, prev = [], b""
+    for i in range(n):
+        span = prompt[i * block_size:(i + 1) * block_size]
+        h = hashlib.sha256(
+            prev + b"," + ",".join(map(str, span)).encode()).digest()
+        out.append(h)
+        prev = h
+    return out
+
+
 def paged_gather_kv(cache: PagedKVCache, layer: int):
     """Materialize per-slot caches ``[S, max_context, H, D]`` through the
     block tables — the pure-JAX decode fallback (CPU / ALiBi / windowed).
@@ -271,33 +333,159 @@ def paged_advance(cache: PagedKVCache, active: jnp.ndarray) -> PagedKVCache:
 
 
 class BlockAllocator:
-    """Host-side free-list over pool blocks 1..num_blocks-1 (block 0 is
-    the reserved null block). The analog of the reference's free-HBM
-    workspace bookkeeping (inference_context.h), except recycling is
-    per-block: an EOS'd sequence's blocks return here and are re-handed
-    to a queued request without any device reallocation or retrace."""
+    """Host-side refcounted free-list over pool blocks 1..num_blocks-1
+    (block 0 is the reserved null block). The analog of the reference's
+    free-HBM workspace bookkeeping (inference_context.h), except
+    recycling is per-block: an EOS'd sequence's blocks return here and
+    are re-handed to a queued request without any device reallocation or
+    retrace.
 
-    def __init__(self, num_blocks: int):
+    Prefix caching (vLLM-style automatic block reuse): a FULL block that
+    covers an immutable block-aligned prompt prefix can be registered
+    under its chain hash (hash of its token span, chained on the
+    previous block's hash — see :meth:`register_prefix`). A later
+    request whose prompt shares that exact prefix takes the block by
+    refcount (:meth:`match_prefix`) instead of allocating + prefilling
+    it. Released cached blocks (refcount 0) are NOT returned to the
+    free list — they park in an LRU of evictable blocks and are evicted
+    (hash dropped, memory reused) only when an allocation outruns the
+    free list. Copy-on-write is never needed: only full, never-again-
+    written prefix blocks are ever registered (decode appends at
+    ``lengths >= prompt_len``, beyond every cached block).
+
+    The free list is a stack (pop → low ids) with a set shadow for O(1)
+    membership, so ``release`` stays O(len(blocks)) — the r5 linear
+    ``b in self._free`` scan made it O(n²) per sequence."""
+
+    def __init__(self, num_blocks: int, enable_prefix_caching: bool = False):
         if num_blocks < 2:
             raise ValueError(
                 f"need >= 2 pool blocks (1 usable + the null block), "
                 f"got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.enable_prefix_caching = enable_prefix_caching
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> low ids
+        self._free_set = set(self._free)
+        self._refcount: Dict[int, int] = {}       # live blocks only
+        # prefix cache index: chain hash <-> block id, plus the LRU of
+        # evictable (refcount-0 but content-retained) cached blocks in
+        # release order — eviction pops the oldest
+        self._hash_to_block: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: immediately free + evictable cached."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def usable_blocks(self) -> int:
+        """Total pool capacity (excludes the reserved null block)."""
+        return self.num_blocks - 1
+
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently holding a reusable hashed prefix (resident
+        shared + evictable LRU)."""
+        return len(self._hash_to_block)
+
+    @property
+    def live_blocks(self) -> int:
+        """DISTINCT blocks held by resident sequences — a shared prefix
+        block counts once however many sequences hold it, so
+        ``live + free == usable`` always."""
+        return len(self._refcount)
+
+    def _pop_free(self) -> int:
+        if self._free:
+            b = self._free.pop()
+            self._free_set.discard(b)
+            return b
+        # free list dry: evict the least-recently-released cached block
+        # — its content is gone for good (the hash index forgets it), so
+        # a later identical prefix re-prefills and re-registers
+        b, _ = self._lru.popitem(last=False)
+        self._drop_hash(b)
+        return b
+
+    def _drop_hash(self, b: int) -> None:
+        h = self._block_hash.pop(b, None)
+        if h is not None and self._hash_to_block.get(h) == b:
+            del self._hash_to_block[h]
 
     def allocate(self, n: int):
-        """``n`` block ids, or None (caller queues) when short."""
-        if n > len(self._free):
+        """``n`` fresh block ids (refcount 1 each), or None (caller
+        queues) when even eviction cannot cover the span."""
+        if n > self.free_blocks:
             return None
-        return [self._free.pop() for _ in range(n)]
+        out = [self._pop_free() for _ in range(n)]
+        for b in out:
+            self._refcount[b] = 1
+        return out
 
     def release(self, blocks) -> None:
+        """Drop one reference per block. A block reaching refcount 0
+        returns to the free list — unless it holds a registered prefix,
+        in which case it parks in the evictable LRU (content retained
+        for future :meth:`match_prefix` hits, memory reclaimable)."""
         for b in blocks:
             if b == 0:
                 raise ValueError("block 0 is the reserved null block")
-            if b in self._free:
+            if b in self._free_set or b in self._lru:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            ref = self._refcount.get(b, 0)
+            if ref <= 0:
+                raise ValueError(f"double free of block {b}")
+            if ref > 1:
+                self._refcount[b] = ref - 1
+                continue
+            del self._refcount[b]
+            if b in self._block_hash:
+                self._lru[b] = None
+            else:
+                self._free.append(b)
+                self._free_set.add(b)
+
+    # ------------------------------------------------------- prefix cache
+
+    def match_prefix(self, hashes) -> list:
+        """Walk a prompt's chain hashes in prefix order, acquiring every
+        consecutive hit (refcount++ on resident blocks, resurrection out
+        of the LRU for evictable ones). Stops at the first miss — a
+        deeper block is only valid under its full prefix chain. Returns
+        the acquired block ids; the caller allocates the tail and, on
+        tail-allocation failure, must ``release`` these."""
+        out = []
+        for h in hashes:
+            b = self._hash_to_block.get(h)
+            if b is None:
+                break
+            if b in self._lru:
+                del self._lru[b]
+                self._refcount[b] = 1
+            else:
+                self._refcount[b] = self._refcount[b] + 1
+            out.append(b)
+        return out
+
+    def register_prefix(self, block: int, h: bytes) -> bool:
+        """Publish a live, fully-written prefix block under its chain
+        hash. First writer wins: if the hash is already claimed (a
+        concurrent identical prefill), this block stays private and
+        recycles normally. Returns True when registered."""
+        if not self.enable_prefix_caching:
+            return False
+        if self._refcount.get(block, 0) <= 0:
+            raise ValueError(
+                f"register_prefix on non-live block {block} — only a "
+                "resident sequence's own blocks can be published")
+        if h in self._hash_to_block or block in self._block_hash:
+            return False
+        self._hash_to_block[h] = block
+        self._block_hash[block] = h
+        return True
+
+    def block_hash(self, block: int):
+        """The chain hash a block is registered under, or None."""
+        return self._block_hash.get(block)

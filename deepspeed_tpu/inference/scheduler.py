@@ -29,7 +29,8 @@ import dataclasses
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
-from deepspeed_tpu.inference.kv_cache import BlockAllocator
+from deepspeed_tpu.inference.kv_cache import (BlockAllocator,
+                                              prefix_block_hashes)
 from deepspeed_tpu.telemetry import MetricRegistry, get_registry
 
 
@@ -40,10 +41,20 @@ class Request:
     prompt: List[int]
     max_new_tokens: int = 32
     eos_token_id: Optional[int] = None
+    # memoized chain hashes of the prompt's full blocks — a blocked
+    # queue head is re-tried every step and must not re-sha256 its
+    # (possibly 100k-token) prompt each time
+    _hashes: Optional[List[bytes]] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def blocks_needed(self, block_size: int) -> int:
         span = len(self.prompt) + self.max_new_tokens
         return -(-span // block_size)   # ceil
+
+    def prefix_hashes(self, block_size: int) -> List[bytes]:
+        if self._hashes is None:
+            self._hashes = prefix_block_hashes(self.prompt, block_size)
+        return self._hashes
 
 
 @dataclasses.dataclass
@@ -54,6 +65,11 @@ class SlotState:
     generated: List[int] = dataclasses.field(default_factory=list)
     pending: int = 0        # last committed token, next decode input
     arrived_step: int = 0   # decode-step clock at admission (telemetry)
+    # prefix caching: leading blocks taken from the cache (no prefill
+    # compute, refcounted — NOT private to this sequence), and the full
+    # prompt blocks' chain hashes for post-prefill registration
+    cached_blocks: int = 0
+    prompt_hashes: List[bytes] = dataclasses.field(default_factory=list)
 
 
 class Scheduler:
@@ -62,15 +78,20 @@ class Scheduler:
 
     def __init__(self, num_slots: int, num_blocks: int, block_size: int,
                  max_blocks_per_slot: int, max_queued_requests: int,
-                 registry: Optional[MetricRegistry] = None):
+                 registry: Optional[MetricRegistry] = None,
+                 enable_prefix_caching: bool = False):
         self.num_slots = num_slots
         self.block_size = block_size
         self.max_blocks_per_slot = max_blocks_per_slot
         self.max_queued_requests = max_queued_requests
-        self.allocator = BlockAllocator(num_blocks)
+        self.enable_prefix_caching = enable_prefix_caching
+        self.allocator = BlockAllocator(
+            num_blocks, enable_prefix_caching=enable_prefix_caching)
         self.queue: Deque[Request] = deque()
         self.slots: Dict[int, SlotState] = {}   # slot id -> state
         self._free_slots = list(range(num_slots - 1, -1, -1))
+        self.prefix_hits = 0      # host mirrors of the registry counters
+        self.prefix_misses = 0    # (stats without a snapshot round-trip)
         reg = registry or get_registry()
         self.telemetry = reg
         self._g_free = reg.gauge("serve_kv_free_blocks",
@@ -81,15 +102,31 @@ class Scheduler:
                                   help="queued-but-unscheduled requests")
         self._g_active = reg.gauge("serve_active_slots",
                                    help="resident (live) sequences")
+        self._g_cached = reg.gauge(
+            "serve_prefix_cached_blocks",
+            help="pool blocks holding a reusable hashed prefix "
+                 "(resident shared + evictable LRU)")
+        self._c_hits = reg.counter(
+            "serve_prefix_cache_hits_total",
+            help="prompt prefix blocks reused from the cache at "
+                 "admission (each hit skips one block of prefill "
+                 "compute and allocates no HBM)")
+        self._c_misses = reg.counter(
+            "serve_prefix_cache_misses_total",
+            help="cacheable prompt prefix blocks NOT found at "
+                 "admission (prefilled cold)")
         self._update_gauges()
 
     def _update_gauges(self) -> None:
         """Refresh level gauges at every admission-state transition —
         pool pressure is readable between steps, not just at drain."""
         self._g_free.set(self.allocator.free_blocks)
-        self._g_used.set(self._resident_blocks())
+        # DISTINCT blocks (allocator view): a shared prefix block counts
+        # once however many slots hold it, so used + free == capacity
+        self._g_used.set(self.allocator.live_blocks)
         self._g_queue.set(len(self.queue))
         self._g_active.set(len(self.slots))
+        self._g_cached.set(self.allocator.cached_blocks)
 
     def _reject(self, reason: str) -> None:
         self.telemetry.counter(
@@ -115,15 +152,14 @@ class Scheduler:
                 f"of {self.block_size} tokens, but a slot holds at most "
                 f"{self.max_blocks_per_slot} (raise max_out_tokens or "
                 "lower the request budget)")
-        if nb >= self.allocator.free_blocks + self._resident_blocks() + 1:
+        if nb > self.allocator.usable_blocks:
             # block-budget admission: even a fully drained pool could not
-            # hold this request (the +1 excludes the null block the
-            # allocator never hands out)
+            # hold this request (usable_blocks excludes the null block
+            # the allocator never hands out)
             self._reject("pool")
             raise ValueError(
                 f"request {req.request_id} needs {nb} blocks but the "
-                f"whole pool holds "
-                f"{self.allocator.free_blocks + self._resident_blocks()} "
+                f"whole pool holds {self.allocator.usable_blocks} "
                 "— raise max_out_tokens / num_slots sizing")
         if len(self.queue) >= self.max_queued_requests:
             self._reject("queue_full")
@@ -134,27 +170,70 @@ class Scheduler:
         self.queue.append(req)
         self._g_queue.set(len(self.queue))
 
-    def _resident_blocks(self) -> int:
-        return sum(len(s.blocks) for s in self.slots.values())
-
     # ------------------------------------------------------------ admit
 
     def admit_next(self, step_clock: int = 0):
         """Pop the FIFO head into a free slot when its whole block span
-        fits the free list. Returns ``(slot, SlotState)`` or None."""
+        fits the free list. Returns ``(slot, SlotState)`` or None.
+
+        With prefix caching, the prompt's block-aligned prefix is
+        walked against the hash index first: every consecutive hit is
+        taken by refcount (no allocation, no prefill compute), and only
+        the tail span allocates. Reuse is capped one token short of the
+        prompt (``(len(prompt) - 1) // block_size`` blocks) — the
+        prefill must process at least the last prompt token to produce
+        the first output logits."""
         if not self.queue or not self._free_slots:
             return None
-        nb = self.queue[0].blocks_needed(self.block_size)
-        blocks = self.allocator.allocate(nb)
-        if blocks is None:
+        req = self.queue[0]
+        nb = req.blocks_needed(self.block_size)
+        hashes: List[bytes] = []
+        hits: List[int] = []
+        reusable = 0
+        if self.enable_prefix_caching:
+            hashes = req.prefix_hashes(self.block_size)
+            reusable = (len(req.prompt) - 1) // self.block_size
+            if nb - reusable > self.allocator.free_blocks:
+                # even an all-hit prefix couldn't cover the tail —
+                # skip the match/rollback refcount churn entirely
+                return None
+            hits = self.allocator.match_prefix(hashes[:reusable])
+        tail = self.allocator.allocate(nb - len(hits))
+        if tail is None:
+            if hits:   # roll the acquired hits back (refcount--)
+                self.allocator.release(hits)
             return None
-        req = self.queue.popleft()
+        self.queue.popleft()
+        if self.enable_prefix_caching:
+            # counted only on successful admission — a blocked head
+            # retried every step must not inflate the hit/miss story
+            self._c_hits.inc(len(hits))
+            self._c_misses.inc(reusable - len(hits))
+            self.prefix_hits += len(hits)
+            self.prefix_misses += reusable - len(hits)
         slot = self._free_slots.pop()
-        state = SlotState(request=req, blocks=blocks,
-                          arrived_step=step_clock)
+        state = SlotState(request=req, blocks=hits + tail,
+                          arrived_step=step_clock,
+                          cached_blocks=len(hits),
+                          prompt_hashes=hashes)
         self.slots[slot] = state
         self._update_gauges()
         return slot, state
+
+    def commit_prefix(self, state: SlotState) -> int:
+        """Publish a just-prefilled sequence's full prompt blocks into
+        the prefix-cache index (called by the server once the prefill
+        has written them — content must be valid before another request
+        can hit it). Cached hits are already registered; only the cold
+        tail's full blocks register here. Returns how many registered."""
+        n = 0
+        for i in range(state.cached_blocks, len(state.prompt_hashes)):
+            if self.allocator.register_prefix(state.blocks[i],
+                                              state.prompt_hashes[i]):
+                n += 1
+        if n:
+            self._g_cached.set(self.allocator.cached_blocks)
+        return n
 
     # ------------------------------------------------------------ recycle
 

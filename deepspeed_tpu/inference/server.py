@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +34,7 @@ from deepspeed_tpu.inference.kv_cache import (PagedKVCache,
                                               init_paged_cache)
 from deepspeed_tpu.inference.scheduler import Request, Scheduler
 from deepspeed_tpu.model_implementations.transformer import (
-    paged_decode_step, paged_prefill)
+    paged_decode_step, paged_prefill, paged_prefill_chunk)
 from deepspeed_tpu.telemetry import (MetricRegistry, ProfilerCapture,
                                      get_event_ring, get_registry,
                                      start_http_server, watched_jit)
@@ -84,6 +85,13 @@ class ContinuousBatchingServer:
                 f"block ({self.block_size}) — raise max_out_tokens or "
                 "shrink block_size")
         self.max_blocks_per_slot = per_slot // self.block_size
+        # prefix caching implies chunked prefill: a cache-hit admission
+        # prefills only the tail, which needs the position-offset chunk
+        # signature — when the knob is unset, one-block chunks keep the
+        # skipped-compute win exact at block granularity
+        self.prefix_caching = cfg.enable_prefix_caching
+        self.chunk_tokens = cfg.prefill_chunk_tokens or (
+            self.block_size if cfg.enable_prefix_caching else 0)
         # telemetry: registry recording is always on (dict lookup + float
         # add per event); telemetry.enabled=False swaps in a private
         # registry, so cost is identical but nothing reaches the process
@@ -125,6 +133,15 @@ class ContinuousBatchingServer:
         self._g_occupancy = reg.gauge(
             "serve_slot_occupancy",
             help="live/num_slots at the last decode step")
+        self._h_prefill_chunk = reg.histogram(
+            "serve_prefill_chunk_seconds",
+            help="one chunked-prefill chunk (prefill_chunk_tokens "
+                 "tokens through the paged trunk)")
+        self._c_tail_reclaimed = reg.counter(
+            "serve_tail_blocks_reclaimed_total",
+            help="reserved-but-never-written tail blocks returned to "
+                 "the free list at retirement (budget the sequence "
+                 "EOSed before reaching)")
         self._submit_ts: Dict[int, float] = {}
         # +1: block 0 is the reserved null block idle slots write into
         num_blocks = 1 + self.num_slots * self.max_blocks_per_slot
@@ -133,7 +150,8 @@ class ContinuousBatchingServer:
             block_size=self.block_size,
             max_blocks_per_slot=self.max_blocks_per_slot,
             max_queued_requests=cfg.max_queued_requests,
-            registry=self.telemetry)
+            registry=self.telemetry,
+            enable_prefix_caching=self.prefix_caching)
         self._cache = self._make_pool(num_blocks)
         # flight recorder (telemetry/compile_watch.py): the serving jits
         # are watched, so a prompt shape that defeats the geometric
@@ -149,11 +167,30 @@ class ContinuousBatchingServer:
                               mesh=engine.mesh),
             name="serve_decode", registry=self.telemetry,
             donate_argnames=("cache",))
+        # the chunked-prefill program: ONE traced signature per
+        # (prefill_chunk_tokens, num_slots, block_size) config — start/
+        # slot/length ride as traced scalars, so neither prompt length
+        # nor cached-prefix depth ever retraces
+        self._chunk_jit = None
+        if self.chunk_tokens:
+            self._chunk_jit = watched_jit(
+                functools.partial(self._chunk_fn, cfg=mcfg,
+                                  mesh=engine.mesh),
+                name="serve_prefill_chunk", registry=self.telemetry,
+                static_argnames=(), donate_argnames=("cache",))
         self._results: Dict[int, List[int]] = {}
         self._next_id = 0
         self._step_clock = 0           # decode steps executed
         self._active_slot_steps = 0    # sum of live slots per decode step
         self._prefills = 0
+        self._prefill_chunks = 0       # chunk programs executed
+        self._prefill_token_units = 0  # tokens run through prefill compute
+        self._prefix_tokens_skipped = 0   # prompt tokens served from cache
+        self._tail_reclaimed = 0
+        # chunked prefills in flight, FIFO; at most ONE chunk runs per
+        # step() so a long prompt never stalls resident decoders
+        self._prefilling: Deque[dict] = deque()
+        self._mid_prefill: set = set()
         self._init_flight_recorder(tcfg)
 
     # ------------------------------------------------------------ setup
@@ -200,6 +237,13 @@ class ContinuousBatchingServer:
     def _decode_fn(params, tokens, cache, active, *, cfg, mesh):
         logits, cache = paged_decode_step(params, cfg, tokens, cache,
                                           active, mesh=mesh)
+        return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+    @staticmethod
+    def _chunk_fn(params, ids, start, length, cache, slot, *, cfg, mesh):
+        logits, cache = paged_prefill_chunk(params, cfg, ids, start,
+                                            length, cache, slot,
+                                            mesh=mesh)
         return jnp.argmax(logits, -1).astype(jnp.int32), cache
 
     def _make_pool(self, num_blocks: int) -> PagedKVCache:
@@ -267,10 +311,14 @@ class ContinuousBatchingServer:
                                 reason=reason, source="server")
 
     def _admit(self, finished: list) -> None:
-        """Prefill queued requests into free slots until blocks or slots
-        run out. One trace per prompt BUCKET (128·2^k, floored at
-        block_size), shared by every slot — `slot` rides as a traced
-        scalar."""
+        """Admit queued requests into free slots until blocks or slots
+        run out. Monolithic mode prefills inline — one trace per prompt
+        BUCKET (128·2^k, floored at block_size), shared by every slot
+        (`slot` rides as a traced scalar). Chunked mode
+        (prefill_chunk_tokens / prefix caching) only claims the slot and
+        installs its block table here; the prefill itself runs one
+        fixed-size chunk per ``step()`` via :meth:`_run_prefill_chunk`,
+        so a long prompt never stalls the resident decoders."""
         while True:
             adm = self.scheduler.admit_next(self._step_clock)
             if adm is None:
@@ -280,27 +328,40 @@ class ContinuousBatchingServer:
             t_admit = time.perf_counter()
             self._h_queue_wait.observe(
                 t_admit - self._submit_ts.get(req.request_id, t_admit))
-            # geometric bucket, floored at one block and clamped to the
-            # slot's whole block span (admission guarantees the prompt
-            # fits the span; the bucket may overshoot it — one ceiling
-            # shape, same move as engine._fit_to_budget)
-            T = min(max(_bucket(len(req.prompt)), self.block_size),
-                    self.max_blocks_per_slot * self.block_size)
-            ids = np.zeros((1, T), np.int32)
-            ids[0, :len(req.prompt)] = req.prompt
             # block table first — the prefill scatter reads it. Entries
-            # beyond the allocated span stay 0 (null block), so bucket
-            # padding past the span spills harmlessly.
+            # beyond the allocated span stay 0 (null block), so bucket/
+            # chunk padding past the span spills harmlessly.
             row = np.zeros((self.max_blocks_per_slot,), np.int32)
             row[:len(state.blocks)] = state.blocks
             self._cache = self._cache.replace(
                 block_tables=self._cache.block_tables.at[slot].set(
                     jnp.asarray(row)))
+            if self.chunk_tokens:
+                cached_len = state.cached_blocks * self.block_size
+                self._prefix_tokens_skipped += cached_len
+                # pin the slot's live length at the cached boundary NOW:
+                # decode steps that run before (or between) this slot's
+                # chunks append their masked garbage token at
+                # ``lengths[slot]`` — which must be the next PRIVATE
+                # position the coming chunk overwrites, never offset 0
+                # of a (possibly shared) prefix block
+                self._cache = self._cache.replace(
+                    lengths=self._cache.lengths.at[slot].set(cached_len))
+                self._prefilling.append(
+                    {"slot": slot, "state": state, "start": cached_len})
+                self._mid_prefill.add(slot)
+                continue
+            # ---------------- monolithic bucketed prefill (chunking off)
+            T = min(max(_bucket(len(req.prompt)), self.block_size),
+                    self.max_blocks_per_slot * self.block_size)
+            ids = np.zeros((1, T), np.int32)
+            ids[0, :len(req.prompt)] = req.prompt
             tok0, self._cache = self._prefill_jit(
                 self.engine.params, jnp.asarray(ids),
                 jnp.asarray([len(req.prompt)], jnp.int32), self._cache,
                 jnp.int32(slot))
             self._prefills += 1
+            self._prefill_token_units += T
             tok0 = int(np.asarray(tok0)[0])   # host sync: prefill done
             now = time.perf_counter()
             # prefill latency by PADDED bucket (the traced shape, not the
@@ -323,6 +384,55 @@ class ContinuousBatchingServer:
             if self._finished(state, tok0):
                 self._retire(slot, state, finished)
 
+    def _run_prefill_chunk(self, finished: list) -> None:
+        """Run AT MOST one chunk of the oldest in-flight chunked
+        prefill — the Sarathi-style interleave: each ``step()`` advances
+        one prefill by ``prefill_chunk_tokens`` tokens and then decodes
+        every active slot, so prefill latency is spread across steps
+        instead of stalling all residents for a whole prompt."""
+        if not self._prefilling:
+            return
+        job = self._prefilling[0]
+        slot, state = job["slot"], job["state"]
+        req = state.request
+        C = self.chunk_tokens
+        start = job["start"]
+        plen = len(req.prompt)
+        ids = np.zeros((1, C), np.int32)
+        valid = min(plen - start, C)
+        ids[0, :valid] = req.prompt[start:start + valid]
+        t0 = time.perf_counter()
+        tok, self._cache = self._chunk_jit(
+            self.engine.params, jnp.asarray(ids), jnp.int32(start),
+            jnp.asarray([plen], jnp.int32), self._cache, jnp.int32(slot))
+        self._prefill_chunks += 1
+        self._prefill_token_units += C
+        tok = np.asarray(tok)     # host sync: honest per-chunk timing
+        self._h_prefill_chunk.observe(time.perf_counter() - t0)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()   # a chunk IS progress
+        job["start"] = start + C
+        if job["start"] < plen:
+            return                # more chunks; logits were chunk-tail
+        # final chunk: the prompt is resident, the first token is real
+        self._prefilling.popleft()
+        self._mid_prefill.discard(slot)
+        if self.prefix_caching:
+            # publish the cold tail's full prompt blocks — only now is
+            # their content valid for another request to hit
+            self.scheduler.commit_prefix(state)
+        tok0 = int(tok[0])
+        now = time.perf_counter()
+        self._h_ttft.observe(
+            now - self._submit_ts.get(req.request_id, now))
+        self._c_prefills.inc()
+        self._c_tokens.inc()
+        self._prefills += 1
+        state.generated.append(tok0)
+        state.pending = tok0
+        if self._finished(state, tok0):
+            self._retire(slot, state, finished)
+
     def _finished(self, state, tok: int) -> bool:
         req = state.request
         return (tok == req.eos_token_id
@@ -337,6 +447,18 @@ class ContinuousBatchingServer:
         if ts is not None:
             self._h_request.observe(time.perf_counter() - ts)
         self._c_finished.inc()
+        # reserved-tail accounting: blocks allocated for budget the
+        # sequence EOSed before reaching were never written — they go
+        # straight back to the free list here (never into the prefix
+        # LRU: unwritten content is not cacheable), counted so early-EOS
+        # traffic's reclaimed headroom is visible
+        # cache holds prompt + all generated but the last (the final
+        # token is committed without ever being appended)
+        live = len(req.prompt) + max(len(state.generated) - 1, 0)
+        tail = max(0, len(state.blocks) - (-(-live // self.block_size)))
+        if tail:
+            self._c_tail_reclaimed.inc(tail)
+            self._tail_reclaimed += tail
         # slot + blocks recycle NOW: the freed span admits the next
         # queued request on the same step, without touching the trace.
         # The retired slot's length resets to 0 on the HOST array only —
@@ -349,11 +471,13 @@ class ContinuousBatchingServer:
 
     def step(self) -> List[int]:
         """One scheduler round: admit from the queue into free slots,
-        then one decode step for all resident slots. Returns the request
+        run at most ONE chunk of any in-flight chunked prefill, then one
+        decode step for all active resident slots. Returns the request
         ids finished this round (fetch outputs via ``result``/``drain``).
         """
         finished: List[int] = []
         self._admit(finished)
+        self._run_prefill_chunk(finished)
         if not self.scheduler.slots:
             if self.watchdog is not None:
                 # an IDLE server being polled is alive, not stalled —
@@ -364,8 +488,14 @@ class ContinuousBatchingServer:
         tokens = np.zeros((self.num_slots,), np.int32)
         active = np.zeros((self.num_slots,), bool)
         for slot, state in self.scheduler.slots.items():
+            if slot in self._mid_prefill:
+                continue   # resident but still prefilling: not decoded
             tokens[slot] = state.pending
             active[slot] = True
+        if not active.any():
+            # every resident slot is mid-prefill — the chunk above was
+            # this step's progress; nothing to decode yet
+            return finished
         self.profiler_capture.step_begin()
         t0 = time.perf_counter()
         nxt, self._cache = self._decode_jit(
@@ -393,6 +523,8 @@ class ContinuousBatchingServer:
                 seconds=round(dt, 6),
                 sampled_every=self._EVENT_EVERY)
         for slot in list(self.scheduler.slots):   # _retire mutates
+            if slot in self._mid_prefill:
+                continue   # not decoded this step; nothing to commit
             state = self.scheduler.slots[slot]
             tok = int(nxt[slot])
             state.generated.append(tok)
@@ -440,20 +572,34 @@ class ContinuousBatchingServer:
         of those units that carried a live sequence — the number
         continuous batching exists to push toward 1.0."""
         units = self._step_clock * self.num_slots
+        alloc = self.scheduler.allocator
         return {
             "decode_steps": self._step_clock,
             "prefills": self._prefills,
+            "prefill_chunks": self._prefill_chunks,
+            "prefill_token_units": self._prefill_token_units,
             "decode_step_slot_units": units,
             "active_slot_steps": self._active_slot_steps,
             "slot_occupancy": (self._active_slot_steps / units
                                if units else 0.0),
             "decode_traces": _safe_cache_size(self._decode_jit),
             "prefill_traces": _safe_cache_size(self._prefill_jit),
+            "chunk_traces": (_safe_cache_size(self._chunk_jit)
+                             if self._chunk_jit is not None else 0),
             "retraces": (
                 len(getattr(self._decode_jit, "retraces", ()))
-                + len(getattr(self._prefill_jit, "retraces", ()))),
+                + len(getattr(self._prefill_jit, "retraces", ()))
+                + (len(getattr(self._chunk_jit, "retraces", ()))
+                   if self._chunk_jit is not None else 0)),
             "num_slots": self.num_slots,
             "block_size": self.block_size,
-            "free_blocks": self.scheduler.allocator.free_blocks,
+            "free_blocks": alloc.free_blocks,
             "queued": self.scheduler.pending_requests,
+            "prefix_caching": self.prefix_caching,
+            "prefill_chunk_tokens": self.chunk_tokens,
+            "prefix_cache_hits": self.scheduler.prefix_hits,
+            "prefix_cache_misses": self.scheduler.prefix_misses,
+            "prefix_cached_blocks": alloc.cached_blocks,
+            "prefix_tokens_skipped": self._prefix_tokens_skipped,
+            "tail_blocks_reclaimed": self._tail_reclaimed,
         }

@@ -39,6 +39,8 @@ from deepspeed_tpu.inference.kv_cache import (KVCache, PagedKVCache, advance,
                                               append_token, paged_advance,
                                               paged_append_token,
                                               paged_gather_kv,
+                                              paged_gather_slot_kv,
+                                              paged_write_chunk,
                                               paged_write_prompt, write_chunk,
                                               write_prompt)
 from deepspeed_tpu.ops.int8_gemm import (maybe_int8_einsum,
@@ -524,6 +526,36 @@ def _chunk_attention(q, k_cache, v_cache, lengths,
                       ).astype(q.dtype)
 
 
+def _paged_chunk_attention(q, cache: PagedKVCache, layer_idx: int,
+                           cfg: InferenceTransformerConfig, slot, start,
+                           window=None):
+    """Chunked-prefill attention through the paged pool: ``q [1, C, H,
+    D]`` at absolute positions ``start..start+C-1`` attends the
+    prefilling slot's already-resident prefix (earlier chunks and
+    prefix-cache hits) plus the chunk itself, through the block table.
+    TPU fast path: the Pallas chunk kernel streams pool blocks via the
+    scalar-prefetched table. Fallback (CPU / ALiBi / windowed): gather
+    ONE slot's cache with XLA and reuse :func:`_chunk_attention` with
+    ``lengths = start`` — the identical per-query causal bound, so the
+    chunked path cannot diverge from the verify/dense math."""
+    C, H = q.shape[1], q.shape[2]
+    KH = cache.k.shape[3]
+    if cfg.positional != "alibi" and window is None \
+            and jax.default_backend() == "tpu" and H % KH == 0 \
+            and not cfg.seq_shard_kv:
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            paged_chunk_attention
+        row = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1,
+                                           0)[0]
+        return paged_chunk_attention(q[0], cache.k[layer_idx],
+                                     cache.v[layer_idx], row, start,
+                                     scale=cfg.scale)[None]
+    k_cache, v_cache = paged_gather_slot_kv(cache, layer_idx, slot)
+    return _chunk_attention(q, k_cache, v_cache,
+                            jnp.reshape(start, (1,)).astype(jnp.int32),
+                            cfg, window=window)
+
+
 # ---------------------------------------------------------------- blocks
 
 def _qkv(x, a, cfg, positions):
@@ -830,6 +862,72 @@ def paged_prefill(params, cfg: InferenceTransformerConfig, input_ids,
     cache = cache.replace(
         lengths=jax.lax.dynamic_update_index_in_dim(
             cache.lengths, length[0].astype(jnp.int32), slot, 0))
+    return _logits(params, cfg, last), cache
+
+
+def _block_chunk_paged(x, layer, cfg, cache: PagedKVCache, layer_idx,
+                       slot, start, mesh=None):
+    """Chunked-prefill block over the paged pool. x ``[1, C, E]`` at
+    absolute positions ``start..start+C-1``; scatters the chunk's k/v
+    into the slot's blocks, then attends over resident-prefix + chunk
+    through the block table."""
+    a = layer["attn"]
+    ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
+    h = ln1_out if cfg.pre_layer_norm else x
+    C = x.shape[1]
+    positions = start + jnp.arange(C)[None, :]               # [1, C]
+    q, k, v = _qkv(h, a, cfg, positions)
+    cache = paged_write_chunk(cache, layer_idx, k[0], v[0], slot, start)
+    window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
+    attn = _paged_chunk_attention(q, cache, layer_idx, cfg, slot, start,
+                                  window=window)
+    attn_out = maybe_int8_einsum("...hd,hde->...e", attn, a["wo"],
+                                 x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
+    return _post_attn(x, ln1_out, attn_out, layer, cfg, mesh), cache
+
+
+def paged_prefill_chunk(params, cfg: InferenceTransformerConfig,
+                        input_ids, start, length, cache: PagedKVCache,
+                        slot, mesh=None):
+    """One chunk of an incremental (Sarathi-style) prefill: run the
+    C-token chunk ``input_ids [1, C]`` at absolute positions
+    ``start..start+C-1`` through the trunk, scattering each layer's k/v
+    into slot ``slot``'s blocks and attending over the already-resident
+    prefix (earlier chunks, prefix-cache hits) through the block table.
+    Returns (next-token logits ``[1, V]``, cache).
+
+    ``start``/``slot`` are traced scalars and ``length [1]`` a traced
+    array, so ONE trace per (C, pool geometry) serves every chunk of
+    every prompt — the whole point vs the bucketed monolithic
+    :func:`paged_prefill` (log2 shapes) when prompts are long or
+    partially cached. ``lengths[slot]`` advances to
+    ``min(start + C, length)`` so interleaved decode steps for OTHER
+    slots see a consistent live bound (this slot stays inactive until
+    the final chunk); the logits are only meaningful on the final chunk
+    (the one containing position ``length - 1``) — earlier chunks
+    return the chunk-tail row, which the caller discards. Chunk
+    right-pad past ``length`` lands as masked garbage, overwritten by
+    the first decode appends — the standard bucket-padding invariant."""
+    if cfg.seq_shard_kv:
+        raise NotImplementedError(
+            "paged serving with a seq-sharded KV pool is unsupported — "
+            "the block pool is already the long-context memory lever")
+    B, C = input_ids.shape
+    positions = start + jnp.arange(C)[None, :]
+    x = _embed(params, cfg, input_ids, positions)
+    for i, layer in enumerate(params["layers"]):
+        x, cache = _block_chunk_paged(x, layer, cfg, cache, i, slot,
+                                      start, mesh)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    # the prompt's last token, when this chunk holds it; clamped to the
+    # chunk tail otherwise (discarded by the host loop)
+    li = jnp.clip(length[0] - 1 - start, 0, C - 1)
+    last = jnp.take_along_axis(x, jnp.reshape(li, (1, 1, 1)),
+                               axis=1)[:, 0]
+    new_len = jnp.minimum(start + C, length[0]).astype(jnp.int32)
+    cache = cache.replace(
+        lengths=jax.lax.dynamic_update_index_in_dim(
+            cache.lengths, new_len, slot, 0))
     return _logits(params, cfg, last), cache
 
 

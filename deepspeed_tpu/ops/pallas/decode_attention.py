@@ -227,6 +227,140 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     return og.reshape(S, H, D)
 
 
+def _paged_chunk_kernel(start_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_ref, l_ref, acc_ref, *, block_size: int,
+                        rep: int, scale: float):
+    """Chunked-prefill attention for ONE slot: grid (kv-head,
+    block-table entry). Queries are the in-flight C-token chunk at
+    absolute positions ``start..start+C-1``; keys stream out of the
+    paged pool through the scalar-prefetched block table, so the chunk
+    attends over the already-resident prefix (earlier chunks AND
+    prefix-cache hits) plus itself without ever materializing a
+    contiguous per-slot cache. Per-query causal bound: key position
+    ``col`` is visible to chunk query ``qi`` iff ``col <= start + qi``.
+    Online-softmax carry in VMEM scratch across the (innermost) block
+    axis — the same recurrence as :func:`_paged_decode_kernel`, with
+    the query dim widened from one token's head group to C·R rows."""
+    i = pl.program_id(1)
+    nb = pl.num_programs(1)
+    start = start_ref[0]
+    CR = q_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # blocks wholly beyond the chunk's last query are dead for every row
+    @pl.when(i * block_size <= start + CR // rep - 1)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale         # [CR, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)        # [BS, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        col = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (CR, block_size), 1)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (CR, block_size), 0) // rep
+        sc = jnp.where(col <= start + qi, sc, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nb - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
+                          v_pool: jax.Array, block_table: jax.Array,
+                          start: jax.Array,
+                          scale: float | None = None,
+                          interpret: bool | None = None) -> jax.Array:
+    """Chunked-prefill attention for one slot through the paged pool,
+    GQA-native.
+
+    q: ``[C, H, D]`` (the in-flight chunk, absolute positions
+    ``start..start+C-1``; the chunk's own k/v must already be written
+    into the pool); k_pool/v_pool: ``[NB, BS, KH, D]``; block_table:
+    ``[MB]`` int32 (the prefilling slot's row; dead entries must be
+    valid ids — the null block); start: scalar int32, block-aligned.
+    Returns ``[C, H, D]``.
+    """
+    C, H, D = q.shape
+    BS, KH = k_pool.shape[1], k_pool.shape[2]
+    MB = block_table.shape[0]
+    if H % KH:
+        raise ValueError(f"q heads {H} not divisible by kv heads {KH}")
+    R = H // KH
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [C, H, D] -> [KH, C*R, D]: rows grouped by the kv head they read,
+    # query index recoverable in-kernel as row // R
+    qg = q.reshape(C, KH, R, D).transpose(1, 0, 2, 3).reshape(KH, C * R, D)
+    kernel = functools.partial(_paged_chunk_kernel, block_size=BS,
+                               rep=R, scale=float(scale))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(KH, MB),
+        in_specs=[
+            pl.BlockSpec((1, C * R, D), lambda h, i, st, bt: (h, 0, 0)),
+            pl.BlockSpec((1, BS, 1, D), lambda h, i, st, bt:
+                         (bt[i], 0, h, 0)),
+            pl.BlockSpec((1, BS, 1, D), lambda h, i, st, bt:
+                         (bt[i], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, C * R, D), lambda h, i, st, bt:
+                               (h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C * R, 1), jnp.float32),
+            pltpu.VMEM((C * R, 1), jnp.float32),
+            pltpu.VMEM((C * R, D), jnp.float32),
+        ],
+    )
+    og = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((KH, C * R, D), q.dtype),
+        interpret=interpret,
+    )(jnp.reshape(start, (1,)).astype(jnp.int32),
+      block_table.astype(jnp.int32), qg, k_pool, v_pool)
+    return og.reshape(KH, C, R, D).transpose(1, 0, 2, 3).reshape(C, H, D)
+
+
+def paged_chunk_attention_reference(q, k_pool, v_pool, block_table, start):
+    """Numerics oracle for :func:`paged_chunk_attention`: gather the
+    slot's cache through its table, dense masked softmax with the
+    per-query causal bound ``col <= start + qi``."""
+    C, H, D = q.shape
+    BS, KH = k_pool.shape[1], k_pool.shape[2]
+    MB = block_table.shape[0]
+    rep = H // KH
+    kc = k_pool[block_table].reshape(MB * BS, KH, D)
+    vc = v_pool[block_table].reshape(MB * BS, KH, D)
+    kc = jnp.repeat(kc, rep, axis=1) if rep > 1 else kc
+    vc = jnp.repeat(vc, rep, axis=1) if rep > 1 else vc
+    s = jnp.einsum("chd,shd->chs", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) / (D ** 0.5)
+    col = jnp.arange(MB * BS)[None, None, :]
+    qi = jnp.arange(C)[:, None, None]
+    s = jnp.where(col <= start + qi, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("chs,shd->chd", p,
+                      vc.astype(jnp.float32)).astype(q.dtype)
+
+
 def paged_decode_attention_reference(q, k_pool, v_pool, block_tables,
                                      lengths):
     """Numerics oracle: gather each slot's cache through its block table

@@ -23,9 +23,15 @@ def run_continuous(eng, prompt, args):
     srv = ContinuousBatchingServer(eng)
     ids = []
     for i in range(args.continuous):
-        # vary lengths/budgets so slots recycle at different times
-        ids.append(srv.submit(prompt[: 1 + i % len(prompt)],
-                              max_new_tokens=2 + args.max_new_tokens
+        if srv.prefix_caching:
+            # shared-prefix workload: every request reuses the full
+            # prompt as its system prefix + a tiny distinct tail, so
+            # the prefix cache has something to hit after request 0
+            p = prompt + [(i * 7 + t) % 90 + 1 for t in range(1 + i % 3)]
+        else:
+            # vary lengths so slots recycle at different times
+            p = prompt[: 1 + i % len(prompt)]
+        ids.append(srv.submit(p, max_new_tokens=2 + args.max_new_tokens
                               * (i % 3) // 2))
         srv.step()   # arrivals interleave with decoding
     out = srv.drain()
@@ -34,6 +40,15 @@ def run_continuous(eng, prompt, args):
     st = srv.stats
     print(f"decode steps {st['decode_steps']}, occupancy "
           f"{st['slot_occupancy']:.2f}, traces {st['decode_traces']}")
+    if st["prefix_caching"]:
+        print(f"prefix cache: {st['prefix_cache_hits']} hits / "
+              f"{st['prefix_cache_misses']} misses, "
+              f"{st['prefix_tokens_skipped']} prefill tokens skipped, "
+              f"{st['prefix_cached_blocks']} blocks cached")
+    if st["prefill_chunk_tokens"]:
+        print(f"chunked prefill: {st['prefill_chunks']} chunks of "
+              f"{st['prefill_chunk_tokens']} tokens, "
+              f"{st['chunk_traces']} trace(s)")
     # registry view of the same run (docs/observability.md)
     snap = srv.telemetry.snapshot()
     for h in ("serve_ttft_seconds", "serve_queue_wait_seconds",
@@ -74,6 +89,16 @@ def main():
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="open a Prometheus/JSON scrape endpoint on this "
                          "port (continuous mode; docs/observability.md)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="automatic prefix caching: shared block-aligned "
+                         "prompt prefixes prefill once and are reused by "
+                         "refcount (continuous mode; docs/serving.md)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    metavar="TOKENS",
+                    help="chunked prefill: prefill prompts this many "
+                         "tokens per scheduler step instead of one "
+                         "monolithic pass (multiple of --block-size; "
+                         "continuous mode)")
     args = ap.parse_args()
 
     import deepspeed_tpu
@@ -84,6 +109,10 @@ def main():
         knobs["block_size"] = args.block_size
     if args.metrics_port is not None:
         knobs["telemetry"] = {"http_port": args.metrics_port}
+    if args.prefix_cache:
+        knobs["enable_prefix_caching"] = True
+    if args.prefill_chunk is not None:
+        knobs["prefill_chunk_tokens"] = args.prefill_chunk
     eng = deepspeed_tpu.init_inference(args.path, **knobs)
     prompt = [int(t) for t in args.prompt_ids.split(",")]
     if args.continuous:

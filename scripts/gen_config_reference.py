@@ -225,6 +225,17 @@ def generate() -> str:
                      "endpoint opens only when `http_port` is set. Full "
                      "metric catalog: docs/observability.md."))
 
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    buf.write("## Inference config (`init_inference`)\n\n")
+    emit_model(
+        buf, "DeepSpeedInferenceConfig", DeepSpeedInferenceConfig,
+        note=("Top-level keys accepted by `deepspeed_tpu.init_inference"
+              "(...)` / `config=` (inference/config.py). The `tp`/`moe`/"
+              "`quant` sections and the serving knobs (`block_size`, "
+              "`num_slots`, `enable_prefix_caching`, "
+              "`prefill_chunk_tokens`, ...) are documented in "
+              "docs/serving.md; `telemetry` shares the schema above."))
+
     buf.write(
         "## Subsystem configs documented elsewhere\n\n"
         "- `autotuning` — autotuning/autotuner.py (`dstpu --autotuning "
@@ -236,10 +247,7 @@ def generate() -> str:
         "- `data_efficiency` — runtime/data_pipeline/ (curriculum, data "
         "sampling, random-ltd)\n"
         "- `sparse_attention` — ops/sparse_attention/sparsity_config.py "
-        "(dense/fixed/variable/bigbird/bslongformer)\n"
-        "- inference: `deepspeed_tpu.init_inference(config=...)` takes "
-        "`DeepSpeedInferenceConfig` (inference/config.py) — tp/moe/quant "
-        "sections documented in docs/serving.md\n")
+        "(dense/fixed/variable/bigbird/bslongformer)\n")
     return buf.getvalue()
 
 

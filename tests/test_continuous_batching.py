@@ -286,6 +286,16 @@ def test_bench_serve_continuous_smoke():
     assert fr["retraces"] == 0
     assert fr["prefill_traces"] >= 1
     assert fr["compile_seconds_total"] > 0
+    # shared-prefix replay (auto 8 requests in smoke mode): prefix
+    # caching must actually hit, skip prefill compute vs the cold
+    # baseline, and stay token-identical to caching-off
+    pc = rec["prefix_cache"]
+    assert pc["parity_exact"] is True
+    assert pc["hit_rate"] >= 0.5
+    assert pc["blocks_reused"] > 0
+    assert pc["prefill_tokens_skipped"] > 0
+    assert pc["prefill_token_units"] < pc["prefill_token_units_cold"]
+    assert pc["chunk_traces"] == 1
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm
