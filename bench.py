@@ -835,13 +835,24 @@ def phase_serve(args) -> dict:
 
     smoke = bool(getattr(args, "smoke", False)) or \
         jax.default_backend() != "tpu"
+    # request tracing + SLO gates ride the replay (docs/observability.md
+    # "Request tracing & SLOs"): every request traced, generous latency
+    # objectives that a healthy replay always meets — the blob proves
+    # the instrumentation works, the smoke asserts it
+    # eval_interval_s stays POSITIVE: 0 would re-snapshot the registry
+    # every decode step and depress the very tokens/s this phase (and
+    # the check_bench_regression gate) measures
+    telem_cfg = {"trace_sample_rate": 1.0, "trace_ring_capacity": 512,
+                 "slo": {"enabled": True, "ttft_p90_s": 120.0,
+                         "token_p50_s": 60.0, "queue_wait_p90_s": 120.0,
+                         "error_rate": 0.99, "eval_interval_s": 0.5}}
     if smoke:
         mcfg = InferenceTransformerConfig(
             vocab_size=256, n_positions=256, n_embd=64, n_layer=2,
             n_head=4, dtype=jnp.float32)
         scfg = DeepSpeedInferenceConfig(
             dtype="float32", max_out_tokens=256, block_size=32,
-            num_slots=4)
+            num_slots=4, telemetry=telem_cfg)
         n_req = min(int(getattr(args, "requests", 10) or 10), 12)
         budgets, plens = [4, 16, 4], [3, 9, 5]
     else:
@@ -849,7 +860,8 @@ def phase_serve(args) -> dict:
             vocab_size=50257, n_positions=1024, n_embd=768, n_layer=12,
             n_head=12, dtype=jnp.bfloat16)
         scfg = DeepSpeedInferenceConfig(max_out_tokens=1024,
-                                        block_size=128, num_slots=8)
+                                        block_size=128, num_slots=8,
+                                        telemetry=telem_cfg)
         n_req = int(getattr(args, "requests", 24) or 24)
         budgets, plens = [16, 64, 16, 16], [64, 128, 32, 96]
     params = init_params(jax.random.PRNGKey(0), mcfg)
@@ -966,6 +978,29 @@ def phase_serve(args) -> dict:
             [rec.cost.get("hbm_bytes", 0.0)
              for rec in getattr(srv._prefill_jit, "executables", ())]
             or [0.0]),
+    }
+    # request tracing + SLO blob (docs/observability.md "Request
+    # tracing & SLOs"): every replay request is a kept span tree; the
+    # span-count histogram and the final SLO evaluation are the proof
+    # the per-request layer saw the whole replay
+    span_fam = snap.get("trace_span_count", {}).get("series") or []
+    slo_res = srv.slo.evaluate()
+    out["tracing"] = {
+        "sample_rate": 1.0,
+        "started": srv.tracer.started,
+        "kept": srv.tracer.kept,
+        "spans_per_trace_p50": (span_fam[0]["p50"] if span_fam
+                                else None),
+        "spans_per_trace_p90": (span_fam[0]["p90"] if span_fam
+                                else None),
+    }
+    out["slo"] = {
+        "compliance_ratio": srv.slo.compliance_ratio,
+        "evaluations": srv.slo.evaluations,
+        "objectives": {k: {"observed": v["observed"],
+                           "target": v["target"],
+                           "violated": v["violated"]}
+                       for k, v in slo_res.items()},
     }
     print(json.dumps({**out, "partial": True}), flush=True)  # salvage
 

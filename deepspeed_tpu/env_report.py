@@ -96,6 +96,20 @@ def telemetry_info():
         out["numerics_watch"] = state
         out["goodput"] = ("on by default config" if cfg.goodput
                           else "off (set telemetry.goodput)")
+        out["request_tracing"] = (
+            f"sample rate {cfg.trace_sample_rate}, ring "
+            f"{cfg.trace_ring_capacity}, slow-keep "
+            f"{cfg.trace_slow_threshold_s}s"
+            if cfg.trace_sample_rate > 0
+            else "off (set telemetry.trace_sample_rate)")
+        slo_targets = [k for k in ("ttft_p90_s", "token_p50_s",
+                                   "queue_wait_p90_s", "error_rate")
+                       if getattr(cfg.slo, k) is not None]
+        out["slo_gates"] = (
+            f"on ({len(slo_targets)} objective(s): "
+            f"{', '.join(slo_targets)}; window {cfg.slo.window_s}s)"
+            if cfg.slo.enabled and slo_targets
+            else "off (set telemetry.slo.enabled + objectives)")
     except Exception as e:  # pragma: no cover - env specific
         out["telemetry"] = f"unavailable: {e}"
         return out

@@ -214,6 +214,19 @@ class InferenceEngine:
         tcfg = getattr(self.config, "telemetry", None)
         self.telemetry = (get_registry() if tcfg is None or tcfg.enabled
                           else MetricRegistry())
+        # request-scoped tracing (telemetry/tracing.py): a one-shot
+        # generate() gets a two-level trace — root + dispatch/fetch
+        # children — under the same sampling config the server uses
+        self.tracer = None
+        if tcfg is not None and tcfg.enabled and \
+                tcfg.trace_sample_rate > 0:
+            from deepspeed_tpu.telemetry import Tracer
+            self.tracer = Tracer(
+                sample_rate=tcfg.trace_sample_rate,
+                ring_capacity=tcfg.trace_ring_capacity,
+                seed=tcfg.trace_seed,
+                slow_threshold_s=tcfg.trace_slow_threshold_s,
+                registry=self.telemetry)
         # flight recorder (telemetry/compile_watch.py): every entry
         # point is watched, so an unexpected prompt shape shows up as a
         # `retrace` event naming the argument that changed, with the
@@ -254,6 +267,13 @@ class InferenceEngine:
                 help="decode-loop cache lookups (see "
                      "docs/observability.md)").inc()
         return hit
+
+    def _fail_trace(self, tr, exc: BaseException) -> None:
+        """Finish a generation trace as an error (always kept) — a
+        crashed generate() must reach /debug/traces, not vanish."""
+        if tr is not None and tr.root.end is None:
+            tr.root.set("error", type(exc).__name__)
+            self.tracer.finish(tr, status="error")
 
     def _record_generate(self, dt: float) -> None:
         """Per-call latency into the registry (+ model_times when the
@@ -501,6 +521,10 @@ class InferenceEngine:
                 "reference sizes its workspace from free HBM, "
                 "inference_context.h:124 — set max_out_tokens='auto' for "
                 "the same behavior here)")
+        # mode validations BEFORE the trace opens (and before any
+        # compute dispatches — strictly earlier failure than scoring
+        # the prefill first): a refused parameter combination is the
+        # caller's error, not a traced request
         if num_beams > 1:
             if float(temperature) > 0.0 or top_k or top_p:
                 raise ValueError(
@@ -511,68 +535,107 @@ class InferenceEngine:
                 raise NotImplementedError(
                     "repetition_penalty/min_new_tokens are wired into "
                     "the greedy/sampled loop, not beam search")
-            # tiled prefill: every beam shares the prefix; one pass per
-            # beam is wasteful but keeps one prefill program for all modes
-            tiled_ids = np.repeat(ids, num_beams, axis=0)
-            tiled_len = np.repeat(lengths, num_beams, axis=0)
-            cache = self._make_cache(B * num_beams, max_seq)
+        else:
+            if float(repetition_penalty) <= 0.0:
+                raise ValueError(
+                    "repetition_penalty must be strictly positive (HF "
+                    "raises the same); 1.0 disables it")
+            if (int(top_k) > 0 or float(top_p) > 0.0) and \
+                    float(temperature) <= 0.0:
+                raise ValueError(
+                    "top_k/top_p are sampling filters — pass "
+                    "temperature>0 (HF samples at temperature=1.0 by "
+                    "default); temperature=0 means greedy and would "
+                    "silently ignore them")
+        # two-level request trace (telemetry/tracing.py): root +
+        # dispatch/fetch children. Generation stays ONE host sync — the
+        # children time the dispatch intervals and the final fetch (the
+        # device wait), not per-phase block_until_ready barriers. A
+        # failure past this point finishes the trace as an error
+        # (always kept), so crashed generations reach /debug/traces.
+        tr = None
+        if self.tracer is not None:
+            tr = self.tracer.start_trace(
+                "generate", rows=B, max_new_tokens=max_new_tokens,
+                prompt_tokens=int(lengths.sum()))
+        try:
+            if num_beams > 1:
+                # tiled prefill: every beam shares the prefix; one pass
+                # per beam is wasteful but keeps one prefill program
+                # for all modes
+                tiled_ids = np.repeat(ids, num_beams, axis=0)
+                tiled_len = np.repeat(lengths, num_beams, axis=0)
+                cache = self._make_cache(B * num_beams, max_seq)
+                sp = tr.begin("dispatch", beams=num_beams) if tr else None
+                logits, cache = self._prefill_jit(
+                    self.params, input_ids=jnp.asarray(tiled_ids),
+                    lengths=jnp.asarray(tiled_len), cache=cache)
+                loop = self._beam_loop(max_new_tokens, num_beams)
+                out_buf, n_gen, _ = loop(
+                    self.params, logits, cache, jnp.asarray(lengths),
+                    jnp.int32(-1 if eos_token_id is None
+                              else eos_token_id),
+                    jnp.float32(length_penalty))
+                if tr:
+                    tr.end_span(sp)
+                    sp = tr.begin("fetch")
+                out_np = np.asarray(out_buf)
+                n_np = np.asarray(n_gen)
+                if tr:
+                    tr.end_span(sp)
+                    self.tracer.finish(tr)
+                self._record_generate(_time.perf_counter() - t0)
+                return self._assemble_output(ids, lengths, out_np, n_np)
+            cache = self._make_cache(B, max_seq)
+            sp = tr.begin("prefill_dispatch", cache_len=max_seq) if tr \
+                else None
             logits, cache = self._prefill_jit(
-                self.params, input_ids=jnp.asarray(tiled_ids),
-                lengths=jnp.asarray(tiled_len), cache=cache)
-            loop = self._beam_loop(max_new_tokens, num_beams)
+                self.params, input_ids=jnp.asarray(ids),
+                lengths=jnp.asarray(lengths), cache=cache)
+            if tr:
+                tr.end_span(sp)
+            rep_on = float(repetition_penalty) != 1.0
+            loop = self._generate_loop(max_new_tokens,
+                                       float(temperature) > 0.0,
+                                       int(top_k) > 0, float(top_p) > 0.0,
+                                       rep_on)
+            # presence mask over the PROMPT (HF's repetition penalty
+            # scores every prior token, context included); pads (beyond
+            # lengths) and the loop's generated tokens extend it on
+            # device
+            if rep_on:
+                V = self.model_config.vocab_size
+                presence = np.zeros((B, V), bool)
+                for b in range(B):
+                    presence[b, np.asarray(ids[b, :lengths[b]])] = True
+                presence = jnp.asarray(presence)
+            else:
+                presence = jnp.zeros((B, 1), bool)   # unused placeholder
+            sp = tr.begin("decode_dispatch") if tr else None
             out_buf, n_gen, _ = loop(
-                self.params, logits, cache, jnp.asarray(lengths),
+                self.params, logits, cache, jax.random.PRNGKey(seed),
+                jnp.float32(temperature), jnp.int32(top_k),
+                jnp.float32(top_p),
                 jnp.int32(-1 if eos_token_id is None else eos_token_id),
-                jnp.float32(length_penalty))
+                presence, jnp.float32(repetition_penalty),
+                jnp.int32(min_new_tokens))
+            if tr:
+                tr.end_span(sp)
+                sp = tr.begin("fetch")
+            # ONE host sync per generation (the reference built CUDA
+            # graphs to kill per-token launch overhead, inference/
+            # engine.py:454-473; the per-token RTT through a remote
+            # relay is the TPU analog).
             out_np = np.asarray(out_buf)
             n_np = np.asarray(n_gen)
+            if tr:
+                tr.end_span(sp)
+                self.tracer.finish(tr)
             self._record_generate(_time.perf_counter() - t0)
             return self._assemble_output(ids, lengths, out_np, n_np)
-        cache = self._make_cache(B, max_seq)
-        logits, cache = self._prefill_jit(
-            self.params, input_ids=jnp.asarray(ids),
-            lengths=jnp.asarray(lengths), cache=cache)
-
-        if float(repetition_penalty) <= 0.0:
-            raise ValueError(
-                "repetition_penalty must be strictly positive (HF raises "
-                "the same); 1.0 disables it")
-        if (int(top_k) > 0 or float(top_p) > 0.0) and \
-                float(temperature) <= 0.0:
-            raise ValueError(
-                "top_k/top_p are sampling filters — pass temperature>0 "
-                "(HF samples at temperature=1.0 by default); "
-                "temperature=0 means greedy and would silently ignore "
-                "them")
-        rep_on = float(repetition_penalty) != 1.0
-        loop = self._generate_loop(max_new_tokens, float(temperature) > 0.0,
-                                   int(top_k) > 0, float(top_p) > 0.0,
-                                   rep_on)
-        # presence mask over the PROMPT (HF's repetition penalty scores
-        # every prior token, context included); pads (beyond lengths) and
-        # the loop's generated tokens extend it on device
-        if rep_on:
-            V = self.model_config.vocab_size
-            presence = np.zeros((B, V), bool)
-            for b in range(B):
-                presence[b, np.asarray(ids[b, :lengths[b]])] = True
-            presence = jnp.asarray(presence)
-        else:
-            presence = jnp.zeros((B, 1), bool)   # unused placeholder
-        out_buf, n_gen, _ = loop(
-            self.params, logits, cache, jax.random.PRNGKey(seed),
-            jnp.float32(temperature), jnp.int32(top_k),
-            jnp.float32(top_p),
-            jnp.int32(-1 if eos_token_id is None else eos_token_id),
-            presence, jnp.float32(repetition_penalty),
-            jnp.int32(min_new_tokens))
-        # ONE host sync per generation (the reference built CUDA graphs to
-        # kill per-token launch overhead, inference/engine.py:454-473; the
-        # per-token RTT through a remote relay is the TPU analog).
-        out_np = np.asarray(out_buf)
-        n_np = np.asarray(n_gen)
-        self._record_generate(_time.perf_counter() - t0)
-        return self._assemble_output(ids, lengths, out_np, n_np)
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            self._fail_trace(tr, e)
+            raise
 
     def generate_speculative(self, input_ids,
                              draft: Optional["InferenceEngine"] = None,

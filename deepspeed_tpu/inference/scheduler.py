@@ -79,8 +79,13 @@ class Scheduler:
     def __init__(self, num_slots: int, num_blocks: int, block_size: int,
                  max_blocks_per_slot: int, max_queued_requests: int,
                  registry: Optional[MetricRegistry] = None,
-                 enable_prefix_caching: bool = False):
+                 enable_prefix_caching: bool = False,
+                 tracer=None):
         self.num_slots = num_slots
+        # request tracer (telemetry/tracing.py) or None; the scheduler
+        # only records its OWN rejections — rejected requests are
+        # always-keep traces, whatever the sampling rate
+        self.tracer = tracer
         self.block_size = block_size
         self.max_blocks_per_slot = max_blocks_per_slot
         self.max_queued_requests = max_queued_requests
@@ -128,7 +133,8 @@ class Scheduler:
         self._g_active.set(len(self.slots))
         self._g_cached.set(self.allocator.cached_blocks)
 
-    def _reject(self, reason: str) -> None:
+    def _reject(self, reason: str,
+                request_id: Optional[int] = None) -> None:
         self.telemetry.counter(
             "serve_admission_rejections_total",
             help="refused submit() calls, by reason",
@@ -136,6 +142,12 @@ class Scheduler:
         from deepspeed_tpu.telemetry.events import (ADMISSION_REJECT,
                                                     record_event)
         record_event(ADMISSION_REJECT, reason=reason, source="scheduler")
+        if self.tracer is not None:
+            # auto trace id (the "t<N>" namespace), request id as an
+            # attribute: a rejected-then-retried request id must not
+            # collide with the retry's real trace on the timeline
+            self.tracer.record_rejected("request", reason,
+                                        request_id=request_id)
 
     # ------------------------------------------------------------ submit
 
@@ -145,7 +157,7 @@ class Scheduler:
         instead of deadlocking the drain loop later."""
         nb = req.blocks_needed(self.block_size)
         if nb > self.max_blocks_per_slot:
-            self._reject("span")
+            self._reject("span", req.request_id)
             raise ValueError(
                 f"request {req.request_id}: prompt ({len(req.prompt)}) + "
                 f"max_new_tokens ({req.max_new_tokens}) spans {nb} blocks "
@@ -156,13 +168,13 @@ class Scheduler:
             # block-budget admission: even a fully drained pool could not
             # hold this request (usable_blocks excludes the null block
             # the allocator never hands out)
-            self._reject("pool")
+            self._reject("pool", req.request_id)
             raise ValueError(
                 f"request {req.request_id} needs {nb} blocks but the "
                 f"whole pool holds {self.allocator.usable_blocks} "
                 "— raise max_out_tokens / num_slots sizing")
         if len(self.queue) >= self.max_queued_requests:
-            self._reject("queue_full")
+            self._reject("queue_full", req.request_id)
             raise RuntimeError(
                 f"request queue is full ({self.max_queued_requests}); "
                 "drain with step() before submitting more, or raise "

@@ -36,8 +36,9 @@ from deepspeed_tpu.inference.scheduler import Request, Scheduler
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill, paged_prefill_chunk)
 from deepspeed_tpu.telemetry import (MetricRegistry, ProfilerCapture,
-                                     get_event_ring, get_registry,
-                                     start_http_server, watched_jit)
+                                     SLOMonitor, Tracer, get_event_ring,
+                                     get_registry, start_http_server,
+                                     watched_jit)
 from deepspeed_tpu.telemetry import events as telemetry_events
 
 
@@ -48,6 +49,23 @@ def _safe_cache_size(fn) -> int:
         return int(fn._cache_size())
     except Exception:  # noqa: BLE001 — any private-API drift
         return -1
+
+
+class _RequestTrace:
+    """Host bookkeeping for one traced request (allocated only when
+    tracing is armed — with ``telemetry.trace_sample_rate == 0`` the
+    serving loop builds none of these, guarded by a test counting live
+    trace objects)."""
+
+    __slots__ = ("trace", "queue", "prefill", "decode", "steps", "tokens")
+
+    def __init__(self, trace):
+        self.trace = trace
+        self.queue = None     # open queue_wait span (submit -> admission)
+        self.prefill = None   # open prefill span (admission -> last chunk)
+        self.decode = None    # open decode-residency span
+        self.steps = 0        # decode steps this request participated in
+        self.tokens = 0       # tokens committed by decode steps
 
 
 class ContinuousBatchingServer:
@@ -100,11 +118,28 @@ class ContinuousBatchingServer:
         enabled = tcfg is None or tcfg.enabled
         self.telemetry = registry or (get_registry() if enabled
                                       else MetricRegistry())
+        # request-scoped tracing (telemetry/tracing.py): armed only when
+        # the sample rate is nonzero — tracing fully off means the hot
+        # path allocates NOTHING per request (no Tracer, no spans)
+        self.tracer = None
+        self._rt: Dict[int, _RequestTrace] = {}
+        if tcfg is not None and enabled and tcfg.trace_sample_rate > 0:
+            self.tracer = Tracer(
+                sample_rate=tcfg.trace_sample_rate,
+                ring_capacity=tcfg.trace_ring_capacity,
+                seed=tcfg.trace_seed,
+                slow_threshold_s=tcfg.trace_slow_threshold_s,
+                registry=self.telemetry)
+        # SLO gates (telemetry/slo.py): windowed objectives over the
+        # serving histograms, re-evaluated at step cadence
+        self.slo = None
+        if tcfg is not None and enabled and tcfg.slo.enabled:
+            self.slo = SLOMonitor(tcfg.slo, registry=self.telemetry)
         self.http_server = None
         if tcfg is not None and enabled and tcfg.http_port is not None:
             self.http_server = start_http_server(
                 tcfg.http_port, host=tcfg.http_host,
-                registry=self.telemetry)
+                registry=self.telemetry, tracer=self.tracer)
         self.profiler_capture = ProfilerCapture()
         reg = self.telemetry
         self._h_queue_wait = reg.histogram(
@@ -151,7 +186,8 @@ class ContinuousBatchingServer:
             max_blocks_per_slot=self.max_blocks_per_slot,
             max_queued_requests=cfg.max_queued_requests,
             registry=self.telemetry,
-            enable_prefix_caching=self.prefix_caching)
+            enable_prefix_caching=self.prefix_caching,
+            tracer=self.tracer)
         self._cache = self._make_pool(num_blocks)
         # flight recorder (telemetry/compile_watch.py): the serving jits
         # are watched, so a prompt shape that defeats the geometric
@@ -272,11 +308,11 @@ class ContinuousBatchingServer:
         never be scheduled (block span beyond a slot) or the queue is
         full — admission control instead of a silent deadlock."""
         if not prompt:
-            self._count_rejection("empty_prompt")
+            self._count_rejection("empty_prompt", request_id)
             raise ValueError("empty prompt")
         floor = max(1, self.engine.config.min_out_tokens)
         if max_new_tokens < floor:
-            self._count_rejection("budget_floor")
+            self._count_rejection("budget_floor", request_id)
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} is below the "
                 f"schedulable floor {floor} (min_out_tokens)")
@@ -287,7 +323,7 @@ class ContinuousBatchingServer:
                      for s in self.scheduler.slots.values())
               or any(r.request_id == request_id
                      for r in self.scheduler.queue)):
-            self._count_rejection("duplicate_id")
+            self._count_rejection("duplicate_id", request_id)
             raise ValueError(
                 f"request_id {request_id} is already queued, resident, "
                 "or finished — a duplicate would silently overwrite its "
@@ -297,10 +333,21 @@ class ContinuousBatchingServer:
             request_id=request_id, prompt=list(prompt),
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id))
         self._submit_ts[request_id] = time.perf_counter()
+        if self.tracer is not None:
+            # root span opens NOW (submit is the request's birth); the
+            # queue_wait child stays open until admission into a slot
+            tr = self.tracer.start_trace(
+                "request", trace_id=request_id,
+                prompt_tokens=len(prompt),
+                max_new_tokens=max_new_tokens)
+            rt = _RequestTrace(tr)
+            rt.queue = tr.begin("queue_wait")
+            self._rt[request_id] = rt
         self._c_submitted.inc()
         return request_id
 
-    def _count_rejection(self, reason: str) -> None:
+    def _count_rejection(self, reason: str,
+                         request_id: Optional[int] = None) -> None:
         """Server-side refusals; the scheduler counts its own (span/pool/
         queue_full) into the same family — one admission-failure metric."""
         self.telemetry.counter(
@@ -309,6 +356,14 @@ class ContinuousBatchingServer:
             labels={"reason": reason}).inc()
         get_event_ring().record(telemetry_events.ADMISSION_REJECT,
                                 reason=reason, source="server")
+        if self.tracer is not None:
+            # rejected requests are ALWAYS kept — the traces an operator
+            # wants never lose the sampling coin flip. The request id
+            # (when the caller supplied one) rides as an attribute, same
+            # as the scheduler's rejection traces, so the operator can
+            # tie the refusal back to client logs.
+            attrs = {} if request_id is None else {"request_id": request_id}
+            self.tracer.record_rejected("request", reason, **attrs)
 
     def _admit(self, finished: list) -> None:
         """Admit queued requests into free slots until blocks or slots
@@ -328,6 +383,17 @@ class ContinuousBatchingServer:
             t_admit = time.perf_counter()
             self._h_queue_wait.observe(
                 t_admit - self._submit_ts.get(req.request_id, t_admit))
+            rt = (self._rt.get(req.request_id)
+                  if self.tracer is not None else None)
+            adm_span = None
+            if rt is not None:
+                rt.trace.end_span(rt.queue)
+                adm_span = rt.trace.begin(
+                    "admission", slot=slot,
+                    prefix_cache_hit=state.cached_blocks > 0,
+                    blocks_reused=state.cached_blocks,
+                    blocks_allocated=(len(state.blocks)
+                                      - state.cached_blocks))
             # block table first — the prefill scatter reads it. Entries
             # beyond the allocated span stay 0 (null block), so bucket/
             # chunk padding past the span spills harmlessly.
@@ -350,10 +416,25 @@ class ContinuousBatchingServer:
                 self._prefilling.append(
                     {"slot": slot, "state": state, "start": cached_len})
                 self._mid_prefill.add(slot)
+                if rt is not None:
+                    rt.trace.end_span(adm_span)
+                    # the prefill span brackets the WHOLE chunked phase
+                    # (chunk spans nest under it); step()-interleave gaps
+                    # between chunks are inside it by design — that IS
+                    # the Sarathi tradeoff made visible
+                    rt.prefill = rt.trace.begin(
+                        "prefill", chunked=True,
+                        tokens=len(req.prompt) - cached_len,
+                        cached_tokens_skipped=cached_len)
                 continue
             # ---------------- monolithic bucketed prefill (chunking off)
             T = min(max(_bucket(len(req.prompt)), self.block_size),
                     self.max_blocks_per_slot * self.block_size)
+            if rt is not None:
+                rt.trace.end_span(adm_span)
+                rt.prefill = rt.trace.begin(
+                    "prefill", chunked=False, tokens=len(req.prompt),
+                    bucket=T)
             ids = np.zeros((1, T), np.int32)
             ids[0, :len(req.prompt)] = req.prompt
             tok0, self._cache = self._prefill_jit(
@@ -379,10 +460,16 @@ class ContinuousBatchingServer:
                 # a prefill IS progress — a long admission burst must
                 # not read as a decode stall
                 self.watchdog.notify_progress()
+            if rt is not None:
+                rt.trace.end_span(rt.prefill)
             state.generated.append(tok0)
             state.pending = tok0
             if self._finished(state, tok0):
                 self._retire(slot, state, finished)
+            elif rt is not None:
+                # decode residency: one span from "slot decodable" to
+                # retirement, annotated at close with tokens/steps
+                rt.decode = rt.trace.begin("decode", slot=slot)
 
     def _run_prefill_chunk(self, finished: list) -> None:
         """Run AT MOST one chunk of the oldest in-flight chunked
@@ -401,6 +488,12 @@ class ContinuousBatchingServer:
         ids = np.zeros((1, C), np.int32)
         valid = min(plen - start, C)
         ids[0, :valid] = req.prompt[start:start + valid]
+        rt = (self._rt.get(req.request_id)
+              if self.tracer is not None else None)
+        ck = None
+        if rt is not None:
+            ck = rt.trace.begin("prefill_chunk", parent=rt.prefill,
+                                start_token=start, tokens=valid)
         t0 = time.perf_counter()
         tok, self._cache = self._chunk_jit(
             self.engine.params, jnp.asarray(ids), jnp.int32(start),
@@ -409,6 +502,8 @@ class ContinuousBatchingServer:
         self._prefill_token_units += C
         tok = np.asarray(tok)     # host sync: honest per-chunk timing
         self._h_prefill_chunk.observe(time.perf_counter() - t0)
+        if ck is not None:
+            rt.trace.end_span(ck)
         if self.watchdog is not None:
             self.watchdog.notify_progress()   # a chunk IS progress
         job["start"] = start + C
@@ -428,10 +523,14 @@ class ContinuousBatchingServer:
         self._c_prefills.inc()
         self._c_tokens.inc()
         self._prefills += 1
+        if rt is not None:
+            rt.trace.end_span(rt.prefill)
         state.generated.append(tok0)
         state.pending = tok0
         if self._finished(state, tok0):
             self._retire(slot, state, finished)
+        elif rt is not None:
+            rt.decode = rt.trace.begin("decode", slot=slot)
 
     def _finished(self, state, tok: int) -> bool:
         req = state.request
@@ -440,6 +539,15 @@ class ContinuousBatchingServer:
 
     def _retire(self, slot: int, state, finished: list) -> None:
         req = state.request
+        rt = (self._rt.pop(req.request_id, None)
+              if self.tracer is not None else None)
+        fin = None
+        if rt is not None:
+            if rt.decode is not None:
+                rt.decode.set("tokens_committed", rt.tokens)
+                rt.decode.set("steps", rt.steps)
+                rt.trace.end_span(rt.decode)
+            fin = rt.trace.begin("finish")
         out = list(req.prompt) + state.generated
         self._results[req.request_id] = out
         finished.append(req.request_id)
@@ -468,6 +576,14 @@ class ContinuousBatchingServer:
             lengths=self._cache.lengths.at[slot].set(0),
             block_tables=self._cache.block_tables.at[slot].set(
                 jnp.zeros((self.max_blocks_per_slot,), jnp.int32)))
+        if rt is not None:
+            reason = ("eos" if state.generated
+                      and state.generated[-1] == req.eos_token_id
+                      else "length")
+            rt.trace.root.set("finish_reason", reason)
+            rt.trace.root.set("generated_tokens", len(state.generated))
+            rt.trace.end_span(fin)
+            self.tracer.finish(rt.trace)
 
     def step(self) -> List[int]:
         """One scheduler round: admit from the queue into free slots,
@@ -528,10 +644,17 @@ class ContinuousBatchingServer:
             state = self.scheduler.slots[slot]
             tok = int(nxt[slot])
             state.generated.append(tok)
+            if self.tracer is not None:
+                rt = self._rt.get(state.request.request_id)
+                if rt is not None and rt.decode is not None:
+                    rt.steps += 1
+                    rt.tokens += 1
             if self._finished(state, tok):
                 self._retire(slot, state, finished)
             else:
                 state.pending = tok
+        if self.slo is not None:
+            self.slo.maybe_evaluate()
         return finished
 
     def result(self, request_id: int) -> Optional[List[int]]:
@@ -544,6 +667,20 @@ class ContinuousBatchingServer:
         while not self.scheduler.idle:
             self.step()
         return dict(self._results)
+
+    def dump_timeline(self, path: str) -> int:
+        """Write the kept request traces plus the flight recorder's
+        decode-step / compile events as Chrome trace-event JSON — load
+        in Perfetto (ui.perfetto.dev) or chrome://tracing to see where
+        each request's time went AND what the device was doing
+        meanwhile. Returns the emitted event count."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "request tracing is off — set telemetry."
+                "trace_sample_rate > 0 (docs/observability.md "
+                "'Request tracing & SLOs')")
+        return self.tracer.dump_timeline(path,
+                                         event_ring=get_event_ring())
 
     def capture_decode_steps(self, num_steps: int, logdir: str) -> None:
         """Arm an on-demand ``jax.profiler`` capture: the next
@@ -602,4 +739,10 @@ class ContinuousBatchingServer:
             "prefix_cached_blocks": alloc.cached_blocks,
             "prefix_tokens_skipped": self._prefix_tokens_skipped,
             "tail_blocks_reclaimed": self._tail_reclaimed,
+            "traces_started": (self.tracer.started
+                               if self.tracer is not None else 0),
+            "traces_kept": (self.tracer.kept
+                            if self.tracer is not None else 0),
+            "slo_compliance": (self.slo.compliance_ratio
+                               if self.slo is not None else None),
         }

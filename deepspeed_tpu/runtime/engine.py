@@ -361,6 +361,20 @@ class DeepSpeedEngine:
         # registry one is backend-free
         from deepspeed_tpu.monitor.monitor import RegistryMonitor
         self._registry_sink = RegistryMonitor(self.telemetry)
+        # request-scoped tracing (telemetry/tracing.py): every sampled
+        # train step becomes a root span with data-wait/device/host
+        # children synthesized from the goodput splits — the same
+        # timeline surface the serving loop exports
+        self.tracer = None
+        if telemetry_on and tcfg is not None and \
+                tcfg.trace_sample_rate > 0:
+            from deepspeed_tpu.telemetry import Tracer
+            self.tracer = Tracer(
+                sample_rate=tcfg.trace_sample_rate,
+                ring_capacity=tcfg.trace_ring_capacity,
+                seed=tcfg.trace_seed,
+                slow_threshold_s=tcfg.trace_slow_threshold_s,
+                registry=self.telemetry)
         self._telemetry_http = None
         if telemetry_on and tcfg is not None and \
                 tcfg.http_port is not None:
@@ -368,7 +382,7 @@ class DeepSpeedEngine:
             try:
                 self._telemetry_http = start_http_server(
                     tcfg.http_port, host=tcfg.http_host,
-                    registry=self.telemetry)
+                    registry=self.telemetry, tracer=self.tracer)
             except OSError as e:   # port taken must not kill training
                 logger.warning(f"telemetry endpoint unavailable: {e}")
         self._init_flight_recorder(tcfg)   # helper honors tcfg.enabled
@@ -1313,6 +1327,9 @@ class DeepSpeedEngine:
             self.goodput.record_step(
                 time.perf_counter() - t_wall, data_wait,
                 getattr(self, "_offload_device_s", 0.0))
+            self._record_step_trace(
+                time.perf_counter() - t_wall, data_wait,
+                getattr(self, "_offload_device_s", 0.0))
             return out
         if (self._sparse_grad_axes and self._step_fn is not None and
                 tuple(tuple(x.shape) for x in jax.tree.leaves(batch))
@@ -1441,7 +1458,36 @@ class DeepSpeedEngine:
             self._write_monitor_events(metrics)
         self.goodput.record_step(time.perf_counter() - t_wall,
                                  data_wait, device_s)
+        self._record_step_trace(time.perf_counter() - t_wall,
+                                data_wait, device_s)
         return metrics
+
+    def _record_step_trace(self, wall: float, data_wait: float,
+                           device_s: float) -> None:
+        """One trace per train step (head-sampled like serving): a root
+        ``train_step`` span whose data-wait/device/host children are
+        synthesized from the goodput splits — intervals laid out in the
+        data→device→host order the step logically runs, summing to the
+        root by construction. With ``telemetry.goodput`` off the device
+        interval is unmeasured (no extra sync is ever added for
+        tracing), so the host child absorbs it."""
+        if self.tracer is None:
+            return
+        now = self.tracer.clock()
+        wall = max(float(wall), 0.0)
+        data = min(max(float(data_wait), 0.0), wall)
+        device = min(max(float(device_s), 0.0), wall - data)
+        t0 = now - wall
+        tr = self.tracer.start_trace(
+            "train_step", trace_id=self.global_steps, start=t0,
+            step=self.global_steps,
+            goodput_measured=self.goodput.enabled)
+        if data:
+            tr.add_span("data_wait", t0, t0 + data)
+        if device:
+            tr.add_span("device", t0 + data, t0 + data + device)
+        tr.add_span("host", t0 + data + device, now)
+        self.tracer.finish(tr, end=now)
 
     def _record_step_progress(self) -> None:
         """Flight-recorder step event + watchdog heartbeat — one host

@@ -22,7 +22,7 @@ from deepspeed_tpu.telemetry.compile_watch import (WatchedFunction,
                                                    compile_report,
                                                    executable_cost,
                                                    watched_jit)
-from deepspeed_tpu.telemetry.config import TelemetryConfig
+from deepspeed_tpu.telemetry.config import SLOConfig, TelemetryConfig
 from deepspeed_tpu.telemetry.events import (EventRing, dump_ring,
                                             get_event_ring,
                                             install_fault_dump,
@@ -46,7 +46,11 @@ from deepspeed_tpu.telemetry.registry import (DEFAULT_TIME_BUCKETS, Counter,
                                               get_registry,
                                               sanitize_metric_name,
                                               set_registry)
+from deepspeed_tpu.telemetry.slo import SLOMonitor
 from deepspeed_tpu.telemetry.spans import span, timed
+from deepspeed_tpu.telemetry.tracing import (Trace, Tracer, TraceSpan,
+                                             current_span, get_tracer,
+                                             set_tracer)
 from deepspeed_tpu.telemetry.watchdog import Watchdog
 
 __all__ = [
@@ -54,7 +58,7 @@ __all__ = [
     "DEFAULT_TIME_BUCKETS", "exponential_buckets", "get_registry",
     "set_registry", "sanitize_metric_name", "span", "timed",
     "TelemetryHTTPServer", "start_http_server", "ProfilerCapture",
-    "TelemetryConfig",
+    "TelemetryConfig", "SLOConfig",
     # flight recorder (events ring / compile watch / memory / watchdog)
     "EventRing", "get_event_ring", "set_event_ring", "record_event",
     "install_fault_dump", "WatchedFunction", "watched_jit",
@@ -66,4 +70,7 @@ __all__ = [
     "block_nonfinite_counts", "numerics_snapshot",
     "register_numerics_watch", "unregister_numerics_watch",
     "GoodputMeter", "dump_ring",
+    # request-scoped tracing + SLO gates
+    "Trace", "Tracer", "TraceSpan", "current_span", "get_tracer",
+    "set_tracer", "SLOMonitor",
 ]

@@ -5,9 +5,55 @@ from __future__ import annotations
 
 from typing import Optional
 
-from pydantic import field_validator
+from pydantic import Field, field_validator
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+
+
+class SLOConfig(DeepSpeedConfigModel):
+    """Serving-loop SLO gates (telemetry/slo.py): objectives evaluated
+    over a sliding window of the registry's serving histograms, exposed
+    as ``slo_*`` gauges + a compliance ratio, with violations recorded
+    into the flight-recorder event ring. Null objectives are ungated;
+    ``enabled`` must be true for the server to arm the monitor."""
+    enabled: bool = False
+    # latency objectives, in seconds (null = not gated)
+    ttft_p90_s: Optional[float] = None
+    token_p50_s: Optional[float] = None
+    queue_wait_p90_s: Optional[float] = None
+    # windowed admission rejections / attempts, attempts = accepted +
+    # rejected submits (null = not gated)
+    error_rate: Optional[float] = None
+    # sliding-window span the objectives are computed over
+    window_s: float = 60.0
+    # re-evaluation cadence; 0 evaluates at every serving step
+    eval_interval_s: float = 5.0
+
+    @field_validator("ttft_p90_s", "token_p50_s", "queue_wait_p90_s",
+                     "window_s")
+    @classmethod
+    def _positive_seconds(cls, v, info):
+        if v is not None and v <= 0:
+            raise ValueError(
+                f"{info.field_name} must be > 0 seconds (or null to "
+                f"disable the objective), got {v}")
+        return v
+
+    @field_validator("error_rate")
+    @classmethod
+    def _valid_rate(cls, v):
+        if v is not None and not 0.0 <= v <= 1.0:
+            raise ValueError(
+                f"error_rate must be in [0, 1] (or null), got {v}")
+        return v
+
+    @field_validator("eval_interval_s")
+    @classmethod
+    def _valid_interval(cls, v):
+        if v < 0:
+            raise ValueError(
+                f"eval_interval_s must be >= 0 (0 = every step), got {v}")
+        return v
 
 
 class TelemetryConfig(DeepSpeedConfigModel):
@@ -55,6 +101,24 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # Off by default: the device bucket costs one block_until_ready per
     # step (trades async step pipelining for the honest split).
     goodput: bool = False
+    # request-scoped tracing (telemetry/tracing.py): per-request span
+    # trees with head sampling. 0 (default) = tracing fully off — the
+    # serving hot path allocates nothing per request; 1.0 traces every
+    # request. Slow / rejected / errored requests are always kept once
+    # tracing is armed, whatever the rate.
+    trace_sample_rate: float = 0.0
+    # bounded ring of finished traces backing /debug/traces and
+    # dump_timeline
+    trace_ring_capacity: int = 256
+    # always-keep threshold: a finished trace whose root span lasted at
+    # least this long is retained even when head sampling dropped it;
+    # null disables the slow-keep rescue
+    trace_slow_threshold_s: Optional[float] = 1.0
+    # head-sampling RNG seed (deterministic retention under a fixed seed
+    # and submission order)
+    trace_seed: int = 0
+    # serving SLO gates (telemetry/slo.py) — see the SLOConfig schema
+    slo: SLOConfig = Field(default_factory=SLOConfig)
 
     @field_validator("http_port")
     @classmethod
@@ -63,11 +127,30 @@ class TelemetryConfig(DeepSpeedConfigModel):
             raise ValueError(f"http_port must be in [0, 65535], got {v}")
         return v
 
-    @field_validator("events_capacity")
+    @field_validator("events_capacity", "trace_ring_capacity")
     @classmethod
-    def _valid_capacity(cls, v):
+    def _valid_capacity(cls, v, info):
         if v < 1:
-            raise ValueError(f"events_capacity must be >= 1, got {v}")
+            raise ValueError(
+                f"{info.field_name} must be >= 1, got {v}")
+        return v
+
+    @field_validator("trace_sample_rate")
+    @classmethod
+    def _valid_rate(cls, v):
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(
+                f"trace_sample_rate must be in [0, 1] (0 = tracing "
+                f"off), got {v}")
+        return v
+
+    @field_validator("trace_slow_threshold_s")
+    @classmethod
+    def _valid_slow(cls, v):
+        if v is not None and v <= 0:
+            raise ValueError(
+                "trace_slow_threshold_s must be > 0 seconds (or null "
+                f"to disable the slow-keep rescue), got {v}")
         return v
 
     @field_validator("watchdog_deadline_s", "memory_interval_s")

@@ -39,6 +39,7 @@ STEP_END = "step_end"
 WATCHDOG_DUMP = "watchdog_dump"
 NUMERICS_NONFINITE = "numerics_nonfinite"
 LOSS_SPIKE = "loss_spike"
+SLO_VIOLATION = "slo_violation"
 
 
 class EventRing:

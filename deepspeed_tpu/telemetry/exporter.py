@@ -6,16 +6,9 @@ container bakes no prometheus_client, and the exposition format is
 simple enough that a renderer (registry.prometheus_text) plus a
 ThreadingHTTPServer IS the integration.
 
-Routes:
-  ``/metrics``       Prometheus text exposition (content-type 0.0.4)
-  ``/metrics.json``  JSON snapshot (registry.snapshot) — same instruments
-  ``/debug/events``  flight-recorder event ring (telemetry/events.py)
-  ``/debug/memory``  live-array accounting by component
-                     (telemetry/memory.py; snapshots on request)
-  ``/debug/compile`` compile_report() text (telemetry/compile_watch.py)
-  ``/debug/numerics`` training numerics watches — per-block norms,
-                     non-finite provenance, loss-spike state
-                     (telemetry/numerics.py)
+The route table (:data:`ROUTES`) is the single source of truth for the
+endpoint's surface: the ``/`` help page and the 404 body are both
+rendered from it, so adding a route updates every listing at once.
 """
 from __future__ import annotations
 
@@ -28,6 +21,30 @@ from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# path -> one-line description; keep in sync with docs/observability.md
+# "Scrape endpoint" (the help/404 renderers below read this table)
+ROUTES = {
+    "/metrics": "Prometheus text exposition (content-type 0.0.4)",
+    "/metrics.json": "JSON snapshot of the same instruments "
+                     "(p50/p90/p99 included)",
+    "/debug/events": "flight-recorder event ring (telemetry/events.py)",
+    "/debug/memory": "live-array accounting by component "
+                     "(telemetry/memory.py; snapshots on request)",
+    "/debug/compile": "compile_report() text (telemetry/compile_watch.py)",
+    "/debug/numerics": "training numerics watches — per-block norms, "
+                       "non-finite provenance, loss-spike state "
+                       "(telemetry/numerics.py)",
+    "/debug/traces": "recent finished request traces as JSON "
+                     "(telemetry/tracing.py; see also dump_timeline)",
+}
+
+
+def _help_text() -> str:
+    lines = ["deepspeed_tpu telemetry endpoint (docs/observability.md)",
+             ""]
+    lines += [f"  {path:<18} {desc}" for path, desc in ROUTES.items()]
+    return "\n".join(lines) + "\n"
+
 
 class TelemetryHTTPServer:
     """Daemon-threaded scrape endpoint; ``close()`` (or context-manager
@@ -35,13 +52,16 @@ class TelemetryHTTPServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[MetricRegistry] = None,
-                 event_ring=None, memory=None):
+                 event_ring=None, memory=None, tracer=None):
         reg = registry or get_registry()
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
                 path = self.path.split("?", 1)[0]
-                if path in ("/metrics", "/"):
+                if path == "/":
+                    body = _help_text().encode()
+                    ctype = "text/plain; charset=utf-8"
+                elif path == "/metrics":
                     body = reg.prometheus_text().encode()
                     ctype = PROMETHEUS_CONTENT_TYPE
                 elif path in ("/metrics.json", "/snapshot"):
@@ -75,11 +95,15 @@ class TelemetryHTTPServer:
                     body = json.dumps(numerics_snapshot(),
                                       default=str).encode()
                     ctype = "application/json"
+                elif path == "/debug/traces":
+                    from deepspeed_tpu.telemetry.tracing import get_tracer
+                    t = tracer if tracer is not None else get_tracer()
+                    body = t.to_json().encode()
+                    ctype = "application/json"
                 else:
-                    self.send_error(404, "unknown path (try /metrics, "
-                                    "/metrics.json, /debug/events, "
-                                    "/debug/memory, /debug/compile, "
-                                    "/debug/numerics)")
+                    self.send_error(
+                        404, "unknown path (try " +
+                        ", ".join(ROUTES) + ")")
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -117,8 +141,9 @@ class TelemetryHTTPServer:
 
 def start_http_server(port: int, host: str = "127.0.0.1",
                       registry: Optional[MetricRegistry] = None,
-                      event_ring=None, memory=None
+                      event_ring=None, memory=None, tracer=None
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
     return TelemetryHTTPServer(port=port, host=host, registry=registry,
-                               event_ring=event_ring, memory=memory)
+                               event_ring=event_ring, memory=memory,
+                               tracer=tracer)

@@ -56,6 +56,22 @@ def run_continuous(eng, prompt, args):
         s = snap[h]["series"][0]
         print(f"{h}: n={s['count']} p50={s['p50'] * 1e3:.2f}ms "
               f"p90={s['p90'] * 1e3:.2f}ms")
+    if srv.tracer is not None:
+        print(f"request tracing: {srv.tracer.kept}/"
+              f"{srv.tracer.started} traces kept")
+        if args.trace_dump:
+            n = srv.dump_timeline(args.trace_dump)
+            print(f"timeline: {n} trace events -> {args.trace_dump} "
+                  "(load in ui.perfetto.dev or chrome://tracing)")
+    if srv.slo is not None:
+        res = srv.slo.evaluate()
+        print(f"SLO compliance: {srv.slo.compliance_ratio:.2f}")
+        for name, r in res.items():
+            obs = ("n/a" if r["observed"] is None
+                   else f"{r['observed']:.4f}")
+            state = "VIOLATED" if r["violated"] else "ok"
+            print(f"  {name}: observed {obs} vs target "
+                  f"{r['target']} [{state}]")
     if srv.http_server is not None:
         port = srv.http_server.port
         input(f"scrape endpoint live at http://127.0.0.1:{port}/metrics "
@@ -99,6 +115,16 @@ def main():
                          "tokens per scheduler step instead of one "
                          "monolithic pass (multiple of --block-size; "
                          "continuous mode)")
+    ap.add_argument("--trace-dump", default=None, metavar="PATH",
+                    help="trace every request (telemetry.trace_sample_"
+                         "rate=1.0) and write a Perfetto-loadable "
+                         "Chrome trace timeline here after the drain "
+                         "(continuous mode; docs/observability.md)")
+    ap.add_argument("--slo", action="store_true",
+                    help="arm default SLO gates (TTFT p90 1s, per-token "
+                         "p50 100ms, queue-wait p90 1s, error rate 5%%) "
+                         "and print windowed compliance after the drain "
+                         "(continuous mode)")
     args = ap.parse_args()
 
     import deepspeed_tpu
@@ -107,8 +133,17 @@ def main():
         knobs["num_slots"] = args.num_slots
     if args.block_size:
         knobs["block_size"] = args.block_size
+    telemetry = {}
     if args.metrics_port is not None:
-        knobs["telemetry"] = {"http_port": args.metrics_port}
+        telemetry["http_port"] = args.metrics_port
+    if args.trace_dump:
+        telemetry["trace_sample_rate"] = 1.0
+    if args.slo:
+        telemetry["slo"] = {"enabled": True, "ttft_p90_s": 1.0,
+                            "token_p50_s": 0.1, "queue_wait_p90_s": 1.0,
+                            "error_rate": 0.05}
+    if telemetry:
+        knobs["telemetry"] = telemetry
     if args.prefix_cache:
         knobs["enable_prefix_caching"] = True
     if args.prefill_chunk is not None:

@@ -1,0 +1,165 @@
+"""Serving-bench regression gate over the checked-in BENCH rounds.
+
+Compares the newest ``BENCH_r*.json`` against the previous round for the
+``serve-continuous`` phase's two headline numbers — ``tokens_per_s``
+(higher is better) and ``token_lat_p90_ms`` (lower is better) — and
+exits nonzero when either moved past the tolerance in the bad
+direction. Wired as tier-1 via tests/test_bench_regression.py, so a PR
+that lands a slower serving loop alongside a fresh BENCH round fails in
+CI instead of in the next operator's dashboard.
+
+Record extraction is deliberately forgiving about the BENCH file shape:
+the round files store ``{"parsed": <final JSON or null>, "tail": <last
+output bytes>}`` — a wedged run has ``parsed: null`` but may still carry
+phase records as JSON lines in the tail (bench.py prints each phase
+record as it completes, the salvage architecture). Rounds with no
+serve-continuous record in either place are reported and skipped: a gate
+that hard-fails on missing data would block every non-serving round.
+
+Usage:
+    python scripts/check_bench_regression.py [--dir DIR]
+        [--tolerance 0.10] [--require-data]
+
+Exit codes: 0 = no regression (or not enough data, unless
+--require-data), 1 = regression, 2 = --require-data and fewer than two
+rounds carry a serve-continuous record.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# metric -> direction ("up" = bigger is better)
+METRICS = {
+    "tokens_per_s": "up",
+    "token_lat_p90_ms": "down",
+}
+
+
+def bench_rounds(directory: str) -> List[Tuple[int, str]]:
+    """(round number, path) for every BENCH_r*.json, oldest first."""
+    rounds = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            rounds.append((int(m.group(1)), path))
+    return sorted(rounds)
+
+
+def _phase_records(obj) -> List[dict]:
+    """serve-continuous records inside one parsed bench JSON value
+    (the final merged dict, a phase list, or a single record)."""
+    if isinstance(obj, dict):
+        if obj.get("phase") == "serve-continuous":
+            return [obj]
+        out = []
+        for v in obj.values():
+            out.extend(_phase_records(v))
+        return out
+    if isinstance(obj, list):
+        out = []
+        for v in obj:
+            out.extend(_phase_records(v))
+        return out
+    return []
+
+
+def extract_serve_record(path: str) -> Optional[dict]:
+    """The round's serve-continuous record, preferring the fully-parsed
+    result over tail-salvaged JSON lines (a later salvage line would be
+    the same record's ``partial: True`` echo)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    found: List[dict] = []
+    found.extend(_phase_records(data.get("parsed")))
+    tail = data.get("tail")
+    if isinstance(tail, str):
+        for line in tail.splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and "serve-continuous" in line):
+                continue
+            try:
+                found.extend(_phase_records(json.loads(line)))
+            except json.JSONDecodeError:
+                continue
+    full = [r for r in found if not r.get("partial")]
+    pool = full or found
+    return pool[-1] if pool else None
+
+
+def compare(prev: dict, new: dict, tolerance: float) -> List[str]:
+    """Human-readable regression lines (empty = within tolerance)."""
+    errors = []
+    for metric, direction in METRICS.items():
+        a, b = prev.get(metric), new.get(metric)
+        if a is None or b is None or a <= 0:
+            continue
+        if direction == "up" and b < a * (1.0 - tolerance):
+            errors.append(
+                f"{metric}: {b} vs {a} previous — "
+                f"{(1.0 - b / a) * 100:.1f}% worse (tolerance "
+                f"{tolerance * 100:.0f}%, higher is better)")
+        elif direction == "down" and b > a * (1.0 + tolerance):
+            errors.append(
+                f"{metric}: {b} vs {a} previous — "
+                f"{(b / a - 1.0) * 100:.1f}% worse (tolerance "
+                f"{tolerance * 100:.0f}%, lower is better)")
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serve-continuous bench regression gate")
+    ap.add_argument("--dir", default=ROOT,
+                    help="directory holding BENCH_r*.json (default: "
+                         "repo root)")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="fractional regression allowed before failing "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--require-data", action="store_true",
+                    help="exit 2 when fewer than two rounds carry a "
+                         "serve-continuous record (default: report and "
+                         "exit 0)")
+    args = ap.parse_args(argv)
+    if args.tolerance < 0:
+        ap.error("--tolerance must be >= 0")
+
+    rounds = bench_rounds(args.dir)
+    with_data = [(n, path, rec) for n, path in rounds
+                 if (rec := extract_serve_record(path)) is not None]
+    if len(with_data) < 2:
+        have = [f"r{n:02d}" for n, _, _ in with_data]
+        print(f"check_bench_regression: {len(rounds)} round(s) found, "
+              f"{len(with_data)} with a serve-continuous record "
+              f"({', '.join(have) or 'none'}) — nothing to compare")
+        return 2 if args.require_data else 0
+    (pn, _, prev), (nn, npath, new) = with_data[-2], with_data[-1]
+    errors = compare(prev, new, args.tolerance)
+    if errors:
+        print(f"check_bench_regression: serve-continuous REGRESSION "
+              f"r{pn:02d} -> r{nn:02d} ({os.path.basename(npath)}):",
+              file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    summary = ", ".join(
+        f"{m}={new.get(m)} (prev {prev.get(m)})" for m in METRICS)
+    print(f"check_bench_regression: r{pn:02d} -> r{nn:02d} within "
+          f"{args.tolerance * 100:.0f}% tolerance: {summary}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

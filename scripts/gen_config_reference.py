@@ -224,6 +224,11 @@ def generate() -> str:
                      "regardless of any monitor backend; the scrape "
                      "endpoint opens only when `http_port` is set. Full "
                      "metric catalog: docs/observability.md."))
+    from deepspeed_tpu.telemetry.config import SLOConfig
+    emit_model(buf, "telemetry.slo", SLOConfig,
+               note=("See docs/observability.md \"Request tracing & "
+                     "SLOs\" for the evaluation semantics and metric "
+                     "names."))
 
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
     buf.write("## Inference config (`init_inference`)\n\n")

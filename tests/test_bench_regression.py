@@ -1,0 +1,88 @@
+"""scripts/check_bench_regression.py — the serving-bench gate.
+
+Tier-1 on the checked-in BENCH_r*.json rounds (whatever data they
+carry, the gate must run clean), plus synthetic rounds proving the
+regression logic: worse tokens/s or worse per-token p90 beyond the
+tolerance exits nonzero, improvement and in-tolerance noise exit zero,
+and records are salvaged from tail JSON lines when the final parse is
+null (the wedged-run shape the salvage architecture produces).
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(scope="module")
+def mod():
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_regression",
+        os.path.join(ROOT, "scripts", "check_bench_regression.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def _write_round(directory, n, tokens_per_s, p90_ms, via_tail=False):
+    rec = {"phase": "serve-continuous", "tokens_per_s": tokens_per_s,
+           "token_lat_p90_ms": p90_ms}
+    if via_tail:
+        payload = {"n": n, "rc": 1, "parsed": None,
+                   "tail": "noise\n" + json.dumps(rec) + "\ntrailer"}
+    else:
+        payload = {"n": n, "rc": 0, "parsed": [rec]}
+    path = os.path.join(directory, f"BENCH_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def test_runs_clean_on_checked_in_rounds(mod):
+    """The repo's own BENCH files: the gate must execute end-to-end and
+    exit 0 — with a comparison when two rounds carry serving data, or a
+    graceful no-data report otherwise (missing phases must never block
+    an unrelated PR)."""
+    assert mod.main(["--dir", ROOT]) == 0
+
+
+def test_regression_in_tokens_per_s_fails(mod, tmp_path):
+    _write_round(tmp_path, 1, 1000.0, 5.0)
+    _write_round(tmp_path, 2, 850.0, 5.0)        # -15% < -10% tolerance
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_regression_in_token_p90_fails(mod, tmp_path):
+    _write_round(tmp_path, 1, 1000.0, 5.0)
+    _write_round(tmp_path, 2, 1000.0, 6.0)       # +20% latency
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_improvement_and_tolerance_pass(mod, tmp_path):
+    _write_round(tmp_path, 1, 1000.0, 5.0)
+    _write_round(tmp_path, 2, 1050.0, 4.8)       # strictly better
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    _write_round(tmp_path, 3, 960.0, 5.2)        # within 10% of r02
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    # tighten the tolerance below the drift and the same pair fails
+    assert mod.main(["--dir", str(tmp_path), "--tolerance", "0.01"]) == 1
+
+
+def test_tail_salvage_and_round_ordering(mod, tmp_path):
+    """A wedged round (parsed: null) still contributes its tail-printed
+    record, and rounds compare newest-vs-previous by round NUMBER, not
+    directory order."""
+    _write_round(tmp_path, 9, 1000.0, 5.0, via_tail=True)
+    _write_round(tmp_path, 10, 500.0, 9.0, via_tail=True)  # regression
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    rec = mod.extract_serve_record(
+        os.path.join(tmp_path, "BENCH_r09.json"))
+    assert rec["tokens_per_s"] == 1000.0
+
+
+def test_single_round_reports_no_data(mod, tmp_path):
+    _write_round(tmp_path, 1, 1000.0, 5.0)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert mod.main(["--dir", str(tmp_path), "--require-data"]) == 2

@@ -286,6 +286,23 @@ def test_bench_serve_continuous_smoke():
     assert fr["retraces"] == 0
     assert fr["prefill_traces"] >= 1
     assert fr["compile_seconds_total"] > 0
+    # request-tracing blob (docs/observability.md "Request tracing &
+    # SLOs"): every replay request kept (sample rate 1.0), span trees
+    # non-trivial
+    tb = rec["tracing"]
+    assert tb["sample_rate"] == 1.0
+    assert tb["kept"] >= rec["requests"]      # every request + warmup
+    assert tb["started"] >= tb["kept"] >= 1
+    assert tb["spans_per_trace_p50"] >= 3     # root+queue+admission+...
+    # SLO blob: generous objectives, so a healthy replay is compliant
+    # and every configured objective was evaluated with a real value
+    sb = rec["slo"]
+    assert sb["compliance_ratio"] == 1.0
+    assert sb["evaluations"] >= 1
+    assert set(sb["objectives"]) == {"ttft_p90", "token_p50",
+                                     "queue_wait_p90", "error_rate"}
+    for obj in sb["objectives"].values():
+        assert obj["violated"] is False
     # shared-prefix replay (auto 8 requests in smoke mode): prefix
     # caching must actually hit, skip prefill compute vs the cold
     # baseline, and stay token-identical to caching-off

@@ -1,0 +1,558 @@
+"""Request-scoped tracing + SLO gates — the per-request contracts.
+
+The acceptance criteria (ISSUE 6): a served multi-chunk request
+(prefix-cache warm, chunked prefill, >=8 decoded tokens) produces a
+span tree whose children nest within their parents and whose
+queue+prefill+decode spans account for the root duration within
+tolerance; ``dump_timeline`` emits valid trace-event JSON (monotonic,
+nested-or-disjoint per-track slices); greedy server output stays
+token-identical to one-shot ``generate()`` with tracing ON; tracing
+fully OFF allocates no trace objects on the hot path (counted via gc);
+head sampling is deterministic under a fixed seed; slow/rejected
+requests are always kept; and the SLO gauges flip on an injected
+latency violation driven by a fake clock (no real sleeps).
+"""
+import gc
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference import (ContinuousBatchingServer,
+                                     DeepSpeedInferenceConfig,
+                                     InferenceEngine)
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, init_params)
+from deepspeed_tpu.telemetry import (EventRing, MetricRegistry, SLOConfig,
+                                     SLOMonitor, Trace, Tracer, TraceSpan,
+                                     get_registry, set_event_ring,
+                                     set_registry, span, start_http_server)
+from deepspeed_tpu.telemetry.exporter import ROUTES
+
+
+@pytest.fixture()
+def fresh_telemetry():
+    """Private process registry + event ring for one test — servers
+    built inside see only their own metrics/events."""
+    prev_reg = set_registry(MetricRegistry())
+    prev_ring = set_event_ring(EventRing(256))
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev_reg)
+        set_event_ring(prev_ring)
+
+
+def make_engine(seed=0, max_out_tokens=256, block_size=32, num_slots=4,
+                **knobs):
+    base = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+                n_head=4, dtype=jnp.float32)
+    cfg = InferenceTransformerConfig(**base)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    return InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=max_out_tokens,
+        block_size=block_size, num_slots=num_slots, **knobs))
+
+
+TRACE_ALL = {"trace_sample_rate": 1.0}
+PREFIX = [1 + (i % 100) for i in range(64)]        # 2 full 32-blocks
+
+
+def _spans_by_name(root):
+    out = {}
+    for c in root.children:
+        out.setdefault(c.name, []).append(c)
+    return out
+
+
+def _assert_nested(sp, eps=1e-6):
+    for c in sp.children:
+        assert c.start >= sp.start - eps, (c.name, "starts before parent")
+        assert c.end is not None, (c.name, "never closed")
+        assert c.end <= sp.end + eps, (c.name, "ends after parent")
+        _assert_nested(c, eps)
+
+
+# ------------------------------------------------------- span tree shape
+
+def test_multichunk_request_span_tree(fresh_telemetry):
+    """THE acceptance tree: warm the prefix cache, then serve a shared-
+    prefix request whose cold tail spans multiple chunks and decodes
+    >=8 tokens — every lifecycle phase is a child span, children nest,
+    and the phases account for the root duration."""
+    eng = make_engine(enable_prefix_caching=True, telemetry=TRACE_ALL)
+    srv = ContinuousBatchingServer(eng)
+    srv.submit(PREFIX + [9, 8, 7], max_new_tokens=4)
+    srv.drain()                                # warms cache + traces
+    rid = srv.submit(PREFIX + [33] * 40, max_new_tokens=10)
+    srv.drain()
+    tr = [t for t in srv.tracer.traces() if t.trace_id == rid][0]
+    assert tr.status == "ok" and tr.keep_reason == "sampled"
+    root = tr.root
+    assert root.name == "request"
+    assert root.attributes["prompt_tokens"] == 104
+    assert root.attributes["finish_reason"] == "length"
+    assert root.attributes["generated_tokens"] == 10
+    by_name = _spans_by_name(root)
+    for phase in ("queue_wait", "admission", "prefill", "decode",
+                  "finish"):
+        assert phase in by_name, f"missing {phase} span"
+    adm = by_name["admission"][0]
+    assert adm.attributes["prefix_cache_hit"] is True
+    assert adm.attributes["blocks_reused"] == 2     # warm 2-block prefix
+    pf = by_name["prefill"][0]
+    assert pf.attributes["chunked"] is True
+    assert pf.attributes["cached_tokens_skipped"] == 64
+    chunks = [c for c in pf.children if c.name == "prefill_chunk"]
+    assert len(chunks) >= 2                         # 40-token cold tail
+    assert [c.attributes["start_token"] for c in chunks] == \
+        sorted(c.attributes["start_token"] for c in chunks)
+    dec = by_name["decode"][0]
+    assert dec.attributes["tokens_committed"] == 9  # first tok = prefill
+    assert dec.attributes["steps"] == 9
+    # nesting + accounting: children inside the root, phases covering
+    # the root duration (structural gaps are sub-millisecond host work)
+    _assert_nested(root)
+    root_dur = root.duration_s
+    assert root_dur > 0
+    covered = sum(c.duration_s for c in root.children)
+    assert 0.7 * root_dur <= covered <= 1.05 * root_dur, \
+        (covered, root_dur)
+
+
+# -------------------------------------------------------- retention rules
+
+def test_head_sampling_deterministic_under_seed():
+    def kept_ids(seed):
+        t = Tracer(sample_rate=0.5, seed=seed, registry=MetricRegistry())
+        kept = []
+        for i in range(64):
+            tr = t.start_trace("r", trace_id=i)
+            if t.finish(tr):
+                kept.append(i)
+        return kept
+
+    assert kept_ids(7) == kept_ids(7)
+    assert kept_ids(7) != kept_ids(8)
+    assert 10 < len(kept_ids(7)) < 54      # actually probabilistic
+
+
+def test_slow_and_error_traces_always_kept():
+    reg = MetricRegistry()
+    t = Tracer(sample_rate=0.0, slow_threshold_s=0.5, registry=reg,
+               clock=lambda: 0.0)
+    fast = t.start_trace("r", start=0.0)
+    assert t.finish(fast, end=0.1) is False          # not sampled, fast
+    slow = t.start_trace("r", start=0.0)
+    assert t.finish(slow, end=0.7) is True
+    assert slow.keep_reason == "slow"
+    err = t.start_trace("r", start=0.0)
+    assert t.finish(err, status="error", end=0.01) is True
+    assert err.keep_reason == "error"
+    snap = reg.snapshot()["trace_kept_total"]["series"]
+    assert {s["labels"]["reason"]: s["value"] for s in snap} == \
+        {"slow": 1, "error": 1}
+
+
+def test_rejected_requests_always_kept(fresh_telemetry):
+    """Server + scheduler rejections produce always-keep error traces
+    even at a vanishing sample rate."""
+    eng = make_engine(telemetry={"trace_sample_rate": 1e-12})
+    srv = ContinuousBatchingServer(eng)
+    with pytest.raises(ValueError):
+        srv.submit([], max_new_tokens=4)                 # server-side
+    with pytest.raises(ValueError):
+        srv.submit(list(range(1, 50)), max_new_tokens=100000)  # span
+    kept = srv.tracer.traces()
+    assert len(kept) == 2
+    assert all(t.status == "rejected" and t.keep_reason == "error"
+               for t in kept)
+    assert {t.root.attributes["error"] for t in kept} == \
+        {"empty_prompt", "span"}
+
+
+# ------------------------------------------------- hot path stays clean
+
+def test_tracing_off_allocates_no_trace_objects(fresh_telemetry):
+    """telemetry.trace_sample_rate=0 (the default) must leave the hot
+    path allocation-free: no Tracer on the server, and serving requests
+    creates zero Trace/TraceSpan objects."""
+    eng = make_engine()          # default telemetry: tracing off
+    srv = ContinuousBatchingServer(eng)
+    assert srv.tracer is None and srv.slo is None
+
+    def live_trace_objects():
+        gc.collect()
+        return sum(isinstance(o, (Trace, TraceSpan))
+                   for o in gc.get_objects())
+
+    before = live_trace_objects()
+    ids = [srv.submit([1 + i, 2, 3], max_new_tokens=4) for i in range(3)]
+    out = srv.drain()
+    assert all(out[i] for i in ids)
+    assert live_trace_objects() <= before
+    assert srv._rt == {}
+    assert srv.stats["traces_started"] == 0
+    assert srv.stats["traces_kept"] == 0
+
+
+# ------------------------------------------------------- parity oracle
+
+def test_greedy_parity_with_tracing_on(fresh_telemetry):
+    """Tracing is host-only bookkeeping: greedy served output must stay
+    token-identical to one-shot generate() with every request traced."""
+    prompts = [[1, 2, 3], [9, 8, 7, 6, 5], PREFIX + [4, 4]]
+    ref = make_engine().generate(prompts, max_new_tokens=8)
+    eng = make_engine(enable_prefix_caching=True, telemetry=TRACE_ALL)
+    srv = ContinuousBatchingServer(eng)
+    ids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+    res = srv.drain()
+    assert [res[i] for i in ids] == ref
+    assert srv.tracer.kept == len(prompts)
+
+
+# ------------------------------------------------------ timeline export
+
+def _validate_trace_events(payload):
+    """Trace-event JSON checks: required keys, non-negative durations,
+    and per-track slices that are monotonic and nested-or-disjoint."""
+    assert isinstance(payload, dict)
+    evs = payload["traceEvents"]
+    assert isinstance(evs, list) and evs
+    tracks = {}
+    for e in evs:
+        assert {"name", "ph", "pid", "tid"} <= set(e), e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and e["ts"] >= 0, e
+            tracks.setdefault((e["pid"], e["tid"]), []).append(
+                (e["ts"], e["dur"], e["name"]))
+    assert tracks, "no complete-event slices at all"
+    eps = 0.5   # µs — float rounding in the writer
+    for key, slices in tracks.items():
+        slices.sort(key=lambda s: (s[0], -s[1]))
+        stack = []
+        for ts, dur, name in slices:
+            while stack and ts >= stack[-1] - eps:
+                stack.pop()                  # enclosing slices closed
+            if stack:                        # nested: stay inside parent
+                assert ts + dur <= stack[-1] + eps, (key, name)
+            stack.append(ts + dur)
+    return tracks
+
+
+def test_dump_timeline_valid_chrome_trace(tmp_path, fresh_telemetry):
+    eng = make_engine(enable_prefix_caching=True, telemetry=TRACE_ALL)
+    srv = ContinuousBatchingServer(eng)
+    for i in range(3):
+        srv.submit(PREFIX + [30 + i] * (5 + i), max_new_tokens=6)
+    srv.drain()
+    path = tmp_path / "timeline.json"
+    n = srv.dump_timeline(str(path))
+    payload = json.loads(path.read_text())
+    assert len(payload["traceEvents"]) == n
+    tracks = _validate_trace_events(payload)
+    # request tracks (pid 1) for every kept trace, plus device tracks
+    # (pid 2) rebuilt from the event ring: the sampled first decode
+    # step and at least one compile slice
+    req_tracks = [k for k in tracks if k[0] == 1]
+    assert len(req_tracks) == srv.tracer.kept == 3
+    names = {e["name"] for e in payload["traceEvents"]}
+    assert any(nm.startswith("decode step") for nm in names)
+    assert any(nm.startswith("compile ") for nm in names)
+    # metadata rows name the processes
+    metas = [e for e in payload["traceEvents"] if e["ph"] == "M"]
+    assert {"requests", "device"} <= {
+        e["args"]["name"] for e in metas if e["name"] == "process_name"}
+
+
+def test_dump_timeline_requires_tracing(fresh_telemetry):
+    srv = ContinuousBatchingServer(make_engine())
+    with pytest.raises(RuntimeError, match="trace_sample_rate"):
+        srv.dump_timeline("/tmp/never_written.json")
+
+
+# ------------------------------------------------------------ SLO gates
+
+def _slo_cfg(**kw):
+    base = dict(enabled=True, ttft_p90_s=0.1, window_s=10.0,
+                eval_interval_s=0.0)
+    base.update(kw)
+    return SLOConfig(**base)
+
+
+def test_slo_gauges_flip_on_injected_violation():
+    """Fake clock, no sleeps: fast TTFTs keep the gate green; a burst of
+    slow ones flips the violation gauge, counts ONE transition, and
+    records the flight-recorder event; once the window slides past the
+    burst the gate re-arms."""
+    reg = MetricRegistry()
+    ring = EventRing(64)
+    t = [0.0]
+    mon = SLOMonitor(_slo_cfg(), registry=reg, clock=lambda: t[0],
+                     ring=ring)
+    h = reg.histogram("serve_ttft_seconds")
+    for _ in range(10):
+        h.observe(0.01)
+    res = mon.evaluate()
+    assert res["ttft_p90"]["violated"] is False
+    assert reg.gauge("slo_violation",
+                     labels={"objective": "ttft_p90"}).value == 0
+    assert mon.compliance_ratio == 1.0
+    # inject the violation: p90 of the window is now ~1s >> 0.1s target
+    for _ in range(50):
+        h.observe(1.0)
+    t[0] = 1.0
+    res = mon.evaluate()
+    assert res["ttft_p90"]["violated"] is True
+    assert res["ttft_p90"]["observed"] > 0.1
+    assert reg.gauge("slo_violation",
+                     labels={"objective": "ttft_p90"}).value == 1
+    assert reg.gauge("slo_compliance_ratio").value == 0.0
+    assert reg.counter("slo_violations_total",
+                       labels={"objective": "ttft_p90"}).value == 1
+    evs = [e for e in ring.snapshot() if e["kind"] == "slo_violation"]
+    assert len(evs) == 1 and evs[0]["data"]["objective"] == "ttft_p90"
+    # still violated: no double-counted transition
+    t[0] = 2.0
+    mon.evaluate()
+    assert reg.counter("slo_violations_total",
+                       labels={"objective": "ttft_p90"}).value == 1
+    # the burst ages out of the 10 s window; fresh traffic is fast
+    t[0] = 13.0
+    for _ in range(20):
+        h.observe(0.01)
+    res = mon.evaluate()
+    assert res["ttft_p90"]["violated"] is False
+    assert reg.gauge("slo_violation",
+                     labels={"objective": "ttft_p90"}).value == 0
+    assert reg.gauge("slo_compliance_ratio").value == 1.0
+    assert len([e for e in ring.snapshot()
+                if e["kind"] == "slo_violation"]) == 1
+
+
+def test_slo_error_rate_objective():
+    """Denominator is ATTEMPTS (accepted + rejected): the submitted
+    counter only counts accepted submits, so an all-rejected outage
+    must read 1.0, never no-data green."""
+    reg = MetricRegistry()
+    t = [0.0]
+    mon = SLOMonitor(_slo_cfg(ttft_p90_s=None, error_rate=0.2),
+                     registry=reg, clock=lambda: t[0], ring=EventRing(8))
+    sub = reg.counter("serve_requests_submitted_total")
+    rej = reg.counter("serve_admission_rejections_total",
+                      labels={"reason": "queue_full"})
+    sub.inc(10)
+    assert mon.evaluate()["error_rate"]["violated"] is False
+    sub.inc(10)
+    rej.inc(9)
+    t[0] = 1.0
+    res = mon.evaluate()
+    assert res["error_rate"]["violated"] is True
+    # monitor younger than window_s: ALL history is in-window —
+    # 9 rejections over 29 attempts (20 accepted + 9 rejected)
+    assert res["error_rate"]["observed"] == pytest.approx(9 / 29)
+    # full outage: every attempt in the window rejected, none accepted
+    rej.inc(40)
+    t[0] = 20.0            # prior traffic aged out of the 10 s window
+    res = mon.evaluate()
+    assert res["error_rate"]["observed"] == pytest.approx(1.0)
+    assert res["error_rate"]["violated"] is True
+
+
+def test_slo_violation_held_through_traffic_pause():
+    """A burning SLO must not auto-clear (then double-fire) across a
+    window with zero samples — no-data holds the previous verdict."""
+    reg = MetricRegistry()
+    ring = EventRing(16)
+    t = [0.0]
+    mon = SLOMonitor(_slo_cfg(), registry=reg, clock=lambda: t[0],
+                     ring=ring)
+    h = reg.histogram("serve_ttft_seconds")
+    for _ in range(20):
+        h.observe(1.0)                       # violating from the start
+    assert mon.evaluate()["ttft_p90"]["violated"] is True
+    # traffic pauses; the burst ages out -> zero in-window samples
+    t[0] = 30.0
+    res = mon.evaluate()
+    assert res["ttft_p90"]["no_data"] is True
+    assert res["ttft_p90"]["violated"] is True        # held, not cleared
+    assert reg.gauge("slo_violation",
+                     labels={"objective": "ttft_p90"}).value == 1
+    # slow traffic resumes: still ONE counted transition, ONE ring event
+    for _ in range(20):
+        h.observe(1.0)
+    t[0] = 31.0
+    assert mon.evaluate()["ttft_p90"]["violated"] is True
+    assert reg.counter("slo_violations_total",
+                       labels={"objective": "ttft_p90"}).value == 1
+    assert len([e for e in ring.snapshot()
+                if e["kind"] == "slo_violation"]) == 1
+
+
+def test_slo_eval_interval_gating():
+    reg = MetricRegistry()
+    t = [0.0]
+    mon = SLOMonitor(_slo_cfg(eval_interval_s=5.0), registry=reg,
+                     clock=lambda: t[0], ring=EventRing(8))
+    assert mon.maybe_evaluate() is not None      # first call evaluates
+    assert mon.maybe_evaluate() is None          # too soon
+    t[0] = 5.0
+    assert mon.maybe_evaluate() is not None
+    assert mon.evaluations == 2
+
+
+def test_server_arms_slo_from_config(fresh_telemetry):
+    eng = make_engine(telemetry={
+        "slo": {"enabled": True, "ttft_p90_s": 30.0,
+                "eval_interval_s": 0.0}})
+    srv = ContinuousBatchingServer(eng)
+    assert srv.slo is not None and srv.tracer is None
+    srv.submit([1, 2, 3], max_new_tokens=4)
+    srv.drain()
+    assert srv.slo.evaluations >= 1
+    assert srv.stats["slo_compliance"] == 1.0
+    assert fresh_telemetry.gauge("slo_compliance_ratio").value == 1.0
+
+
+# ----------------------------------------------- span() trace integration
+
+def test_span_joins_active_trace_and_records_exceptions():
+    reg = MetricRegistry()
+    t = Tracer(sample_rate=1.0, registry=reg)
+    tr = t.start_trace("request")
+    with pytest.raises(RuntimeError):
+        with tr.activate():
+            with span("detokenize", registry=reg):
+                raise RuntimeError("boom")
+    child = tr.root.children[0]
+    assert child.name == "detokenize"
+    assert child.end is not None                  # closed despite raise
+    assert child.attributes["error"] == "RuntimeError"
+    hist = reg.histogram("span_duration_seconds",
+                         labels={"span": "detokenize"})
+    assert hist.count == 1                        # histogram still fed
+    # explicit parent nests under a span other than the active one
+    with span("byte_decode", registry=reg, parent=child):
+        pass
+    assert [c.name for c in child.children] == ["byte_decode"]
+    # errored traces are always kept
+    assert t.finish(tr, status="error") is True
+
+
+def test_nested_spans_nest_not_flatten():
+    """A span() inside a span() parents under the OUTER span: entering a
+    span advances the context anchor, so nesting in code is nesting in
+    the tree (not a flat run of root-level siblings)."""
+    reg = MetricRegistry()
+    t = Tracer(sample_rate=1.0, registry=reg)
+    tr = t.start_trace("request")
+    with tr.activate():
+        with span("outer", registry=reg) as outer:
+            with span("inner", registry=reg) as inner:
+                pass
+    assert outer.parent is tr.root
+    assert inner.parent is outer
+    assert [c.name for c in tr.root.children] == ["outer"]
+    assert [c.name for c in outer.children] == ["inner"]
+
+
+def test_span_without_active_trace_unchanged():
+    reg = MetricRegistry()
+    with span("plain", registry=reg) as sp:
+        assert sp is None
+    assert reg.histogram("span_duration_seconds",
+                         labels={"span": "plain"}).count == 1
+
+
+# ------------------------------------------------ one-shot + train traces
+
+def test_generate_gets_two_level_trace(fresh_telemetry):
+    eng = make_engine(telemetry=TRACE_ALL)
+    out = eng.generate([[1, 2, 3], [4, 5]], max_new_tokens=5)
+    assert len(out) == 2
+    assert eng.tracer is not None and eng.tracer.kept == 1
+    tr = eng.tracer.traces()[0]
+    assert tr.root.name == "generate"
+    assert tr.root.attributes["rows"] == 2
+    assert [c.name for c in tr.root.children] == \
+        ["prefill_dispatch", "decode_dispatch", "fetch"]
+    _assert_nested(tr.root)
+
+
+def test_generate_error_trace_always_kept(fresh_telemetry):
+    """A generation that crashes mid-flight finishes its trace as an
+    error (always kept); parameter-validation refusals raise BEFORE the
+    trace opens and leave no half-open trace behind."""
+    eng = make_engine(telemetry={"trace_sample_rate": 1e-12})
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        eng.generate([[1, 2]], max_new_tokens=4, repetition_penalty=-1.0)
+    assert eng.tracer.started == 0          # refused pre-trace
+    # crash mid-flight: a prompt token beyond the vocab blows up the
+    # host-side presence-mask build AFTER the trace opened
+    with pytest.raises(IndexError):
+        eng.generate([[500, 2]], max_new_tokens=4,
+                     temperature=1.0, repetition_penalty=1.5)
+    assert eng.tracer.started == 1
+    kept = eng.tracer.traces()
+    assert len(kept) == 1 and kept[0].keep_reason == "error"
+    assert kept[0].root.attributes["error"] == "IndexError"
+
+
+def test_train_step_trace_reuses_goodput_splits(fresh_telemetry):
+    import deepspeed_tpu
+    params = {"w": jnp.ones((8, 4), jnp.float32)}
+
+    def loss_fn(p, b, rng):
+        return jnp.mean((b["x"] @ p["w"]) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "steps_per_print": 100,
+                "optimizer": {"type": "sgd", "params": {"lr": 0.01}},
+                "telemetry": {"trace_sample_rate": 1.0, "goodput": True}})
+    try:
+        B = engine.train_batch_size
+        batch = {"x": jnp.ones((B, 8), jnp.float32)}
+        engine.train_batch(batch)
+        engine.train_batch(batch)
+        tr = engine.tracer.traces()[-1]
+        assert tr.root.name == "train_step"
+        assert tr.root.attributes["goodput_measured"] is True
+        names = [c.name for c in tr.root.children]
+        assert "device" in names and "host" in names
+        # the synthesized children partition the root exactly
+        covered = sum(c.duration_s for c in tr.root.children)
+        assert covered == pytest.approx(tr.root.duration_s, rel=1e-6)
+        _assert_nested(tr.root)
+    finally:
+        engine.destroy()
+
+
+# ------------------------------------------------------- scrape surface
+
+def test_debug_traces_route_and_route_table(fresh_telemetry):
+    reg = MetricRegistry()
+    tracer = Tracer(sample_rate=1.0, registry=reg)
+    tracer.finish(tracer.start_trace("request", trace_id=5))
+    with start_http_server(0, registry=reg, tracer=tracer) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        d = json.loads(urllib.request.urlopen(
+            base + "/debug/traces", timeout=10).read())
+        assert d["kept"] == 1
+        assert d["traces"][0]["trace_id"] == 5
+        assert d["traces"][0]["root"]["name"] == "request"
+        # `/` help text and the 404 body both render from ROUTES — one
+        # table, every listing (the factoring this PR's small-fix asked)
+        help_text = urllib.request.urlopen(
+            base + "/", timeout=10).read().decode()
+        for route in ROUTES:
+            assert route in help_text
+        assert "/debug/traces" in ROUTES
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        body = ei.value.read().decode()
+        assert "/debug/traces" in body and "/metrics.json" in body
