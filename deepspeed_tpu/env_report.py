@@ -110,6 +110,14 @@ def telemetry_info():
             f"{', '.join(slo_targets)}; window {cfg.slo.window_s}s)"
             if cfg.slo.enabled and slo_targets
             else "off (set telemetry.slo.enabled + objectives)")
+        fic = cfg.fault_injection
+        out["fault_injection"] = (
+            f"ARMED (seed {fic.seed}; step latency "
+            f"{fic.step_latency_s}s, prefill failure rate "
+            f"{fic.prefill_failure_rate}, famine {fic.famine_blocks} "
+            f"blocks, wedge every {fic.wedge_nth_request})"
+            if fic.enabled
+            else "off (chaos hooks; telemetry.fault_injection)")
     except Exception as e:  # pragma: no cover - env specific
         out["telemetry"] = f"unavailable: {e}"
         return out

@@ -106,9 +106,34 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # enable_prefix_caching, which defaults this to block_size). Must
     # be a multiple of block_size.
     prefill_chunk_tokens: int = 0
+    # -------- request lifecycle (docs/serving.md "Request lifecycle &
+    # overload behavior") --------------------------------------------
+    # recompute preemption: how often one request may be preempted and
+    # requeued before the server fails it (always-keep error trace)
+    max_preemptions: int = 3
+    # requeue backoff, in decode steps: after its k-th preemption a
+    # request is not re-admittable for backoff * 2^(k-1) steps — it
+    # cannot thrash with the request that preempted it
+    preemption_backoff_steps: int = 4
+    # SLO-driven load shedding: when the telemetry.slo queue_wait_p90
+    # objective is in violation, each step() fast-fails the lowest-
+    # priority newest queued request (finish reason "shed") while the
+    # queue is deeper than num_slots — bounding queue wait before
+    # latency collapses. Requires telemetry.slo.enabled with
+    # queue_wait_p90_s set.
+    enable_load_shedding: bool = False
     # metrics registry + optional scrape endpoint (docs/observability.md);
     # the shared section schema lives in telemetry/config.py
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+
+    @field_validator("max_preemptions", "preemption_backoff_steps")
+    @classmethod
+    def _non_negative(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"{info.field_name} must be >= 0 (max_preemptions=0 "
+                f"disables preemption entirely), got {v}")
+        return v
 
     @field_validator("max_batch_size", "num_slots", "max_queued_requests")
     @classmethod

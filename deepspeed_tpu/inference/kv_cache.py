@@ -373,11 +373,32 @@ class BlockAllocator:
         self._hash_to_block: Dict[bytes, int] = {}
         self._block_hash: Dict[int, bytes] = {}
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # blocks withheld from the free budget (fault injection / tests
+        # simulating pool pressure — telemetry/faultinject.py); never
+        # handed out while reserved
+        self.reserved_blocks = 0
+        # observer for LRU evictions (the scheduler counts them + drops
+        # a ring event: the first rung of the degradation ladder must be
+        # visible, not silent)
+        self.on_evict = None
+        self.evictions = 0
+
+    def set_reserved(self, n: int) -> None:
+        """Withhold ``n`` blocks from the free budget (famine
+        injection). Already-live blocks are unaffected — the squeeze
+        lands on future admissions, exactly like real pressure."""
+        if n < 0 or n > self.usable_blocks:
+            raise ValueError(
+                f"reserved blocks must be in [0, {self.usable_blocks}], "
+                f"got {n}")
+        self.reserved_blocks = int(n)
 
     @property
     def free_blocks(self) -> int:
-        """Allocatable blocks: immediately free + evictable cached."""
-        return len(self._free) + len(self._lru)
+        """Allocatable blocks: immediately free + evictable cached,
+        minus any fault-injected reservation."""
+        return max(
+            0, len(self._free) + len(self._lru) - self.reserved_blocks)
 
     @property
     def usable_blocks(self) -> int:
@@ -407,6 +428,9 @@ class BlockAllocator:
         # a later identical prefix re-prefills and re-registers
         b, _ = self._lru.popitem(last=False)
         self._drop_hash(b)
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(b)
         return b
 
     def _drop_hash(self, b: int) -> None:

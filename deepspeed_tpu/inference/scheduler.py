@@ -18,16 +18,31 @@ Design choices vs GPU vLLM, for the static-shape TPU world:
   cost is reserving the tail of a sequence that EOSes early — those
   blocks come back at completion, which is still per-request granularity
   instead of the dense cache's per-BATCH granularity.
-* FIFO admission (head-of-line): a request that does not fit blocks
-  requests behind it even if they would fit. This is deliberate —
-  skip-ahead is a starvation policy decision that belongs to a future
-  priority scheduler, not the substrate.
+* Priority-then-FIFO admission (head-of-line): the highest-priority
+  eligible request is considered next (FIFO within a priority level),
+  and if it does not fit it blocks requests behind it even if they
+  would fit. Two lifecycle states make a queued request temporarily
+  ineligible and are skipped without blocking the line: a preempted
+  request still in its requeue backoff (``ready_at_step``), and an
+  expired deadline (reaped by the server, never admitted — doomed work
+  must not take a slot from live work). Priority-aware ordering also
+  keeps preemption stable (see :meth:`Scheduler._next_eligible`).
+* **Preemption** (vLLM-style recompute, docs/serving.md "Request
+  lifecycle & overload behavior"): under pool pressure the server may
+  preempt the lowest-priority (tie: newest) resident via
+  :meth:`pick_preemption_victim` + :meth:`preempt`; the victim's blocks
+  release through the normal refcount path (full prefix-cached blocks
+  park in the LRU, so re-admission replays warm) and the request
+  requeues at the FRONT with its committed tokens carried in
+  ``Request.committed`` — re-admission prefills ``prompt + committed``
+  and decoding continues exactly where it stopped (greedy parity with
+  an uninterrupted run is test-pinned).
 """
 from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator,
                                               prefix_block_hashes)
@@ -41,19 +56,46 @@ class Request:
     prompt: List[int]
     max_new_tokens: int = 32
     eos_token_id: Optional[int] = None
-    # memoized chain hashes of the prompt's full blocks — a blocked
-    # queue head is re-tried every step and must not re-sha256 its
-    # (possibly 100k-token) prompt each time
+    # scheduling priority: higher wins. Preemption and shedding both
+    # act on the LOWEST priority first; FIFO order breaks ties.
+    priority: int = 0
+    # absolute deadline on the server's clock (None = no deadline);
+    # expired requests are reaped, never admitted
+    deadline_ts: Optional[float] = None
+    # recompute-preemption state: tokens already generated before the
+    # last preemption (re-admission prefills prompt + committed), how
+    # often this request was preempted, and the decode-step clock tick
+    # before which it must not be re-admitted (backoff)
+    committed: List[int] = dataclasses.field(default_factory=list)
+    preemptions: int = 0
+    ready_at_step: int = 0
+    # memoized chain hashes of the scheduling prompt's full blocks — a
+    # blocked queue head is re-tried every step and must not re-sha256
+    # its (possibly 100k-token) prompt each time. Invalidated on
+    # preemption (the scheduling prompt grows by the committed tokens).
     _hashes: Optional[List[bytes]] = dataclasses.field(
         default=None, repr=False, compare=False)
 
+    @property
+    def sched_prompt(self) -> List[int]:
+        """What admission actually prefills: the original prompt plus
+        any tokens committed before a preemption."""
+        return self.prompt + self.committed if self.committed \
+            else self.prompt
+
     def blocks_needed(self, block_size: int) -> int:
+        # the full span is invariant under preemption: committed tokens
+        # move from budget to prompt, prompt+max_new_tokens stays put
         span = len(self.prompt) + self.max_new_tokens
         return -(-span // block_size)   # ceil
 
+    def expired(self, now: float) -> bool:
+        return self.deadline_ts is not None and now >= self.deadline_ts
+
     def prefix_hashes(self, block_size: int) -> List[bytes]:
         if self._hashes is None:
-            self._hashes = prefix_block_hashes(self.prompt, block_size)
+            self._hashes = prefix_block_hashes(self.sched_prompt,
+                                               block_size)
         return self._hashes
 
 
@@ -67,9 +109,14 @@ class SlotState:
     arrived_step: int = 0   # decode-step clock at admission (telemetry)
     # prefix caching: leading blocks taken from the cache (no prefill
     # compute, refcounted — NOT private to this sequence), and the full
-    # prompt blocks' chain hashes for post-prefill registration
+    # scheduling-prompt blocks' chain hashes for post-prefill
+    # registration
     cached_blocks: int = 0
     prompt_hashes: List[bytes] = dataclasses.field(default_factory=list)
+    # True when this admission resumes a preempted request (generated
+    # starts pre-seeded with Request.committed; TTFT was observed long
+    # ago and must not be re-observed)
+    resumed: bool = False
 
 
 class Scheduler:
@@ -111,6 +158,11 @@ class Scheduler:
             "serve_prefix_cached_blocks",
             help="pool blocks holding a reusable hashed prefix "
                  "(resident shared + evictable LRU)")
+        self._g_requeue = reg.gauge(
+            "serve_requeue_depth",
+            help="preempted requests waiting in the queue for "
+                 "re-admission (recompute preemption — docs/serving.md "
+                 "'Request lifecycle & overload behavior')")
         self._c_hits = reg.counter(
             "serve_prefix_cache_hits_total",
             help="prompt prefix blocks reused from the cache at "
@@ -120,7 +172,22 @@ class Scheduler:
             "serve_prefix_cache_misses_total",
             help="cacheable prompt prefix blocks NOT found at "
                  "admission (prefilled cold)")
+        self._c_evict = reg.counter(
+            "serve_prefix_cache_evictions_total",
+            help="cached blocks evicted from the LRU because an "
+                 "allocation outran the free list — the first rung of "
+                 "the degradation ladder (evict before preempt before "
+                 "shed)")
+        self.allocator.on_evict = self._on_evict
         self._update_gauges()
+
+    def _on_evict(self, block: int) -> None:
+        """LRU eviction observer: the ladder's first rung leaves a
+        counter tick and a ring entry."""
+        self._c_evict.inc()
+        from deepspeed_tpu.telemetry.events import (PREFIX_EVICT,
+                                                    record_event)
+        record_event(PREFIX_EVICT, block=block, source="scheduler")
 
     def _update_gauges(self) -> None:
         """Refresh level gauges at every admission-state transition —
@@ -132,6 +199,7 @@ class Scheduler:
         self._g_queue.set(len(self.queue))
         self._g_active.set(len(self.slots))
         self._g_cached.set(self.allocator.cached_blocks)
+        self._g_requeue.set(self.requeue_depth)
 
     def _reject(self, reason: str,
                 request_id: Optional[int] = None) -> None:
@@ -184,27 +252,68 @@ class Scheduler:
 
     # ------------------------------------------------------------ admit
 
-    def admit_next(self, step_clock: int = 0):
-        """Pop the FIFO head into a free slot when its whole block span
-        fits the free list. Returns ``(slot, SlotState)`` or None.
+    def _next_eligible(self, step_clock: int,
+                       now: Optional[float]) -> Optional[int]:
+        """Queue index of the next admittable request: the
+        highest-priority eligible entry, FIFO within a priority level.
+        Skips preempted requests still backing off (``ready_at_step``)
+        and — when the server supplied its clock — requests whose
+        deadline already expired (the server reaps those; admitting
+        doomed work would steal a slot from live work). Skipped
+        requests keep their queue position.
 
-        With prefix caching, the prompt's block-aligned prefix is
-        walked against the hash index first: every consecutive hit is
-        taken by refcount (no allocation, no prefill compute), and only
-        the tail span allocates. Reuse is capped one token short of the
-        prompt (``(len(prompt) - 1) // block_size`` blocks) — the
-        prefill must process at least the last prompt token to produce
-        the first output logits."""
-        if not self.queue or not self._free_slots:
+        Priority-aware selection is what keeps preemption stable: a
+        backed-off low-priority request front-requeued by a preemption
+        must not grab the free slot ahead of the very high-priority
+        waiter it was evicted for — FIFO here would re-admit it, waste
+        a full prefill, and immediately preempt it again, burning its
+        retry budget toward a spurious ``failed``."""
+        best = None
+        for i, req in enumerate(self.queue):
+            if req.ready_at_step > step_clock:
+                continue
+            if now is not None and req.expired(now):
+                continue
+            if best is None or req.priority > self.queue[best].priority:
+                best = i
+        return best
+
+    def next_ready(self, step_clock: int,
+                   now: Optional[float] = None) -> Optional[Request]:
+        """The request :meth:`admit_next` would consider right now (the
+        server's preemption logic peeks at its priority/span)."""
+        i = self._next_eligible(step_clock, now)
+        return None if i is None else self.queue[i]
+
+    def admit_next(self, step_clock: int = 0,
+                   now: Optional[float] = None):
+        """Pop the first eligible request into a free slot when its
+        whole block span fits the free list. Returns ``(slot,
+        SlotState)`` or None.
+
+        With prefix caching, the scheduling prompt's block-aligned
+        prefix is walked against the hash index first: every consecutive
+        hit is taken by refcount (no allocation, no prefill compute),
+        and only the tail span allocates. Reuse is capped one token
+        short of the prompt (``(len(prompt) - 1) // block_size``
+        blocks) — the prefill must process at least the last prompt
+        token to produce the first output logits. A resumed (preempted)
+        request's scheduling prompt includes its committed tokens, so
+        blocks its previous residency demoted into the LRU hit warm."""
+        if not self._free_slots:
             return None
-        req = self.queue[0]
+        idx = self._next_eligible(step_clock, now)
+        if idx is None:
+            return None
+        req = self.queue[idx]
         nb = req.blocks_needed(self.block_size)
+        sched_prompt = req.sched_prompt
         hashes: List[bytes] = []
         hits: List[int] = []
         reusable = 0
         if self.enable_prefix_caching:
             hashes = req.prefix_hashes(self.block_size)
-            reusable = (len(req.prompt) - 1) // self.block_size
+            reusable = (len(sched_prompt) - 1) // self.block_size
             if nb - reusable > self.allocator.free_blocks:
                 # even an all-hit prefix couldn't cover the tail —
                 # skip the match/rollback refcount churn entirely
@@ -215,7 +324,7 @@ class Scheduler:
             if hits:   # roll the acquired hits back (refcount--)
                 self.allocator.release(hits)
             return None
-        self.queue.popleft()
+        del self.queue[idx]
         if self.enable_prefix_caching:
             # counted only on successful admission — a blocked head
             # retried every step must not inflate the hit/miss story
@@ -225,9 +334,11 @@ class Scheduler:
             self.prefix_misses += reusable - len(hits)
         slot = self._free_slots.pop()
         state = SlotState(request=req, blocks=hits + tail,
+                          generated=list(req.committed),
                           arrived_step=step_clock,
                           cached_blocks=len(hits),
-                          prompt_hashes=hashes)
+                          prompt_hashes=hashes,
+                          resumed=req.preemptions > 0)
         self.slots[slot] = state
         self._update_gauges()
         return slot, state
@@ -258,6 +369,92 @@ class Scheduler:
         self._update_gauges()
         return state
 
+    # --------------------------------------------------------- lifecycle
+
+    def remove_queued(self, request_id: int) -> Optional[Request]:
+        """Pull one request out of the queue (cancellation / shedding /
+        deadline reap of queued work). Returns it, or None when it is
+        not queued."""
+        for i, req in enumerate(self.queue):
+            if req.request_id == request_id:
+                del self.queue[i]
+                self._update_gauges()
+                return req
+        return None
+
+    def find_slot(self, request_id: int) -> Optional[int]:
+        """The slot a request is resident in, or None."""
+        for slot, state in self.slots.items():
+            if state.request.request_id == request_id:
+                return slot
+        return None
+
+    def pick_preemption_victim(self
+                               ) -> Optional[Tuple[int, "SlotState"]]:
+        """The resident the ladder would preempt next: lowest priority,
+        tie broken by NEWEST admission (least sunk prefill/decode work
+        lost). Returns ``(slot, state)`` or None when no resident is
+        preemptible. The server compares the victim's priority against
+        the waiting request's — the scheduler only ranks."""
+        best = None
+        for slot, state in self.slots.items():
+            key = (state.request.priority, -state.arrived_step)
+            if best is None or key < best[0]:
+                best = (key, slot, state)
+        return None if best is None else (best[1], best[2])
+
+    def preempt(self, slot: int, step_clock: int, backoff_steps: int,
+                register_extension: bool = True) -> Request:
+        """vLLM-style recompute preemption: fold the victim's generated
+        tokens into ``Request.committed`` (re-admission prefills
+        ``prompt + committed`` — the pending token included, its KV was
+        never written and the replayed prefill recomputes it), release
+        its blocks through the refcount path (registered prefix blocks
+        park in the LRU → warm re-admission), and requeue at the FRONT
+        with an exponential backoff so it cannot thrash with its
+        preemptor. ``register_extension`` must be False for a victim
+        whose prefill never completed (mid-chunk content is not valid
+        cache material). The caller (server) owns the device-array
+        reset and the retry bound."""
+        state = self.slots[slot]
+        req = state.request
+        span = len(state.blocks) * self.block_size
+        if (self.enable_prefix_caching and register_extension
+                and state.generated
+                and len(req.prompt) + len(state.generated) - 1 <= span):
+            # demote the extension too: full blocks covering generated
+            # tokens whose KV IS written (everything but the pending
+            # token, whose KV the recompute prefill regenerates) are
+            # registered now, so re-admission hits them instead of
+            # replaying the whole sequence cold. A victim that
+            # out-decoded its allocated span (an injected wedge ignores
+            # the budget; appends past the span clamp into the LAST
+            # block, clobbering it) registers NOTHING — its tail
+            # content is garbage and must not poison the shared cache.
+            written = req.prompt + state.generated[:-1]
+            ext = prefix_block_hashes(written, self.block_size)
+            for i in range(len(state.prompt_hashes),
+                           min(len(ext), len(state.blocks))):
+                self.allocator.register_prefix(state.blocks[i], ext[i])
+        # fold at most max_new_tokens-1 generated tokens into the
+        # scheduling prompt: sched_prompt + >=1 budget token must stay
+        # inside the blocks_needed span. Only an out-of-budget wedged
+        # victim ever hits the clamp (its output is reaped, not served),
+        # so preempt-requeue greedy parity is unaffected.
+        keep = max(0, req.max_new_tokens - 1)
+        req.committed = list(state.generated[:keep])
+        req.preemptions += 1
+        req._hashes = None   # the scheduling prompt just grew
+        # floor of one tick: the victim requeues at the FRONT, so with
+        # zero backoff it would re-admit into the slot it just vacated
+        # BEFORE its preemptor and thrash straight to its retry bound
+        req.ready_at_step = step_clock + max(
+            1, backoff_steps * (2 ** (req.preemptions - 1)))
+        self.release(slot)
+        self.queue.appendleft(req)
+        self._update_gauges()
+        return req
+
     @property
     def active_slots(self) -> int:
         return len(self.slots)
@@ -265,6 +462,13 @@ class Scheduler:
     @property
     def pending_requests(self) -> int:
         return len(self.queue)
+
+    @property
+    def requeue_depth(self) -> int:
+        """Preempted requests waiting for re-admission (the
+        ``serve_requeue_depth`` gauge and ``server.stats`` both read
+        this — one predicate, no drift)."""
+        return sum(1 for r in self.queue if r.preemptions > 0)
 
     @property
     def idle(self) -> bool:

@@ -10,6 +10,22 @@ and an EOS'd sequence's blocks return to the pool immediately instead of
 spinning as dead weight until the batch's slowest row finishes (the
 one-shot ``generate`` head-of-line cost).
 
+Request lifecycle (docs/serving.md "Request lifecycle & overload
+behavior"): every request ends in exactly one finish reason — ``eos`` /
+``length`` (normal), ``cancelled`` (``cancel()`` or a bounded
+``drain(timeout_s=...)``), ``deadline`` (per-request ``deadline_s``
+expired; reaped each ``step()`` and never admitted), ``shed``
+(SLO-driven load shedding fast-failed it while queued), or ``failed``
+(prefill died, or preemption retries exhausted). Preemption is the one
+lifecycle edge that does NOT finish a request: under pool pressure a
+higher-priority arrival preempts the lowest-priority newest resident,
+whose committed tokens fold into its prompt and whose request requeues
+with backoff (vLLM-style recompute preemption — greedy output after a
+preempt→requeue round trip is token-identical to an uninterrupted run,
+test-pinned). All of it is host bookkeeping: the traced decode/prefill
+programs never change, so with no lifecycle action triggered the served
+tokens are byte-identical to a server without this layer.
+
 Tradeoff vs ``InferenceEngine.generate``: generate compiles the WHOLE
 token loop as one ``lax.while_loop`` (one host sync per generation);
 continuous batching needs the host scheduler between steps, so it pays
@@ -22,7 +38,7 @@ from __future__ import annotations
 import functools
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -35,11 +51,21 @@ from deepspeed_tpu.inference.kv_cache import (PagedKVCache,
 from deepspeed_tpu.inference.scheduler import Request, Scheduler
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill, paged_prefill_chunk)
-from deepspeed_tpu.telemetry import (MetricRegistry, ProfilerCapture,
+from deepspeed_tpu.telemetry import (FaultInjector, MetricRegistry,
+                                     PrefillFault, ProfilerCapture,
                                      SLOMonitor, Tracer, get_event_ring,
                                      get_registry, start_http_server,
                                      watched_jit)
 from deepspeed_tpu.telemetry import events as telemetry_events
+
+# finish reason -> event-ring kind (every lifecycle finish leaves a
+# forensic entry; "eos"/"length" are the quiet normal path)
+_LIFECYCLE_EVENTS = {
+    "cancelled": telemetry_events.CANCEL,
+    "deadline": telemetry_events.DEADLINE_EXPIRED,
+    "shed": telemetry_events.SHED,
+    "failed": telemetry_events.REQUEST_FAILED,
+}
 
 
 def _safe_cache_size(fn) -> int:
@@ -76,10 +102,19 @@ class ContinuousBatchingServer:
     output is token-for-token identical to ``engine.generate``).
     Sampling per-request is a scheduler-policy follow-up, not a
     substrate change — temperatures would ride as a per-slot array.
+
+    ``clock`` (injectable, default ``time.perf_counter``) is the basis
+    for every latency observation, deadline, and the ``drain`` timeout —
+    the chaos tests drive deadlines and wedged-slot reaping with a fake
+    clock and zero real sleeps. ``fault_injector`` arms the chaos hooks
+    (telemetry/faultinject.py); None (the default, and the default
+    config) costs nothing per step.
     """
 
     def __init__(self, engine: InferenceEngine,
-                 registry: Optional[MetricRegistry] = None):
+                 registry: Optional[MetricRegistry] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 fault_injector: Optional[FaultInjector] = None):
         if engine.model_config.head == "none":
             raise ValueError("continuous batching needs an LM head — "
                              "encoder models have nothing to decode")
@@ -93,6 +128,7 @@ class ContinuousBatchingServer:
         mcfg = engine.model_config
         self.block_size = cfg.block_size
         self.num_slots = cfg.num_slots
+        self._clock = clock if clock is not None else time.perf_counter
         # per-slot token budget reuses the engine's HBM accounting
         # (explicit max_out_tokens, or 'auto' free-memory sizing at
         # batch=num_slots — kv_cache.auto_max_tokens)
@@ -131,10 +167,32 @@ class ContinuousBatchingServer:
                 slow_threshold_s=tcfg.trace_slow_threshold_s,
                 registry=self.telemetry)
         # SLO gates (telemetry/slo.py): windowed objectives over the
-        # serving histograms, re-evaluated at step cadence
+        # serving histograms, re-evaluated at step cadence. Shares the
+        # server clock so fake-clock tests drive violations coherently.
         self.slo = None
         if tcfg is not None and enabled and tcfg.slo.enabled:
-            self.slo = SLOMonitor(tcfg.slo, registry=self.telemetry)
+            self.slo = SLOMonitor(tcfg.slo, registry=self.telemetry,
+                                  clock=self._clock)
+        # chaos hooks (telemetry/faultinject.py): explicit injector
+        # beats config; both default to None = zero per-step cost
+        self._fi = fault_injector
+        if self._fi is None and tcfg is not None and enabled:
+            self._fi = FaultInjector.from_config(
+                tcfg.fault_injection, registry=self.telemetry)
+        # SLO-driven load shedding (docs/serving.md "Request lifecycle
+        # & overload behavior"): config error if armed without the
+        # objective it consults — silently never shedding would defeat
+        # the operator's intent at the worst possible moment
+        self._shedding = cfg.enable_load_shedding
+        if self._shedding and (self.slo is None
+                               or "queue_wait_p90" not in self.slo.targets):
+            raise ValueError(
+                "enable_load_shedding consults the telemetry.slo "
+                "queue_wait_p90_s objective — enable telemetry.slo and "
+                "set queue_wait_p90_s (docs/serving.md 'Request "
+                "lifecycle & overload behavior')")
+        self.max_preemptions = cfg.max_preemptions
+        self._backoff_steps = cfg.preemption_backoff_steps
         self.http_server = None
         if tcfg is not None and enabled and tcfg.http_port is not None:
             self.http_server = start_http_server(
@@ -177,7 +235,48 @@ class ContinuousBatchingServer:
             help="reserved-but-never-written tail blocks returned to "
                  "the free list at retirement (budget the sequence "
                  "EOSed before reaching)")
+        # lifecycle counters (docs/serving.md "Request lifecycle &
+        # overload behavior"; docs/observability.md catalog)
+        # one registry counter per terminal reason, keyed the way
+        # _finalize receives it — adding a reason means adding it here,
+        # in _LIFECYCLE_EVENTS, and in stats; a miss fails loudly at
+        # finish time
+        self._c_finish = {
+            "cancelled": reg.counter(
+                "serve_cancelled_total",
+                help="requests finished by cancel() or a bounded drain "
+                     "(finish reason 'cancelled'; partial output "
+                     "returned)"),
+            "deadline": reg.counter(
+                "serve_deadline_expired_total",
+                help="requests reaped past their deadline_s (finish "
+                     "reason 'deadline'; queued expiries are never "
+                     "admitted)"),
+            "shed": reg.counter(
+                "serve_shed_total",
+                help="queued requests fast-failed by SLO-driven load "
+                     "shedding (finish reason 'shed')"),
+            "failed": reg.counter(
+                "serve_requests_failed_total",
+                help="requests failed by the server: prefill fault, or "
+                     "preemption retries exhausted (finish reason "
+                     "'failed'; always-kept error trace)"),
+        }
+        self._c_preempted = reg.counter(
+            "serve_preempted_total",
+            help="slot preemptions (recompute-requeue): the victim's "
+                 "committed tokens fold into its prompt and it waits "
+                 "out a backoff before re-admission")
         self._submit_ts: Dict[int, float] = {}
+        # when the request last ENTERED the queue (submit or preemption
+        # requeue) — the shed guard's notion of "how long has this
+        # waiter actually been waiting"; _submit_ts must stay the
+        # original birth time for TTFT/queue-wait/total-latency
+        self._queued_ts: Dict[int, float] = {}
+        # only requests WITH a deadline live here — the reap scan is
+        # O(deadlined requests), zero when the feature is unused
+        self._deadlines: Dict[int, float] = {}
+        self.finish_reasons: Dict[int, str] = {}
         # +1: block 0 is the reserved null block idle slots write into
         num_blocks = 1 + self.num_slots * self.max_blocks_per_slot
         self.scheduler = Scheduler(
@@ -217,12 +316,22 @@ class ContinuousBatchingServer:
         self._results: Dict[int, List[int]] = {}
         self._next_id = 0
         self._step_clock = 0           # decode steps executed
+        # scheduler tick: advances on EVERY step() call, decode or not —
+        # requeue backoff counts against this clock, so a backing-off
+        # queue head on an otherwise-idle server still becomes eligible
+        # (keying backoff on decode steps would deadlock the drain loop:
+        # no admittable work -> no decode -> no clock -> never ready)
+        self._tick = 0
         self._active_slot_steps = 0    # sum of live slots per decode step
         self._prefills = 0
         self._prefill_chunks = 0       # chunk programs executed
         self._prefill_token_units = 0  # tokens run through prefill compute
         self._prefix_tokens_skipped = 0   # prompt tokens served from cache
         self._tail_reclaimed = 0
+        # lifecycle host mirrors (stats without a snapshot round-trip),
+        # keyed by finish reason + "preempted" (not a terminal state)
+        self._lifecycle_counts = dict.fromkeys(
+            ("cancelled", "deadline", "preempted", "shed", "failed"), 0)
         # chunked prefills in flight, FIFO; at most ONE chunk runs per
         # step() so a long prompt never stalls resident decoders
         self._prefilling: Deque[dict] = deque()
@@ -303,10 +412,19 @@ class ContinuousBatchingServer:
 
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               request_id: Optional[int] = None) -> int:
+               request_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> int:
         """Queue one request; returns its id. Raises when the request can
         never be scheduled (block span beyond a slot) or the queue is
-        full — admission control instead of a silent deadlock."""
+        full — admission control instead of a silent deadlock.
+
+        ``deadline_s`` bounds the request's WHOLE lifetime (queue wait
+        included) on the server clock: an expired request is reaped with
+        finish reason ``deadline`` — dequeued if still waiting, retired
+        mid-prefill/decode with its partial output if resident — and is
+        never admitted past its deadline. ``priority`` (higher wins)
+        orders preemption and shedding victims; FIFO breaks ties."""
         if not prompt:
             self._count_rejection("empty_prompt", request_id)
             raise ValueError("empty prompt")
@@ -316,6 +434,11 @@ class ContinuousBatchingServer:
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} is below the "
                 f"schedulable floor {floor} (min_out_tokens)")
+        if deadline_s is not None and deadline_s <= 0:
+            self._count_rejection("bad_deadline", request_id)
+            raise ValueError(
+                f"deadline_s must be > 0 seconds (or None for no "
+                f"deadline), got {deadline_s}")
         if request_id is None:
             request_id = self._next_id
         elif (request_id in self._results
@@ -329,10 +452,16 @@ class ContinuousBatchingServer:
                 "or finished — a duplicate would silently overwrite its "
                 "output")
         self._next_id = max(self._next_id, request_id) + 1
+        now = self._clock()
+        deadline_ts = None if deadline_s is None else now + deadline_s
         self.scheduler.submit(Request(
             request_id=request_id, prompt=list(prompt),
-            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id))
-        self._submit_ts[request_id] = time.perf_counter()
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            priority=priority, deadline_ts=deadline_ts))
+        self._submit_ts[request_id] = now
+        self._queued_ts[request_id] = now
+        if deadline_ts is not None:
+            self._deadlines[request_id] = deadline_ts
         if self.tracer is not None:
             # root span opens NOW (submit is the request's birth); the
             # queue_wait child stays open until admission into a slot
@@ -340,10 +469,16 @@ class ContinuousBatchingServer:
                 "request", trace_id=request_id,
                 prompt_tokens=len(prompt),
                 max_new_tokens=max_new_tokens)
+            if priority:
+                tr.root.set("priority", priority)
+            if deadline_s is not None:
+                tr.root.set("deadline_s", deadline_s)
             rt = _RequestTrace(tr)
             rt.queue = tr.begin("queue_wait")
             self._rt[request_id] = rt
         self._c_submitted.inc()
+        if self._fi is not None:
+            self._fi.on_submit(request_id)
         return request_id
 
     def _count_rejection(self, reason: str,
@@ -365,6 +500,259 @@ class ContinuousBatchingServer:
             attrs = {} if request_id is None else {"request_id": request_id}
             self.tracer.record_rejected("request", reason, **attrs)
 
+    # ------------------------------------------------- lifecycle actions
+
+    def _reset_slot_arrays(self, slot: int) -> None:
+        """Host-side device-array reset for a vacated slot: length 0 and
+        an all-null block table, so interleaved decode appends land in
+        the null block until the next admission repopulates the row."""
+        self._cache = self._cache.replace(
+            lengths=self._cache.lengths.at[slot].set(0),
+            block_tables=self._cache.block_tables.at[slot].set(
+                jnp.zeros((self.max_blocks_per_slot,), jnp.int32)))
+
+    def _drop_prefill_job(self, slot: int) -> None:
+        """Forget any in-flight chunked prefill for a vacated slot."""
+        if slot in self._mid_prefill:
+            self._mid_prefill.discard(slot)
+            self._prefilling = deque(
+                j for j in self._prefilling if j["slot"] != slot)
+
+    def _teardown_slot(self, slot: int) -> None:
+        """Vacate a resident slot mid-flight (cancel / injected prefill
+        fault / retries-exhausted preemption): drop any in-flight chunk
+        job, release the blocks through the refcount path, scrub the
+        device-side slot state — in that order (the chunk job reads the
+        block table; the array reset assumes the slot is off the
+        scheduler's books)."""
+        self._drop_prefill_job(slot)
+        self.scheduler.release(slot)
+        self._reset_slot_arrays(slot)
+
+    def _finalize(self, req: Request, tokens: List[int], reason: str,
+                  finished: Optional[list] = None) -> None:
+        """Terminal lifecycle bookkeeping shared by cancel / deadline /
+        shed / fail: record the (possibly partial) output + finish
+        reason, tick the reason's counter and ring event, close the
+        trace (always kept — a non-ok status never loses the sampling
+        coin flip), and feed the watchdog (a server busy degrading is
+        making progress, not hanging)."""
+        rid = req.request_id
+        self._results[rid] = tokens
+        self.finish_reasons[rid] = reason
+        if finished is not None:
+            finished.append(rid)
+        self._submit_ts.pop(rid, None)
+        self._queued_ts.pop(rid, None)
+        self._deadlines.pop(rid, None)
+        self._c_finish[reason].inc()
+        self._lifecycle_counts[reason] += 1
+        get_event_ring().record(
+            _LIFECYCLE_EVENTS[reason], request_id=rid,
+            generated=len(tokens) - len(req.prompt),
+            preemptions=req.preemptions)
+        rt = (self._rt.pop(rid, None) if self.tracer is not None
+              else None)
+        if rt is not None:
+            for sp in (rt.queue, rt.prefill, rt.decode):
+                if sp is not None and sp.end is None:
+                    rt.trace.end_span(sp)
+            rt.trace.root.set("finish_reason", reason)
+            rt.trace.root.set("generated_tokens",
+                              len(tokens) - len(req.prompt))
+            self.tracer.finish(rt.trace, status=reason)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()
+
+    def cancel(self, request_id: int, reason: str = "cancelled") -> bool:
+        """Cancel one request in ANY state: queued (dequeued, prompt
+        returned as the partial result), mid-prefill or decoding (slot
+        retired, blocks released through the refcount path, prompt +
+        tokens-so-far returned). Returns False when the request is
+        already finished or unknown. ``reason`` lands in
+        ``finish_reasons`` ("cancelled" from callers, "deadline" from
+        the reaper)."""
+        if reason not in ("cancelled", "deadline"):
+            raise ValueError(
+                f"cancel reason must be 'cancelled' or 'deadline', "
+                f"got {reason!r}")
+        if request_id in self._results:
+            return False
+        req = self.scheduler.remove_queued(request_id)
+        if req is not None:
+            self._finalize(req, list(req.prompt) + list(req.committed),
+                           reason)
+            return True
+        slot = self.scheduler.find_slot(request_id)
+        if slot is None:
+            return False
+        state = self.scheduler.slots[slot]
+        self._teardown_slot(slot)
+        self._finalize(state.request,
+                       list(state.request.prompt) + list(state.generated),
+                       reason)
+        return True
+
+    def _fail_request(self, req: Request, tokens: List[int],
+                      error: str, finished: Optional[list]) -> None:
+        """Server-side failure (injected prefill fault / preemption
+        retries exhausted): finish reason ``failed`` + an always-kept
+        error trace naming the cause."""
+        rt = (self._rt.get(req.request_id)
+              if self.tracer is not None else None)
+        if rt is not None:
+            rt.trace.root.set("error", error)
+        self._finalize(req, tokens, "failed", finished)
+
+    def _injected_prefill_fault(self, slot: int, state,
+                                finished: list,
+                                seeded: bool = True) -> bool:
+        """Fault-injection prefill site, shared by the monolithic and
+        chunked paths: when the injector kills this request's prefill,
+        tear the slot down (drop the chunk job, release blocks, scrub
+        device arrays) and fail the request. True = caller skips the
+        prefill. ``seeded=False`` = targeted arms only (non-first
+        chunks — the seeded coin is per REQUEST, not per chunk)."""
+        if self._fi is None:
+            return False
+        req = state.request
+        try:
+            self._fi.check_prefill(req.request_id, seeded=seeded)
+        except PrefillFault as e:
+            self._teardown_slot(slot)
+            self._fail_request(
+                req, list(req.prompt) + list(state.generated),
+                str(e), finished)
+            return True
+        return False
+
+    def _reap_deadlines(self, finished: list) -> None:
+        """Retire every request whose deadline passed — queued or
+        resident — with finish reason ``deadline``. O(requests that HAVE
+        deadlines); free when the feature is unused."""
+        if not self._deadlines:
+            return
+        now = self._clock()
+        expired = [rid for rid, ts in self._deadlines.items()
+                   if now >= ts]
+        for rid in expired:
+            if self.cancel(rid, reason="deadline"):
+                finished.append(rid)
+            else:
+                self._deadlines.pop(rid, None)
+
+    def _maybe_shed(self, finished: list) -> None:
+        """SLO-driven load shedding: while the queue-wait p90 objective
+        is in violation, fast-fail the lowest-priority newest queued
+        requests down to a floor of ``num_slots`` waiters — the queue
+        stops growing faster than the machine drains it, so accepted
+        requests keep meeting the objective instead of everyone
+        missing it."""
+        if not self._shedding or self.slo is None:
+            return
+        # refresh the verdict (rate-limited by eval_interval_s) and act
+        # only on LIVE in-window evidence: a held verdict (no_data — the
+        # window emptied while traffic paused) keeps the SLO red for
+        # reporting but must not fast-fail a fresh burst whose queue
+        # wait is ~0
+        self.slo.maybe_evaluate()
+        res = self.slo.last_results.get("queue_wait_p90")
+        if not res or not res["violated"] or res.get("no_data"):
+            return
+        # live-pressure guard: the verdict can be stale (held across a
+        # traffic pause, or a window baseline that predates an idle
+        # gap) — only shed while some waiter has ACTUALLY aged past
+        # the target since it last entered the queue (requeue time for
+        # preempted work, not birth time — a once-preempted old request
+        # must not keep the guard permanently satisfied); a fresh burst
+        # with ~0 wait is never the victim of an old breach
+        now = self._clock()
+        target = self.slo.targets["queue_wait_p90"]
+        if not any(now - self._queued_ts.get(r.request_id, now) > target
+                   for r in self.scheduler.queue):
+            return
+        while self.scheduler.pending_requests > self.num_slots:
+            victim = min(
+                enumerate(self.scheduler.queue),
+                key=lambda iv: (iv[1].priority, -iv[0]))[1]
+            self.scheduler.remove_queued(victim.request_id)
+            self._finalize(victim,
+                           list(victim.prompt) + list(victim.committed),
+                           "shed", finished)
+
+    def _preempt_slot(self, slot: int, finished: list) -> None:
+        """Preempt one resident (recompute-requeue), or fail it when its
+        retry budget is spent."""
+        state = self.scheduler.slots[slot]
+        req = state.request
+        if req.preemptions >= self.max_preemptions:
+            # bounded retries: the pool keeps evicting this request —
+            # failing it loudly (kept error trace) beats an unbounded
+            # preempt/requeue livelock
+            self._teardown_slot(slot)
+            self._fail_request(
+                req, list(req.prompt) + list(state.generated),
+                f"preempted {req.preemptions}x (max_preemptions)",
+                finished)
+            return
+        mid = slot in self._mid_prefill
+        self._drop_prefill_job(slot)
+        rt = (self._rt.get(req.request_id)
+              if self.tracer is not None else None)
+        if rt is not None:
+            if rt.decode is not None:
+                rt.decode.set("tokens_committed", rt.tokens)
+                rt.decode.set("steps", rt.steps)
+                rt.trace.end_span(rt.decode)
+                rt.decode = None
+            if rt.prefill is not None and rt.prefill.end is None:
+                rt.prefill.set("preempted", True)
+                rt.trace.end_span(rt.prefill)
+            rt.prefill = None
+        self.scheduler.preempt(slot, self._tick,
+                               self._backoff_steps,
+                               register_extension=not mid)
+        # requeue moment: the shed guard measures wait from HERE, not
+        # from the original submit
+        self._queued_ts[req.request_id] = self._clock()
+        self._reset_slot_arrays(slot)
+        self._c_preempted.inc()
+        self._lifecycle_counts["preempted"] += 1
+        get_event_ring().record(
+            telemetry_events.PREEMPT, request_id=req.request_id,
+            slot=slot, preemptions=req.preemptions,
+            committed_tokens=len(req.committed),
+            ready_at_step=req.ready_at_step)
+        if rt is not None:
+            # the requeue wait gets its own open span; the root carries
+            # the running preemption count
+            rt.trace.root.set("preemptions", req.preemptions)
+            rt.queue = rt.trace.begin("queue_wait", requeue=True)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()
+
+    def _preempt_for_head(self, finished: list) -> bool:
+        """One degradation-ladder rung: when the first eligible queued
+        request still isn't resident after admission (slots or blocks
+        short — the allocator already evicted prefix-LRU blocks trying),
+        preempt the lowest-priority newest resident IF it ranks strictly
+        below the waiter. Equal priorities never preempt — plain FIFO
+        traffic on a tight pool must queue, not thrash."""
+        if self.max_preemptions <= 0:
+            return False        # preemption disabled by config
+        now = self._clock() if self._deadlines else None
+        head = self.scheduler.next_ready(self._tick, now=now)
+        if head is None:
+            return False
+        victim = self.scheduler.pick_preemption_victim()
+        if victim is None:
+            return False
+        slot, state = victim
+        if state.request.priority >= head.priority:
+            return False
+        self._preempt_slot(slot, finished)
+        return True
+
     def _admit(self, finished: list) -> None:
         """Admit queued requests into free slots until blocks or slots
         run out. Monolithic mode prefills inline — one trace per prompt
@@ -375,14 +763,18 @@ class ContinuousBatchingServer:
         fixed-size chunk per ``step()`` via :meth:`_run_prefill_chunk`,
         so a long prompt never stalls the resident decoders."""
         while True:
-            adm = self.scheduler.admit_next(self._step_clock)
+            now = self._clock() if self._deadlines else None
+            adm = self.scheduler.admit_next(self._tick, now=now)
             if adm is None:
                 return
             slot, state = adm
             req = state.request
-            t_admit = time.perf_counter()
-            self._h_queue_wait.observe(
-                t_admit - self._submit_ts.get(req.request_id, t_admit))
+            sched_prompt = req.sched_prompt
+            t_admit = self._clock()
+            if not state.resumed:
+                self._h_queue_wait.observe(
+                    t_admit - self._submit_ts.get(req.request_id,
+                                                  t_admit))
             rt = (self._rt.get(req.request_id)
                   if self.tracer is not None else None)
             adm_span = None
@@ -390,6 +782,7 @@ class ContinuousBatchingServer:
                 rt.trace.end_span(rt.queue)
                 adm_span = rt.trace.begin(
                     "admission", slot=slot,
+                    resumed=state.resumed,
                     prefix_cache_hit=state.cached_blocks > 0,
                     blocks_reused=state.cached_blocks,
                     blocks_allocated=(len(state.blocks)
@@ -402,6 +795,23 @@ class ContinuousBatchingServer:
             self._cache = self._cache.replace(
                 block_tables=self._cache.block_tables.at[slot].set(
                     jnp.asarray(row)))
+            if rt is not None:
+                # admission work (slot pick, block table) is done —
+                # close the span BEFORE the fault site, so an injected
+                # failure's always-kept error trace has every child
+                # closed
+                rt.trace.end_span(adm_span)
+            # fault-injection prefill site: admission is the ONE place
+            # both prefill paths pass exactly once per FIRST admission,
+            # so the seeded coin flips here — per-chunk flips would
+            # compound the configured rate with prompt length, keying
+            # on a chunk's start offset would skip warm-prefix requests
+            # (their first chunk starts at cached_len, not 0), and
+            # re-flipping at a preemption re-admission (resumed) would
+            # compound the rate with preemption count
+            if self._injected_prefill_fault(slot, state, finished,
+                                            seeded=not state.resumed):
+                continue
             if self.chunk_tokens:
                 cached_len = state.cached_blocks * self.block_size
                 self._prefix_tokens_skipped += cached_len
@@ -417,43 +827,48 @@ class ContinuousBatchingServer:
                     {"slot": slot, "state": state, "start": cached_len})
                 self._mid_prefill.add(slot)
                 if rt is not None:
-                    rt.trace.end_span(adm_span)
                     # the prefill span brackets the WHOLE chunked phase
                     # (chunk spans nest under it); step()-interleave gaps
                     # between chunks are inside it by design — that IS
                     # the Sarathi tradeoff made visible
                     rt.prefill = rt.trace.begin(
                         "prefill", chunked=True,
-                        tokens=len(req.prompt) - cached_len,
+                        tokens=len(sched_prompt) - cached_len,
                         cached_tokens_skipped=cached_len)
                 continue
             # ---------------- monolithic bucketed prefill (chunking off)
-            T = min(max(_bucket(len(req.prompt)), self.block_size),
+            T = min(max(_bucket(len(sched_prompt)), self.block_size),
                     self.max_blocks_per_slot * self.block_size)
             if rt is not None:
-                rt.trace.end_span(adm_span)
                 rt.prefill = rt.trace.begin(
-                    "prefill", chunked=False, tokens=len(req.prompt),
+                    "prefill", chunked=False, tokens=len(sched_prompt),
                     bucket=T)
             ids = np.zeros((1, T), np.int32)
-            ids[0, :len(req.prompt)] = req.prompt
+            ids[0, :len(sched_prompt)] = sched_prompt
             tok0, self._cache = self._prefill_jit(
                 self.engine.params, jnp.asarray(ids),
-                jnp.asarray([len(req.prompt)], jnp.int32), self._cache,
+                jnp.asarray([len(sched_prompt)], jnp.int32), self._cache,
                 jnp.int32(slot))
             self._prefills += 1
             self._prefill_token_units += T
             tok0 = int(np.asarray(tok0)[0])   # host sync: prefill done
-            now = time.perf_counter()
+            now_t = self._clock()
             # prefill latency by PADDED bucket (the traced shape, not the
             # raw prompt length — per-shape latency is what regressions
             # in the prefill program show up against)
             self.telemetry.histogram(
                 "serve_prefill_seconds",
                 help="prefill wall time, by padded prompt-bucket length",
-                labels={"bucket": str(T)}).observe(now - t_admit)
-            self._h_ttft.observe(
-                now - self._submit_ts.get(req.request_id, now))
+                labels={"bucket": str(T)}).observe(now_t - t_admit)
+            if not state.generated:
+                # TTFT is observed when the request's FIRST token ever
+                # leaves (generated == committed until tok0 appends): a
+                # resumed request that already emitted tokens skips it,
+                # but one preempted mid-prefill still owes its first
+                # token — hiding its (slow) TTFT would green an SLO
+                # that is actually collapsing under preemption pressure
+                self._h_ttft.observe(
+                    now_t - self._submit_ts.get(req.request_id, now_t))
             self._c_prefills.inc()
             self._c_tokens.inc()
             if self.watchdog is not None:
@@ -482,26 +897,32 @@ class ContinuousBatchingServer:
         job = self._prefilling[0]
         slot, state = job["slot"], job["state"]
         req = state.request
+        sched_prompt = req.sched_prompt
         C = self.chunk_tokens
         start = job["start"]
-        plen = len(req.prompt)
+        plen = len(sched_prompt)
+        # targeted arms only (seeded=False): the per-request seeded
+        # coin already flipped at this request's admission
+        if self._injected_prefill_fault(slot, state, finished,
+                                        seeded=False):
+            return
         ids = np.zeros((1, C), np.int32)
         valid = min(plen - start, C)
-        ids[0, :valid] = req.prompt[start:start + valid]
+        ids[0, :valid] = sched_prompt[start:start + valid]
         rt = (self._rt.get(req.request_id)
               if self.tracer is not None else None)
         ck = None
         if rt is not None:
             ck = rt.trace.begin("prefill_chunk", parent=rt.prefill,
                                 start_token=start, tokens=valid)
-        t0 = time.perf_counter()
+        t0 = self._clock()
         tok, self._cache = self._chunk_jit(
             self.engine.params, jnp.asarray(ids), jnp.int32(start),
             jnp.asarray([plen], jnp.int32), self._cache, jnp.int32(slot))
         self._prefill_chunks += 1
         self._prefill_token_units += C
         tok = np.asarray(tok)     # host sync: honest per-chunk timing
-        self._h_prefill_chunk.observe(time.perf_counter() - t0)
+        self._h_prefill_chunk.observe(self._clock() - t0)
         if ck is not None:
             rt.trace.end_span(ck)
         if self.watchdog is not None:
@@ -517,9 +938,13 @@ class ContinuousBatchingServer:
             # their content valid for another request to hit
             self.scheduler.commit_prefix(state)
         tok0 = int(tok[0])
-        now = time.perf_counter()
-        self._h_ttft.observe(
-            now - self._submit_ts.get(req.request_id, now))
+        now = self._clock()
+        if not state.generated:
+            # first-ever token for this request (see the monolithic
+            # site): resumed-with-committed skips, resumed-before-first-
+            # token still observes its true TTFT
+            self._h_ttft.observe(
+                now - self._submit_ts.get(req.request_id, now))
         self._c_prefills.inc()
         self._c_tokens.inc()
         self._prefills += 1
@@ -534,6 +959,16 @@ class ContinuousBatchingServer:
 
     def _finished(self, state, tok: int) -> bool:
         req = state.request
+        if self._fi is not None and self._fi.is_wedged(req.request_id):
+            # injected wedge: neither EOS nor budget ever finishes this
+            # request — it decodes until a deadline / cancel / bounded
+            # drain reaps it. Appends past its allocated span spill
+            # into the null block / clobber its own tail, and the reap
+            # returns the whole over-budget token list as the partial
+            # result: incoherent past the span, but deliberate — the
+            # length itself is forensic evidence of how long the wedge
+            # ran (the chaos tests pin len > budget)
+            return False
         return (tok == req.eos_token_id
                 or len(state.generated) >= req.max_new_tokens)
 
@@ -550,10 +985,16 @@ class ContinuousBatchingServer:
             fin = rt.trace.begin("finish")
         out = list(req.prompt) + state.generated
         self._results[req.request_id] = out
+        reason = ("eos" if state.generated
+                  and state.generated[-1] == req.eos_token_id
+                  else "length")
+        self.finish_reasons[req.request_id] = reason
         finished.append(req.request_id)
         ts = self._submit_ts.pop(req.request_id, None)
+        self._queued_ts.pop(req.request_id, None)
+        self._deadlines.pop(req.request_id, None)
         if ts is not None:
-            self._h_request.observe(time.perf_counter() - ts)
+            self._h_request.observe(self._clock() - ts)
         self._c_finished.inc()
         # reserved-tail accounting: blocks allocated for budget the
         # sequence EOSed before reaching were never written — they go
@@ -572,27 +1013,37 @@ class ContinuousBatchingServer:
         # The retired slot's length resets to 0 on the HOST array only —
         # the device sees it at the next decode call's lengths input.
         self.scheduler.release(slot)
-        self._cache = self._cache.replace(
-            lengths=self._cache.lengths.at[slot].set(0),
-            block_tables=self._cache.block_tables.at[slot].set(
-                jnp.zeros((self.max_blocks_per_slot,), jnp.int32)))
+        self._reset_slot_arrays(slot)
         if rt is not None:
-            reason = ("eos" if state.generated
-                      and state.generated[-1] == req.eos_token_id
-                      else "length")
             rt.trace.root.set("finish_reason", reason)
             rt.trace.root.set("generated_tokens", len(state.generated))
             rt.trace.end_span(fin)
             self.tracer.finish(rt.trace)
 
     def step(self) -> List[int]:
-        """One scheduler round: admit from the queue into free slots,
-        run at most ONE chunk of any in-flight chunked prefill, then one
-        decode step for all active resident slots. Returns the request
-        ids finished this round (fetch outputs via ``result``/``drain``).
-        """
+        """One scheduler round: reap expired deadlines, shed under SLO
+        breach, admit from the queue into free slots (preempting
+        lower-priority residents for a higher-priority waiter when the
+        pool is short), run at most ONE chunk of any in-flight chunked
+        prefill, then one decode step for all active resident slots.
+        Returns the request ids that got a result this round — normal
+        finishes AND lifecycle finishes (fetch outputs via ``result`` /
+        ``drain``; ``finish_reasons`` tells them apart)."""
         finished: List[int] = []
+        self._tick += 1
+        if self._fi is not None:
+            self._fi.apply_famine(self.scheduler.allocator)
+        self._reap_deadlines(finished)
+        self._maybe_shed(finished)
         self._admit(finished)
+        # degradation ladder, rung 2 (rung 1, prefix-LRU eviction,
+        # already ran inside the allocator during admission): preempt
+        # strictly-lower-priority residents for the blocked waiter,
+        # re-admitting after each victim frees its slot + blocks
+        guard = self.num_slots
+        while guard > 0 and self._preempt_for_head(finished):
+            guard -= 1
+            self._admit(finished)
         self._run_prefill_chunk(finished)
         if not self.scheduler.slots:
             if self.watchdog is not None:
@@ -613,7 +1064,7 @@ class ContinuousBatchingServer:
             # this step's progress; nothing to decode yet
             return finished
         self.profiler_capture.step_begin()
-        t0 = time.perf_counter()
+        t0 = self._clock()
         nxt, self._cache = self._decode_jit(
             self.engine.params, jnp.asarray(tokens), self._cache,
             jnp.asarray(active))
@@ -621,7 +1072,11 @@ class ContinuousBatchingServer:
         n_active = int(active.sum())
         self._active_slot_steps += n_active
         nxt = np.asarray(nxt)             # host sync: the step completed
-        dt = time.perf_counter() - t0
+        dt = self._clock() - t0
+        if self._fi is not None:
+            # injected latency is ACCOUNTED, never slept — the SLO /
+            # shedding chaos tests collapse latency with no real delay
+            dt += self._fi.step_latency()
         self.profiler_capture.step_end()
         self._h_decode_step.observe(dt)
         # every live slot committed one token this step, each costing one
@@ -653,18 +1108,52 @@ class ContinuousBatchingServer:
                 self._retire(slot, state, finished)
             else:
                 state.pending = tok
-        if self.slo is not None:
+        if self.slo is not None and not self._shedding:
+            # with shedding armed, _maybe_shed already refreshed the
+            # monitor this step — don't pay a second registry snapshot
             self.slo.maybe_evaluate()
         return finished
 
     def result(self, request_id: int) -> Optional[List[int]]:
-        """Finished output (prompt + generated, EOS included) or None."""
+        """Finished output (prompt + generated, EOS included) or None.
+        Lifecycle-terminated requests (``cancelled`` / ``deadline`` /
+        ``shed`` / ``failed`` in ``finish_reasons``) return their
+        partial output — prompt plus whatever was committed."""
         return self._results.get(request_id)
 
-    def drain(self) -> Dict[int, List[int]]:
+    def finish_reason(self, request_id: int) -> Optional[str]:
+        """``eos`` / ``length`` / ``cancelled`` / ``deadline`` /
+        ``shed`` / ``failed``, or None while unfinished."""
+        return self.finish_reasons.get(request_id)
+
+    def drain(self, timeout_s: Optional[float] = None
+              ) -> Dict[int, List[int]]:
         """Run ``step`` until queue and slots are empty; returns all
-        finished outputs keyed by request id."""
+        finished outputs keyed by request id.
+
+        ``timeout_s`` bounds the drain on the server clock: past it,
+        every still-unfinished request is cancelled (finish reason
+        ``cancelled``, partial results returned) — a single wedged slot
+        can no longer spin the process forever. ``timeout_s=0`` cancels
+        immediately; None preserves the unbounded behavior."""
+        if timeout_s is not None and timeout_s < 0:
+            raise ValueError(
+                f"drain timeout_s must be >= 0 (or None for unbounded), "
+                f"got {timeout_s}")
+        deadline = None if timeout_s is None \
+            else self._clock() + timeout_s
         while not self.scheduler.idle:
+            if deadline is not None and self._clock() >= deadline:
+                get_event_ring().record(
+                    telemetry_events.CANCEL, source="drain_timeout",
+                    timeout_s=timeout_s,
+                    stragglers=(self.scheduler.pending_requests
+                                + self.scheduler.active_slots))
+                for req in list(self.scheduler.queue):
+                    self.cancel(req.request_id)
+                for state in list(self.scheduler.slots.values()):
+                    self.cancel(state.request.request_id)
+                break
             self.step()
         return dict(self._results)
 
@@ -737,8 +1226,19 @@ class ContinuousBatchingServer:
             "prefix_cache_hits": self.scheduler.prefix_hits,
             "prefix_cache_misses": self.scheduler.prefix_misses,
             "prefix_cached_blocks": alloc.cached_blocks,
+            "prefix_cache_evictions": alloc.evictions,
             "prefix_tokens_skipped": self._prefix_tokens_skipped,
             "tail_blocks_reclaimed": self._tail_reclaimed,
+            # lifecycle (docs/serving.md "Request lifecycle & overload
+            # behavior")
+            "cancelled": self._lifecycle_counts["cancelled"],
+            "deadline_expired": self._lifecycle_counts["deadline"],
+            "preempted": self._lifecycle_counts["preempted"],
+            "shed": self._lifecycle_counts["shed"],
+            "failed": self._lifecycle_counts["failed"],
+            "requeue_depth": self.scheduler.requeue_depth,
+            "fault_injection": (self._fi.snapshot()
+                                if self._fi is not None else None),
             "traces_started": (self.tracer.started
                                if self.tracer is not None else 0),
             "traces_kept": (self.tracer.kept

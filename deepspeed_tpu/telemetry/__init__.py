@@ -22,11 +22,13 @@ from deepspeed_tpu.telemetry.compile_watch import (WatchedFunction,
                                                    compile_report,
                                                    executable_cost,
                                                    watched_jit)
-from deepspeed_tpu.telemetry.config import SLOConfig, TelemetryConfig
+from deepspeed_tpu.telemetry.config import (FaultInjectionConfig,
+                                            SLOConfig, TelemetryConfig)
 from deepspeed_tpu.telemetry.events import (EventRing, dump_ring,
                                             get_event_ring,
                                             install_fault_dump,
                                             record_event, set_event_ring)
+from deepspeed_tpu.telemetry.faultinject import FaultInjector, PrefillFault
 from deepspeed_tpu.telemetry.goodput import GoodputMeter
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
                                               start_http_server)
@@ -73,4 +75,6 @@ __all__ = [
     # request-scoped tracing + SLO gates
     "Trace", "Tracer", "TraceSpan", "current_span", "get_tracer",
     "set_tracer", "SLOMonitor",
+    # fault injection (chaos hooks for the serving lifecycle layer)
+    "FaultInjector", "FaultInjectionConfig", "PrefillFault",
 ]

@@ -56,6 +56,48 @@ class SLOConfig(DeepSpeedConfigModel):
         return v
 
 
+class FaultInjectionConfig(DeepSpeedConfigModel):
+    """Chaos hooks for the serving loop (telemetry/faultinject.py).
+    Off by default — a disabled section builds NO injector and the
+    serving hot path never branches on it. Enabled, every injected
+    fault is seeded (deterministic replay), counted
+    (``fault_injections_total``), and ring-recorded, so chaos-test
+    forensics look exactly like a real incident's."""
+    enabled: bool = False
+    # seed for the probabilistic faults (prefill_failure_rate)
+    seed: int = 0
+    # extra seconds ACCOUNTED into each decode step's observed latency
+    # (never slept): drives SLO breach / shedding without real delay
+    step_latency_s: float = 0.0
+    # probability an individual prefill raises (seeded RNG); the request
+    # fails with an always-kept error trace, the loop survives
+    prefill_failure_rate: float = 0.0
+    # pool blocks withheld from the allocator's free budget — forces the
+    # famine ladder: prefix-LRU evict -> preempt -> shed
+    famine_blocks: int = 0
+    # every Nth submitted request never finishes (decodes until a
+    # deadline / drain timeout reaps it); 0 = off
+    wedge_nth_request: int = 0
+
+    @field_validator("step_latency_s", "famine_blocks",
+                     "wedge_nth_request")
+    @classmethod
+    def _non_negative(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"{info.field_name} must be >= 0 (0 = fault off), "
+                f"got {v}")
+        return v
+
+    @field_validator("prefill_failure_rate")
+    @classmethod
+    def _valid_rate(cls, v):
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(
+                f"prefill_failure_rate must be in [0, 1], got {v}")
+        return v
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """Registry recording is on by default (dict-lookup + float-add cost);
     the HTTP scrape endpoint is OFF by default and opens only when a port
@@ -119,6 +161,9 @@ class TelemetryConfig(DeepSpeedConfigModel):
     trace_seed: int = 0
     # serving SLO gates (telemetry/slo.py) — see the SLOConfig schema
     slo: SLOConfig = Field(default_factory=SLOConfig)
+    # chaos hooks (telemetry/faultinject.py) — see FaultInjectionConfig
+    fault_injection: FaultInjectionConfig = Field(
+        default_factory=FaultInjectionConfig)
 
     @field_validator("http_port")
     @classmethod

@@ -40,6 +40,15 @@ WATCHDOG_DUMP = "watchdog_dump"
 NUMERICS_NONFINITE = "numerics_nonfinite"
 LOSS_SPIKE = "loss_spike"
 SLO_VIOLATION = "slo_violation"
+# request-lifecycle events (docs/serving.md "Request lifecycle &
+# overload behavior"): every degradation-ladder rung leaves a ring entry
+CANCEL = "cancel"
+DEADLINE_EXPIRED = "deadline_expired"
+PREEMPT = "preempt"
+SHED = "shed"
+REQUEST_FAILED = "request_failed"
+PREFIX_EVICT = "prefix_evict"
+FAULT_INJECTED = "fault_injected"
 
 
 class EventRing:

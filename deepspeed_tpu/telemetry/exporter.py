@@ -18,8 +18,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
+from deepspeed_tpu.utils.logging import logger
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# per-connection socket timeout: a scrape client that connects and then
+# stalls (or never reads the response) times out instead of pinning one
+# of the ThreadingHTTPServer's handler threads forever
+DEFAULT_HANDLER_TIMEOUT_S = 10.0
 
 # path -> one-line description; keep in sync with docs/observability.md
 # "Scrape endpoint" (the help/404 renderers below read this table)
@@ -52,10 +58,21 @@ class TelemetryHTTPServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[MetricRegistry] = None,
-                 event_ring=None, memory=None, tracer=None):
+                 event_ring=None, memory=None, tracer=None,
+                 handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S):
+        if handler_timeout_s is not None and handler_timeout_s <= 0:
+            raise ValueError(
+                f"handler_timeout_s must be > 0 seconds (or None to "
+                f"allow handlers to block forever), got "
+                f"{handler_timeout_s}")
         reg = registry or get_registry()
 
         class _Handler(BaseHTTPRequestHandler):
+            # socket read/write timeout (http.server applies it in
+            # setup()); a timed-out read sets close_connection and the
+            # handler thread exits instead of waiting on a dead client
+            timeout = handler_timeout_s
+
             def do_GET(self):  # noqa: N802 — http.server API
                 path = self.path.split("?", 1)[0]
                 if path == "/":
@@ -127,10 +144,22 @@ class TelemetryHTTPServer:
         """Bound port (useful with port=0 ephemeral binding in tests)."""
         return self._httpd.server_address[1]
 
-    def close(self) -> None:
+    def close(self) -> bool:
+        """Shut the listener down; returns True when the serve thread
+        actually joined. A False return (logged as a warning) means the
+        thread is wedged — the port is closed but the thread leaks,
+        which the operator should know instead of discovering a zombie
+        at the next bind."""
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            logger.warning(
+                "telemetry scrape thread failed to join within 5s — "
+                "the port is released but the serve thread is wedged "
+                "(stacks via faulthandler / the watchdog dump)")
+            return False
+        return True
 
     def __enter__(self) -> "TelemetryHTTPServer":
         return self
@@ -141,9 +170,11 @@ class TelemetryHTTPServer:
 
 def start_http_server(port: int, host: str = "127.0.0.1",
                       registry: Optional[MetricRegistry] = None,
-                      event_ring=None, memory=None, tracer=None
+                      event_ring=None, memory=None, tracer=None,
+                      handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
     return TelemetryHTTPServer(port=port, host=host, registry=registry,
                                event_ring=event_ring, memory=memory,
-                               tracer=tracer)
+                               tracer=tracer,
+                               handler_timeout_s=handler_timeout_s)

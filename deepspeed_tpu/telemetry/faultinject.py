@@ -1,0 +1,182 @@
+"""Fault injection: deterministic chaos for the serving loop.
+
+The request-lifecycle layer (deadlines, cancellation, preemption, load
+shedding — docs/serving.md "Request lifecycle & overload behavior")
+exists to survive failures that are hard to produce on demand: a wedged
+slot, an allocator famine, a prefill that dies mid-flight, a decode step
+that suddenly takes 50×. :class:`FaultInjector` produces them on
+demand — config-gated, **seeded** (the chaos tests replay the exact same
+fault schedule every run), and with zero hot-path cost when off (the
+server holds ``None`` and never calls in here).
+
+Injection sites (all consulted by ``inference/server.py`` /
+``inference/scheduler.py``):
+
+* **step latency** — extra seconds *accounted into* the decode-step and
+  per-token histograms (and any injected clock), never slept: the SLO /
+  shedding tests drive a latency collapse with zero real sleeps.
+* **prefill failure** — the prefill for a chosen (or seeded-random)
+  request raises; the server fails the request with an always-kept
+  error trace instead of crashing the loop.
+* **allocator famine** — N pool blocks are withheld from the free list
+  (``BlockAllocator.set_reserved``), forcing the degradation ladder:
+  prefix-LRU eviction → preemption → shedding.
+* **wedged slot** — a chosen (or every-Nth) request never satisfies the
+  finish check: it decodes forever until a deadline or a bounded
+  ``drain(timeout_s=...)`` reaps it — the watchdog-clears scenario.
+
+Every injection is counted (``fault_injections_total`` by kind) and
+recorded into the flight-recorder event ring, so a chaos run's forensics
+look exactly like a real incident's.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional, Set
+
+from deepspeed_tpu.telemetry import events as _ev
+from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
+
+# canonical injection kinds (the `kind` label on fault_injections_total
+# and the event-ring entries)
+STEP_LATENCY = "step_latency"
+PREFILL_FAILURE = "prefill_failure"
+FAMINE = "famine"
+WEDGED_SLOT = "wedged_slot"
+
+
+class PrefillFault(RuntimeError):
+    """Raised by the injector at the prefill site — distinct from real
+    prefill errors so tests can assert the injected one specifically."""
+
+
+class FaultInjector:
+    """Seeded fault schedule. Built from ``telemetry.fault_injection``
+    config (:meth:`from_config`) or constructed directly by chaos tests,
+    which may also arm targeted faults (:meth:`wedge`,
+    :meth:`fail_prefill_for`) for per-request determinism."""
+
+    def __init__(self, seed: int = 0, step_latency_s: float = 0.0,
+                 prefill_failure_rate: float = 0.0,
+                 famine_blocks: int = 0, wedge_nth_request: int = 0,
+                 registry: Optional[MetricRegistry] = None):
+        if not 0.0 <= prefill_failure_rate <= 1.0:
+            raise ValueError(
+                f"prefill_failure_rate must be in [0, 1], got "
+                f"{prefill_failure_rate}")
+        if famine_blocks < 0 or wedge_nth_request < 0:
+            raise ValueError("famine_blocks / wedge_nth_request must be "
+                             ">= 0 (0 = fault off)")
+        if step_latency_s < 0:
+            raise ValueError(
+                f"step_latency_s must be >= 0, got {step_latency_s}")
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.step_latency_s = float(step_latency_s)
+        self.prefill_failure_rate = float(prefill_failure_rate)
+        self.famine_blocks = int(famine_blocks)
+        self.wedge_nth_request = int(wedge_nth_request)
+        self._registry = registry
+        self._wedged: Set[int] = set()        # request ids, targeted
+        self._fail_prefill: Set[int] = set()  # request ids, targeted
+        self._submitted = 0                   # wedge_nth counter
+        self.injected: dict = {}              # kind -> count (host stats)
+
+    @classmethod
+    def from_config(cls, cfg, registry: Optional[MetricRegistry] = None
+                    ) -> Optional["FaultInjector"]:
+        """``None`` unless the config section is enabled — the server
+        stores the None and pays nothing per step."""
+        if cfg is None or not cfg.enabled:
+            return None
+        return cls(seed=cfg.seed, step_latency_s=cfg.step_latency_s,
+                   prefill_failure_rate=cfg.prefill_failure_rate,
+                   famine_blocks=cfg.famine_blocks,
+                   wedge_nth_request=cfg.wedge_nth_request,
+                   registry=registry)
+
+    # ------------------------------------------------------------ account
+
+    def _count(self, kind: str, **data) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        reg = self._registry if self._registry is not None \
+            else get_registry()
+        reg.counter("fault_injections_total",
+                    help="injected faults, by kind (telemetry/"
+                         "faultinject.py; nonzero only under chaos "
+                         "testing)",
+                    labels={"kind": kind}).inc()
+        _ev.record_event(_ev.FAULT_INJECTED, fault=kind, **data)
+
+    # ------------------------------------------------------------- sites
+
+    def on_submit(self, request_id: int) -> None:
+        """Called once per accepted submit — drives the every-Nth wedge
+        schedule (targeted :meth:`wedge` calls are independent)."""
+        self._submitted += 1
+        if (self.wedge_nth_request
+                and self._submitted % self.wedge_nth_request == 0):
+            self.wedge(request_id)
+
+    def wedge(self, request_id: int) -> None:
+        """Arm a wedge: the request never finishes (EOS and budget both
+        ignored) until cancelled/reaped."""
+        self._wedged.add(request_id)
+        self._count(WEDGED_SLOT, request_id=request_id)
+
+    def unwedge(self, request_id: int) -> None:
+        self._wedged.discard(request_id)
+
+    def is_wedged(self, request_id: int) -> bool:
+        return request_id in self._wedged
+
+    def fail_prefill_for(self, request_id: int) -> None:
+        """Arm a targeted prefill failure for one request."""
+        self._fail_prefill.add(request_id)
+
+    def check_prefill(self, request_id: int, seeded: bool = True) -> None:
+        """Prefill site: raises :class:`PrefillFault` when this request's
+        prefill is scheduled to die (targeted arm, or the seeded coin).
+
+        ``seeded=False`` skips the probabilistic coin while still honoring
+        targeted arms — the chunked prefill path flips the coin only on a
+        request's FIRST chunk, so ``prefill_failure_rate`` stays a
+        per-request probability instead of compounding with prompt
+        length."""
+        if request_id in self._fail_prefill:
+            self._fail_prefill.discard(request_id)
+            self._count(PREFILL_FAILURE, request_id=request_id)
+            raise PrefillFault(
+                f"injected prefill failure for request {request_id}")
+        if (seeded and self.prefill_failure_rate
+                and self._rng.random() < self.prefill_failure_rate):
+            self._count(PREFILL_FAILURE, request_id=request_id)
+            raise PrefillFault(
+                f"injected prefill failure for request {request_id} "
+                f"(seeded rate {self.prefill_failure_rate})")
+
+    def step_latency(self) -> float:
+        """Decode-step site: extra seconds to ACCOUNT into the step's
+        observed latency (and any injected clock). Never slept — chaos
+        tests stay real-sleep-free."""
+        if self.step_latency_s:
+            self._count(STEP_LATENCY, seconds=self.step_latency_s)
+        return self.step_latency_s
+
+    def apply_famine(self, allocator) -> None:
+        """Allocator site: withhold ``famine_blocks`` from the free
+        budget, clamped to the pool size (idempotent; counted only on
+        transitions)."""
+        target = min(self.famine_blocks, allocator.usable_blocks)
+        if allocator.reserved_blocks != target:
+            allocator.set_reserved(target)
+            if target:
+                # a transition to 0 is the chaos ENDING, not a fault
+                self._count(FAMINE, blocks=target)
+
+    def snapshot(self) -> dict:
+        return {"seed": self.seed, "injected": dict(self.injected),
+                "wedged": sorted(self._wedged),
+                "famine_blocks": self.famine_blocks,
+                "step_latency_s": self.step_latency_s,
+                "prefill_failure_rate": self.prefill_failure_rate}

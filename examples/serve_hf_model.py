@@ -20,7 +20,16 @@ def run_continuous(eng, prompt, args):
     every other scheduler step, drain, report per-request outputs and
     the slot-recycling telemetry."""
     from deepspeed_tpu.inference.server import ContinuousBatchingServer
-    srv = ContinuousBatchingServer(eng)
+    fi = None
+    if args.chaos:
+        # deterministic chaos demo (telemetry/faultinject.py): every
+        # 5th request wedges (reaped by --deadline-s or the bounded
+        # drain below) and prefills occasionally die — the lifecycle
+        # layer degrades; the process survives
+        from deepspeed_tpu.telemetry import FaultInjector
+        fi = FaultInjector(seed=0, wedge_nth_request=5,
+                           prefill_failure_rate=0.1)
+    srv = ContinuousBatchingServer(eng, fault_injector=fi)
     ids = []
     for i in range(args.continuous):
         if srv.prefix_caching:
@@ -31,13 +40,27 @@ def run_continuous(eng, prompt, args):
         else:
             # vary lengths so slots recycle at different times
             p = prompt[: 1 + i % len(prompt)]
+        # mixed priorities only under --chaos: a plain demo run stays
+        # pure-FIFO and lossless (no preemption, nothing ever 'failed')
         ids.append(srv.submit(p, max_new_tokens=2 + args.max_new_tokens
-                              * (i % 3) // 2))
+                              * (i % 3) // 2,
+                              deadline_s=args.deadline_s,
+                              priority=i % 2 if args.chaos else 0))
         srv.step()   # arrivals interleave with decoding
-    out = srv.drain()
+    # chaos mode needs the bounded drain — a wedged slot would spin the
+    # unbounded loop forever (docs/serving.md "Request lifecycle")
+    out = srv.drain(timeout_s=60.0 if args.chaos else None)
     for rid in ids:
-        print(f"request {rid}: {out[rid]}")
+        reason = srv.finish_reason(rid)
+        tag = "" if reason in ("eos", "length") else f"  [{reason}]"
+        print(f"request {rid}: {out.get(rid)}{tag}")
     st = srv.stats
+    if any(st[k] for k in ("cancelled", "deadline_expired", "preempted",
+                           "shed", "failed")):
+        print(f"lifecycle: {st['cancelled']} cancelled, "
+              f"{st['deadline_expired']} deadline-expired, "
+              f"{st['preempted']} preempted, {st['shed']} shed, "
+              f"{st['failed']} failed")
     print(f"decode steps {st['decode_steps']}, occupancy "
           f"{st['slot_occupancy']:.2f}, traces {st['decode_traces']}")
     if st["prefix_caching"]:
@@ -120,6 +143,19 @@ def main():
                          "rate=1.0) and write a Perfetto-loadable "
                          "Chrome trace timeline here after the drain "
                          "(continuous mode; docs/observability.md)")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    metavar="SECONDS",
+                    help="per-request deadline: a request still queued "
+                         "or decoding past this many seconds after "
+                         "submit is reaped with finish reason "
+                         "'deadline' (continuous mode; docs/serving.md "
+                         "'Request lifecycle & overload behavior')")
+    ap.add_argument("--chaos", action="store_true",
+                    help="seeded fault injection demo: wedge every 5th "
+                         "request and fail ~10%% of prefills "
+                         "(telemetry/faultinject.py) — watch the "
+                         "lifecycle layer degrade gracefully under a "
+                         "bounded drain (continuous mode)")
     ap.add_argument("--slo", action="store_true",
                     help="arm default SLO gates (TTFT p90 1s, per-token "
                          "p50 100ms, queue-wait p90 1s, error rate 5%%) "

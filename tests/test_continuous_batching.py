@@ -313,6 +313,24 @@ def test_bench_serve_continuous_smoke():
     assert pc["prefill_tokens_skipped"] > 0
     assert pc["prefill_token_units"] < pc["prefill_token_units_cold"]
     assert pc["chunk_traces"] == 1
+    # overload A/B (auto in smoke mode): with the lifecycle layer on
+    # (deadlines + priorities + SLO shedding), accepted-request p90
+    # per-token latency AND goodput under the shared deadline are
+    # strictly better than plain FIFO at the same overload arrival
+    # rate — and the degradation ladder demonstrably fired
+    lc = rec["lifecycle"]
+    on, off = lc["on"], lc["off"]
+    assert lc["p90_improved"] is True
+    assert lc["goodput_improved"] is True
+    assert on["token_p90_ms"] < off["token_p90_ms"]
+    assert on["goodput_tokens_per_s"] > off["goodput_tokens_per_s"]
+    assert on["shed"] + on["deadline_expired"] >= 1
+    assert on["preempted"] >= 1
+    assert on["accepted"] >= 1
+    # the off-leg is the no-lifecycle baseline: nothing degraded
+    assert (off["shed"], off["deadline_expired"], off["preempted"],
+            off["cancelled"], off["failed"]) == (0, 0, 0, 0, 0)
+    assert off["accepted"] == lc["on"]["requests"]
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm
