@@ -110,6 +110,15 @@ def telemetry_info():
             f"{', '.join(slo_targets)}; window {cfg.slo.window_s}s)"
             if cfg.slo.enabled and slo_targets
             else "off (set telemetry.slo.enabled + objectives)")
+        from deepspeed_tpu.inference.config import \
+            DeepSpeedInferenceConfig
+        k = DeepSpeedInferenceConfig().speculation_tokens
+        out["serve_speculation"] = (
+            f"on by default config (speculation_tokens={k}, "
+            "prompt-lookup proposals, batched paged verify)"
+            if k else
+            "off (set DeepSpeedInferenceConfig.speculation_tokens>=2 — "
+            "docs/serving.md 'Per-slot speculative decoding')")
         fic = cfg.fault_injection
         out["fault_injection"] = (
             f"ARMED (seed {fic.seed}; step latency "

@@ -106,6 +106,20 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # enable_prefix_caching, which defaults this to block_size). Must
     # be a multiple of block_size.
     prefill_chunk_tokens: int = 0
+    # per-slot speculative decoding (docs/serving.md "Per-slot
+    # speculative decoding"): each active slot proposes up to
+    # speculation_tokens-1 tokens per scheduler tick by prompt lookup
+    # over its own committed history (draft-model-free — composes with
+    # any served model, no second set of weights); ONE batched verify
+    # forward scores every slot's candidate chunk through the block
+    # tables and the accepted prefix commits (1..speculation_tokens
+    # tokens per slot per step). Greedy output is unchanged; only
+    # tokens/step changes. 0 = off (one token per slot per step);
+    # otherwise >= 2 and <= block_size (rejected-position garbage from
+    # a mid-prefill slot must stay inside the next chunk's first
+    # block). Each request reserves speculation_tokens-1 extra cache
+    # positions for the verify overshoot.
+    speculation_tokens: int = 0
     # -------- request lifecycle (docs/serving.md "Request lifecycle &
     # overload behavior") --------------------------------------------
     # recompute preemption: how often one request may be preempted and
@@ -184,6 +198,20 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
             raise ValueError(
                 f"prefill_chunk_tokens ({self.prefill_chunk_tokens}) "
                 f"must be a multiple of block_size ({self.block_size})")
+        if self.speculation_tokens:
+            if self.speculation_tokens < 2:
+                raise ValueError(
+                    f"speculation_tokens must be 0 (off) or >= 2 (one "
+                    f"proposal minimum — a 1-token chunk IS plain "
+                    f"decode), got {self.speculation_tokens}")
+            if self.speculation_tokens > self.block_size:
+                # a mid-prefill slot's rejected-position garbage must
+                # land inside the next chunk's first (private, about-to-
+                # be-overwritten) block — K beyond a block would spill
+                # past what the coming chunk rewrites
+                raise ValueError(
+                    f"speculation_tokens ({self.speculation_tokens}) "
+                    f"must not exceed block_size ({self.block_size})")
 
     @property
     def tp_size(self) -> int:

@@ -28,54 +28,19 @@ import dataclasses
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.kv_cache import (KVCache, auto_max_tokens,
                                               init_cache)
+# shared speculative primitives (inference/speculation.py): the server's
+# per-slot speculative path uses the SAME acceptance/commit/proposal
+# rules, so the one-shot and paged paths cannot drift. The leading-
+# underscore aliases keep this module's historical names importable.
+from deepspeed_tpu.inference.speculation import (
+    commit_speculative_block as _commit_speculative_block,
+    greedy_accept as _greedy_accept, lookup_proposals)
 from deepspeed_tpu.model_implementations.transformer import (
     InferenceTransformerConfig, causal_forward, decode_chunk, decode_step,
     encoder_forward,
     init_params, prefill, tp_param_specs)
 from deepspeed_tpu.telemetry import (MetricRegistry, get_registry,
                                      watched_jit)
-
-
-def _greedy_accept(t_toks, props, K):
-    """Shared greedy acceptance: longest prefix of ``props [B, K-1]``
-    agreeing with the target's argmax ``t_toks [B, K]``; returns
-    ``(m, correction, committed)`` for _commit_speculative_block."""
-    B = t_toks.shape[0]
-    matches = props == t_toks[:, :K - 1]
-    m = jnp.argmin(
-        jnp.concatenate([matches, jnp.zeros((B, 1), bool)], 1).astype(
-            jnp.int32), axis=1)              # first mismatch = #accepted
-    correction = jnp.take_along_axis(t_toks, m[:, None], 1)
-    iota = jnp.arange(K)[None, :]
-    props_pad = jnp.concatenate([props, props[:, -1:]], 1)
-    committed = jnp.where(iota < m[:, None], props_pad, correction)
-    return m, correction, committed
-
-
-def _commit_speculative_block(committed, m, done, n_gen, out, eos, K,
-                              max_new_tokens):
-    """Shared verify→commit bookkeeping for the speculative loops:
-    scatter the accepted block into the out buffer, EOS/budget done
-    tracking, and the per-row context advance. Returns
-    ``(out, n_gen, done, adv, active)`` where ``adv`` is how many tokens
-    each row's caches/history gain this round."""
-    B = committed.shape[0]
-    iota = jnp.arange(K)[None, :]
-    active = ~done
-    commit_mask = (iota <= m[:, None]) & active[:, None]
-    # tokens after an in-block EOS must not count as output
-    is_eos = (committed == eos) & commit_mask
-    after_eos = (jnp.cumsum(is_eos.astype(jnp.int32), 1)
-                 - is_eos.astype(jnp.int32)) > 0
-    emit = commit_mask & ~after_eos
-    rows = jnp.arange(B)[:, None]
-    cols = jnp.clip(n_gen[:, None] + iota, 0, max_new_tokens + K - 1)
-    gathered = out[rows, cols]
-    out = out.at[rows, cols].set(jnp.where(emit, committed, gathered))
-    n_gen = n_gen + jnp.sum(emit.astype(jnp.int32), 1)
-    done = done | jnp.any(is_eos, 1) | (n_gen >= max_new_tokens)
-    adv = jnp.where(active, m + 1, 0)
-    return out, n_gen, done, adv, active
 
 
 def _round_up(n: int, m: int) -> int:
@@ -785,22 +750,10 @@ class InferenceEngine:
                 cur, cache_t, hist, done, n_gen, out, rounds, hlen = c
                 base_t = cache_t.lengths
 
-                # 1) propose: latest j with hist[j:j+2] == the current
-                # bigram (strictly before it), continuation as proposals
-                b0 = hist[ar, jnp.maximum(hlen - 2, 0)]
-                b1 = hist[ar, hlen - 1]
-                pos = jnp.arange(S)[None, :]
-                nxt = jnp.roll(hist, -1, axis=1)
-                match = ((hist == b0[:, None]) & (nxt == b1[:, None]) &
-                         (pos < (hlen - 2)[:, None]) & ((hlen >= 2)[:, None]))
-                found = jnp.any(match, 1)
-                jstar = jnp.max(jnp.where(match, pos, -1), 1)  # latest
-                iprop = jnp.arange(K - 1)[None, :]
-                pcols = jnp.clip(jstar[:, None] + 2 + iprop, 0, S - 1)
-                valid = (found[:, None] &
-                         (jstar[:, None] + 2 + iprop < hlen[:, None]))
-                props = jnp.where(valid, hist[ar[:, None], pcols],
-                                  cur[:, None])          # [B, K-1]
+                # 1) propose (shared rule, inference/speculation.py):
+                # latest j with hist[j:j+2] == the current bigram
+                # (strictly before it), continuation as proposals
+                props = lookup_proposals(hist, hlen, cur, K)  # [B, K-1]
 
                 # 2) target verifies [cur, props] in one forward
                 chunk = jnp.concatenate([cur[:, None], props], axis=1)

@@ -253,6 +253,37 @@ def paged_append_token(cache: PagedKVCache, layer: int, k: jnp.ndarray,
     return cache.replace(k=newk, v=newv)
 
 
+def paged_write_tokens(cache: PagedKVCache, layer: int, k: jnp.ndarray,
+                       v: jnp.ndarray) -> PagedKVCache:
+    """Speculative verify: write K tokens' ``[S, K, H, D]`` k/v for
+    EVERY slot at logical positions ``lengths[s]..lengths[s]+K-1``
+    through the block tables. Lengths are NOT advanced — the caller
+    commits only the accepted prefix by advancing per-slot lengths;
+    rejected positions stay as garbage beyond ``lengths`` (masked by
+    attention, overwritten by the next round's writes) — the paged
+    analog of :func:`write_chunk`, and :func:`paged_append_token`
+    generalized to K positions (K=1 writes the identical bytes).
+
+    The span may straddle a block boundary mid-write (positions are not
+    block-aligned, unlike :func:`paged_write_chunk`): each position
+    resolves its own table entry. A position whose block index runs
+    past the table itself (a wedged slot decoding beyond its budget)
+    redirects to the reserved null block 0 instead of letting the
+    gather clamp silently target the table's LAST live entry."""
+    BS = cache.block_size
+    K = k.shape[1]
+    MB = cache.max_blocks
+    pos = cache.lengths[:, None] + jnp.arange(K)[None, :]     # [S, K]
+    pb = pos // BS
+    blk = jnp.take_along_axis(cache.block_tables,
+                              jnp.clip(pb, 0, MB - 1), axis=1)
+    blk = jnp.where(pb < MB, blk, 0)       # overshoot -> null block
+    off = pos % BS
+    newk = cache.k.at[layer, blk, off].set(k.astype(cache.k.dtype))
+    newv = cache.v.at[layer, blk, off].set(v.astype(cache.v.dtype))
+    return cache.replace(k=newk, v=newv)
+
+
 def paged_write_chunk(cache: PagedKVCache, layer: int, k: jnp.ndarray,
                       v: jnp.ndarray, slot: jnp.ndarray,
                       start: jnp.ndarray) -> PagedKVCache:

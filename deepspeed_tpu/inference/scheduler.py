@@ -83,10 +83,15 @@ class Request:
         return self.prompt + self.committed if self.committed \
             else self.prompt
 
-    def blocks_needed(self, block_size: int) -> int:
+    def blocks_needed(self, block_size: int, margin: int = 0) -> int:
         # the full span is invariant under preemption: committed tokens
-        # move from budget to prompt, prompt+max_new_tokens stays put
-        span = len(self.prompt) + self.max_new_tokens
+        # move from budget to prompt, prompt+max_new_tokens stays put.
+        # ``margin`` is the speculative-verify overshoot (K-1 tokens):
+        # a verify forward writes K candidate positions past the live
+        # length, and a committed token's KV must be REAL — spilling an
+        # accepted position into the null block would corrupt decoding,
+        # so the span reserves the overshoot up front.
+        span = len(self.prompt) + self.max_new_tokens + margin
         return -(-span // block_size)   # ceil
 
     def expired(self, now: float) -> bool:
@@ -127,8 +132,13 @@ class Scheduler:
                  max_blocks_per_slot: int, max_queued_requests: int,
                  registry: Optional[MetricRegistry] = None,
                  enable_prefix_caching: bool = False,
-                 tracer=None):
+                 tracer=None, spec_margin: int = 0):
         self.num_slots = num_slots
+        # speculative-verify overshoot (speculation_tokens - 1): every
+        # request's block span reserves this many extra cache positions
+        # so a verify forward's K-token write window never runs past
+        # the allocated blocks (Request.blocks_needed)
+        self.spec_margin = spec_margin
         # request tracer (telemetry/tracing.py) or None; the scheduler
         # only records its OWN rejections — rejected requests are
         # always-keep traces, whatever the sampling rate
@@ -223,12 +233,15 @@ class Scheduler:
         """Admission control: reject loudly what can NEVER run (block
         span beyond one slot's table) or what the queue bound refuses,
         instead of deadlocking the drain loop later."""
-        nb = req.blocks_needed(self.block_size)
+        nb = req.blocks_needed(self.block_size, self.spec_margin)
         if nb > self.max_blocks_per_slot:
             self._reject("span", req.request_id)
+            margin = (f" + speculation margin ({self.spec_margin})"
+                      if self.spec_margin else "")
             raise ValueError(
                 f"request {req.request_id}: prompt ({len(req.prompt)}) + "
-                f"max_new_tokens ({req.max_new_tokens}) spans {nb} blocks "
+                f"max_new_tokens ({req.max_new_tokens}){margin} spans "
+                f"{nb} blocks "
                 f"of {self.block_size} tokens, but a slot holds at most "
                 f"{self.max_blocks_per_slot} (raise max_out_tokens or "
                 "lower the request budget)")
@@ -306,7 +319,7 @@ class Scheduler:
         if idx is None:
             return None
         req = self.queue[idx]
-        nb = req.blocks_needed(self.block_size)
+        nb = req.blocks_needed(self.block_size, self.spec_margin)
         sched_prompt = req.sched_prompt
         hashes: List[bytes] = []
         hits: List[int] = []

@@ -49,8 +49,11 @@ from deepspeed_tpu.inference.engine import InferenceEngine, _bucket
 from deepspeed_tpu.inference.kv_cache import (PagedKVCache,
                                               init_paged_cache)
 from deepspeed_tpu.inference.scheduler import Request, Scheduler
+from deepspeed_tpu.inference.speculation import (LookupIndex,
+                                                 greedy_accept_host)
 from deepspeed_tpu.model_implementations.transformer import (
-    paged_decode_step, paged_prefill, paged_prefill_chunk)
+    paged_decode_step, paged_prefill, paged_prefill_chunk,
+    paged_verify_step)
 from deepspeed_tpu.telemetry import (FaultInjector, MetricRegistry,
                                      PrefillFault, ProfilerCapture,
                                      SLOMonitor, Tracer, get_event_ring,
@@ -146,6 +149,12 @@ class ContinuousBatchingServer:
         self.prefix_caching = cfg.enable_prefix_caching
         self.chunk_tokens = cfg.prefill_chunk_tokens or (
             self.block_size if cfg.enable_prefix_caching else 0)
+        # per-slot speculative decoding (docs/serving.md "Per-slot
+        # speculative decoding"): K = chunk width of the batched verify
+        # forward (pending token + up to K-1 prompt-lookup proposals
+        # per active slot). 0 = off — the decode path is byte-identical
+        # to a server without this layer.
+        self.spec_tokens = cfg.speculation_tokens
         # telemetry: registry recording is always on (dict lookup + float
         # add per event); telemetry.enabled=False swaps in a private
         # registry, so cost is identical but nothing reaches the process
@@ -267,6 +276,23 @@ class ContinuousBatchingServer:
             help="slot preemptions (recompute-requeue): the victim's "
                  "committed tokens fold into its prompt and it waits "
                  "out a backoff before re-admission")
+        # speculative decoding (docs/serving.md "Per-slot speculative
+        # decoding"): proposal/acceptance volume plus the headline
+        # number — committed tokens per target forward per slot
+        self._c_spec_proposed = reg.counter(
+            "serve_spec_proposed_total",
+            help="prompt-lookup draft tokens submitted to the batched "
+                 "verify forward ((speculation_tokens-1) per active "
+                 "slot per step)")
+        self._c_spec_accepted = reg.counter(
+            "serve_spec_accepted_total",
+            help="proposed draft tokens the target's argmax accepted "
+                 "(acceptance rate = accepted / proposed)")
+        self._h_spec_commit = reg.histogram(
+            "serve_spec_committed_per_forward",
+            help="tokens committed per active slot per verify forward "
+                 "(1 = speculation wins nothing; up to "
+                 "speculation_tokens on full acceptance)")
         self._submit_ts: Dict[int, float] = {}
         # when the request last ENTERED the queue (submit or preemption
         # requeue) — the shed guard's notion of "how long has this
@@ -286,7 +312,8 @@ class ContinuousBatchingServer:
             max_queued_requests=cfg.max_queued_requests,
             registry=self.telemetry,
             enable_prefix_caching=self.prefix_caching,
-            tracer=self.tracer)
+            tracer=self.tracer,
+            spec_margin=max(self.spec_tokens - 1, 0))
         self._cache = self._make_pool(num_blocks)
         # flight recorder (telemetry/compile_watch.py): the serving jits
         # are watched, so a prompt shape that defeats the geometric
@@ -313,6 +340,17 @@ class ContinuousBatchingServer:
                                   mesh=engine.mesh),
                 name="serve_prefill_chunk", registry=self.telemetry,
                 static_argnames=(), donate_argnames=("cache",))
+        # the batched speculative-verify program: ONE traced signature
+        # per (speculation_tokens, num_slots, block_size) — per-slot
+        # acceptance lengths ride in cache.lengths as traced data, so
+        # varying acceptance NEVER retraces (PR-5 discipline)
+        self._verify_jit = None
+        if self.spec_tokens:
+            self._verify_jit = watched_jit(
+                functools.partial(self._verify_fn, cfg=mcfg,
+                                  mesh=engine.mesh),
+                name="serve_spec_verify", registry=self.telemetry,
+                donate_argnames=("cache",))
         self._results: Dict[int, List[int]] = {}
         self._next_id = 0
         self._step_clock = 0           # decode steps executed
@@ -328,6 +366,25 @@ class ContinuousBatchingServer:
         self._prefill_token_units = 0  # tokens run through prefill compute
         self._prefix_tokens_skipped = 0   # prompt tokens served from cache
         self._tail_reclaimed = 0
+        # speculation host mirrors (stats without a snapshot round-trip)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_committed = 0       # tokens committed by verify steps
+        self._spec_steps = 0           # verify forwards executed
+        self._spec_slot_steps = 0      # sum of active slots per verify
+        # acceptance-collapse detector: rolling (proposed, accepted)
+        # window; a sustained near-zero acceptance rate means the
+        # workload stopped being lookup-friendly and every verify
+        # forward is wasted width — ring-evented once per collapse,
+        # re-armed on recovery
+        self._spec_window: Deque[tuple] = deque(
+            maxlen=self._SPEC_WINDOW_STEPS)
+        self._spec_alarm = False
+        # per-slot incremental lookup state (speculation.LookupIndex):
+        # proposals cost O(1) per step instead of rescanning the whole
+        # history; keyed by slot, identity-checked against the resident
+        # SlotState so a recycled slot always rebuilds
+        self._spec_hist: Dict[int, tuple] = {}
         # lifecycle host mirrors (stats without a snapshot round-trip),
         # keyed by finish reason + "preempted" (not a terminal state)
         self._lifecycle_counts = dict.fromkeys(
@@ -345,6 +402,15 @@ class ContinuousBatchingServer:
     # events would flush the compile/admission forensics out of the
     # bounded ring in seconds
     _EVENT_EVERY = 64
+
+    # acceptance-collapse detector: over the last _SPEC_WINDOW_STEPS
+    # verify steps (once at least _SPEC_MIN_PROPOSED proposals are in
+    # the window), an acceptance rate below COLLAPSE fires one
+    # spec_collapse ring event; the alarm re-arms above RECOVER
+    _SPEC_WINDOW_STEPS = 64
+    _SPEC_MIN_PROPOSED = 64
+    _SPEC_COLLAPSE_RATE = 0.05
+    _SPEC_RECOVER_RATE = 0.10
 
     def _init_flight_recorder(self, tcfg) -> None:
         """Arm the config-gated flight-recorder surfaces (see
@@ -389,6 +455,12 @@ class ContinuousBatchingServer:
         logits, cache = paged_prefill_chunk(params, cfg, ids, start,
                                             length, cache, slot,
                                             mesh=mesh)
+        return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+    @staticmethod
+    def _verify_fn(params, tokens, cache, *, cfg, mesh):
+        logits, cache = paged_verify_step(params, cfg, tokens, cache,
+                                          mesh=mesh)
         return jnp.argmax(logits, -1).astype(jnp.int32), cache
 
     def _make_pool(self, num_blocks: int) -> PagedKVCache:
@@ -510,6 +582,9 @@ class ContinuousBatchingServer:
             lengths=self._cache.lengths.at[slot].set(0),
             block_tables=self._cache.block_tables.at[slot].set(
                 jnp.zeros((self.max_blocks_per_slot,), jnp.int32)))
+        # every slot-vacating path (retire / cancel / preempt / fault)
+        # runs through here — drop its lookup state with it
+        self._spec_hist.pop(slot, None)
 
     def _drop_prefill_job(self, slot: int) -> None:
         """Forget any in-flight chunked prefill for a vacated slot."""
@@ -1052,6 +1127,20 @@ class ContinuousBatchingServer:
                 # the deadline fires a spurious dump
                 self.watchdog.notify_progress()
             return finished
+        if self.spec_tokens:
+            self._decode_speculative(finished)
+        else:
+            self._decode_once(finished)
+        if self.slo is not None and not self._shedding:
+            # with shedding armed, _maybe_shed already refreshed the
+            # monitor this step — don't pay a second registry snapshot
+            self.slo.maybe_evaluate()
+        return finished
+
+    def _decode_once(self, finished: List[int]) -> None:
+        """One plain decode step for all active resident slots — the
+        speculation-off hot path, byte-identical to a server without
+        the speculative layer."""
         tokens = np.zeros((self.num_slots,), np.int32)
         active = np.zeros((self.num_slots,), bool)
         for slot, state in self.scheduler.slots.items():
@@ -1062,7 +1151,7 @@ class ContinuousBatchingServer:
         if not active.any():
             # every resident slot is mid-prefill — the chunk above was
             # this step's progress; nothing to decode yet
-            return finished
+            return
         self.profiler_capture.step_begin()
         t0 = self._clock()
         nxt, self._cache = self._decode_jit(
@@ -1108,11 +1197,157 @@ class ContinuousBatchingServer:
                 self._retire(slot, state, finished)
             else:
                 state.pending = tok
-        if self.slo is not None and not self._shedding:
-            # with shedding armed, _maybe_shed already refreshed the
-            # monitor this step — don't pay a second registry snapshot
-            self.slo.maybe_evaluate()
-        return finished
+
+    def _decode_speculative(self, finished: List[int]) -> None:
+        """One speculative round for all active resident slots: each
+        slot proposes up to K-1 tokens by prompt lookup over its own
+        committed history (prompt + generated, the pending token
+        included), ONE batched verify forward scores every slot's
+        ``[pending, p_1..p_{K-1}]`` chunk through the block tables, and
+        the accepted prefix commits host-side — 1..K tokens per slot
+        per step. The verify writes K candidate positions past each
+        slot's live length without advancing it; commit = advance the
+        length over the accepted prefix only, so rejected KV is never
+        rolled back, just left as masked garbage the next round
+        overwrites (the garbage-beyond-lengths invariant)."""
+        K = self.spec_tokens
+        S = self.num_slots
+        tokens = np.zeros((S, K), np.int32)
+        props: Dict[int, List[int]] = {}
+        active_slots: List[int] = []
+        for slot, state in self.scheduler.slots.items():
+            if slot in self._mid_prefill:
+                continue   # resident but still prefilling: not decoded
+            # proposal source = committed history ONLY (prompt + every
+            # generated token incl. pending) — never the speculative
+            # garbage beyond it, so a preempted slot's requeue prompt
+            # (prompt + committed) replays the same proposals. The
+            # LookupIndex makes this O(1) per step: full build at the
+            # slot's first verify, tail-sync after.
+            entry = self._spec_hist.get(slot)
+            if entry is None or entry[0] is not state:
+                idx = LookupIndex(state.request.prompt)
+                idx.extend(state.generated)
+                self._spec_hist[slot] = (state, idx)
+            else:
+                idx = entry[1]
+                grown = (len(state.request.prompt)
+                         + len(state.generated) - len(idx.hist))
+                if grown > 0:
+                    idx.extend(state.generated[-grown:])
+            prop = idx.proposals(K - 1)
+            tokens[slot, 0] = state.pending
+            tokens[slot, 1:] = prop
+            props[slot] = prop
+            active_slots.append(slot)
+        if not active_slots:
+            return
+        n_active = len(active_slots)
+        self.profiler_capture.step_begin()
+        t0 = self._clock()
+        t_toks, self._cache = self._verify_jit(
+            self.engine.params, jnp.asarray(tokens), self._cache)
+        self._step_clock += 1
+        self._active_slot_steps += n_active
+        t_np = np.asarray(t_toks)         # host sync: the verify ran
+        dt = self._clock() - t0
+        if self._fi is not None:
+            # injected latency is ACCOUNTED, never slept (see step())
+            dt += self._fi.step_latency()
+        self.profiler_capture.step_end()
+        # accept + commit, host-side (the scheduler lives here anyway):
+        # greedy acceptance against the verify argmaxes, per-token EOS/
+        # budget bookkeeping, ONE vectorized length advance at the end
+        adv = np.zeros((S,), np.int32)
+        committed_total = 0
+        accepted_total = 0
+        retire: List[int] = []
+        for slot in active_slots:
+            state = self.scheduler.slots[slot]
+            m, committed = greedy_accept_host(t_np[slot], props[slot])
+            accepted_total += m
+            rt = (self._rt.get(state.request.request_id)
+                  if self.tracer is not None else None)
+            if rt is not None and rt.decode is not None:
+                rt.steps += 1
+            done = False
+            n_committed = 0
+            for tok in committed:
+                state.generated.append(tok)
+                n_committed += 1
+                if rt is not None and rt.decode is not None:
+                    rt.tokens += 1
+                if self._finished(state, tok):
+                    done = True
+                    break
+            committed_total += n_committed
+            # one observation PER SLOT-FORWARD (not a cross-slot step
+            # mean): the histogram's distribution must expose per-slot
+            # acceptance skew — one lookup-friendly request carrying an
+            # otherwise-collapsed batch shows as {K, 1, 1, 1}, not 1.75
+            self._h_spec_commit.observe(n_committed)
+            # a continuing slot's cache gains [pending, p_1..p_m]; the
+            # correction becomes the next pending (its KV, like any
+            # pending token's, is written by the NEXT verify). A
+            # retiring slot's lengths are reset right below, so its
+            # adv value never matters.
+            adv[slot] = n_committed
+            if done:
+                retire.append(slot)
+            else:
+                state.pending = committed[-1]
+        self._cache = self._cache.replace(
+            lengths=self._cache.lengths + jnp.asarray(adv))
+        for slot in retire:
+            self._retire(slot, self.scheduler.slots[slot], finished)
+        self._h_decode_step.observe(dt)
+        # per-token latency: each active slot committed
+        # committed_total/n_active tokens on average this step, so one
+        # committed token cost dt / that — keeps serve_token_seconds
+        # meaning "wall per committed token per slot" under speculation
+        self._h_token.observe(dt * n_active / max(committed_total, 1))
+        self._c_decode_steps.inc()
+        self._c_tokens.inc(committed_total)
+        self._g_occupancy.set(n_active / S)
+        proposed = n_active * (K - 1)
+        self._c_spec_proposed.inc(proposed)
+        self._c_spec_accepted.inc(accepted_total)
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted_total
+        self._spec_committed += committed_total
+        self._spec_steps += 1
+        self._spec_slot_steps += n_active
+        self._maybe_spec_collapse(proposed, accepted_total)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()
+        if self._step_clock % self._EVENT_EVERY == 1:
+            get_event_ring().record(
+                telemetry_events.STEP_END, source="serve_spec_verify",
+                step=self._step_clock, live=n_active,
+                committed=committed_total, accepted=accepted_total,
+                seconds=round(dt, 6),
+                sampled_every=self._EVENT_EVERY)
+
+    def _maybe_spec_collapse(self, proposed: int, accepted: int) -> None:
+        """Ring-event an acceptance-rate collapse ONCE per episode: over
+        the rolling window, enough proposal volume with near-zero
+        acceptance means every verify forward is wasted width — the
+        operator should turn speculation off (or the workload changed
+        under them). Re-arms after the rate recovers."""
+        self._spec_window.append((proposed, accepted))
+        p = sum(w[0] for w in self._spec_window)
+        if p < self._SPEC_MIN_PROPOSED:
+            return
+        rate = sum(w[1] for w in self._spec_window) / p
+        if not self._spec_alarm and rate < self._SPEC_COLLAPSE_RATE:
+            self._spec_alarm = True
+            get_event_ring().record(
+                telemetry_events.SPEC_COLLAPSE,
+                acceptance_rate=round(rate, 4),
+                window_steps=len(self._spec_window), proposed=p,
+                k=self.spec_tokens)
+        elif self._spec_alarm and rate >= self._SPEC_RECOVER_RATE:
+            self._spec_alarm = False
 
     def result(self, request_id: int) -> Optional[List[int]]:
         """Finished output (prompt + generated, EOS included) or None.
@@ -1216,7 +1451,9 @@ class ContinuousBatchingServer:
                 len(getattr(self._decode_jit, "retraces", ()))
                 + len(getattr(self._prefill_jit, "retraces", ()))
                 + (len(getattr(self._chunk_jit, "retraces", ()))
-                   if self._chunk_jit is not None else 0)),
+                   if self._chunk_jit is not None else 0)
+                + (len(getattr(self._verify_jit, "retraces", ()))
+                   if self._verify_jit is not None else 0)),
             "num_slots": self.num_slots,
             "block_size": self.block_size,
             "free_blocks": alloc.free_blocks,
@@ -1237,6 +1474,25 @@ class ContinuousBatchingServer:
             "shed": self._lifecycle_counts["shed"],
             "failed": self._lifecycle_counts["failed"],
             "requeue_depth": self.scheduler.requeue_depth,
+            # speculation (docs/serving.md "Per-slot speculative
+            # decoding"): tokens_per_forward is THE number that decides
+            # whether the verify width pays for itself (1.0 = nothing
+            # won; up to speculation_tokens on full acceptance)
+            "speculation": {
+                "k": self.spec_tokens,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "acceptance_rate": round(
+                    self._spec_accepted / self._spec_proposed, 4)
+                if self._spec_proposed else None,
+                "verify_steps": self._spec_steps,
+                "committed_tokens": self._spec_committed,
+                "tokens_per_forward": round(
+                    self._spec_committed / self._spec_slot_steps, 3)
+                if self._spec_slot_steps else None,
+                "verify_traces": (_safe_cache_size(self._verify_jit)
+                                  if self._verify_jit is not None else 0),
+            },
             "fault_injection": (self._fi.snapshot()
                                 if self._fi is not None else None),
             "traces_started": (self.tracer.started

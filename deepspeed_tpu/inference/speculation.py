@@ -1,0 +1,188 @@
+"""Shared speculative-decoding primitives.
+
+One home for the proposal + verify→commit bookkeeping used by BOTH
+speculative paths — the one-shot jitted loops in
+:mod:`deepspeed_tpu.inference.engine` (``generate_speculative`` /
+``_lookup_loop``) and the per-slot path in
+:class:`~deepspeed_tpu.inference.server.ContinuousBatchingServer` — so
+the two cannot drift. The in-graph (jnp) functions run inside the
+engine's ``lax.while_loop``; the ``*_host`` mirrors are the server's
+between-steps bookkeeping (the server schedules on the host anyway, so
+acceptance is plain Python over the verify forward's argmaxes).
+``tests/test_server_speculation.py`` pins host == in-graph on random
+histories — a change to one side that forgets the other fails loudly.
+
+Prompt-lookup proposals (draft-model-free speculation): the candidate
+continuation is whatever followed the most recent earlier occurrence of
+the current BIGRAM in the sequence's own prompt+generated history.
+Zero extra model cost per proposal, composes with any served model
+(no second set of weights), and greedy acceptance keeps the output
+exactly greedy — the draft can only change how many target forwards
+run, never what they commit.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def greedy_accept(t_toks, props, K: int):
+    """Greedy acceptance: longest prefix of ``props [B, K-1]`` agreeing
+    with the target's argmax ``t_toks [B, K]``; returns
+    ``(m, correction, committed)`` for :func:`commit_speculative_block`.
+    ``m [B]`` is the number of accepted proposals (first mismatch
+    index), ``correction [B, 1]`` the target token at the mismatch, and
+    ``committed [B, K]`` the block ``[p_1..p_m, correction, ...]``."""
+    B = t_toks.shape[0]
+    matches = props == t_toks[:, :K - 1]
+    m = jnp.argmin(
+        jnp.concatenate([matches, jnp.zeros((B, 1), bool)], 1).astype(
+            jnp.int32), axis=1)              # first mismatch = #accepted
+    correction = jnp.take_along_axis(t_toks, m[:, None], 1)
+    iota = jnp.arange(K)[None, :]
+    props_pad = jnp.concatenate([props, props[:, -1:]], 1)
+    committed = jnp.where(iota < m[:, None], props_pad, correction)
+    return m, correction, committed
+
+
+def commit_speculative_block(committed, m, done, n_gen, out, eos, K: int,
+                             max_new_tokens: int):
+    """Shared verify→commit bookkeeping for the speculative loops:
+    scatter the accepted block into the out buffer, EOS/budget done
+    tracking, and the per-row context advance. Returns
+    ``(out, n_gen, done, adv, active)`` where ``adv`` is how many tokens
+    each row's caches/history gain this round."""
+    B = committed.shape[0]
+    iota = jnp.arange(K)[None, :]
+    active = ~done
+    commit_mask = (iota <= m[:, None]) & active[:, None]
+    # tokens after an in-block EOS must not count as output
+    is_eos = (committed == eos) & commit_mask
+    after_eos = (jnp.cumsum(is_eos.astype(jnp.int32), 1)
+                 - is_eos.astype(jnp.int32)) > 0
+    emit = commit_mask & ~after_eos
+    rows = jnp.arange(B)[:, None]
+    cols = jnp.clip(n_gen[:, None] + iota, 0, max_new_tokens + K - 1)
+    gathered = out[rows, cols]
+    out = out.at[rows, cols].set(jnp.where(emit, committed, gathered))
+    n_gen = n_gen + jnp.sum(emit.astype(jnp.int32), 1)
+    done = done | jnp.any(is_eos, 1) | (n_gen >= max_new_tokens)
+    adv = jnp.where(active, m + 1, 0)
+    return out, n_gen, done, adv, active
+
+
+def lookup_proposals(hist, hlen, cur, K: int):
+    """In-graph prompt-lookup proposals: for each row, find the latest
+    ``j < hlen-2`` with ``hist[j:j+2]`` equal to the current bigram
+    (the two most recent history tokens, ``cur`` included) and propose
+    the ``K-1`` tokens that followed it. Rows with no match (or not yet
+    two tokens of history) propose ``cur`` repeated — a deliberate
+    worst-case proposal that the verify forward simply rejects.
+
+    ``hist [B, S]`` is the padded history buffer with ``hlen [B]`` live
+    tokens; ``cur [B]`` is the pending token (``hist[b, hlen[b]-1]``).
+    Returns ``props [B, K-1]`` int32."""
+    B, S = hist.shape
+    ar = jnp.arange(B)
+    b0 = hist[ar, jnp.maximum(hlen - 2, 0)]
+    b1 = hist[ar, hlen - 1]
+    pos = jnp.arange(S)[None, :]
+    nxt = jnp.roll(hist, -1, axis=1)
+    match = ((hist == b0[:, None]) & (nxt == b1[:, None]) &
+             (pos < (hlen - 2)[:, None]) & ((hlen >= 2)[:, None]))
+    found = jnp.any(match, 1)
+    jstar = jnp.max(jnp.where(match, pos, -1), 1)  # latest occurrence
+    iprop = jnp.arange(K - 1)[None, :]
+    pcols = jnp.clip(jstar[:, None] + 2 + iprop, 0, S - 1)
+    valid = (found[:, None] &
+             (jstar[:, None] + 2 + iprop < hlen[:, None]))
+    return jnp.where(valid, hist[ar[:, None], pcols],
+                     cur[:, None])                 # [B, K-1]
+
+
+def lookup_proposals_host(history: Sequence[int], k: int) -> List[int]:
+    """Host mirror of :func:`lookup_proposals` for ONE sequence: exact
+    same semantics over a plain token list (``history`` ends with the
+    pending token). Returns ``k`` proposed tokens, padded with the
+    pending token where the lookup has nothing better — the server's
+    per-slot proposal source (pinned equal to the in-graph rule by
+    tests/test_server_speculation.py)."""
+    n = len(history)
+    cur = int(history[-1])
+    out = [cur] * k
+    if n < 2:
+        return out
+    b0, b1 = int(history[-2]), int(history[-1])
+    jstar = -1
+    for j in range(n - 3, -1, -1):      # latest j with j < n-2
+        if history[j] == b0 and history[j + 1] == b1:
+            jstar = j
+            break
+    if jstar < 0:
+        return out
+    for i in range(k):
+        idx = jstar + 2 + i
+        if idx < n:
+            out[i] = int(history[idx])
+    return out
+
+
+class LookupIndex:
+    """Incremental prompt-lookup state for ONE sequence: the same
+    latest-bigram-match rule as :func:`lookup_proposals_host`, without
+    rescanning the whole history every step. ``extend`` registers each
+    new committed token in O(1) (the pair ending at the previous tail
+    becomes matchable once a newer token arrives — exactly the
+    ``j < n-2`` exclusion of the query bigram itself); ``proposals`` is
+    a dict lookup plus a K-token slice. The serving hot path calls this
+    once per active slot per verify step, so proposal cost stays flat
+    as contexts grow instead of O(prompt+generated) per step.
+
+    Equivalence with the rescan (and therefore with the in-graph rule)
+    is property-pinned in tests/test_server_speculation.py."""
+
+    __slots__ = ("hist", "_latest")
+
+    def __init__(self, history: Sequence[int] = ()):
+        self.hist: List[int] = []
+        self._latest = {}          # (tok_j, tok_j+1) -> latest j <= n-3
+        self.extend(history)
+
+    def extend(self, tokens: Sequence[int]) -> None:
+        hist = self.hist
+        for t in tokens:
+            n = len(hist)
+            if n >= 2:
+                # the pair ending at the old tail (j = n-2) is now
+                # strictly before the new query bigram — index it;
+                # later occurrences overwrite, keeping "latest j"
+                self._latest[(hist[n - 2], hist[n - 1])] = n - 2
+            hist.append(int(t))
+
+    def proposals(self, k: int) -> List[int]:
+        hist = self.hist
+        cur = int(hist[-1])
+        out = [cur] * k
+        if len(hist) < 2:
+            return out
+        j = self._latest.get((hist[-2], hist[-1]))
+        if j is None:
+            return out
+        for i in range(k):
+            idx = j + 2 + i
+            if idx < len(hist):
+                out[i] = hist[idx]
+        return out
+
+
+def greedy_accept_host(t_row: Sequence[int], props: Sequence[int]
+                       ) -> Tuple[int, List[int]]:
+    """Host mirror of :func:`greedy_accept` for ONE row: ``t_row`` is
+    the verify forward's K argmax tokens, ``props`` the K-1 proposals.
+    Returns ``(m, committed)`` — the number of accepted proposals and
+    the committed block ``[p_1..p_m, correction]`` (1..K tokens)."""
+    m = 0
+    while m < len(props) and int(props[m]) == int(t_row[m]):
+        m += 1
+    return m, [int(p) for p in props[:m]] + [int(t_row[m])]

@@ -41,7 +41,8 @@ from deepspeed_tpu.inference.kv_cache import (KVCache, PagedKVCache, advance,
                                               paged_gather_kv,
                                               paged_gather_slot_kv,
                                               paged_write_chunk,
-                                              paged_write_prompt, write_chunk,
+                                              paged_write_prompt,
+                                              paged_write_tokens, write_chunk,
                                               write_prompt)
 from deepspeed_tpu.ops.int8_gemm import (maybe_int8_einsum,
                                          maybe_int8_matmul)
@@ -526,6 +527,34 @@ def _chunk_attention(q, k_cache, v_cache, lengths,
                       ).astype(q.dtype)
 
 
+def _paged_verify_attention(q, cache: PagedKVCache, layer_idx: int,
+                            cfg: InferenceTransformerConfig, window=None):
+    """Speculative-verify attention through the paged pool for ALL
+    slots: ``q [S, K, H, D]`` — each slot's K-token candidate chunk at
+    absolute positions ``lengths[s]..lengths[s]+K-1`` — attends that
+    slot's resident context plus the chunk itself through its block
+    table. TPU fast path: the Pallas batched-verify kernel streams pool
+    blocks via the scalar-prefetched tables, grid (slot, kv-head, table
+    entry). Fallback (CPU / ALiBi / windowed): gather per-slot caches
+    with XLA and reuse :func:`_chunk_attention` with per-slot
+    ``lengths`` — the identical per-query causal bound, so the paged
+    verify cannot diverge from the dense :func:`decode_chunk` math."""
+    S, K, H, D = q.shape
+    KH = cache.k.shape[3]
+    if cfg.positional != "alibi" and window is None \
+            and jax.default_backend() == "tpu" and H % KH == 0 \
+            and not cfg.seq_shard_kv:
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            paged_verify_attention
+        return paged_verify_attention(q, cache.k[layer_idx],
+                                      cache.v[layer_idx],
+                                      cache.block_tables, cache.lengths,
+                                      scale=cfg.scale)
+    k_cache, v_cache = paged_gather_kv(cache, layer_idx)
+    return _chunk_attention(q, k_cache, v_cache, cache.lengths, cfg,
+                            window=window)
+
+
 def _paged_chunk_attention(q, cache: PagedKVCache, layer_idx: int,
                            cfg: InferenceTransformerConfig, slot, start,
                            window=None):
@@ -929,6 +958,54 @@ def paged_prefill_chunk(params, cfg: InferenceTransformerConfig,
         lengths=jax.lax.dynamic_update_index_in_dim(
             cache.lengths, new_len, slot, 0))
     return _logits(params, cfg, last), cache
+
+
+def _block_verify_paged(x, layer, cfg, cache: PagedKVCache, layer_idx,
+                        mesh=None):
+    """K-token speculative-verify block over the paged pool. x
+    ``[S, K, E]`` (one candidate chunk per SLOT); writes each slot's
+    chunk k/v at per-slot offsets ``lengths[s]..lengths[s]+K-1``
+    through the block tables without advancing lengths — the paged
+    analog of :func:`_block_chunk`."""
+    a = layer["attn"]
+    ln1_out = _layer_norm(x, layer["ln1"], cfg.layer_norm_eps)
+    h = ln1_out if cfg.pre_layer_norm else x
+    K = x.shape[1]
+    positions = cache.lengths[:, None] + jnp.arange(K)[None, :]  # [S, K]
+    q, k, v = _qkv(h, a, cfg, positions)
+    cache = paged_write_tokens(cache, layer_idx, k, v)
+    window = (cfg.local_windows[layer_idx] if cfg.local_windows else None)
+    attn = _paged_verify_attention(q, cache, layer_idx, cfg,
+                                   window=window)
+    attn_out = maybe_int8_einsum("...hd,hde->...e", attn, a["wo"],
+                                 x.dtype, cfg.int8_compute, 2, 1) + a["bo"]
+    return _post_attn(x, ln1_out, attn_out, layer, cfg, mesh), cache
+
+
+def paged_verify_step(params, cfg: InferenceTransformerConfig, tokens,
+                      cache: PagedKVCache, mesh=None):
+    """Speculative verify for ALL resident slots: score each slot's
+    K-token candidate chunk ``tokens [S, K]`` in ONE forward at
+    positions ``lengths[s]..lengths[s]+K-1`` → (logits ``[S, K, V]``,
+    cache). The chunk's k/v are written through the block tables;
+    lengths are NOT advanced — the caller commits the accepted prefix
+    by advancing per-slot lengths host-side (rejected positions remain
+    masked garbage beyond ``lengths``, overwritten by the next round —
+    the same rollback-free invariant as :func:`decode_chunk` on the
+    dense cache). ONE traced signature per ``(K, num_slots,
+    block_size)``: per-slot acceptance state rides in ``lengths``, so
+    varying acceptance lengths never retrace."""
+    if cfg.seq_shard_kv:
+        raise NotImplementedError(
+            "paged serving with a seq-sharded KV pool is unsupported — "
+            "the block pool is already the long-context memory lever")
+    S, K = tokens.shape
+    positions = cache.lengths[:, None] + jnp.arange(K)[None, :]
+    x = _embed(params, cfg, tokens, positions)
+    for i, layer in enumerate(params["layers"]):
+        x, cache = _block_verify_paged(x, layer, cfg, cache, i, mesh)
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    return _logits(params, cfg, x), cache
 
 
 def paged_decode_step(params, cfg: InferenceTransformerConfig, tokens,

@@ -339,6 +339,147 @@ def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
     return og.reshape(KH, C, R, D).transpose(1, 0, 2, 3).reshape(C, H, D)
 
 
+def _paged_verify_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, block_size: int,
+                         rep: int, spec: int, scale: float):
+    """Speculative-verify attention for ALL slots: grid (slot, kv-head,
+    block-table entry). Queries are each slot's K-token candidate chunk
+    at absolute positions ``lengths[s]..lengths[s]+K-1`` (the chunk's
+    own k/v already written into the pool at those positions —
+    kv_cache.paged_write_tokens); keys stream out of the pool through
+    the scalar-prefetched block table, per-query causal bound
+    ``col <= lengths[s] + qi``. The same online-softmax recurrence as
+    :func:`_paged_chunk_kernel`, with the per-slot ``lengths`` playing
+    the chunk kernel's ``start`` role — so varying acceptance lengths
+    ride as data, never as a new signature."""
+    s, i = pl.program_id(0), pl.program_id(2)
+    nb = pl.num_programs(2)
+    length = len_ref[s]
+    KR = q_ref.shape[2]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # blocks wholly beyond the chunk's last query position are dead for
+    # every row of this slot
+    @pl.when(i * block_size <= length + spec - 1)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [K*R, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)        # [BS, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        col = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (KR, block_size), 1)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (KR, block_size), 0) // rep
+        sc = jnp.where(col <= length + qi, sc, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nb - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, block_tables: jax.Array,
+                           lengths: jax.Array,
+                           scale: float | None = None,
+                           interpret: bool | None = None) -> jax.Array:
+    """Batched speculative-verify attention through a paged KV pool,
+    GQA-native.
+
+    q: ``[S, K, H, D]`` (each slot's K-token candidate chunk at
+    absolute positions ``lengths[s]..lengths[s]+K-1``; the chunk's own
+    k/v must already be written into the pool); k_pool/v_pool:
+    ``[NB, BS, KH, D]``; block_tables: ``[S, MB]`` int32 (dead entries
+    must be valid ids — the null block); lengths: ``[S]`` int32 live
+    lengths per slot. Returns ``[S, K, H, D]``.
+
+    ONE kernel signature per ``(K, num_slots, block geometry)`` —
+    per-slot acceptance state rides in ``lengths``, so varying
+    acceptance never retraces (the PR-8 trace-discipline contract)."""
+    S, K, H, D = q.shape
+    BS, KH = k_pool.shape[1], k_pool.shape[2]
+    MB = block_tables.shape[1]
+    if H % KH:
+        raise ValueError(f"q heads {H} not divisible by kv heads {KH}")
+    R = H // KH
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [S, K, H, D] -> [S, KH, K*R, D]: rows grouped by the kv head they
+    # read, query index recoverable in-kernel as row // R
+    qg = q.reshape(S, K, KH, R, D).transpose(0, 2, 1, 3, 4).reshape(
+        S, KH, K * R, D)
+    kernel = functools.partial(_paged_verify_kernel, block_size=BS,
+                               rep=R, spec=K, scale=float(scale))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, KH, MB),
+        in_specs=[
+            pl.BlockSpec((1, 1, K * R, D), lambda s, h, i, lens, bt:
+                         (s, h, 0, 0)),
+            pl.BlockSpec((1, BS, 1, D), lambda s, h, i, lens, bt:
+                         (bt[s, i], 0, h, 0)),
+            pl.BlockSpec((1, BS, 1, D), lambda s, h, i, lens, bt:
+                         (bt[s, i], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, K * R, D), lambda s, h, i, lens, bt:
+                               (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((K * R, 1), jnp.float32),
+            pltpu.VMEM((K * R, 1), jnp.float32),
+            pltpu.VMEM((K * R, D), jnp.float32),
+        ],
+    )
+    og = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KH, K * R, D), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return og.reshape(S, KH, K, R, D).transpose(0, 2, 1, 3, 4).reshape(
+        S, K, H, D)
+
+
+def paged_verify_attention_reference(q, k_pool, v_pool, block_tables,
+                                     lengths):
+    """Numerics oracle for :func:`paged_verify_attention`: gather each
+    slot's cache through its table, dense masked softmax with the
+    per-query causal bound ``col <= lengths[s] + qi``."""
+    S, K, H, D = q.shape
+    BS, KH = k_pool.shape[1], k_pool.shape[2]
+    MB = block_tables.shape[1]
+    rep = H // KH
+    kc = k_pool[block_tables].reshape(S, MB * BS, KH, D)
+    vc = v_pool[block_tables].reshape(S, MB * BS, KH, D)
+    kc = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+    vc = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+    s = jnp.einsum("skhd,sphd->shkp", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) / (D ** 0.5)
+    col = jnp.arange(MB * BS)[None, None, None, :]
+    qi = jnp.arange(K)[None, None, :, None]
+    s = jnp.where(col <= lengths[:, None, None, None] + qi, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("shkp,sphd->skhd", p,
+                      vc.astype(jnp.float32)).astype(q.dtype)
+
+
 def paged_chunk_attention_reference(q, k_pool, v_pool, block_table, start):
     """Numerics oracle for :func:`paged_chunk_attention`: gather the
     slot's cache through its table, dense masked softmax with the

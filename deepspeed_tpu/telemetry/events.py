@@ -49,6 +49,10 @@ SHED = "shed"
 REQUEST_FAILED = "request_failed"
 PREFIX_EVICT = "prefix_evict"
 FAULT_INJECTED = "fault_injected"
+# speculative serving (docs/serving.md "Per-slot speculative
+# decoding"): rolling acceptance rate collapsed — every verify forward
+# is wasted width until the workload turns lookup-friendly again
+SPEC_COLLAPSE = "spec_collapse"
 
 
 class EventRing:

@@ -72,6 +72,13 @@ def run_continuous(eng, prompt, args):
         print(f"chunked prefill: {st['prefill_chunks']} chunks of "
               f"{st['prefill_chunk_tokens']} tokens, "
               f"{st['chunk_traces']} trace(s)")
+    sp = st["speculation"]
+    if sp["k"]:
+        print(f"speculation (K={sp['k']}): "
+              f"{sp['tokens_per_forward']} tokens/forward, acceptance "
+              f"{sp['acceptance_rate']}, {sp['committed_tokens']} "
+              f"tokens over {sp['verify_steps']} verify steps, "
+              f"{sp['verify_traces']} trace(s)")
     # registry view of the same run (docs/observability.md)
     snap = srv.telemetry.snapshot()
     for h in ("serve_ttft_seconds", "serve_queue_wait_seconds",
@@ -138,6 +145,14 @@ def main():
                          "tokens per scheduler step instead of one "
                          "monolithic pass (multiple of --block-size; "
                          "continuous mode)")
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="per-slot speculative decoding: each active "
+                         "slot proposes up to K-1 tokens per step by "
+                         "prompt lookup over its own history, verified "
+                         "in one batched forward — 1..K tokens per "
+                         "slot per step, greedy output unchanged "
+                         "(continuous mode; docs/serving.md 'Per-slot "
+                         "speculative decoding')")
     ap.add_argument("--trace-dump", default=None, metavar="PATH",
                     help="trace every request (telemetry.trace_sample_"
                          "rate=1.0) and write a Perfetto-loadable "
@@ -184,6 +199,8 @@ def main():
         knobs["enable_prefix_caching"] = True
     if args.prefill_chunk is not None:
         knobs["prefill_chunk_tokens"] = args.prefill_chunk
+    if args.speculate:
+        knobs["speculation_tokens"] = args.speculate
     eng = deepspeed_tpu.init_inference(args.path, **knobs)
     prompt = [int(t) for t in args.prompt_ids.split(",")]
     if args.continuous:

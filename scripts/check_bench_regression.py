@@ -38,11 +38,28 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
-# metric -> direction ("up" = bigger is better)
+# metric -> direction ("up" = bigger is better). Dotted keys reach into
+# nested blobs ("speculation.tokens_per_forward" = record["speculation"]
+# ["tokens_per_forward"]); rounds that predate a blob skip that metric.
 METRICS = {
     "tokens_per_s": "up",
     "token_lat_p90_ms": "down",
+    # committed tokens per verify forward per slot on the speculation
+    # A/B (docs/serving.md "Per-slot speculative decoding") — a
+    # regression here means the serving speculative path stopped
+    # converting verify width into committed tokens
+    "speculation.tokens_per_forward": "up",
 }
+
+
+def _metric(rec: dict, key: str):
+    """Resolve a (possibly dotted) metric key against one record."""
+    cur = rec
+    for part in key.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur if isinstance(cur, (int, float)) else None
 
 
 def bench_rounds(directory: str) -> List[Tuple[int, str]]:
@@ -103,7 +120,7 @@ def compare(prev: dict, new: dict, tolerance: float) -> List[str]:
     """Human-readable regression lines (empty = within tolerance)."""
     errors = []
     for metric, direction in METRICS.items():
-        a, b = prev.get(metric), new.get(metric)
+        a, b = _metric(prev, metric), _metric(new, metric)
         if a is None or b is None or a <= 0:
             continue
         if direction == "up" and b < a * (1.0 - tolerance):
@@ -155,7 +172,8 @@ def main(argv=None) -> int:
             print(f"  {e}", file=sys.stderr)
         return 1
     summary = ", ".join(
-        f"{m}={new.get(m)} (prev {prev.get(m)})" for m in METRICS)
+        f"{m}={_metric(new, m)} (prev {_metric(prev, m)})"
+        for m in METRICS)
     print(f"check_bench_regression: r{pn:02d} -> r{nn:02d} within "
           f"{args.tolerance * 100:.0f}% tolerance: {summary}")
     return 0

@@ -26,9 +26,12 @@ def mod():
     return m
 
 
-def _write_round(directory, n, tokens_per_s, p90_ms, via_tail=False):
+def _write_round(directory, n, tokens_per_s, p90_ms, via_tail=False,
+                 spec_tpf=None):
     rec = {"phase": "serve-continuous", "tokens_per_s": tokens_per_s,
            "token_lat_p90_ms": p90_ms}
+    if spec_tpf is not None:
+        rec["speculation"] = {"k": 4, "tokens_per_forward": spec_tpf}
     if via_tail:
         payload = {"n": n, "rc": 1, "parsed": None,
                    "tail": "noise\n" + json.dumps(rec) + "\ntrailer"}
@@ -80,6 +83,24 @@ def test_tail_salvage_and_round_ordering(mod, tmp_path):
     rec = mod.extract_serve_record(
         os.path.join(tmp_path, "BENCH_r09.json"))
     assert rec["tokens_per_s"] == 1000.0
+
+
+def test_speculation_blob_metric_gated(mod, tmp_path):
+    """The dotted speculation.tokens_per_forward metric: a collapse in
+    committed tokens per verify forward fails the gate; rounds that
+    predate the blob skip the metric instead of blocking."""
+    _write_round(tmp_path, 1, 1000.0, 5.0, spec_tpf=2.0)
+    _write_round(tmp_path, 2, 1000.0, 5.0, spec_tpf=1.05)   # collapse
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    _write_round(tmp_path, 3, 1000.0, 5.0, spec_tpf=1.95)
+    assert mod.main(["--dir", str(tmp_path)]) == 0           # recovered
+    _write_round(tmp_path, 4, 1000.0, 5.0)                   # no blob
+    assert mod.main(["--dir", str(tmp_path)]) == 0           # skipped
+    # dotted resolver: nested hit, missing leaf, non-dict traversal
+    assert mod._metric({"speculation": {"tokens_per_forward": 2.0}},
+                       "speculation.tokens_per_forward") == 2.0
+    assert mod._metric({}, "speculation.tokens_per_forward") is None
+    assert mod._metric({"speculation": 3}, "speculation.x") is None
 
 
 def test_single_round_reports_no_data(mod, tmp_path):

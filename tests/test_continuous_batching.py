@@ -331,6 +331,24 @@ def test_bench_serve_continuous_smoke():
     assert (off["shed"], off["deadline_expired"], off["preempted"],
             off["cancelled"], off["failed"]) == (0, 0, 0, 0, 0)
     assert off["accepted"] == lc["on"]["requests"]
+    # speculation A/B (auto K=4 in smoke mode, docs/serving.md
+    # "Per-slot speculative decoding"): on the lookup-friendly
+    # repetitive trace the verify forward must commit MORE than one
+    # token per slot per forward, slot-step efficiency must be strictly
+    # higher than the non-speculative leg (which is 1.0 by
+    # construction), the outputs must be token-identical, and the
+    # verify step must have compiled exactly ONE executable with zero
+    # retraces across the replay's varying acceptance lengths
+    sp = rec["speculation"]
+    assert sp["k"] == 4
+    assert sp["tokens_per_forward"] > 1.0
+    assert sp["slot_step_efficiency_off"] == 1.0
+    assert sp["slot_step_efficiency_on"] > sp["slot_step_efficiency_off"]
+    assert sp["decode_steps_on"] < sp["decode_steps_off"]
+    assert 0.0 < sp["acceptance_rate"] <= 1.0
+    assert sp["parity_exact"] is True
+    assert sp["verify_traces"] == 1
+    assert sp["retraces_on"] == 0
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm

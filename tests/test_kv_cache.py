@@ -14,8 +14,8 @@ import pytest
 
 from deepspeed_tpu.inference.kv_cache import (
     BlockAllocator, advance, append_token, init_cache, init_paged_cache,
-    paged_append_token, paged_gather_kv, paged_write_prompt, write_chunk,
-    write_prompt)
+    paged_append_token, paged_gather_kv, paged_write_prompt,
+    paged_write_tokens, write_chunk, write_prompt)
 
 
 def _rand(key, shape):
@@ -111,6 +111,136 @@ def test_paged_append_isolates_idle_slots():
     # slot 1's (discarded) token landed in null block 0, nowhere else
     np.testing.assert_array_equal(pool[0, 0], np.asarray(k[1]))
     assert np.all(pool[[1, 3, 4, 5]] == 0)
+
+
+def test_paged_write_tokens_k1_equals_append_token():
+    """The multi-token speculative writer at K=1 must be byte-identical
+    to paged_append_token — the verify path and the decode path share
+    the pool layout only if this holds (the paged mirror of the dense
+    write_chunk(K=1) ≡ append_token pin)."""
+    L, H, D, BS = 2, 2, 4, 16
+    bt = np.zeros((3, 3), np.int32)
+    bt[0] = [2, 5, 1]
+    bt[1] = [4, 3, 0]               # slot 2 idle (null table, length 0)
+    lengths = jnp.asarray([5, 17, 0], jnp.int32)
+    cache_a = init_paged_cache(L, 3, 8, BS, 3, H, D, jnp.float32)
+    cache_a = cache_a.replace(block_tables=jnp.asarray(bt),
+                              lengths=lengths)
+    cache_b = cache_a
+    for layer in range(L):
+        k = _rand(layer, (3, H, D))
+        v = _rand(layer + 50, (3, H, D))
+        cache_a = paged_append_token(cache_a, layer, k, v)
+        cache_b = paged_write_tokens(cache_b, layer, k[:, None],
+                                     v[:, None])
+    np.testing.assert_array_equal(np.asarray(cache_a.k),
+                                  np.asarray(cache_b.k))
+    np.testing.assert_array_equal(np.asarray(cache_a.v),
+                                  np.asarray(cache_b.v))
+
+
+def test_paged_write_tokens_commit_rollback_across_block_edges():
+    """THE speculative-rollback property: write K candidate positions
+    at ``lengths``, advance only the accepted prefix, repeat — whatever
+    the per-round acceptance (0..K-1 proposals, crossing block edges
+    mid-chunk or not), the live span gathered through the table is
+    byte-identical to appending exactly the committed stream one token
+    at a time. Rejected garbage beyond ``lengths`` never survives a
+    later round's overwrite, and the blocks the table maps stay the
+    RIGHT blocks (out-of-order ids pin the indirection)."""
+    H, D, BS, MB = 2, 3, 4, 4
+    K = 3
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        bt = np.zeros((1, MB), np.int32)
+        bt[0] = rng.permutation([3, 7, 2, 9])[:MB]   # out-of-order
+        start = int(rng.integers(0, BS))             # mid-block start
+        cache = init_paged_cache(1, 1, 12, BS, MB, H, D, jnp.float32)
+        cache = cache.replace(block_tables=jnp.asarray(bt),
+                              lengths=jnp.asarray([start], jnp.int32))
+        committed_ref = []
+        for rnd in range(6):
+            k = rng.normal(size=(1, K, H, D)).astype(np.float32)
+            v = rng.normal(size=(1, K, H, D)).astype(np.float32)
+            cache = paged_write_tokens(cache, 0, jnp.asarray(k),
+                                       jnp.asarray(v))
+            adv = int(rng.integers(1, K + 1))        # accept 1..K
+            live = int(cache.lengths[0])
+            if live + adv > MB * BS:
+                break
+            committed_ref.extend((k[0, i], v[0, i]) for i in range(adv))
+            cache = cache.replace(lengths=cache.lengths + adv)
+        gk, gv = paged_gather_kv(cache, 0)
+        live = int(cache.lengths[0])
+        assert live == start + len(committed_ref)
+        for i, (k_ref, v_ref) in enumerate(committed_ref):
+            np.testing.assert_array_equal(np.asarray(gk[0, start + i]),
+                                          k_ref, err_msg=f"t{trial} p{i}")
+            np.testing.assert_array_equal(np.asarray(gv[0, start + i]),
+                                          v_ref)
+
+
+def test_paged_write_tokens_overshoot_spills_to_null_block():
+    """A write window running past the block table (a wedged slot
+    decoding beyond its budget) must land in the reserved null block —
+    NOT clamp onto the table's last live entry and clobber it."""
+    H, D, BS, MB = 2, 3, 4, 2
+    cache = init_paged_cache(1, 1, 6, BS, MB, H, D, jnp.float32)
+    cache = cache.replace(
+        block_tables=jnp.asarray([[3, 5]], jnp.int32),
+        lengths=jnp.asarray([BS * MB - 1], jnp.int32))  # one slot left
+    k = _rand(1, (1, 3, H, D))
+    cache = paged_write_tokens(cache, 0, k, k)
+    pool = np.asarray(cache.k[0])
+    # position 7 (last live) landed in block 5 offset 3; the two
+    # overshooting positions landed in null block 0 offsets 0..1
+    np.testing.assert_array_equal(pool[5, 3], np.asarray(k[0, 0]))
+    np.testing.assert_array_equal(pool[0, 0], np.asarray(k[0, 1]))
+    np.testing.assert_array_equal(pool[0, 1], np.asarray(k[0, 2]))
+    assert np.all(pool[3] == 0)     # the OTHER live block is untouched
+
+
+def test_paged_garbage_beyond_lengths_invisible_with_k_gt_1():
+    """Mask invariance, paged + multi-token: random garbage at every
+    position >= lengths (exactly where rejected speculative writes
+    land) must not move paged_verify_step logits by a single bit — the
+    invariant that makes advance-only-the-accepted-prefix a correct
+    rollback."""
+    from deepspeed_tpu.model_implementations.transformer import (
+        InferenceTransformerConfig, init_params, paged_prefill,
+        paged_verify_step)
+    V, E, L, H, BS, MB = 64, 32, 2, 4, 16, 4
+    cfg = InferenceTransformerConfig(vocab_size=V, n_positions=128,
+                                     n_embd=E, n_layer=L, n_head=H,
+                                     dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cache = init_paged_cache(L, 2, 10, BS, MB, cfg.kv_heads,
+                             cfg.head_dim, jnp.float32)
+    bt = np.zeros((2, MB), np.int32)
+    bt[0], bt[1] = [2, 5, 1, 0], [4, 3, 0, 0]
+    cache = cache.replace(block_tables=jnp.asarray(bt))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, V)
+    for slot, plen in ((0, 16), (1, 9)):
+        _, cache = paged_prefill(params, cfg, ids,
+                                 jnp.asarray([plen], jnp.int32), cache,
+                                 jnp.int32(slot))
+    toks = jnp.asarray([[5, 9, 3], [7, 2, 8]], jnp.int32)
+    logits_clean, _ = paged_verify_step(params, cfg, toks, cache)
+
+    # poison EVERY pool position that is not live content for its slot
+    # (per-slot live spans mapped through the tables)
+    live = np.zeros((10, BS), bool)
+    for slot, plen in ((0, 16), (1, 9)):
+        for p in range(plen):
+            live[bt[slot][p // BS], p % BS] = True
+    garbage = np.asarray(_rand(7, cache.k.shape)) * 100.0
+    mask = live[None, :, :, None, None]
+    cache_dirty = cache.replace(
+        k=jnp.where(mask, cache.k, garbage),
+        v=jnp.where(mask, cache.v, garbage * 2))
+    logits_dirty, _ = paged_verify_step(params, cfg, toks, cache_dirty)
+    np.testing.assert_array_equal(np.asarray(logits_clean),
+                                  np.asarray(logits_dirty))
 
 
 def test_block_allocator_free_list():
