@@ -815,14 +815,22 @@ def phase_spec(args) -> dict:
     return out
 
 
-def _snap_quantile_ms(snap, name, q, default=None):
+def _snap_quantile_ms(snap, name, q, default=None, labels=None):
     """One histogram quantile out of a registry snapshot, in ms — the
     shared reader for every serve-phase blob (main replay, prefix-cache
-    A/B, speculation A/B)."""
+    A/B, speculation A/B, step-profile phases). ``labels`` selects the
+    series whose label dict contains them (default: the first)."""
     fam = snap.get(name)
-    if not fam or not fam["series"] or not fam["series"][0]["count"]:
+    if not fam or not fam["series"]:
         return default
-    v = fam["series"][0][q]
+    series = fam["series"]
+    if labels:
+        series = [s for s in series
+                  if all(s["labels"].get(k) == v
+                         for k, v in labels.items())]
+    if not series or not series[0]["count"]:
+        return default
+    v = series[0][q]
     return round(v * 1e3, 3) if v is not None else default
 
 
@@ -1008,6 +1016,50 @@ def phase_serve(args) -> dict:
                            "target": v["target"],
                            "violated": v["violated"]}
                        for k, v in slo_res.items()},
+    }
+    # step observatory blob (docs/observability.md "Serving goodput &
+    # KV-pool accounting"): per-phase p50/p90, the host-tax fraction,
+    # the dispatch-gap p90 (ROADMAP item 5's A/B number), and the pool
+    # lifetime/fragmentation view — the measured baseline the
+    # async-loop and KV-offload PRs must beat, gated across rounds by
+    # scripts/check_bench_regression.py
+    spf = srv.stats["step_profile"]
+    pool = srv.stats["kv_pool"]
+    phase_q = {
+        ph: {
+            "total_s": round(total, 6),
+            "p50_ms": _snap_quantile_ms(snap, "serve_step_phase_seconds",
+                                        "p50", labels={"phase": ph}),
+            "p90_ms": _snap_quantile_ms(snap, "serve_step_phase_seconds",
+                                        "p90", labels={"phase": ph}),
+        }
+        for ph, total in spf["phases_s"].items()
+    }
+    out["step_profile"] = {
+        "steps": spf["steps"],
+        "wall_s": round(spf["wall_s"], 6),
+        "goodput_fraction": round(spf["goodput_fraction"], 4),
+        "host_fraction": round(spf["host_fraction"], 4),
+        "residual_fraction": round(
+            spf["phases_s"].get("other", 0.0)
+            / max(spf["wall_s"], 1e-12), 6),
+        "dispatch_gap_p90_ms": _snap_quantile_ms(
+            snap, "serve_dispatch_gap_seconds", "p90"),
+        "dispatch_gap_count": spf["dispatch_gap"]["count"],
+        "dispatch_gap_total_s": round(
+            spf["dispatch_gap"]["total_s"], 6),
+        "phases": phase_q,
+        "pool": {
+            "fragmentation_free_run_ratio":
+                pool["free_longest_run_ratio"],
+            "famine_episodes": pool["famine_episodes"],
+            "block_lifetime_p50_ms": _snap_quantile_ms(
+                snap, "serve_kv_block_lifetime_seconds", "p50"),
+            "peak_blocks_p90": (
+                snap["serve_request_peak_blocks"]["series"][0]["p90"]
+                if snap.get("serve_request_peak_blocks", {}).get(
+                    "series") else None),
+        },
     }
     print(json.dumps({**out, "partial": True}), flush=True)  # salvage
 

@@ -96,6 +96,19 @@ def telemetry_info():
         out["numerics_watch"] = state
         out["goodput"] = ("on by default config" if cfg.goodput
                           else "off (set telemetry.goodput)")
+        out["step_profile"] = (
+            "on by default config (serve step phase decomposition + "
+            "goodput fraction + dispatch-gap detector; /debug/goodput; "
+            f"ring/timeline sample every {cfg.step_profile_events_every}"
+            " steps)"
+            if cfg.step_profile
+            else "off (set telemetry.step_profile)")
+        out["kv_pool_accounting"] = (
+            "on by default config (block lifetime / age-at-eviction "
+            "histograms, free-list fragmentation gauge, per-request "
+            "peak blocks, famine ring snapshots)"
+            if cfg.step_profile
+            else "off (rides telemetry.step_profile)")
         out["request_tracing"] = (
             f"sample rate {cfg.trace_sample_rate}, ring "
             f"{cfg.trace_ring_capacity}, slow-keep "

@@ -388,13 +388,18 @@ class BlockAllocator:
     membership, so ``release`` stays O(len(blocks)) — the r5 linear
     ``b in self._free`` scan made it O(n²) per sequence."""
 
-    def __init__(self, num_blocks: int, enable_prefix_caching: bool = False):
+    def __init__(self, num_blocks: int, enable_prefix_caching: bool = False,
+                 accountant=None):
         if num_blocks < 2:
             raise ValueError(
                 f"need >= 2 pool blocks (1 usable + the null block), "
                 f"got {num_blocks}")
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
+        # pool lifetime/fragmentation accounting (telemetry/memory.py
+        # KVPoolAccountant) or None — every hook sits behind a None
+        # check, so an unaccounted allocator costs nothing extra
+        self.accountant = accountant
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> low ids
         self._free_set = set(self._free)
         self._refcount: Dict[int, int] = {}       # live blocks only
@@ -460,6 +465,8 @@ class BlockAllocator:
         b, _ = self._lru.popitem(last=False)
         self._drop_hash(b)
         self.evictions += 1
+        if self.accountant is not None:
+            self.accountant.on_evict(b)
         if self.on_evict is not None:
             self.on_evict(b)
         return b
@@ -473,17 +480,53 @@ class BlockAllocator:
         """``n`` fresh block ids (refcount 1 each), or None (caller
         queues) when even eviction cannot cover the span."""
         if n > self.free_blocks:
+            if self.accountant is not None:
+                # famine: freeze the allocator state into the event
+                # ring (once per episode — re-armed by the next
+                # successful allocation); fragmentation refreshed so
+                # the frozen snapshot is current, not Nth-transition
+                # stale
+                self.accountant.update_fragmentation(self._free_set)
+                self.accountant.on_famine(n, self.famine_state())
             return None
         out = [self._pop_free() for _ in range(n)]
         for b in out:
             self._refcount[b] = 1
+        if self.accountant is not None:
+            for b in out:
+                self.accountant.on_acquire(b)
+            self.accountant.on_alloc_ok()
         return out
+
+    def famine_state(self) -> dict:
+        """JSON-able allocator state for the famine ring event."""
+        return {
+            "free_list": len(self._free),
+            "evictable_lru": len(self._lru),
+            "live_blocks": len(self._refcount),
+            "cached_blocks": len(self._hash_to_block),
+            "reserved_blocks": self.reserved_blocks,
+            "usable_blocks": self.usable_blocks,
+        }
+
+    @property
+    def free_ids(self):
+        """Immediately-free block ids (the free list proper, evictable
+        LRU excluded) — the fragmentation gauge's input."""
+        return tuple(self._free_set)
 
     def release(self, blocks) -> None:
         """Drop one reference per block. A block reaching refcount 0
         returns to the free list — unless it holds a registered prefix,
         in which case it parks in the evictable LRU (content retained
         for future :meth:`match_prefix` hits, memory reclaimable)."""
+        self._drop_refs(blocks, rollback=False)
+
+    def _drop_refs(self, blocks, rollback: bool) -> None:
+        """The refcount-decrement / park-or-free invariant, in ONE
+        place (release and rollback differ only in which accounting
+        hook fires at refcount 0 — duplicating the loop would leave
+        the free-list bookkeeping to drift apart by hand)."""
         for b in blocks:
             if b == 0:
                 raise ValueError("block 0 is the reserved null block")
@@ -496,11 +539,29 @@ class BlockAllocator:
                 self._refcount[b] = ref - 1
                 continue
             del self._refcount[b]
-            if b in self._block_hash:
+            parked = b in self._block_hash
+            if parked:
                 self._lru[b] = None
             else:
                 self._free.append(b)
                 self._free_set.add(b)
+            if self.accountant is not None:
+                if rollback:
+                    self.accountant.on_rollback(b)
+                else:
+                    self.accountant.on_release(b, parked)
+
+    def rollback_match(self, blocks) -> None:
+        """Undo a :meth:`match_prefix` acquisition whose tail
+        allocation failed (a blocked queue head retried every step):
+        refcounts drop exactly like :meth:`release`, but the pool
+        accounting is REWOUND, not observed — a rollback was never a
+        residency, so no lifetime sample is recorded and a resurrected
+        block re-parks under its ORIGINAL timestamp (flooding the
+        lifetime histogram with ~0s samples and re-stamping LRU ages
+        each retry would corrupt exactly the numbers the offload/
+        eviction decision reads)."""
+        self._drop_refs(blocks, rollback=True)
 
     # ------------------------------------------------------- prefix cache
 
@@ -519,6 +580,9 @@ class BlockAllocator:
             if b in self._lru:
                 del self._lru[b]
                 self._refcount[b] = 1
+                if self.accountant is not None:
+                    # resurrection is a fresh residency (refcount 0->1)
+                    self.accountant.on_acquire(b)
             else:
                 self._refcount[b] = self._refcount[b] + 1
             out.append(b)

@@ -69,6 +69,10 @@ class Request:
     committed: List[int] = dataclasses.field(default_factory=list)
     preemptions: int = 0
     ready_at_step: int = 0
+    # high-water pool-block count across this request's residencies
+    # (admission sets it; the server observes it at finish into the
+    # serve_request_peak_blocks histogram — KV-pool accounting)
+    peak_blocks: int = 0
     # memoized chain hashes of the scheduling prompt's full blocks — a
     # blocked queue head is re-tried every step and must not re-sha256
     # its (possibly 100k-token) prompt each time. Invalidated on
@@ -132,7 +136,8 @@ class Scheduler:
                  max_blocks_per_slot: int, max_queued_requests: int,
                  registry: Optional[MetricRegistry] = None,
                  enable_prefix_caching: bool = False,
-                 tracer=None, spec_margin: int = 0):
+                 tracer=None, spec_margin: int = 0,
+                 pool_accountant=None):
         self.num_slots = num_slots
         # speculative-verify overshoot (speculation_tokens - 1): every
         # request's block span reserves this many extra cache positions
@@ -147,8 +152,14 @@ class Scheduler:
         self.max_blocks_per_slot = max_blocks_per_slot
         self.max_queued_requests = max_queued_requests
         self.enable_prefix_caching = enable_prefix_caching
+        # KV-pool lifetime/fragmentation accounting (telemetry/
+        # memory.py KVPoolAccountant) or None — hooks ride the
+        # allocator, the fragmentation gauge refreshes with the level
+        # gauges at admission-state transitions
+        self.accountant = pool_accountant
         self.allocator = BlockAllocator(
-            num_blocks, enable_prefix_caching=enable_prefix_caching)
+            num_blocks, enable_prefix_caching=enable_prefix_caching,
+            accountant=pool_accountant)
         self.queue: Deque[Request] = deque()
         self.slots: Dict[int, SlotState] = {}   # slot id -> state
         self._free_slots = list(range(num_slots - 1, -1, -1))
@@ -210,6 +221,12 @@ class Scheduler:
         self._g_active.set(len(self.slots))
         self._g_cached.set(self.allocator.cached_blocks)
         self._g_requeue.set(self.requeue_depth)
+        if self.accountant is not None:
+            # rate-limited (every Nth transition): the O(free log free)
+            # scan must not run per retire on a large pool; snapshot
+            # consumers (stats, /debug/goodput) refresh unconditionally
+            self.accountant.maybe_update_fragmentation(
+                lambda: self.allocator.free_ids)
 
     def _reject(self, reason: str,
                 request_id: Optional[int] = None) -> None:
@@ -334,8 +351,10 @@ class Scheduler:
             hits = self.allocator.match_prefix(hashes[:reusable])
         tail = self.allocator.allocate(nb - len(hits))
         if tail is None:
-            if hits:   # roll the acquired hits back (refcount--)
-                self.allocator.release(hits)
+            if hits:   # roll the acquired hits back (refcount--;
+                       # accounting rewound, not observed — a blocked
+                       # head retried every step is not a residency)
+                self.allocator.rollback_match(hits)
             return None
         del self.queue[idx]
         if self.enable_prefix_caching:
@@ -346,6 +365,7 @@ class Scheduler:
             self.prefix_hits += len(hits)
             self.prefix_misses += reusable - len(hits)
         slot = self._free_slots.pop()
+        req.peak_blocks = max(req.peak_blocks, len(hits) + len(tail))
         state = SlotState(request=req, blocks=hits + tail,
                           generated=list(req.committed),
                           arrived_step=step_clock,

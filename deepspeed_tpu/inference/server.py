@@ -54,11 +54,12 @@ from deepspeed_tpu.inference.speculation import (LookupIndex,
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill, paged_prefill_chunk,
     paged_verify_step)
-from deepspeed_tpu.telemetry import (FaultInjector, MetricRegistry,
+from deepspeed_tpu.telemetry import (NULL_STEP_HANDLE, FaultInjector,
+                                     KVPoolAccountant, MetricRegistry,
                                      PrefillFault, ProfilerCapture,
-                                     SLOMonitor, Tracer, get_event_ring,
-                                     get_registry, start_http_server,
-                                     watched_jit)
+                                     SLOMonitor, StepProfiler, Tracer,
+                                     get_event_ring, get_registry,
+                                     start_http_server, watched_jit)
 from deepspeed_tpu.telemetry import events as telemetry_events
 
 # finish reason -> event-ring kind (every lifecycle finish leaves a
@@ -202,11 +203,27 @@ class ContinuousBatchingServer:
                 "lifecycle & overload behavior')")
         self.max_preemptions = cfg.max_preemptions
         self._backoff_steps = cfg.preemption_backoff_steps
+        # serving step observatory (telemetry/step_profile.py) + KV-pool
+        # accounting (telemetry/memory.py): ON by default — a handful
+        # of monotonic-clock reads and histogram observes per step, NO
+        # device syncs. OFF builds neither object: the loop holds the
+        # shared no-op handle, the allocator hooks stay None, and none
+        # of the serve_step_* / serve_kv_* families register.
+        self._profiler = None
+        self._pool_acct = None
+        if tcfg is None or tcfg.step_profile:
+            self._profiler = StepProfiler(
+                registry=self.telemetry, clock=self._clock,
+                events_every=(tcfg.step_profile_events_every
+                              if tcfg is not None else 32))
+            self._pool_acct = KVPoolAccountant(
+                registry=self.telemetry, clock=self._clock)
         self.http_server = None
         if tcfg is not None and enabled and tcfg.http_port is not None:
             self.http_server = start_http_server(
                 tcfg.http_port, host=tcfg.http_host,
-                registry=self.telemetry, tracer=self.tracer)
+                registry=self.telemetry, tracer=self.tracer,
+                goodput=self._goodput_snapshot)
         self.profiler_capture = ProfilerCapture()
         reg = self.telemetry
         self._h_queue_wait = reg.histogram(
@@ -313,7 +330,8 @@ class ContinuousBatchingServer:
             registry=self.telemetry,
             enable_prefix_caching=self.prefix_caching,
             tracer=self.tracer,
-            spec_margin=max(self.spec_tokens - 1, 0))
+            spec_margin=max(self.spec_tokens - 1, 0),
+            pool_accountant=self._pool_acct)
         self._cache = self._make_pool(num_blocks)
         # flight recorder (telemetry/compile_watch.py): the serving jits
         # are watched, so a prompt shape that defeats the geometric
@@ -437,6 +455,40 @@ class ContinuousBatchingServer:
             tcfg, self.telemetry, "serve_watchdog",
             [("kv_block_pool", _pool), ("params", _params)])
         self.watchdog = self._flight.watchdog
+
+    def _goodput_snapshot(self) -> dict:
+        """``GET /debug/goodput`` payload: the step observatory's phase
+        totals + goodput fraction + dispatch-gap accounting beside the
+        KV-pool lifetime/fragmentation view — one JSON answer to
+        "where did the serving step go, and who holds the pool".
+
+        Runs on the SCRAPE thread, so it reads only the accountant's
+        own (lock-free but internally consistent) totals — it must
+        never walk live allocator structures the serving loop is
+        mutating (``free_ids`` iterates ``_free_set``; a concurrent
+        ``allocate`` would raise mid-scrape) and must stay valid
+        before ``__init__`` finishes (the listener opens a few lines
+        before the scheduler exists). The fragmentation value is the
+        last computed one — at most ``FRAG_EVERY`` transitions stale;
+        :attr:`stats` (owner thread) refreshes it exactly."""
+        return {
+            "step_profile": (self._profiler.snapshot()
+                             if self._profiler is not None
+                             else {"enabled": False}),
+            "kv_pool": (self._pool_acct.snapshot()
+                        if self._pool_acct is not None
+                        else {"enabled": False}),
+        }
+
+    def _pool_snapshot(self) -> dict:
+        """Fresh pool-accounting view for :attr:`stats` (OWNER-thread
+        callers only — between steps, never from the scrape thread):
+        the fragmentation scan on the transition path is rate-limited,
+        so this recomputes it exactly (O(free log free), read
+        cadence)."""
+        self._pool_acct.update_fragmentation(
+            self.scheduler.allocator.free_ids)
+        return self._pool_acct.snapshot()
 
     @staticmethod
     def _prefill_fn(params, ids, length, cache, slot, *, cfg, mesh):
@@ -620,6 +672,10 @@ class ContinuousBatchingServer:
         self._submit_ts.pop(rid, None)
         self._queued_ts.pop(rid, None)
         self._deadlines.pop(rid, None)
+        if self._pool_acct is not None:
+            # high-water pool blocks across the request's residencies
+            # (zero = never admitted; skipped inside the accountant)
+            self._pool_acct.observe_request_peak(req.peak_blocks)
         self._c_finish[reason].inc()
         self._lifecycle_counts[reason] += 1
         get_event_ring().record(
@@ -828,7 +884,7 @@ class ContinuousBatchingServer:
         self._preempt_slot(slot, finished)
         return True
 
-    def _admit(self, finished: list) -> None:
+    def _admit(self, finished: list, sp=NULL_STEP_HANDLE) -> None:
         """Admit queued requests into free slots until blocks or slots
         run out. Monolithic mode prefills inline — one trace per prompt
         BUCKET (128·2^k, floored at block_size), shared by every slot
@@ -920,6 +976,7 @@ class ContinuousBatchingServer:
                     bucket=T)
             ids = np.zeros((1, T), np.int32)
             ids[0, :len(sched_prompt)] = sched_prompt
+            t_pf = self._clock()
             tok0, self._cache = self._prefill_jit(
                 self.engine.params, jnp.asarray(ids),
                 jnp.asarray([len(sched_prompt)], jnp.int32), self._cache,
@@ -928,6 +985,10 @@ class ContinuousBatchingServer:
             self._prefill_token_units += T
             tok0 = int(np.asarray(tok0)[0])   # host sync: prefill done
             now_t = self._clock()
+            # prefill compute runs inside the admission phase; its
+            # dispatch->fetch interval is still device-attributed (and
+            # advances the dispatch-gap boundary — the device was busy)
+            sp.device_interval(t_pf, now_t)
             # prefill latency by PADDED bucket (the traced shape, not the
             # raw prompt length — per-shape latency is what regressions
             # in the prefill program show up against)
@@ -961,7 +1022,8 @@ class ContinuousBatchingServer:
                 # retirement, annotated at close with tokens/steps
                 rt.decode = rt.trace.begin("decode", slot=slot)
 
-    def _run_prefill_chunk(self, finished: list) -> None:
+    def _run_prefill_chunk(self, finished: list,
+                           sp=NULL_STEP_HANDLE) -> None:
         """Run AT MOST one chunk of the oldest in-flight chunked
         prefill — the Sarathi-style interleave: each ``step()`` advances
         one prefill by ``prefill_chunk_tokens`` tokens and then decodes
@@ -997,7 +1059,9 @@ class ContinuousBatchingServer:
         self._prefill_chunks += 1
         self._prefill_token_units += C
         tok = np.asarray(tok)     # host sync: honest per-chunk timing
-        self._h_prefill_chunk.observe(self._clock() - t0)
+        t1 = self._clock()
+        self._h_prefill_chunk.observe(t1 - t0)
+        sp.device_interval(t0, t1)   # chunk compute = device time
         if ck is not None:
             rt.trace.end_span(ck)
         if self.watchdog is not None:
@@ -1070,6 +1134,8 @@ class ContinuousBatchingServer:
         self._deadlines.pop(req.request_id, None)
         if ts is not None:
             self._h_request.observe(self._clock() - ts)
+        if self._pool_acct is not None:
+            self._pool_acct.observe_request_peak(req.peak_blocks)
         self._c_finished.inc()
         # reserved-tail accounting: blocks allocated for budget the
         # sequence EOSed before reaching were never written — they go
@@ -1104,13 +1170,18 @@ class ContinuousBatchingServer:
         Returns the request ids that got a result this round — normal
         finishes AND lifecycle finishes (fetch outputs via ``result`` /
         ``drain``; ``finish_reasons`` tells them apart)."""
+        # step observatory (telemetry/step_profile.py): phase marks at
+        # boundaries the loop already crosses — monotonic-clock reads
+        # only, zero new device syncs; OFF = the shared no-op handle
+        sp = (self._profiler.begin() if self._profiler is not None
+              else NULL_STEP_HANDLE)
         finished: List[int] = []
         self._tick += 1
         if self._fi is not None:
             self._fi.apply_famine(self.scheduler.allocator)
         self._reap_deadlines(finished)
         self._maybe_shed(finished)
-        self._admit(finished)
+        self._admit(finished, sp)
         # degradation ladder, rung 2 (rung 1, prefix-LRU eviction,
         # already ran inside the allocator during admission): preempt
         # strictly-lower-priority residents for the blocked waiter,
@@ -1118,26 +1189,36 @@ class ContinuousBatchingServer:
         guard = self.num_slots
         while guard > 0 and self._preempt_for_head(finished):
             guard -= 1
-            self._admit(finished)
-        self._run_prefill_chunk(finished)
+            self._admit(finished, sp)
+        sp.mark("admission")
+        self._run_prefill_chunk(finished, sp)
+        sp.mark("prefill_chunk")
         if not self.scheduler.slots:
             if self.watchdog is not None:
                 # an IDLE server being polled is alive, not stalled —
                 # without this heartbeat every traffic lull longer than
                 # the deadline fires a spurious dump
                 self.watchdog.notify_progress()
+            # nothing resident: the device idles for lack of WORK, so
+            # the dispatch-gap baseline resets (a lull is not host tax)
+            sp.finish(live=False)
             return finished
         if self.spec_tokens:
-            self._decode_speculative(finished)
+            self._decode_speculative(finished, sp)
         else:
-            self._decode_once(finished)
+            self._decode_once(finished, sp)
         if self.slo is not None and not self._shedding:
             # with shedding armed, _maybe_shed already refreshed the
             # monitor this step — don't pay a second registry snapshot
             self.slo.maybe_evaluate()
+        sp.mark("publish")
+        # live=False when this step retired the last resident: the gap
+        # to the NEXT dispatch would measure traffic, not host tax
+        sp.finish(live=bool(self.scheduler.slots))
         return finished
 
-    def _decode_once(self, finished: List[int]) -> None:
+    def _decode_once(self, finished: List[int],
+                     sp=NULL_STEP_HANDLE) -> None:
         """One plain decode step for all active resident slots — the
         speculation-off hot path, byte-identical to a server without
         the speculative layer."""
@@ -1151,17 +1232,25 @@ class ContinuousBatchingServer:
         if not active.any():
             # every resident slot is mid-prefill — the chunk above was
             # this step's progress; nothing to decode yet
+            sp.mark("propose")
             return
         self.profiler_capture.step_begin()
         t0 = self._clock()
+        # the propose phase ends HERE and the decode program dispatches:
+        # the dispatch-gap detector measures this boundary against the
+        # previous fetch (how long the device sat idle on host work)
+        sp.mark("propose", now=t0, dispatch=True)
         nxt, self._cache = self._decode_jit(
             self.engine.params, jnp.asarray(tokens), self._cache,
             jnp.asarray(active))
+        sp.mark("dispatch")
         self._step_clock += 1
         n_active = int(active.sum())
         self._active_slot_steps += n_active
         nxt = np.asarray(nxt)             # host sync: the step completed
-        dt = self._clock() - t0
+        t1 = self._clock()
+        dt = t1 - t0
+        sp.mark("sync_wait", now=t1, fetch=True)
         if self._fi is not None:
             # injected latency is ACCOUNTED, never slept — the SLO /
             # shedding chaos tests collapse latency with no real delay
@@ -1182,6 +1271,7 @@ class ContinuousBatchingServer:
                 step=self._step_clock, live=n_active,
                 seconds=round(dt, 6),
                 sampled_every=self._EVENT_EVERY)
+        sp.mark("publish")
         for slot in list(self.scheduler.slots):   # _retire mutates
             if slot in self._mid_prefill:
                 continue   # not decoded this step; nothing to commit
@@ -1197,8 +1287,10 @@ class ContinuousBatchingServer:
                 self._retire(slot, state, finished)
             else:
                 state.pending = tok
+        sp.mark("commit")
 
-    def _decode_speculative(self, finished: List[int]) -> None:
+    def _decode_speculative(self, finished: List[int],
+                            sp=NULL_STEP_HANDLE) -> None:
         """One speculative round for all active resident slots: each
         slot proposes up to K-1 tokens by prompt lookup over its own
         committed history (prompt + generated, the pending token
@@ -1241,16 +1333,23 @@ class ContinuousBatchingServer:
             props[slot] = prop
             active_slots.append(slot)
         if not active_slots:
+            sp.mark("propose")
             return
         n_active = len(active_slots)
         self.profiler_capture.step_begin()
         t0 = self._clock()
+        # proposal scan ends, the batched verify dispatches (the
+        # dispatch-gap boundary — see _decode_once)
+        sp.mark("propose", now=t0, dispatch=True)
         t_toks, self._cache = self._verify_jit(
             self.engine.params, jnp.asarray(tokens), self._cache)
+        sp.mark("dispatch")
         self._step_clock += 1
         self._active_slot_steps += n_active
         t_np = np.asarray(t_toks)         # host sync: the verify ran
-        dt = self._clock() - t0
+        t1 = self._clock()
+        dt = t1 - t0
+        sp.mark("sync_wait", now=t1, fetch=True)
         if self._fi is not None:
             # injected latency is ACCOUNTED, never slept (see step())
             dt += self._fi.step_latency()
@@ -1300,6 +1399,7 @@ class ContinuousBatchingServer:
             lengths=self._cache.lengths + jnp.asarray(adv))
         for slot in retire:
             self._retire(slot, self.scheduler.slots[slot], finished)
+        sp.mark("commit")
         self._h_decode_step.observe(dt)
         # per-token latency: each active slot committed
         # committed_total/n_active tokens on average this step, so one
@@ -1327,6 +1427,7 @@ class ContinuousBatchingServer:
                 committed=committed_total, accepted=accepted_total,
                 seconds=round(dt, 6),
                 sampled_every=self._EVENT_EVERY)
+        sp.mark("publish")
 
     def _maybe_spec_collapse(self, proposed: int, accepted: int) -> None:
         """Ring-event an acceptance-rate collapse ONCE per episode: over
@@ -1495,6 +1596,13 @@ class ContinuousBatchingServer:
             },
             "fault_injection": (self._fi.snapshot()
                                 if self._fi is not None else None),
+            # serving step observatory + KV-pool accounting
+            # (docs/observability.md "Serving goodput & KV-pool
+            # accounting"); None = telemetry.step_profile off
+            "step_profile": (self._profiler.snapshot()
+                             if self._profiler is not None else None),
+            "kv_pool": (self._pool_snapshot()
+                        if self._pool_acct is not None else None),
             "traces_started": (self.tracer.started
                                if self.tracer is not None else 0),
             "traces_kept": (self.tracer.kept

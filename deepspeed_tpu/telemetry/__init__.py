@@ -32,7 +32,8 @@ from deepspeed_tpu.telemetry.faultinject import FaultInjector, PrefillFault
 from deepspeed_tpu.telemetry.goodput import GoodputMeter
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
                                               start_http_server)
-from deepspeed_tpu.telemetry.memory import (MemoryMonitor,
+from deepspeed_tpu.telemetry.memory import (KVPoolAccountant,
+                                            MemoryMonitor,
                                             get_memory_monitor,
                                             set_memory_monitor)
 from deepspeed_tpu.telemetry.numerics import (BlockSpec, NumericsWatch,
@@ -50,6 +51,8 @@ from deepspeed_tpu.telemetry.registry import (DEFAULT_TIME_BUCKETS, Counter,
                                               set_registry)
 from deepspeed_tpu.telemetry.slo import SLOMonitor
 from deepspeed_tpu.telemetry.spans import span, timed
+from deepspeed_tpu.telemetry.step_profile import (NULL_STEP_HANDLE,
+                                                  StepProfiler)
 from deepspeed_tpu.telemetry.tracing import (Trace, Tracer, TraceSpan,
                                              current_span, get_tracer,
                                              set_tracer)
@@ -77,4 +80,6 @@ __all__ = [
     "set_tracer", "SLOMonitor",
     # fault injection (chaos hooks for the serving lifecycle layer)
     "FaultInjector", "FaultInjectionConfig", "PrefillFault",
+    # serving step observatory + KV-pool accounting
+    "StepProfiler", "NULL_STEP_HANDLE", "KVPoolAccountant",
 ]

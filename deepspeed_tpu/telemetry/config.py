@@ -159,6 +159,21 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # head-sampling RNG seed (deterministic retention under a fixed seed
     # and submission order)
     trace_seed: int = 0
+    # serving step observatory (telemetry/step_profile.py): per-step
+    # phase decomposition (admission / prefill_chunk / propose /
+    # dispatch / sync_wait / commit / publish, summing to wall by
+    # construction), the serve goodput fraction, the dispatch-gap
+    # detector, and the KV-pool lifetime/fragmentation accounting
+    # (telemetry/memory.py KVPoolAccountant). ON by default — the cost
+    # is a handful of monotonic-clock reads and histogram observes per
+    # step, no device syncs; OFF leaves the decode program and greedy
+    # output byte-identical and registers none of the serve_step_* /
+    # serve_kv_block_* metric families.
+    step_profile: bool = True
+    # sample every Nth profiled step's ordered phase slices into the
+    # flight-recorder ring (rendered by dump_timeline as the "server
+    # host" track); 0 = no ring/timeline sampling
+    step_profile_events_every: int = 32
     # serving SLO gates (telemetry/slo.py) — see the SLOConfig schema
     slo: SLOConfig = Field(default_factory=SLOConfig)
     # chaos hooks (telemetry/faultinject.py) — see FaultInjectionConfig
@@ -205,6 +220,15 @@ class TelemetryConfig(DeepSpeedConfigModel):
             raise ValueError(
                 f"{info.field_name} must be > 0 seconds (or null to "
                 f"disable), got {v}")
+        return v
+
+    @field_validator("step_profile_events_every")
+    @classmethod
+    def _valid_every(cls, v):
+        if v < 0:
+            raise ValueError(
+                "step_profile_events_every must be >= 0 (0 = no ring/"
+                f"timeline sampling), got {v}")
         return v
 
     @field_validator("numerics_block_depth")

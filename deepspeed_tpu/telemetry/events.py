@@ -53,6 +53,13 @@ FAULT_INJECTED = "fault_injected"
 # decoding"): rolling acceptance rate collapsed — every verify forward
 # is wasted width until the workload turns lookup-friendly again
 SPEC_COLLAPSE = "spec_collapse"
+# serving step observatory (telemetry/step_profile.py): every Nth
+# step's ordered phase slices — dump_timeline's "server host" track
+SERVER_STEP_PROFILE = "server_step_profile"
+# KV-pool famine (telemetry/memory.py KVPoolAccountant): an allocation
+# the pool could not cover froze the allocator state here — one event
+# per famine episode, re-armed by the next successful allocation
+POOL_FAMINE = "pool_famine"
 
 
 class EventRing:

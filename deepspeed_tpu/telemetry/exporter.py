@@ -42,6 +42,8 @@ ROUTES = {
                        "(telemetry/numerics.py)",
     "/debug/traces": "recent finished request traces as JSON "
                      "(telemetry/tracing.py; see also dump_timeline)",
+    "/debug/goodput": "serving step-profile phase/goodput totals + "
+                      "KV-pool accounting (telemetry/step_profile.py)",
 }
 
 
@@ -59,6 +61,7 @@ class TelemetryHTTPServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[MetricRegistry] = None,
                  event_ring=None, memory=None, tracer=None,
+                 goodput=None,
                  handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S):
         if handler_timeout_s is not None and handler_timeout_s <= 0:
             raise ValueError(
@@ -117,6 +120,17 @@ class TelemetryHTTPServer:
                     t = tracer if tracer is not None else get_tracer()
                     body = t.to_json().encode()
                     ctype = "application/json"
+                elif path == "/debug/goodput":
+                    # ``goodput`` is the owner's zero-arg snapshot
+                    # callable (the serving loop's step profiler +
+                    # pool accountant); an endpoint armed without one
+                    # still answers with a valid, self-describing body
+                    payload = (goodput() if goodput is not None else
+                               {"enabled": False,
+                                "hint": "owner armed no step profiler "
+                                        "(telemetry.step_profile)"})
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(
                         404, "unknown path (try " +
@@ -171,10 +185,11 @@ class TelemetryHTTPServer:
 def start_http_server(port: int, host: str = "127.0.0.1",
                       registry: Optional[MetricRegistry] = None,
                       event_ring=None, memory=None, tracer=None,
+                      goodput=None,
                       handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
     return TelemetryHTTPServer(port=port, host=host, registry=registry,
                                event_ring=event_ring, memory=memory,
-                               tracer=tracer,
+                               tracer=tracer, goodput=goodput,
                                handler_timeout_s=handler_timeout_s)

@@ -23,9 +23,14 @@ the ``/debug/memory`` route, or periodically from a daemon thread when
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+import time
+from typing import Callable, Dict, Iterable, List, Optional
 
 from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
+
+# per-request peak block counts are small integers, not latencies —
+# power-of-two buckets (1 … 4096) give the histogram sane resolution
+BLOCK_COUNT_BUCKETS = [2.0 ** i for i in range(13)]
 
 
 class MemoryMonitor:
@@ -196,6 +201,190 @@ class MemoryMonitor:
             stop.set()
         if t is not None:
             t.join(timeout=5)
+
+
+class KVPoolAccountant:
+    """Block-pool lifetime & fragmentation accounting for the paged KV
+    cache (docs/observability.md "Serving goodput & KV-pool
+    accounting") — the measurements KV quantization / host offload
+    (ROADMAP item 2) need before choosing eviction candidates:
+
+    * **Residency lifetime** — acquire (refcount 0→1: fresh allocation
+      or LRU resurrection) to release (refcount back to 0) per block,
+      as a histogram: how long does a block actually stay pinned?
+    * **Age at eviction** — park-in-LRU to eviction per cached block:
+      how long does reusable prefix content survive before the free
+      list runs dry? Short ages mean the LRU is churning and offload
+      (demotion instead of eviction) would win.
+    * **Free-list fragmentation** — longest contiguous run of free
+      block ids over the free count (1.0 = one unbroken run). The pool
+      is position-independent today, but tiered/offloaded blocks want
+      contiguous spans for batched host DMA, so the gauge is the
+      early-warning signal.
+    * **Per-request peak blocks** — the high-water block count a
+      request held across its (possibly preempted) residencies.
+    * **Famine snapshot** — when an allocation cannot be covered even
+      by eviction, the allocator's state (free/live/cached/reserved/
+      fragmentation) freezes into the flight-recorder ring, once per
+      famine episode (re-armed by the next successful allocation).
+
+    Host-pure; ``clock`` is injectable (the property tests drive it
+    manually). The :class:`~deepspeed_tpu.inference.kv_cache.
+    BlockAllocator` calls the ``on_*`` hooks; a server with
+    ``telemetry.step_profile`` off builds no accountant and the
+    allocator hot path never branches past a ``None`` check.
+    """
+
+    # admission-state transitions between periodic fragmentation
+    # recomputes (the scan is O(free log free) — a 100k-block pool
+    # serving short requests must not sort its free list per retire);
+    # snapshot consumers and the famine path refresh unconditionally
+    FRAG_EVERY = 64
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry if registry is not None else get_registry()
+        self.clock = clock
+        self._acquired: Dict[int, float] = {}   # block -> acquire ts
+        self._parked: Dict[int, float] = {}     # block -> LRU-park ts
+        self._famine_armed = True
+        self._frag_tick = 0
+        self.famines = 0
+        self.last_fragmentation = 1.0
+        self.last_longest_run = 0
+        reg = self.registry
+        self._h_lifetime = reg.histogram(
+            "serve_kv_block_lifetime_seconds",
+            help="pool-block residency lifetime: refcount 0->1 "
+                 "(allocation or LRU resurrection) to refcount 0 "
+                 "(release)")
+        self._h_evict_age = reg.histogram(
+            "serve_kv_block_age_at_eviction_seconds",
+            help="cached-block age at LRU eviction: parked (released "
+                 "with a registered prefix) to evicted because the "
+                 "free list ran dry")
+        self._h_peak = reg.histogram(
+            "serve_request_peak_blocks",
+            help="per-request peak pool blocks held across all of the "
+                 "request's residencies (observed at finish)",
+            buckets=BLOCK_COUNT_BUCKETS)
+        self._g_frag = reg.gauge(
+            "serve_kv_free_longest_run_ratio",
+            help="longest contiguous run of free block ids / free-list "
+                 "size (1.0 = unfragmented; recomputed every Nth "
+                 "admission-state transition and at every snapshot/"
+                 "famine)")
+
+    # ----------------------------------------------------- block hooks
+
+    def on_acquire(self, block: int) -> None:
+        """Refcount 0→1: fresh allocation or LRU resurrection. The
+        previous park timestamp (if any) rides along so a ROLLBACK can
+        restore it instead of re-stamping the block's LRU age."""
+        self._acquired[block] = (self.clock(),
+                                 self._parked.pop(block, None))
+
+    def on_release(self, block: int, parked: bool) -> None:
+        """Refcount back to 0; ``parked`` = the block kept its prefix
+        hash and entered the evictable LRU instead of the free list."""
+        now = self.clock()
+        entry = self._acquired.pop(block, None)
+        if entry is not None:
+            self._h_lifetime.observe(max(now - entry[0], 0.0))
+        if parked:
+            self._parked[block] = now
+
+    def on_rollback(self, block: int) -> None:
+        """Undo an acquisition that never became a residency (a failed
+        admission rolling back its prefix-cache hits): NO lifetime
+        observation — a blocked queue head retried every step must not
+        flood the histogram with ~0s samples — and the block's
+        original park timestamp is restored, so its age-at-eviction
+        still measures from when it actually parked."""
+        entry = self._acquired.pop(block, None)
+        if entry is not None and entry[1] is not None:
+            self._parked[block] = entry[1]
+
+    def on_evict(self, block: int) -> None:
+        """LRU eviction: the parked content is gone for good."""
+        ts = self._parked.pop(block, None)
+        if ts is not None:
+            self._h_evict_age.observe(max(self.clock() - ts, 0.0))
+
+    def on_alloc_ok(self) -> None:
+        """A successful allocation re-arms the famine event."""
+        self._famine_armed = True
+
+    def on_famine(self, requested: int, state: dict) -> None:
+        """Allocation failure even after eviction: freeze the allocator
+        state into the event ring, once per episode."""
+        if not self._famine_armed:
+            return
+        self._famine_armed = False
+        self.famines += 1
+        from deepspeed_tpu.telemetry.events import POOL_FAMINE, \
+            record_event
+        record_event(POOL_FAMINE, requested_blocks=requested,
+                     fragmentation=round(self.last_fragmentation, 4),
+                     **state)
+
+    # -------------------------------------------------------- requests
+
+    def observe_request_peak(self, blocks: int) -> None:
+        """High-water block count of a finished request (skipped for
+        requests that never reached a slot — a zero would pollute the
+        distribution with queue-only rejections)."""
+        if blocks > 0:
+            self._h_peak.observe(blocks)
+
+    # --------------------------------------------------- fragmentation
+
+    def maybe_update_fragmentation(
+            self, free_ids_factory: Callable[[], Iterable[int]]) -> float:
+        """Rate-limited recompute for the per-transition call site
+        (every :data:`FRAG_EVERY`-th admission-state transition); the
+        factory is only invoked when the scan actually runs, so a
+        skipped call costs one counter increment."""
+        self._frag_tick += 1
+        if (self._frag_tick - 1) % self.FRAG_EVERY:
+            return self.last_fragmentation
+        return self.update_fragmentation(free_ids_factory())
+
+    def update_fragmentation(self, free_ids: Iterable[int]) -> float:
+        """Recompute the longest-contiguous-run ratio over the
+        IMMEDIATELY free ids (the free list proper — evictable LRU
+        blocks still hold content and are excluded). O(free log free);
+        rate-limited on the transition path
+        (:meth:`maybe_update_fragmentation`), unconditional from
+        snapshot consumers and the famine path — never per decode
+        step."""
+        ids = sorted(free_ids)
+        if not ids:
+            ratio, longest = 1.0, 0
+        else:
+            longest = run = 1
+            for prev, cur in zip(ids, ids[1:]):
+                run = run + 1 if cur == prev + 1 else 1
+                longest = max(longest, run)
+            ratio = longest / len(ids)
+        self.last_fragmentation = ratio
+        self.last_longest_run = longest
+        self._g_frag.set(ratio)
+        return ratio
+
+    # --------------------------------------------------------- export
+
+    def snapshot(self) -> dict:
+        """JSON-able view for ``/debug/goodput`` / ``server.stats`` /
+        the bench blob."""
+        return {
+            "enabled": True,
+            "live_tracked": len(self._acquired),
+            "parked_tracked": len(self._parked),
+            "free_longest_run_ratio": self.last_fragmentation,
+            "free_longest_run": self.last_longest_run,
+            "famine_episodes": self.famines,
+        }
 
 
 _default_monitor = MemoryMonitor()

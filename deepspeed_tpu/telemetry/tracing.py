@@ -186,6 +186,84 @@ class Trace:
         }
 
 
+# ------------------------------------------------- ring -> timeline slices
+# Shared by Tracer.trace_events and anything else that renders the
+# flight-recorder ring as Chrome trace tracks. Track layout:
+#   pid 2 "device":      tid 1 decode steps, tid 2 compiles,
+#                        tid 3 instant markers (everything else)
+#   pid 3 "server host": tid 1 serving-step phase slices
+#                        (telemetry/step_profile.py ring samples)
+
+def ring_timeline_events(event_ring) -> List[dict]:
+    """Convert the event ring into Chrome trace-event slices, in ONE
+    place (the r8 export rebuilt device slices inline, so a second
+    consumer would have re-implemented — and drifted from — the
+    conversion). Durations anchor backwards from each event's ring
+    timestamp. Slices are deduped by ``(pid, tid, ts)``: a ring that
+    recorded the same instant twice (fake clocks collapse timestamps;
+    a re-recorded step) must not emit overlapping duplicates that break
+    the timeline validator's non-overlap invariant."""
+    slices: List[dict] = []
+    seen = set()
+    have_server = False
+
+    def _slice(name, pid, tid, cat, ts, dur, args):
+        key = (pid, tid, round(ts * 1e6, 3))
+        if key in seen:
+            return
+        seen.add(key)
+        slices.append({
+            "name": name, "ph": "X", "cat": cat, "pid": pid, "tid": tid,
+            "ts": round(ts * 1e6, 3),
+            "dur": round(max(dur, 0.0) * 1e6, 3), "args": args})
+
+    for ev in event_ring.snapshot():
+        kind, ts, data = ev["kind"], ev["ts"], dict(ev["data"])
+        dur = data.get("seconds")
+        if kind == "step_end" and dur is not None:
+            _slice(f"decode step {data.get('step', '?')}", 2, 1,
+                   "device", ts - dur, dur, data)
+        elif kind == "compile_end" and dur is not None:
+            _slice(f"compile {data.get('fn', '?')}", 2, 2,
+                   "device", ts - dur, dur, data)
+        elif kind == "server_step_profile":
+            # contiguous phase slices reconstructed backwards from the
+            # record timestamp (the step's finish boundary): the last
+            # phase ends at ts, each earlier one abuts the next
+            have_server = True
+            end = ts
+            step = data.get("step", "?")
+            for entry in reversed(data.get("slices", [])):
+                name, pdur = entry[0], float(entry[1])
+                _slice(f"{name}", 3, 1, "server_host",
+                       end - pdur, pdur,
+                       {"step": step, "phase": name})
+                end -= pdur
+        else:
+            # everything else (retraces, admission rejects, SLO
+            # violations, famine snapshots, …) as instant markers
+            slices.append({
+                "name": kind, "ph": "i", "s": "p", "cat": "events",
+                "pid": 2, "tid": 3, "ts": round(ts * 1e6, 3),
+                "args": data})
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
+         "args": {"name": "device"}},
+        {"name": "thread_name", "ph": "M", "pid": 2, "tid": 1,
+         "args": {"name": "decode steps (sampled)"}},
+        {"name": "thread_name", "ph": "M", "pid": 2, "tid": 2,
+         "args": {"name": "compiles"}},
+    ]
+    if have_server:
+        meta.extend([
+            {"name": "process_name", "ph": "M", "pid": 3, "tid": 0,
+             "args": {"name": "server host"}},
+            {"name": "thread_name", "ph": "M", "pid": 3, "tid": 1,
+             "args": {"name": "step phases (sampled)"}},
+        ])
+    return meta + slices
+
+
 class Tracer:
     """Process- or engine-scoped trace factory + bounded finished ring.
 
@@ -349,9 +427,11 @@ class Tracer:
 
     def trace_events(self, event_ring=None) -> List[dict]:
         """Chrome trace-event list: one track (tid) per kept trace under
-        the ``requests`` process, plus ``device`` tracks rebuilt from the
-        flight-recorder ring — sampled decode-step slices and compile
-        slices, the "what was the device doing meanwhile" half."""
+        the ``requests`` process, plus ``device`` / ``server host``
+        tracks rebuilt from the flight-recorder ring by
+        :func:`ring_timeline_events` — sampled decode-step slices,
+        compile slices, and serving-step phase slices: "what were the
+        device AND the host doing meanwhile"."""
         events: List[dict] = [
             {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
              "args": {"name": "requests"}},
@@ -367,36 +447,7 @@ class Tracer:
                             extra_args={"status": tr.status,
                                         "keep_reason": tr.keep_reason})
         if event_ring is not None:
-            events.extend([
-                {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
-                 "args": {"name": "device"}},
-                {"name": "thread_name", "ph": "M", "pid": 2, "tid": 1,
-                 "args": {"name": "decode steps (sampled)"}},
-                {"name": "thread_name", "ph": "M", "pid": 2, "tid": 2,
-                 "args": {"name": "compiles"}},
-            ])
-            for ev in event_ring.snapshot():
-                kind, ts, data = ev["kind"], ev["ts"], dict(ev["data"])
-                dur = data.get("seconds")
-                if kind == "step_end" and dur is not None:
-                    events.append({
-                        "name": f"decode step {data.get('step', '?')}",
-                        "ph": "X", "cat": "device", "pid": 2, "tid": 1,
-                        "ts": round((ts - dur) * 1e6, 3),
-                        "dur": round(dur * 1e6, 3), "args": data})
-                elif kind == "compile_end" and dur is not None:
-                    events.append({
-                        "name": f"compile {data.get('fn', '?')}",
-                        "ph": "X", "cat": "device", "pid": 2, "tid": 2,
-                        "ts": round((ts - dur) * 1e6, 3),
-                        "dur": round(dur * 1e6, 3), "args": data})
-                else:
-                    # everything else (retraces, admission rejects,
-                    # SLO violations, …) as instant markers
-                    events.append({
-                        "name": kind, "ph": "i", "s": "p",
-                        "cat": "events", "pid": 2, "tid": 3,
-                        "ts": round(ts * 1e6, 3), "args": data})
+            events.extend(ring_timeline_events(event_ring))
         return events
 
     def dump_timeline(self, path: str, event_ring=None) -> int:

@@ -72,6 +72,25 @@ def run_continuous(eng, prompt, args):
         print(f"chunked prefill: {st['prefill_chunks']} chunks of "
               f"{st['prefill_chunk_tokens']} tokens, "
               f"{st['chunk_traces']} trace(s)")
+    if args.step_profile and st["step_profile"] is not None:
+        spf = st["step_profile"]
+        wall = max(spf["wall_s"], 1e-12)
+        print(f"step profile: {spf['steps']} steps, "
+              f"goodput {spf['goodput_fraction']:.3f} "
+              f"(host tax {spf['host_fraction']:.3f})")
+        for ph, secs in sorted(spf["phases_s"].items(),
+                               key=lambda kv: -kv[1]):
+            print(f"  {ph:<14} {secs * 1e3:9.2f} ms  "
+                  f"({secs / wall:6.1%} of wall)")
+        gap = spf["dispatch_gap"]
+        print(f"  dispatch gap: {gap['count']} gaps, total "
+              f"{gap['total_s'] * 1e3:.2f} ms, max "
+              f"{gap['max_s'] * 1e3:.2f} ms (device idle between "
+              "fetch and next dispatch)")
+        pool = st["kv_pool"]
+        print(f"  kv pool: free-run ratio "
+              f"{pool['free_longest_run_ratio']:.3f}, "
+              f"{pool['famine_episodes']} famine episode(s)")
     sp = st["speculation"]
     if sp["k"]:
         print(f"speculation (K={sp['k']}): "
@@ -153,6 +172,15 @@ def main():
                          "slot per step, greedy output unchanged "
                          "(continuous mode; docs/serving.md 'Per-slot "
                          "speculative decoding')")
+    ap.add_argument("--step-profile", action="store_true",
+                    help="print the rolling serving-step phase "
+                         "breakdown (admission/propose/dispatch/"
+                         "sync-wait/commit/publish, goodput fraction, "
+                         "dispatch gaps) after the drain, and sample "
+                         "EVERY step's phase slices into the timeline "
+                         "(combine with --trace-dump for the merged "
+                         "Perfetto view; docs/observability.md "
+                         "'Serving goodput & KV-pool accounting')")
     ap.add_argument("--trace-dump", default=None, metavar="PATH",
                     help="trace every request (telemetry.trace_sample_"
                          "rate=1.0) and write a Perfetto-loadable "
@@ -189,6 +217,10 @@ def main():
         telemetry["http_port"] = args.metrics_port
     if args.trace_dump:
         telemetry["trace_sample_rate"] = 1.0
+    if args.step_profile:
+        # dense timeline: every step's phase slices reach the ring, so
+        # --trace-dump renders a gap-free server-host track
+        telemetry["step_profile_events_every"] = 1
     if args.slo:
         telemetry["slo"] = {"enabled": True, "ttft_p90_s": 1.0,
                             "token_p50_s": 0.1, "queue_wait_p90_s": 1.0,

@@ -49,6 +49,14 @@ METRICS = {
     # regression here means the serving speculative path stopped
     # converting verify width into committed tokens
     "speculation.tokens_per_forward": "up",
+    # serving step observatory (docs/observability.md "Serving goodput
+    # & KV-pool accounting"): host-tax share of step wall and the
+    # device-idle gap between a fetch and the next dispatch — the two
+    # numbers the async-serving-loop refactor (ROADMAP item 5) exists
+    # to push down; a regression means the host got back between the
+    # device and its next program
+    "step_profile.host_fraction": "down",
+    "step_profile.dispatch_gap_p90_ms": "down",
 }
 
 

@@ -331,6 +331,30 @@ def test_bench_serve_continuous_smoke():
     assert (off["shed"], off["deadline_expired"], off["preempted"],
             off["cancelled"], off["failed"]) == (0, 0, 0, 0, 0)
     assert off["accepted"] == lc["on"]["requests"]
+    # step observatory blob (docs/observability.md "Serving goodput &
+    # KV-pool accounting"): phases decompose step wall BY CONSTRUCTION
+    # (the 'other' residual stays ≤5%), the goodput fraction is a real
+    # fraction, the dispatch-gap detector saw every decode boundary,
+    # and the pool accounting is live
+    spb = rec["step_profile"]
+    assert spb["steps"] > 0
+    assert 0.0 < spb["goodput_fraction"] <= 1.0
+    assert abs(spb["goodput_fraction"] + spb["host_fraction"]
+               - 1.0) < 1e-6
+    assert 0.0 <= spb["residual_fraction"] <= 0.05
+    for ph in ("admission", "propose", "dispatch", "sync_wait",
+               "commit", "publish"):
+        assert ph in spb["phases"], ph
+    # phase totals reconcile with the step wall (identity up to float
+    # rounding in the blob)
+    assert abs(sum(p["total_s"] for p in spb["phases"].values())
+               - spb["wall_s"]) <= 0.05 * spb["wall_s"] + 1e-5
+    assert spb["dispatch_gap_count"] >= 1
+    assert spb["dispatch_gap_p90_ms"] is not None
+    assert spb["dispatch_gap_p90_ms"] >= 0.0
+    assert 0.0 <= spb["pool"]["fragmentation_free_run_ratio"] <= 1.0
+    assert spb["pool"]["block_lifetime_p50_ms"] is not None
+    assert spb["pool"]["peak_blocks_p90"] >= 1
     # speculation A/B (auto K=4 in smoke mode, docs/serving.md
     # "Per-slot speculative decoding"): on the lookup-friendly
     # repetitive trace the verify forward must commit MORE than one
