@@ -1445,6 +1445,125 @@ def phase_serve(args) -> dict:
             f"{off_leg['slot_step_efficiency']}, steps "
             f"{on_leg['decode_steps']} vs {off_leg['decode_steps']}, "
             f"parity={out['speculation']['parity_exact']}")
+
+    # ---- async dispatch loop A/B (docs/serving.md "Async dispatch
+    # loop"): the SAME Poisson staggered trace, inference.async_loop ON
+    # (pipelined dispatch, lag-1 commit, worker-thread publish) vs OFF
+    # (the PR-1 synchronous loop). The blob records THE two numbers the
+    # refactor exists to push down — dispatch_gap_p90_ms (device idle
+    # between a fetch and the next dispatch; pipelined dispatches close
+    # it by construction) and step_profile.host_fraction — plus the
+    # tokens/s delta and the exact-parity flag. Both legs measure real
+    # wall time, so like the overload A/B a losing attempt re-runs both
+    # legs (bounded at 3) to gate the claim rather than box noise;
+    # the structural verdicts (gap, host fraction) are noise-robust.
+    if bool(getattr(args, "async_loop", False)) or smoke:
+        from deepspeed_tpu.telemetry import TelemetryConfig
+
+        # each leg replays the trace several times: a single replay is
+        # ~60 ms of serving on CPU, small enough for scheduler jitter
+        # to flip the tokens/s verdict under a loaded box (the exact
+        # failure mode the overload A/B's retry loop was built for) —
+        # repeats cut the variance, retries gate the rest
+        ab_repeats = 3
+
+        def _async_leg(on):
+            reg = MetricRegistry()
+            s = ContinuousBatchingServer(
+                InferenceEngine((mcfg, params), scfg.model_copy(
+                    update={"async_loop": on,
+                            "telemetry": TelemetryConfig(
+                                trace_sample_rate=0.0)})),
+                registry=reg)
+            s.submit(reqs[0][0], max_new_tokens=2)
+            s.drain()                          # warm the traces
+            t0 = time.time()
+            rids = []
+            for _ in range(ab_repeats):
+                nxt_i, vclk = 0, 0
+                while nxt_i < n_req or not s.scheduler.idle:
+                    while nxt_i < n_req and arrive_at[nxt_i] <= vclk:
+                        rids.append(s.submit(
+                            reqs[nxt_i][0],
+                            max_new_tokens=reqs[nxt_i][1]))
+                        nxt_i += 1
+                    if s.scheduler.idle:
+                        vclk = int(arrive_at[nxt_i])
+                        continue
+                    s.step()
+                    vclk += 1
+                s.drain()      # flush the lag-1 remnant + worker queue
+            wall = time.time() - t0
+            outs = [s.result(r) for r in rids]
+            gen = sum(len(o) - len(reqs[i % n_req][0])
+                      for i, o in enumerate(outs))
+            st = s.stats
+            spf = st["step_profile"]
+            snap_ = reg.snapshot()
+            leg = {
+                "wall_s": round(wall, 3),
+                "tokens_per_s": round(gen / max(wall, 1e-9), 1),
+                "host_fraction": round(spf["host_fraction"], 4),
+                "goodput_fraction": round(spf["goodput_fraction"], 4),
+                "dispatch_gap_p90_ms": _snap_quantile_ms(
+                    snap_, "serve_dispatch_gap_seconds", "p90"),
+                "dispatch_gap_total_s": round(
+                    spf["dispatch_gap"]["total_s"], 6),
+                "pipelined_steps": st["async_loop"]["pipelined_steps"],
+                "flushes": sum(st["async_loop"]["flushes"].values()),
+                "decode_traces": st["decode_traces"],
+                "retraces": st["retraces"],
+            }
+            s.close()
+            return leg, outs
+
+        best_on_tps, best_off_tps = 0.0, 0.0
+        for attempt in range(3):
+            a_on, out_on = _async_leg(True)
+            a_off, out_off = _async_leg(False)
+            best_on_tps = max(best_on_tps, a_on["tokens_per_s"])
+            best_off_tps = max(best_off_tps, a_off["tokens_per_s"])
+            gap_improved = (
+                a_on["dispatch_gap_p90_ms"] is not None
+                and a_off["dispatch_gap_p90_ms"] is not None
+                and a_on["dispatch_gap_p90_ms"]
+                < a_off["dispatch_gap_p90_ms"])
+            host_improved = a_on["host_fraction"] < a_off["host_fraction"]
+            tokens_ok = a_on["tokens_per_s"] >= a_off["tokens_per_s"]
+            if gap_improved and host_improved and tokens_ok:
+                break
+        tokens_basis = "single_attempt"
+        if not tokens_ok:
+            # attempts exhausted on the one wall-clock-noisy verdict:
+            # judge best-of-attempts against best-of-attempts (both
+            # legs get the same N shots — symmetric, and far more
+            # stable than one saturated-box sample). The structural
+            # verdicts (gap, host fraction) never take this fallback.
+            tokens_ok = best_on_tps >= best_off_tps
+            tokens_basis = "best_of_attempts"
+        out["async_loop"] = {
+            "attempts": attempt + 1,
+            "tokens_per_s_basis": tokens_basis,
+            "tokens_per_s_best_on": best_on_tps,
+            "tokens_per_s_best_off": best_off_tps,
+            "on": a_on, "off": a_off,
+            # top-level mirrors so check_bench_regression can gate the
+            # headline with a flat dotted key across rounds
+            "dispatch_gap_p90_ms": a_on["dispatch_gap_p90_ms"],
+            "host_fraction": a_on["host_fraction"],
+            "tokens_per_s_delta": round(
+                a_on["tokens_per_s"] - a_off["tokens_per_s"], 1),
+            "gap_improved": gap_improved,
+            "host_fraction_improved": host_improved,
+            "tokens_per_s_no_worse": tokens_ok,
+            "parity_exact": bool(out_on == out_off),
+        }
+        log(f"async-loop A/B: gap p90 {a_on['dispatch_gap_p90_ms']} vs "
+            f"{a_off['dispatch_gap_p90_ms']} ms, host fraction "
+            f"{a_on['host_fraction']} vs {a_off['host_fraction']}, "
+            f"{a_on['tokens_per_s']} vs {a_off['tokens_per_s']} tok/s, "
+            f"pipelined {a_on['pipelined_steps']} steps, parity="
+            f"{out['async_loop']['parity_exact']}")
     return out
 
 
@@ -2299,6 +2418,14 @@ def main() -> None:
                          "ON vs OFF at the same trace, recording "
                          "accepted-request token p90 and goodput under "
                          "the same deadline (auto in smoke mode)")
+    ap.add_argument("--async-loop", dest="async_loop",
+                    action="store_true",
+                    help="serve-continuous: also run the async-loop A/B "
+                         "— inference.async_loop (pipelined dispatch, "
+                         "lag-1 host commit) ON vs OFF on the same "
+                         "Poisson trace, recording dispatch_gap_p90_ms, "
+                         "step-profile host_fraction, tokens/s delta and "
+                         "the exact-parity flag (auto in smoke mode)")
     ap.add_argument("--train-numerics", dest="train_numerics",
                     action="store_true",
                     help="train phases: arm the in-graph numerics "

@@ -132,6 +132,12 @@ def telemetry_info():
             if k else
             "off (set DeepSpeedInferenceConfig.speculation_tokens>=2 — "
             "docs/serving.md 'Per-slot speculative decoding')")
+        out["serve_async_loop"] = (
+            "on by default config (pipelined dispatch, lag-1 host "
+            "commit, worker-thread publish, flush on host actions — "
+            "docs/serving.md 'Async dispatch loop')"
+            if DeepSpeedInferenceConfig().async_loop else
+            "off (set DeepSpeedInferenceConfig.async_loop=true)")
         fic = cfg.fault_injection
         out["fault_injection"] = (
             f"ARMED (seed {fic.seed}; step latency "

@@ -136,6 +136,19 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # latency collapses. Requires telemetry.slo.enabled with
     # queue_wait_p90_s set.
     enable_load_shedding: bool = False
+    # pipelined dispatch with lag-1 host commit (docs/serving.md "Async
+    # dispatch loop"): in steady-state decode the server dispatches
+    # step N+1 from step N's device-resident outputs BEFORE fetching
+    # step N's tokens, and runs host commit (EOS/length checks,
+    # retirement, metric publishing) one step behind on the fetched
+    # lag-1 results — the device pipelines instead of idling on host
+    # work between steps. Any host-driven state change (admission,
+    # chunk scheduling, preemption, shed, cancel, deadline reap)
+    # forces a bounded pipeline flush, so the scheduler always acts on
+    # committed state; greedy output stays token-identical to the sync
+    # loop (and to one-shot generate()). False = the PR-1 synchronous
+    # loop, byte-identical to servers before this knob existed.
+    async_loop: bool = True
     # metrics registry + optional scrape endpoint (docs/observability.md);
     # the shared section schema lives in telemetry/config.py
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)

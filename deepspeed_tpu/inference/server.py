@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.inference.async_loop import InFlightStep, PublishWorker
 from deepspeed_tpu.inference.engine import InferenceEngine, _bucket
 from deepspeed_tpu.inference.kv_cache import (PagedKVCache,
                                               init_paged_cache)
@@ -255,7 +256,9 @@ class ContinuousBatchingServer:
         self._h_prefill_chunk = reg.histogram(
             "serve_prefill_chunk_seconds",
             help="one chunked-prefill chunk (prefill_chunk_tokens "
-                 "tokens through the paged trunk)")
+                 "tokens through the paged trunk; non-final chunks "
+                 "observe the dispatch interval — they no longer "
+                 "force a fetch)")
         self._c_tail_reclaimed = reg.counter(
             "serve_tail_blocks_reclaimed_total",
             help="reserved-but-never-written tail blocks returned to "
@@ -411,6 +414,39 @@ class ContinuousBatchingServer:
         # step() so a long prompt never stalls resident decoders
         self._prefilling: Deque[dict] = deque()
         self._mid_prefill: set = set()
+        # ---- async dispatch loop (docs/serving.md "Async dispatch
+        # loop"): pipelined dispatch with lag-1 host commit. At most
+        # ONE device program is ever in flight across step() calls;
+        # every host-driven state change flushes it first, so the
+        # scheduler only ever acts on committed state.
+        self._async = cfg.async_loop
+        self._inflight: Optional[InFlightStep] = None
+        # metric publishing rides a worker thread under the async loop
+        # (drained at every flush / drain() / stats read); built even
+        # when async is off so close()/stats stay uniform — the thread
+        # itself is lazy and never starts in sync fallback
+        self._worker = PublishWorker()
+        # finishes discovered by an out-of-step flush (cancel/drain
+        # between steps): returned by the NEXT step() call
+        self._deferred_finished: List[int] = []
+        # per-step publish records buffer locally and ship to the
+        # worker in batches: a Queue.put + thread wakeup per step is a
+        # measurable slice of a CPU decode step (and worse under core
+        # contention — exactly when overlap matters); a tuple append
+        # is not. Drained (buffer first, then worker) at every flush
+        # point, so visibility is unchanged at every readable surface.
+        self._pub_buf: List[tuple] = []
+        # a chunk dispatched without its own fetch (the PR-10 satellite
+        # removed the per-chunk host sync): earliest unrealized dispatch
+        # time; its device span closes at the next real fetch
+        self._chunk_pending_t0: Optional[float] = None
+        self._async_stats = {
+            "pipeline_starts": 0,    # dispatch-without-fetch entries
+            "pipelined_steps": 0,    # lag-1 commits (decode) / rounds (verify)
+            "flushes": {},           # reason -> count
+            "discarded_tokens": 0,   # lag-1 garbage dropped at commit
+            "garbage_steps": 0,      # in-flight steps with no survivor
+        }
         self._init_flight_recorder(tcfg)
 
     # ------------------------------------------------------------ setup
@@ -641,6 +677,18 @@ class ContinuousBatchingServer:
     def _drop_prefill_job(self, slot: int) -> None:
         """Forget any in-flight chunked prefill for a vacated slot."""
         if slot in self._mid_prefill:
+            if (self._chunk_pending_t0 is not None and self._prefilling
+                    and self._prefilling[0]["slot"] == slot):
+                # the dropped slot owns the deferred chunk dispatch
+                # (only the head job runs chunks): rebalance the
+                # profiler's outstanding pairing NOW — leaving it would
+                # force 0-gaps on every later dispatch and let the next
+                # realize credit idle wall as device time. No span is
+                # credited (conservative: the chunk did run, but its
+                # fetch boundary is unobservable once the slot dies).
+                if self._profiler is not None:
+                    self._profiler.note_fetch(self._clock())
+                self._chunk_pending_t0 = None
             self._mid_prefill.discard(slot)
             self._prefilling = deque(
                 j for j in self._prefilling if j["slot"] != slot)
@@ -717,6 +765,16 @@ class ContinuousBatchingServer:
         slot = self.scheduler.find_slot(request_id)
         if slot is None:
             return False
+        if self._inflight is not None:
+            # cancel takes effect at the COMMITTED boundary the caller
+            # observed: the target's in-flight token is discarded (its
+            # slot arrays are about to be reset anyway), everyone
+            # else's commits normally — no other request loses a token
+            # to this cancellation. Collateral finishes surface on the
+            # next step() (or via results/finish_reasons immediately).
+            self._flush_pipeline(self._deferred_finished,
+                                 reason="cancel",
+                                 discard_rid=request_id)
         state = self.scheduler.slots[slot]
         self._teardown_slot(slot)
         self._finalize(state.request,
@@ -1058,18 +1116,46 @@ class ContinuousBatchingServer:
             jnp.asarray([plen], jnp.int32), self._cache, jnp.int32(slot))
         self._prefill_chunks += 1
         self._prefill_token_units += C
-        tok = np.asarray(tok)     # host sync: honest per-chunk timing
+        job["start"] = start + C
+        if job["start"] < plen:
+            # NON-final chunk: its logits are chunk-tail garbage the
+            # host never reads, so there is nothing to fetch — forcing
+            # np.asarray here existed only for "honest per-chunk
+            # timing" and stalled the whole pipeline once per chunk.
+            # The dispatch boundary is noted NOW (gap accounting); the
+            # chunk's device span closes at the next real fetch
+            # (decode/verify/final-chunk — _realize_chunk_span), which
+            # its compute provably precedes: the decode program chains
+            # on this chunk's cache output.
+            t1 = self._clock()
+            self._h_prefill_chunk.observe(t1 - t0)   # dispatch interval
+            if self._chunk_pending_t0 is None:
+                # ONE dispatch note per pending chain: the whole chain
+                # realizes through ONE fetch note (_realize_chunk_span),
+                # so noting every chunk would leak the profiler's
+                # outstanding counter and zero the gap metric forever
+                self._chunk_pending_t0 = t0
+                sp.note_dispatch(t0)
+            if ck is not None:
+                rt.trace.end_span(ck)
+            if self.watchdog is not None:
+                self.watchdog.notify_progress()   # a chunk IS progress
+            return                # more chunks; logits were chunk-tail
+        # final chunk: the prompt is resident, the first token is real —
+        # this fetch is once per REQUEST (not per chunk) and the loop
+        # needs the token to seed decoding
+        tok = np.asarray(tok)     # host sync: prefill complete
         t1 = self._clock()
         self._h_prefill_chunk.observe(t1 - t0)
-        sp.device_interval(t0, t1)   # chunk compute = device time
+        sp.device_interval(self._chunk_pending_t0
+                           if self._chunk_pending_t0 is not None
+                           else t0, t1,
+                           note_dispatch=self._chunk_pending_t0 is None)
+        self._chunk_pending_t0 = None
         if ck is not None:
             rt.trace.end_span(ck)
         if self.watchdog is not None:
             self.watchdog.notify_progress()   # a chunk IS progress
-        job["start"] = start + C
-        if job["start"] < plen:
-            return                # more chunks; logits were chunk-tail
-        # final chunk: the prompt is resident, the first token is real
         self._prefilling.popleft()
         self._mid_prefill.discard(slot)
         if self.prefix_caching:
@@ -1169,18 +1255,41 @@ class ContinuousBatchingServer:
         prefill, then one decode step for all active resident slots.
         Returns the request ids that got a result this round — normal
         finishes AND lifecycle finishes (fetch outputs via ``result`` /
-        ``drain``; ``finish_reasons`` tells them apart)."""
+        ``drain``; ``finish_reasons`` tells them apart).
+
+        With ``inference.async_loop`` (default) a steady-state step —
+        no queued work, no chunked prefill in flight, no expired
+        deadline — runs PIPELINED: the decode path dispatches step N+1
+        chained from step N's device-resident outputs before fetching
+        N, and commits N's tokens lag-1 (docs/serving.md "Async
+        dispatch loop"); finishes therefore surface one ``step()`` call
+        after their device step. Any step with host-driven state change
+        flushes the pipeline first and runs the synchronous body below,
+        so admission, chunk scheduling, preemption, shedding, and fault
+        injection always act on committed state."""
         # step observatory (telemetry/step_profile.py): phase marks at
         # boundaries the loop already crosses — monotonic-clock reads
         # only, zero new device syncs; OFF = the shared no-op handle
         sp = (self._profiler.begin() if self._profiler is not None
               else NULL_STEP_HANDLE)
         finished: List[int] = []
+        self._take_deferred(finished)
         self._tick += 1
         if self._fi is not None:
             self._fi.apply_famine(self.scheduler.allocator)
         self._reap_deadlines(finished)
         self._maybe_shed(finished)
+        # an out-of-step flush inside a reap-triggered cancel defers its
+        # collateral finishes — fold them into THIS round's return
+        self._take_deferred(finished)
+        if (self._async and not self.scheduler.queue
+                and not self._prefilling):
+            return self._step_pipelined(sp, finished)
+        if self._inflight is not None:
+            # host-driven state change ahead (admission / chunk
+            # scheduling / preemption ladder): commit the in-flight
+            # step FIRST so every decision below sees committed state
+            self._flush_pipeline(finished, sp, reason="host_action")
         self._admit(finished, sp)
         # degradation ladder, rung 2 (rung 1, prefix-LRU eviction,
         # already ran inside the allocator during admission): preempt
@@ -1217,6 +1326,441 @@ class ContinuousBatchingServer:
         sp.finish(live=bool(self.scheduler.slots))
         return finished
 
+    # ------------------------------------------------ async dispatch loop
+
+    def _take_deferred(self, finished: List[int]) -> None:
+        """Fold finishes an out-of-step flush produced (cancel / drain
+        between steps) into this round's return value."""
+        if self._deferred_finished:
+            finished.extend(self._deferred_finished)
+            self._deferred_finished.clear()
+
+    def _realize_chunk_span(self, sp, t1: float) -> None:
+        """Close the device span of chunk dispatches whose fetch was
+        deferred (the chunk program provably finished before whatever
+        result just landed at ``t1`` — the later program chains on its
+        cache output)."""
+        if self._chunk_pending_t0 is None:
+            return
+        if sp is not NULL_STEP_HANDLE:
+            sp.device_interval(self._chunk_pending_t0, t1,
+                               note_dispatch=False)
+        elif self._profiler is not None:
+            # profiler armed but no step handle live (out-of-step
+            # flush): keep the outstanding-dispatch pairing exact even
+            # though the device credit has no step to land in
+            self._profiler.note_fetch(t1)
+        self._chunk_pending_t0 = None
+
+    def _step_pipelined(self, sp, finished: List[int]) -> List[int]:
+        """Steady-state async round: no queued work, no chunked prefill,
+        no lifecycle action — the only host work is the lag-1 commit, so
+        the device pipelines across step() calls."""
+        sp.mark("admission")      # the reap/shed/famine checks above
+        sp.mark("prefill_chunk")  # by definition: no chunk work here
+        if not self.scheduler.slots:
+            if self._inflight is not None:
+                # every resident retired at the last lag-1 commit; the
+                # step dispatched beside that commit is pure garbage —
+                # fetch and discard it so its writes complete before
+                # any future admission reuses the released blocks
+                self._flush_pipeline(finished, sp, reason="drain_tail")
+            if self.watchdog is not None:
+                # an IDLE server being polled is alive, not stalled
+                self.watchdog.notify_progress()
+            sp.finish(live=False)
+            return finished
+        if self.spec_tokens:
+            self._pipelined_verify(finished, sp)
+        else:
+            self._pipelined_decode(finished, sp)
+        if self.slo is not None and not self._shedding:
+            self.slo.maybe_evaluate()
+        sp.mark("publish")
+        sp.finish(live=bool(self.scheduler.slots))
+        return finished
+
+    def _pipelined_decode(self, finished: List[int], sp) -> None:
+        """THE tentpole mechanism: dispatch decode step N+1 BEFORE
+        fetching step N. Step N's greedy outputs are already a device
+        array, so N+1's inputs chain from them with no host round trip
+        (tokens feed back directly; lengths advanced in-graph by
+        ``paged_decode_step``; the cache is the donated thread) — JAX
+        async dispatch then overlaps N's device compute with the lag-1
+        host commit of N-1 for free. A slot that turns out to have
+        finished at step N already ran one garbage row in step N+1:
+        commit discards it by state identity (advance-only rollback —
+        the retire path reset its lengths/table, so the garbage KV sits
+        masked in released blocks no one can reuse before the next
+        flush fetches N+1)."""
+        rec = self._inflight
+        S = self.num_slots
+        active = np.zeros((S,), bool)
+        states: Dict[int, object] = {}
+        for slot, state in self.scheduler.slots.items():
+            if slot in self._mid_prefill:
+                continue   # unreachable here (chunks force sync steps)
+            active[slot] = True
+            states[slot] = state
+        if not states:
+            sp.mark("propose")
+            return
+        self.profiler_capture.step_begin()
+        if rec is None:
+            # pipeline start: host-built inputs (identical to the sync
+            # path), dispatched WITHOUT a fetch — the lag begins here
+            tokens = np.zeros((S,), np.int32)
+            for slot, state in states.items():
+                tokens[slot] = state.pending
+            tok_in = jnp.asarray(tokens)
+        else:
+            tok_in = rec.tokens    # device-side token feedback
+        t0 = self._clock()
+        # device-credit window: with a step already in flight the device
+        # verifiably has work for this WHOLE step (N runs until its
+        # fetch, N+1 from before that fetch onward); a pipeline start is
+        # busy from its own dispatch to the step's end
+        sp.pipelined(since=None if rec is not None else t0)
+        sp.mark("propose", now=t0, dispatch=True)
+        nxt, self._cache = self._decode_jit(
+            self.engine.params, tok_in, self._cache, jnp.asarray(active))
+        sp.mark("dispatch")
+        new_rec = InFlightStep("decode", nxt, states, t0)
+        if rec is None:
+            self._async_stats["pipeline_starts"] += 1
+            sp.mark("sync_wait")
+            sp.mark("commit")
+            if self.watchdog is not None:
+                self.watchdog.notify_progress()   # a dispatch IS progress
+        else:
+            new_rec.prev_fetch = self._commit_decode_record(rec,
+                                                            finished, sp)
+            self._async_stats["pipelined_steps"] += 1
+        self.profiler_capture.step_end()
+        self._inflight = new_rec
+
+    def _commit_decode_record(self, rec: InFlightStep,
+                              finished: List[int], sp=NULL_STEP_HANDLE,
+                              discard_rid: Optional[int] = None) -> float:
+        """Lag-1 host commit of one in-flight decode step: fetch its
+        tokens, append/EOS-check/retire for every slot whose SlotState
+        is still the one that was resident at dispatch, and hand the
+        metric publishing to the worker thread. ``discard_rid`` drops
+        one request's token on the floor (cancel/deadline teardown in
+        progress: the caller observed the committed boundary, and the
+        slot's arrays are about to be reset anyway). Returns the fetch
+        timestamp."""
+        in_step = sp is not NULL_STEP_HANDLE
+        nxt = np.asarray(rec.tokens)         # host sync: the lag-1 fetch
+        t1 = self._clock()
+        if in_step:
+            sp.mark("sync_wait", now=t1, fetch=True)
+        elif self._profiler is not None:
+            self._profiler.note_fetch(t1)
+        self._realize_chunk_span(sp, t1)
+        # tokens are DELIVERED at fetches: the honest per-step latency
+        # under pipelining is fetch-to-fetch (dispatch→fetch for the
+        # pipeline's first step)
+        dt = t1 - (rec.prev_fetch if rec.prev_fetch is not None
+                   else rec.t_dispatch)
+        if self._fi is not None:
+            # injected latency is ACCOUNTED, never slept (see step())
+            dt += self._fi.step_latency()
+        n_live = 0
+        # insertion order (scheduler.slots iteration at dispatch) —
+        # deterministic, and commit order matches the sync loop's
+        for slot, state in rec.states.items():
+            if self.scheduler.slots.get(slot) is not state:
+                # retired / torn down after this step dispatched: the
+                # lag-1 token is garbage (its KV was reset with the slot)
+                self._async_stats["discarded_tokens"] += 1
+                continue
+            if (discard_rid is not None
+                    and state.request.request_id == discard_rid):
+                self._async_stats["discarded_tokens"] += 1
+                continue
+            n_live += 1
+            self._commit_slot_token(slot, state, int(nxt[slot]),
+                                    finished)
+        if in_step:
+            sp.mark("commit")
+        if n_live == 0:
+            # pure garbage (every slot vanished between dispatch and
+            # commit): the device step ran but served nothing — not a
+            # decode step in any accounting the sync loop would count
+            self._async_stats["garbage_steps"] += 1
+            return t1
+        self._step_clock += 1
+        self._active_slot_steps += n_live
+        self._queue_publish("decode", dt, n_live,
+                            n_live / self.num_slots)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()
+        if self._step_clock % self._EVENT_EVERY == 1:
+            get_event_ring().record(
+                telemetry_events.STEP_END, source="serve_decode",
+                step=self._step_clock, live=n_live,
+                seconds=round(dt, 6), pipelined=True,
+                sampled_every=self._EVENT_EVERY)
+        return t1
+
+    def _publish_decode_step(self, dt: float, n_live: int,
+                             occ: float) -> None:
+        """Worker-thread metric publish for one committed decode step
+        (values computed on the owner thread — the worker never reads a
+        clock or scheduler state)."""
+        self._h_decode_step.observe(dt)
+        self._h_token.observe(dt)
+        self._c_decode_steps.inc()
+        self._c_tokens.inc(n_live)
+        self._g_occupancy.set(occ)
+
+    def _pipelined_verify(self, finished: List[int], sp) -> None:
+        """Async speculation round: commit the in-flight verify, then
+        propose + dispatch the NEXT one and return with it in flight —
+        its device compute overlaps the publish work (worker thread),
+        the inter-step host time, and the next round's checks.
+
+        The verify path deliberately commits BEFORE dispatching (the
+        opposite ordering from :meth:`_pipelined_decode`): prompt-lookup
+        proposals are a host data structure over the *committed*
+        history, so chaining N+1's inputs from N's un-fetched outputs
+        would mean proposing from a history K tokens stale — acceptance
+        (and with it the entire speculation win) collapses, trading the
+        very tokens/s the async loop must not regress for a closed
+        dispatch gap. Commit-then-dispatch keeps proposals fresh and
+        acceptance intact; the dispatch gap shrinks to accept+propose
+        because publishing rides the worker. It also means a verify
+        round needs NO lag-1 reconciliation: the active set is computed
+        after commit, so no garbage rows are ever dispatched."""
+        rec = self._inflight
+        prev_fetch = None
+        # device credit in this round rides explicit spans ([step begin
+        # → fetch] at commit, [dispatch → step end] via pipelined())
+        sp.pipelined_mode()
+        if rec is not None:
+            prev_fetch = self._commit_verify_record(rec, finished, sp)
+            self._inflight = None
+            self._async_stats["pipelined_steps"] += 1
+        K = self.spec_tokens
+        S = self.num_slots
+        tokens = np.zeros((S, K), np.int32)
+        props: Dict[int, List[int]] = {}
+        states: Dict[int, object] = {}
+        for slot, state in self.scheduler.slots.items():
+            if slot in self._mid_prefill:
+                continue   # unreachable here (chunks force sync steps)
+            # proposal source = committed history ONLY (see
+            # _decode_speculative — this is the same incremental
+            # LookupIndex discipline)
+            entry = self._spec_hist.get(slot)
+            if entry is None or entry[0] is not state:
+                idx = LookupIndex(state.request.prompt)
+                idx.extend(state.generated)
+                self._spec_hist[slot] = (state, idx)
+            else:
+                idx = entry[1]
+                grown = (len(state.request.prompt)
+                         + len(state.generated) - len(idx.hist))
+                if grown > 0:
+                    idx.extend(state.generated[-grown:])
+            prop = idx.proposals(K - 1)
+            tokens[slot, 0] = state.pending
+            tokens[slot, 1:] = prop
+            props[slot] = prop
+            states[slot] = state
+        if not states:
+            # the commit above retired every resident — nothing to
+            # dispatch; the caller's live=False finish resets the gap
+            sp.mark("propose")
+            return
+        self.profiler_capture.step_begin()
+        t0 = self._clock()
+        sp.mark("propose", now=t0, dispatch=True)
+        t_toks, self._cache = self._verify_jit(
+            self.engine.params, jnp.asarray(tokens), self._cache)
+        sp.mark("dispatch")
+        self.profiler_capture.step_end()
+        if rec is None:
+            self._async_stats["pipeline_starts"] += 1
+            if self.watchdog is not None:
+                self.watchdog.notify_progress()   # a dispatch IS progress
+        # device busy from this dispatch through the step's end (the
+        # [step-begin → fetch] half was credited at commit)
+        sp.pipelined(since=t0)
+        self._inflight = InFlightStep("verify", t_toks, states, t0,
+                                      props=props, prev_fetch=prev_fetch)
+
+    def _commit_verify_record(self, rec: InFlightStep,
+                              finished: List[int], sp=NULL_STEP_HANDLE,
+                              discard_rid: Optional[int] = None) -> float:
+        """Commit one in-flight verify round: fetch the target argmaxes,
+        greedy-accept against the proposals the round was scored with,
+        append/EOS-check/retire per surviving slot, advance lengths over
+        the accepted prefixes in ONE vectorized update, and hand metric
+        publishing to the worker. Mirrors ``_decode_speculative``'s
+        post-fetch half exactly (same helpers, same order) so the sync
+        and async commit paths cannot drift."""
+        in_step = sp is not NULL_STEP_HANDLE
+        K = self.spec_tokens
+        S = self.num_slots
+        t_np = np.asarray(rec.tokens)       # host sync: the verify ran
+        t1 = self._clock()
+        if in_step and getattr(sp, "_pipelined_mode", False):
+            sp.mark("sync_wait", now=t1)
+            # device busy from step begin (the round was in flight
+            # across the call boundary) until this fetch; 0.0 clamps to
+            # the handle's begin. note_dispatch=False: the dispatch was
+            # noted when the round left the host.
+            sp.device_interval(0.0, t1, note_dispatch=False)
+        elif in_step:
+            # flush inside a sync action step: the plain fetch-wait
+            # attribution (mode off — the sliver credit IS the span)
+            sp.mark("sync_wait", now=t1, fetch=True)
+        elif self._profiler is not None:
+            self._profiler.note_fetch(t1)
+        self._realize_chunk_span(sp, t1)
+        dt = t1 - (rec.prev_fetch if rec.prev_fetch is not None
+                   else rec.t_dispatch)
+        if self._fi is not None:
+            dt += self._fi.step_latency()
+        adv = np.zeros((S,), np.int32)
+        committed_total = 0
+        accepted_total = 0
+        n_live = 0
+        per_slot_commits: List[int] = []
+        retire: List[int] = []
+        for slot, state in rec.states.items():
+            if self.scheduler.slots.get(slot) is not state:
+                self._async_stats["discarded_tokens"] += 1
+                continue
+            if (discard_rid is not None
+                    and state.request.request_id == discard_rid):
+                self._async_stats["discarded_tokens"] += 1
+                continue
+            m, committed = greedy_accept_host(t_np[slot],
+                                              rec.props[slot])
+            accepted_total += m
+            n_live += 1
+            rt = (self._rt.get(state.request.request_id)
+                  if self.tracer is not None else None)
+            if rt is not None and rt.decode is not None:
+                rt.steps += 1
+            done = False
+            n_committed = 0
+            for tok in committed:
+                state.generated.append(tok)
+                n_committed += 1
+                if rt is not None and rt.decode is not None:
+                    rt.tokens += 1
+                if self._finished(state, tok):
+                    done = True
+                    break
+            committed_total += n_committed
+            per_slot_commits.append(n_committed)
+            adv[slot] = n_committed
+            if done:
+                retire.append(slot)
+            else:
+                state.pending = committed[-1]
+        self._cache = self._cache.replace(
+            lengths=self._cache.lengths + jnp.asarray(adv))
+        for slot in retire:
+            self._retire(slot, self.scheduler.slots[slot], finished)
+        if in_step:
+            sp.mark("commit")
+        if n_live == 0:
+            self._async_stats["garbage_steps"] += 1
+            return t1
+        self._step_clock += 1
+        self._active_slot_steps += n_live
+        proposed = n_live * (K - 1)
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted_total
+        self._spec_committed += committed_total
+        self._spec_steps += 1
+        self._spec_slot_steps += n_live
+        self._maybe_spec_collapse(proposed, accepted_total)
+        self._queue_publish("verify", dt, n_live, committed_total,
+                            proposed, accepted_total, per_slot_commits)
+        if self.watchdog is not None:
+            self.watchdog.notify_progress()
+        if self._step_clock % self._EVENT_EVERY == 1:
+            get_event_ring().record(
+                telemetry_events.STEP_END, source="serve_spec_verify",
+                step=self._step_clock, live=n_live,
+                committed=committed_total, accepted=accepted_total,
+                seconds=round(dt, 6), pipelined=True,
+                sampled_every=self._EVENT_EVERY)
+        return t1
+
+    def _publish_verify_step(self, dt: float, n_live: int,
+                             committed_total: int, proposed: int,
+                             accepted: int,
+                             per_slot_commits: List[int]) -> None:
+        """Worker-thread metric publish for one committed verify round
+        (same instruments and semantics as the sync path)."""
+        self._h_decode_step.observe(dt)
+        self._h_token.observe(dt * n_live / max(committed_total, 1))
+        self._c_decode_steps.inc()
+        self._c_tokens.inc(committed_total)
+        self._g_occupancy.set(n_live / self.num_slots)
+        self._c_spec_proposed.inc(proposed)
+        self._c_spec_accepted.inc(accepted)
+        for n in per_slot_commits:
+            self._h_spec_commit.observe(n)
+
+    # one worker job per this many buffered step records (see _pub_buf)
+    _PUBLISH_BATCH = 16
+
+    def _queue_publish(self, kind: str, *vals) -> None:
+        self._pub_buf.append((kind, vals))
+        if len(self._pub_buf) >= self._PUBLISH_BATCH:
+            self._ship_publish_buf()
+
+    def _ship_publish_buf(self) -> None:
+        """Hand the buffered step records to the worker as ONE job."""
+        if not self._pub_buf:
+            return
+        buf, self._pub_buf = self._pub_buf, []
+
+        def job():
+            for kind, vals in buf:
+                if kind == "decode":
+                    self._publish_decode_step(*vals)
+                else:
+                    self._publish_verify_step(*vals)
+
+        self._worker.submit(job)
+
+    def _drain_publishing(self) -> None:
+        """Every buffered and queued publish lands in the registry —
+        called at each flush point so no readable surface ever sees a
+        half-published step."""
+        self._ship_publish_buf()
+        self._worker.drain()
+
+    def _flush_pipeline(self, finished: List[int], sp=NULL_STEP_HANDLE,
+                        reason: str = "",
+                        discard_rid: Optional[int] = None) -> None:
+        """Commit whatever is in flight and drain the publish worker —
+        the bounded flush every host-driven state change pays so the
+        scheduler (and anyone reading results/metrics afterwards) acts
+        on committed state. Bounded by construction: the loop holds at
+        most ONE in-flight step."""
+        rec = self._inflight
+        if rec is not None:
+            self._inflight = None
+            if rec.kind == "decode":
+                self._commit_decode_record(rec, finished, sp,
+                                           discard_rid=discard_rid)
+            else:
+                self._commit_verify_record(rec, finished, sp,
+                                           discard_rid=discard_rid)
+            fl = self._async_stats["flushes"]
+            fl[reason] = fl.get(reason, 0) + 1
+        self._drain_publishing()
+
     def _decode_once(self, finished: List[int],
                      sp=NULL_STEP_HANDLE) -> None:
         """One plain decode step for all active resident slots — the
@@ -1236,6 +1780,11 @@ class ContinuousBatchingServer:
             return
         self.profiler_capture.step_begin()
         t0 = self._clock()
+        # any deferred chunk span closes HERE: the device was busy with
+        # the chunk from its dispatch until (at least) this boundary,
+        # and the decode's own dispatch/sync_wait slivers cover the rest
+        # — adjacent windows, no double count
+        self._realize_chunk_span(sp, t0)
         # the propose phase ends HERE and the decode program dispatches:
         # the dispatch-gap detector measures this boundary against the
         # previous fetch (how long the device sat idle on host work)
@@ -1256,13 +1805,10 @@ class ContinuousBatchingServer:
             # shedding chaos tests collapse latency with no real delay
             dt += self._fi.step_latency()
         self.profiler_capture.step_end()
-        self._h_decode_step.observe(dt)
-        # every live slot committed one token this step, each costing one
-        # step of wall time — THE per-token serving latency
-        self._h_token.observe(dt)
-        self._c_decode_steps.inc()
-        self._c_tokens.inc(n_active)
-        self._g_occupancy.set(n_active / self.num_slots)
+        # the shared publish body (inline here — the sync loop has no
+        # worker): every live slot committed one token this step, each
+        # costing one step of wall time — THE per-token serving latency
+        self._publish_decode_step(dt, n_active, n_active / self.num_slots)
         if self.watchdog is not None:
             self.watchdog.notify_progress()
         if self._step_clock % self._EVENT_EVERY == 1:
@@ -1276,18 +1822,28 @@ class ContinuousBatchingServer:
             if slot in self._mid_prefill:
                 continue   # not decoded this step; nothing to commit
             state = self.scheduler.slots[slot]
-            tok = int(nxt[slot])
-            state.generated.append(tok)
-            if self.tracer is not None:
-                rt = self._rt.get(state.request.request_id)
-                if rt is not None and rt.decode is not None:
-                    rt.steps += 1
-                    rt.tokens += 1
-            if self._finished(state, tok):
-                self._retire(slot, state, finished)
-            else:
-                state.pending = tok
+            self._commit_slot_token(slot, state, int(nxt[slot]),
+                                    finished)
         sp.mark("commit")
+
+    def _commit_slot_token(self, slot: int, state, tok: int,
+                           finished: List[int]) -> None:
+        """Commit ONE decode token for one slot — append, trace bump,
+        EOS/budget check, retire-or-continue. THE shared per-slot
+        commit body: the sync loop and the async lag-1 commit both
+        route through it, so finish semantics and token accounting
+        cannot drift between the paths (the byte-identical
+        sync-fallback oracle depends on exactly this)."""
+        state.generated.append(tok)
+        if self.tracer is not None:
+            rt = self._rt.get(state.request.request_id)
+            if rt is not None and rt.decode is not None:
+                rt.steps += 1
+                rt.tokens += 1
+        if self._finished(state, tok):
+            self._retire(slot, state, finished)
+        else:
+            state.pending = tok
 
     def _decode_speculative(self, finished: List[int],
                             sp=NULL_STEP_HANDLE) -> None:
@@ -1338,6 +1894,7 @@ class ContinuousBatchingServer:
         n_active = len(active_slots)
         self.profiler_capture.step_begin()
         t0 = self._clock()
+        self._realize_chunk_span(sp, t0)   # see _decode_once
         # proposal scan ends, the batched verify dispatches (the
         # dispatch-gap boundary — see _decode_once)
         sp.mark("propose", now=t0, dispatch=True)
@@ -1360,6 +1917,7 @@ class ContinuousBatchingServer:
         adv = np.zeros((S,), np.int32)
         committed_total = 0
         accepted_total = 0
+        per_slot_commits: List[int] = []
         retire: List[int] = []
         for slot in active_slots:
             state = self.scheduler.slots[slot]
@@ -1380,11 +1938,11 @@ class ContinuousBatchingServer:
                     done = True
                     break
             committed_total += n_committed
-            # one observation PER SLOT-FORWARD (not a cross-slot step
-            # mean): the histogram's distribution must expose per-slot
+            # collected PER SLOT-FORWARD (not a cross-slot step mean):
+            # the histogram's distribution must expose per-slot
             # acceptance skew — one lookup-friendly request carrying an
             # otherwise-collapsed batch shows as {K, 1, 1, 1}, not 1.75
-            self._h_spec_commit.observe(n_committed)
+            per_slot_commits.append(n_committed)
             # a continuing slot's cache gains [pending, p_1..p_m]; the
             # correction becomes the next pending (its KV, like any
             # pending token's, is written by the NEXT verify). A
@@ -1400,18 +1958,13 @@ class ContinuousBatchingServer:
         for slot in retire:
             self._retire(slot, self.scheduler.slots[slot], finished)
         sp.mark("commit")
-        self._h_decode_step.observe(dt)
-        # per-token latency: each active slot committed
-        # committed_total/n_active tokens on average this step, so one
-        # committed token cost dt / that — keeps serve_token_seconds
-        # meaning "wall per committed token per slot" under speculation
-        self._h_token.observe(dt * n_active / max(committed_total, 1))
-        self._c_decode_steps.inc()
-        self._c_tokens.inc(committed_total)
-        self._g_occupancy.set(n_active / S)
         proposed = n_active * (K - 1)
-        self._c_spec_proposed.inc(proposed)
-        self._c_spec_accepted.inc(accepted_total)
+        # the shared publish body (inline here — the sync loop has no
+        # worker); per-token latency keeps meaning "wall per committed
+        # token per slot" under speculation
+        self._publish_verify_step(dt, n_active, committed_total,
+                                  proposed, accepted_total,
+                                  per_slot_commits)
         self._spec_proposed += proposed
         self._spec_accepted += accepted_total
         self._spec_committed += committed_total
@@ -1491,6 +2044,12 @@ class ContinuousBatchingServer:
                     self.cancel(state.request.request_id)
                 break
             self.step()
+        # the drain loop exits the moment the scheduler empties, which
+        # under the async loop can leave one garbage step in flight
+        # (dispatched beside the final lag-1 commit): fetch + discard
+        # it and drain the publish worker, so a drained server has no
+        # device work outstanding and fully-published metrics
+        self._flush_pipeline(self._deferred_finished, reason="drain")
         return dict(self._results)
 
     def dump_timeline(self, path: str) -> int:
@@ -1521,6 +2080,11 @@ class ContinuousBatchingServer:
         if self.http_server is not None:
             self.http_server.close()
             self.http_server = None
+        # commit whatever is still in flight: a close() without a
+        # drain() must not silently drop a pipelined step's committed
+        # tokens, finishes, or metrics
+        self._flush_pipeline(self._deferred_finished, reason="close")
+        self._worker.close()
         self._flight.close()
         self.watchdog = None
 
@@ -1533,6 +2097,10 @@ class ContinuousBatchingServer:
         num_slots rows, live or idle); ``slot_occupancy`` is the fraction
         of those units that carried a live sequence — the number
         continuous batching exists to push toward 1.0."""
+        # owner-thread read: flush buffered publishes + drain the
+        # worker first so every registry instrument agrees with the
+        # host mirrors below
+        self._drain_publishing()
         units = self._step_clock * self.num_slots
         alloc = self.scheduler.allocator
         return {
@@ -1596,6 +2164,20 @@ class ContinuousBatchingServer:
             },
             "fault_injection": (self._fi.snapshot()
                                 if self._fi is not None else None),
+            # async dispatch loop (docs/serving.md "Async dispatch
+            # loop"): pipeline state, flush forensics by reason, lag-1
+            # reconciliation counters, and the publish worker's queue
+            "async_loop": {
+                "enabled": self._async,
+                "commit_lag": 1 if self._inflight is not None else 0,
+                "pipeline_starts": self._async_stats["pipeline_starts"],
+                "pipelined_steps": self._async_stats["pipelined_steps"],
+                "flushes": dict(self._async_stats["flushes"]),
+                "discarded_tokens":
+                    self._async_stats["discarded_tokens"],
+                "garbage_steps": self._async_stats["garbage_steps"],
+                "worker": self._worker.snapshot(),
+            },
             # serving step observatory + KV-pool accounting
             # (docs/observability.md "Serving goodput & KV-pool
             # accounting"); None = telemetry.step_profile off

@@ -29,6 +29,21 @@ with the same discipline:
   ``now - last_fetch`` into ``serve_dispatch_gap_seconds``; the
   cumulative gap is the exact wall-time budget an async loop can win
   back.
+* **Commit lag awareness.** The async serving loop dispatches step
+  N+1 BEFORE fetching step N (``inference.async_loop``), so a naive
+  fetch→dispatch pairing would charge the lag-1 commit+publish work as
+  device idle even though the device moved straight from N to N+1.
+  The profiler counts dispatches outstanding (dispatched, not yet
+  fetched): a dispatch issued while another program is still in flight
+  observes a **zero** gap (the device had queued work — it never
+  idled), and a fetch that leaves work outstanding does NOT open an
+  idle span. Gaps are therefore always measured against the fetch
+  that actually drained the device — the correct step's fetch, at any
+  commit lag. A step the loop marks ``pipelined(since=...)`` credits
+  device time for the whole window the device verifiably had work in
+  flight (clamped to the step wall), keeping
+  ``serve_goodput_fraction`` meaningful when dispatch/sync_wait host
+  slivers no longer bound device activity.
 
 Phase vocabulary (docs/observability.md "Serving goodput & KV-pool
 accounting"):
@@ -86,7 +101,17 @@ class _NullStepHandle:
              dispatch: bool = False, fetch: bool = False) -> None:
         return None
 
-    def device_interval(self, t0: float, t1: float) -> None:
+    def device_interval(self, t0: float, t1: float,
+                        note_dispatch: bool = True) -> None:
+        return None
+
+    def note_dispatch(self, now: float) -> None:
+        return None
+
+    def pipelined(self, since: Optional[float] = None) -> None:
+        return None
+
+    def pipelined_mode(self) -> None:
         return None
 
     def finish(self, live: bool = True) -> None:
@@ -101,7 +126,8 @@ class _StepHandle:
     resets it; the serving loop is single-threaded per server)."""
 
     __slots__ = ("_prof", "_t0", "_last", "acc", "device", "_sampled",
-                 "slices", "worked")
+                 "slices", "worked", "_pipelined_since",
+                 "_pipelined_mode")
 
     def __init__(self, prof: "StepProfiler"):
         self._prof = prof
@@ -116,6 +142,9 @@ class _StepHandle:
         # goodput fraction — it would track traffic pattern, not host
         # tax (see StepProfiler._record)
         self.worked = False
+        # async-loop device credit (see pipelined()): None = sync step
+        self._pipelined_since: Optional[float] = None
+        self._pipelined_mode = False
 
     def _reset(self, now: float, sampled: bool) -> None:
         self._t0 = now
@@ -125,6 +154,8 @@ class _StepHandle:
         self._sampled = sampled
         self.slices = []
         self.worked = False
+        self._pipelined_since = None
+        self._pipelined_mode = False
 
     def mark(self, phase: str, now: Optional[float] = None,
              dispatch: bool = False, fetch: bool = False) -> float:
@@ -142,7 +173,10 @@ class _StepHandle:
             dt = 0.0
         self._last = now
         self.acc[phase] = self.acc.get(phase, 0.0) + dt
-        if phase in DEVICE_PHASES:
+        if phase in DEVICE_PHASES and not self._pipelined_mode:
+            # under pipelining the dispatch/sync_wait host slivers sit
+            # INSIDE the explicitly-credited busy windows — crediting
+            # both would double count
             self.device += dt
         if self._sampled and dt > 1e-9:
             self.slices.append([phase, dt])
@@ -153,16 +187,53 @@ class _StepHandle:
             prof._note_fetch(now)
         return now
 
-    def device_interval(self, t0: float, t1: float) -> None:
+    def device_interval(self, t0: float, t1: float,
+                        note_dispatch: bool = True) -> None:
         """Attribute an already-measured device interval (prefill /
         chunk program: dispatch at ``t0``, fetch complete at ``t1``)
         that nests inside a host phase. Counts toward the goodput
         fraction and advances the dispatch-gap boundary — the device
-        was busy, not idle, across it."""
+        was busy, not idle, across it. ``note_dispatch=False`` realizes
+        a span whose dispatch boundary was already noted at dispatch
+        time (the deferred chunked-prefill attribution: the chunk no
+        longer forces its own fetch, so its device span closes at the
+        NEXT real fetch — which may be in a later step; the credit is
+        clamped to this step's window so cumulative device time can
+        never outrun cumulative wall)."""
         self.worked = True
-        self.device += max(t1 - t0, 0.0)
-        self._prof._note_dispatch(t0)
+        self.device += max(t1 - max(t0, self._t0), 0.0)
+        if note_dispatch:
+            self._prof._note_dispatch(t0)
         self._prof._note_fetch(t1)
+
+    def note_dispatch(self, now: float) -> None:
+        """A device program left the host at ``now`` with its fetch
+        deferred (async chunk dispatch): the gap detector advances, the
+        device-time credit waits for :meth:`device_interval` with
+        ``note_dispatch=False``."""
+        self.worked = True
+        self._prof._note_dispatch(now)
+
+    def pipelined(self, since: Optional[float] = None) -> None:
+        """Mark this step as running with the async loop's commit lag:
+        the device verifiably had work in flight from ``since`` (default
+        the step's begin — an in-flight program from the previous step)
+        through the step's end, so ``finish()`` credits that window as
+        device time (clamped to the step wall). Implies
+        :meth:`pipelined_mode`: the dispatch/sync_wait host slivers no
+        longer bound device activity under pipelining — crediting them
+        would double count, and NOT crediting the busy window would
+        collapse the goodput fraction exactly when the loop gets good."""
+        self.worked = True
+        self._pipelined_mode = True
+        self._pipelined_since = self._t0 if since is None else since
+
+    def pipelined_mode(self) -> None:
+        """Suppress the DEVICE_PHASES sliver credit without arming a
+        finish-time busy window — for rounds whose device credit is
+        carried entirely by explicit :meth:`device_interval` spans plus
+        a later :meth:`pipelined` tail (the async verify round)."""
+        self._pipelined_mode = True
 
     def finish(self, live: bool = True) -> None:
         """Close the step: the tail since the last mark becomes the
@@ -179,9 +250,18 @@ class _StepHandle:
         self.acc["other"] = self.acc.get("other", 0.0) + tail
         if self._sampled and tail > 1e-9:
             self.slices.append(["other", tail])
+        wall = max(end - self._t0, 0.0)
+        if self._pipelined_since is not None:
+            # additive, then clamped: phase slivers in DEVICE_PHASES may
+            # overlap the pipelined window — the clamp keeps the
+            # per-step device credit a true fraction of wall
+            self.device += max(end - max(self._pipelined_since,
+                                         self._t0), 0.0)
+        if self.device > wall:
+            self.device = wall
         if not live:
             self._prof._last_fetch = None
-        self._prof._record(max(end - self._t0, 0.0), self)
+        self._prof._record(wall, self)
 
 
 class StepProfiler:
@@ -223,6 +303,13 @@ class StepProfiler:
         self.gap_count = 0
         self.gap_total = 0.0
         self.gap_max = 0.0
+        # commit-lag accounting: programs dispatched but not yet
+        # fetched. A dispatch that overlaps outstanding work observes a
+        # ZERO gap (the device had queued work — see module docstring);
+        # a fetch that leaves work outstanding opens no idle span.
+        self.outstanding = 0
+        self.pipelined_dispatches = 0   # dispatches issued into a busy device
+        self.pipelined_steps = 0        # steps credited via pipelined()
         self._handle = _StepHandle(self)
         reg = self.registry
         self._h_wall = reg.histogram(
@@ -253,6 +340,19 @@ class StepProfiler:
         return self._handle
 
     def _note_dispatch(self, now: float) -> None:
+        if self.outstanding > 0:
+            # another program is still in flight: the device moves
+            # straight from it to this one — zero idle by construction.
+            # Observed (not skipped) so the gap histogram's count keeps
+            # meaning "one observation per dispatch boundary" and the
+            # p90 the async A/B gates on reflects the closed gaps.
+            self.outstanding += 1
+            self._h_gap.observe(0.0)
+            with self._lock:
+                self.gap_count += 1
+                self.pipelined_dispatches += 1
+            return
+        self.outstanding = 1
         if self._last_fetch is None:
             return
         gap = max(now - self._last_fetch, 0.0)
@@ -264,7 +364,17 @@ class StepProfiler:
             self.gap_max = max(self.gap_max, gap)
 
     def _note_fetch(self, now: float) -> None:
-        self._last_fetch = now
+        self.outstanding = max(self.outstanding - 1, 0)
+        if self.outstanding == 0:
+            # the device actually drained here — idle begins
+            self._last_fetch = now
+
+    def note_fetch(self, now: float) -> None:
+        """Out-of-step fetch boundary (a pipeline flush from ``cancel``
+        or ``drain`` between ``step()`` calls): keeps the
+        outstanding-dispatch pairing exact when no step handle is
+        live."""
+        self._note_fetch(now)
 
     def _phase_h(self, phase: str):
         h = self._phase_hist.get(phase)
@@ -291,6 +401,8 @@ class StepProfiler:
             return
         with self._lock:
             self.steps += 1
+            if handle._pipelined_mode:
+                self.pipelined_steps += 1
             self.wall_total += wall
             self.device_total += handle.device
             for phase, dt in handle.acc.items():
@@ -339,6 +451,15 @@ class StepProfiler:
                     "max_s": self.gap_max,
                     "mean_s": (self.gap_total / self.gap_count
                                if self.gap_count else 0.0),
+                },
+                # async-loop commit-lag view (docs/serving.md "Async
+                # dispatch loop"): how deep the pipeline currently is,
+                # how many dispatches landed on a busy device (gap 0),
+                # and how many steps were credited via pipelined()
+                "commit_lag": {
+                    "outstanding": self.outstanding,
+                    "pipelined_dispatches": self.pipelined_dispatches,
+                    "pipelined_steps": self.pipelined_steps,
                 },
                 "events_every": self.events_every,
             }

@@ -63,6 +63,12 @@ def run_continuous(eng, prompt, args):
               f"{st['failed']} failed")
     print(f"decode steps {st['decode_steps']}, occupancy "
           f"{st['slot_occupancy']:.2f}, traces {st['decode_traces']}")
+    al = st["async_loop"]
+    print(f"async loop: {'on' if al['enabled'] else 'off (sync)'} — "
+          f"{al['pipelined_steps']} pipelined steps, "
+          f"{sum(al['flushes'].values())} flushes, "
+          f"{al['discarded_tokens']} lag-1 tokens discarded, "
+          f"worker published {al['worker']['published']}")
     if st["prefix_caching"]:
         print(f"prefix cache: {st['prefix_cache_hits']} hits / "
               f"{st['prefix_cache_misses']} misses, "
@@ -172,6 +178,16 @@ def main():
                          "slot per step, greedy output unchanged "
                          "(continuous mode; docs/serving.md 'Per-slot "
                          "speculative decoding')")
+    ap.add_argument("--async-loop", dest="async_loop",
+                    action="store_true", default=True,
+                    help="pipelined dispatch with lag-1 host commit "
+                         "(the default — docs/serving.md 'Async "
+                         "dispatch loop'); see --sync-loop")
+    ap.add_argument("--sync-loop", dest="async_loop",
+                    action="store_false",
+                    help="force the synchronous serving loop "
+                         "(async_loop=false): dispatch, fetch, commit "
+                         "every step — the A/B baseline")
     ap.add_argument("--step-profile", action="store_true",
                     help="print the rolling serving-step phase "
                          "breakdown (admission/propose/dispatch/"
@@ -233,6 +249,7 @@ def main():
         knobs["prefill_chunk_tokens"] = args.prefill_chunk
     if args.speculate:
         knobs["speculation_tokens"] = args.speculate
+    knobs["async_loop"] = args.async_loop
     eng = deepspeed_tpu.init_inference(args.path, **knobs)
     prompt = [int(t) for t in args.prompt_ids.split(",")]
     if args.continuous:

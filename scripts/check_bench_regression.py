@@ -57,6 +57,10 @@ METRICS = {
     # device and its next program
     "step_profile.host_fraction": "down",
     "step_profile.dispatch_gap_p90_ms": "down",
+    # async serving loop (docs/serving.md "Async dispatch loop"): the
+    # pipelined-leg device-idle p90 from the ON/OFF A/B — a regression
+    # means the loop stopped closing the gap it exists to close
+    "async_loop.dispatch_gap_p90_ms": "down",
 }
 
 

@@ -373,6 +373,23 @@ def test_bench_serve_continuous_smoke():
     assert sp["parity_exact"] is True
     assert sp["verify_traces"] == 1
     assert sp["retraces_on"] == 0
+    # async dispatch loop A/B (auto in smoke, docs/serving.md "Async
+    # dispatch loop"): pipelined dispatch with lag-1 commit must close
+    # the device-idle gap (dispatch_gap_p90_ms strictly lower ON) and
+    # cut the host-tax share of step wall, at tokens/s no worse and
+    # greedy output token-identical to the synchronous loop
+    al = rec["async_loop"]
+    assert al["parity_exact"] is True
+    assert al["gap_improved"] is True
+    assert al["host_fraction_improved"] is True
+    assert al["tokens_per_s_no_worse"] is True
+    assert al["on"]["dispatch_gap_p90_ms"] < \
+        al["off"]["dispatch_gap_p90_ms"]
+    assert al["on"]["host_fraction"] < al["off"]["host_fraction"]
+    assert al["on"]["pipelined_steps"] >= 1
+    assert al["on"]["retraces"] == 0
+    assert al["on"]["decode_traces"] == 1     # zero new executables
+    assert al["off"]["pipelined_steps"] == 0  # the off-leg never chains
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm
