@@ -1564,6 +1564,143 @@ def phase_serve(args) -> dict:
             f"{a_on['tokens_per_s']} vs {a_off['tokens_per_s']} tok/s, "
             f"pipelined {a_on['pipelined_steps']} steps, parity="
             f"{out['async_loop']['parity_exact']}")
+
+    # ---- KV tiering A/B (docs/serving.md "KV quantization & host
+    # tiering"): int8 paged pool + host offload vs the fp baseline.
+    # Two claims, two measurements: (1) CAPACITY — the int8 pool at 2x
+    # the slots costs fewer device bytes per slot (capacity_ratio =
+    # fp bytes/slot over int8 bytes/slot, gated "up" across rounds by
+    # check_bench_regression) and actually sustains 2x the concurrent
+    # residents on a burst trace, at exact greedy parity with ONE
+    # decode executable; (2) TIERING — a rotating shared-prefix replay
+    # on a deliberately tight pool demotes cold blocks to host RAM and
+    # swaps them back on prefix hits, token-identical to a pool big
+    # enough to never evict, with host-tier bytes visible the way
+    # /debug/memory reports them.
+    kv_dtype = str(getattr(args, "kv_dtype", "") or "")
+    kv_off = bool(getattr(args, "kv_host_offload", False))
+    if smoke:
+        kv_dtype = kv_dtype or "int8"
+        kv_off = True
+    if kv_dtype == "int8":
+        from deepspeed_tpu.telemetry import TelemetryConfig
+        from deepspeed_tpu.telemetry.memory import get_memory_monitor
+        bs = scfg.block_size
+        s0 = scfg.num_slots
+        burst_n = 2 * s0 + 1
+        burst_reqs = [[1 + (11 * j + t) % (mcfg.vocab_size - 1)
+                       for t in range(bs - 2 + (j % 3))]
+                      for j in range(burst_n)]
+
+        def _cap_leg(dtype, slots):
+            """One capacity leg: submit the whole burst up front, track
+            the max concurrently-resident slot count while stepping."""
+            upd = {"kv_cache_dtype": dtype, "num_slots": slots,
+                   "max_out_tokens": 4 * bs,
+                   "telemetry": TelemetryConfig(trace_sample_rate=0.0)}
+            s = ContinuousBatchingServer(
+                InferenceEngine((mcfg, params),
+                                scfg.model_copy(update=upd)),
+                registry=MetricRegistry())
+            s.submit(burst_reqs[0], max_new_tokens=2)
+            s.drain()                          # warm the traces
+            rids = [s.submit(p, max_new_tokens=8) for p in burst_reqs]
+            max_res = 0
+            while not s.scheduler.idle:
+                s.step()
+                max_res = max(max_res, s.scheduler.active_slots)
+            s.drain()      # flush the async remnant
+            outs = [s.result(r) for r in rids]
+            st = s.stats
+            s.close()
+            return outs, st, max_res
+
+        # capacity legs run WITHOUT prefix caching: chunked prefill
+        # reads back quantized K/V mid-prompt (monolithic prefill
+        # attends the exact in-flight values), so int8-chunked vs fp
+        # is a different numeric path — the tiering replay below pins
+        # that comparison against an int8 golden instead
+        fp_out_t, fp_st, fp_res = _cap_leg("fp", s0)
+        i8_out_t, i8_st, i8_res = _cap_leg("int8", 2 * s0)
+        bps_fp = fp_st["kv_tier"]["pool_bytes"] / s0
+        bps_i8 = i8_st["kv_tier"]["pool_bytes"] / (2 * s0)
+        blob = {
+            "kv_dtype": "int8", "host_offload": kv_off,
+            "slots_fp": s0, "slots_int8": 2 * s0,
+            "pool_bytes_fp": fp_st["kv_tier"]["pool_bytes"],
+            "pool_bytes_int8": i8_st["kv_tier"]["pool_bytes"],
+            "bytes_per_slot_fp": round(bps_fp, 1),
+            "bytes_per_slot_int8": round(bps_i8, 1),
+            # THE headline: device KV bytes one resident slot costs,
+            # fp over int8 — how many more sequences the same HBM holds
+            "capacity_ratio": round(bps_fp / max(bps_i8, 1e-9), 3),
+            "max_resident_fp": fp_res,
+            "max_resident_int8": i8_res,
+            "parity_exact": bool(fp_out_t == i8_out_t),
+            "decode_traces_int8": i8_st["decode_traces"],
+            "retraces_int8": i8_st["retraces"],
+        }
+        if kv_off:
+            # tiering churn replay: 3 rotating 3-block prefixes on a
+            # 2-slot pool — the parked LRU overflows every cycle, so
+            # cold blocks demote and later hits swap them back in
+            tier_prefixes = [[1 + (s_ * 7 + t) % (mcfg.vocab_size - 1)
+                              for t in range(3 * bs)] for s_ in range(3)]
+            tier_reqs = [tier_prefixes[i % 3]
+                         + [7 + i % 40, 9, 4 + i % 5]
+                         for i in range(9 if smoke else 18)]
+
+            def _tier_leg(**kw):
+                upd = {"num_slots": 2, "max_out_tokens": 4 * bs,
+                       "enable_prefix_caching": True,
+                       "telemetry": TelemetryConfig(
+                           trace_sample_rate=0.0)}
+                upd.update(kw)
+                s = ContinuousBatchingServer(
+                    InferenceEngine((mcfg, params),
+                                    scfg.model_copy(update=upd)),
+                    registry=MetricRegistry())
+                outs = []
+                for p in tier_reqs:
+                    rid = s.submit(p, max_new_tokens=6)
+                    outs.append(s.drain()[rid])
+                st = s.stats
+                host_bytes = get_memory_monitor().snapshot(
+                    MetricRegistry()).get("host_components", {}).get(
+                    "kv_host_tier", {}).get("bytes", 0)
+                s.close()
+                return outs, st, host_bytes
+
+            # golden: the SAME int8 storage on a pool wide enough that
+            # nothing ever leaves HBM — the A/B isolates TIERING
+            # (demote -> hit -> swap-in must be byte-invisible), not
+            # quantization (the capacity legs above pin that)
+            golden_out, _, _ = _tier_leg(num_slots=8,
+                                         kv_cache_dtype="int8")
+            t_out, t_st, host_bytes = _tier_leg(
+                kv_cache_dtype="int8", kv_host_offload=True)
+            snap_t = t_st["kv_pool"] or {}
+            blob["offload"] = {
+                "requests": len(tier_reqs),
+                "demotions": t_st["kv_tier"]["demotions"],
+                "swap_ins": t_st["kv_tier"]["swap_ins"],
+                "host_blocks": t_st["kv_tier"]["host_blocks"],
+                "host_bytes": t_st["kv_tier"]["host_bytes"],
+                "evictions": t_st["prefix_cache_evictions"],
+                "preempted": t_st["preempted"],
+                "prefix_hits": t_st["prefix_cache_hits"],
+                "swap_outs_accounted": snap_t.get("swap_outs"),
+                "parity_exact": bool(t_out == golden_out),
+                "host_bytes_visible": bool(host_bytes > 0),
+            }
+        out["kv_tiering"] = blob
+        off_note = (f", offload: {blob['offload']['demotions']} demote/"
+                    f"{blob['offload']['swap_ins']} swap-in, parity="
+                    f"{blob['offload']['parity_exact']}"
+                    if kv_off else "")
+        log(f"kv-tiering A/B: capacity ratio {blob['capacity_ratio']}x "
+            f"bytes/slot, residents {i8_res} vs {fp_res}, parity="
+            f"{blob['parity_exact']}{off_note}")
     return out
 
 
@@ -2007,8 +2144,12 @@ PHASES = {
     # tokens/s, p50/p90 per-token latency, slot occupancy, and the
     # decode-step·slot-unit A/B (the head-of-line-blocking number)
     # --speculate 4: TPU rounds record the speculation blob too, so
-    # check_bench_regression can gate speculation.tokens_per_forward
-    "serve-continuous": (["--requests", "24", "--speculate", "4"], 900),
+    # check_bench_regression can gate speculation.tokens_per_forward;
+    # --kv-dtype int8 --kv-host-offload: the KV-tiering A/B rides along
+    # (capacity ratio, swap counts, parity) for the capacity_ratio gate
+    "serve-continuous": (["--requests", "24", "--speculate", "4",
+                          "--kv-dtype", "int8", "--kv-host-offload"],
+                         900),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
     # score tensor per head)
@@ -2426,6 +2567,23 @@ def main() -> None:
                          "Poisson trace, recording dispatch_gap_p90_ms, "
                          "step-profile host_fraction, tokens/s delta and "
                          "the exact-parity flag (auto in smoke mode)")
+    ap.add_argument("--kv-dtype", dest="kv_dtype", default="",
+                    choices=["", "fp", "int8"],
+                    help="serve-continuous: also run the KV-tiering A/B "
+                         "— paged-pool storage dtype int8 (per-block-"
+                         "per-head scales, VMEM dequant) at 2x the "
+                         "slots vs the fp baseline, recording bytes/"
+                         "slot capacity ratio, max resident slots, and "
+                         "the exact-parity flag (auto int8 in smoke "
+                         "mode)")
+    ap.add_argument("--kv-host-offload", dest="kv_host_offload",
+                    action="store_true",
+                    help="serve-continuous: arm host offload on the "
+                         "KV-tiering A/B's int8 leg and replay a "
+                         "rotating shared-prefix trace on a tight pool "
+                         "— records demotions, swap-ins, host-tier "
+                         "bytes, and parity vs a never-evicted pool "
+                         "(auto in smoke mode)")
     ap.add_argument("--train-numerics", dest="train_numerics",
                     action="store_true",
                     help="train phases: arm the in-graph numerics "

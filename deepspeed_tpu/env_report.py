@@ -138,6 +138,21 @@ def telemetry_info():
             "docs/serving.md 'Async dispatch loop')"
             if DeepSpeedInferenceConfig().async_loop else
             "off (set DeepSpeedInferenceConfig.async_loop=true)")
+        icfg = DeepSpeedInferenceConfig()
+        out["serve_kv_dtype"] = (
+            "int8 by default config (per-block-per-head scales, VMEM "
+            "dequant in the paged kernels)"
+            if icfg.kv_cache_dtype == "int8" else
+            "fp by default config (set kv_cache_dtype='int8' for ~2x "
+            "KV capacity — docs/serving.md 'KV quantization & host "
+            "tiering')")
+        out["serve_kv_host_offload"] = (
+            f"on by default config (cold prefix blocks demote to host "
+            f"RAM, cap {icfg.kv_host_blocks or 'unbounded'} blocks)"
+            if icfg.kv_host_offload else
+            "off (set kv_host_offload=true + enable_prefix_caching — "
+            "demotion replaces eviction, swap-in restores on prefix "
+            "hits)")
         fic = cfg.fault_injection
         out["fault_injection"] = (
             f"ARMED (seed {fic.seed}; step latency "

@@ -15,14 +15,15 @@ from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
 
 __all__ = ["DeepSpeedInferenceConfig", "DeepSpeedTPConfig",
            "DeepSpeedMoEConfig", "InferenceEngine", "KVCache", "init_cache",
-           "PagedKVCache", "init_paged_cache", "ContinuousBatchingServer",
-           "Request", "Scheduler"]
+           "PagedKVCache", "init_paged_cache", "HostKVTier",
+           "ContinuousBatchingServer", "Request", "Scheduler"]
 
 _LAZY = {"InferenceEngine": "deepspeed_tpu.inference.engine",
          "KVCache": "deepspeed_tpu.inference.kv_cache",
          "init_cache": "deepspeed_tpu.inference.kv_cache",
          "PagedKVCache": "deepspeed_tpu.inference.kv_cache",
          "init_paged_cache": "deepspeed_tpu.inference.kv_cache",
+         "HostKVTier": "deepspeed_tpu.inference.kv_cache",
          "ContinuousBatchingServer": "deepspeed_tpu.inference.server",
          "Request": "deepspeed_tpu.inference.scheduler",
          "Scheduler": "deepspeed_tpu.inference.scheduler"}

@@ -136,6 +136,28 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # latency collapses. Requires telemetry.slo.enabled with
     # queue_wait_p90_s set.
     enable_load_shedding: bool = False
+    # -------- KV tiering (docs/serving.md "KV quantization & host
+    # tiering") ---------------------------------------------------------
+    # paged-pool storage dtype: "fp" stores the engine's activation
+    # dtype; "int8" stores symmetric per-(position, head) int8 with
+    # amax/127 scale tiles carried beside the pool (ops/quant_core.py)
+    # — roughly half the KV HBM at bf16 serving (scales cost 4/head_dim
+    # per element), dequantized in-VMEM by the Pallas paged kernels and
+    # at the gather on the XLA fallback. Greedy smoke parity is pinned;
+    # the scales are data in the donated cache pytree, so the knob
+    # never changes a traced signature.
+    kv_cache_dtype: Literal["fp", "int8"] = "fp"
+    # host offload of cold paged blocks: prefix-LRU eviction becomes
+    # DEMOTION (payload moves to host RAM under its chain hash) and a
+    # later prefix hit swaps the block back into a freshly allocated
+    # device block — the pool serves past HBM. Requires
+    # enable_prefix_caching (only hashed prefix blocks have an identity
+    # to swap back in under). Demotion runs inside admission's
+    # allocation, i.e. before the preemption ladder ever fires.
+    kv_host_offload: bool = False
+    # host-tier capacity in blocks (None = unbounded): past it the
+    # OLDEST host payload drops for good, exactly like a plain eviction
+    kv_host_blocks: Optional[int] = None
     # pipelined dispatch with lag-1 host commit (docs/serving.md "Async
     # dispatch loop"): in steady-state decode the server dispatches
     # step N+1 from step N's device-resident outputs BEFORE fetching
@@ -225,6 +247,20 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
                 raise ValueError(
                     f"speculation_tokens ({self.speculation_tokens}) "
                     f"must not exceed block_size ({self.block_size})")
+        if self.kv_host_offload and not self.enable_prefix_caching:
+            raise ValueError(
+                "kv_host_offload demotes PREFIX blocks — it requires "
+                "enable_prefix_caching (a hashless block has no "
+                "identity to swap back in under)")
+        if self.kv_host_blocks is not None:
+            if not self.kv_host_offload:
+                raise ValueError(
+                    "kv_host_blocks bounds the host tier — it needs "
+                    "kv_host_offload enabled")
+            if self.kv_host_blocks < 1:
+                raise ValueError(
+                    f"kv_host_blocks must be >= 1 (or None for "
+                    f"unbounded), got {self.kv_host_blocks}")
 
     @property
     def tp_size(self) -> int:

@@ -11,13 +11,17 @@ values plus per-sequence live ``lengths [B]``. Allocation is explicit
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 from collections import OrderedDict
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
+
+from deepspeed_tpu.ops.quant_core import dequantize_int8, quantize_int8
 
 
 @struct.dataclass
@@ -172,11 +176,27 @@ class PagedKVCache:
     ``j*block_size .. (j+1)*block_size-1``); unallocated entries are 0
     (the null block).
     lengths: ``[num_slots]`` int32 live context length per slot.
-    """
-    k: jnp.ndarray             # [L, NB, BS, H, D]
+
+    int8 storage (``kv_cache_dtype: "int8"``): k/v hold int8 payloads
+    and ``k_scale``/``v_scale`` carry the per-block-per-head scale
+    tiles beside the pool — ``[L, NB, KH, BS]`` f32, one symmetric
+    amax/127 scale per written (position, head) row (ops/quant_core.py;
+    the SwitchBack per-axis idiom), laid out so a Pallas kernel's scale
+    block ``(1, 1, BS)`` puts the block_size positions on the lane dim.
+    Writers quantize on write; readers dequantize in-kernel (VMEM) or
+    at the gather. Scales are DATA in the same donated pytree — tier
+    membership and quantization never change a traced signature.
+    ``None`` scales = full-precision pool (the default)."""
+    k: jnp.ndarray             # [L, NB, BS, H, D] (fp or int8)
     v: jnp.ndarray             # [L, NB, BS, H, D]
     block_tables: jnp.ndarray  # [S, MB] int32
     lengths: jnp.ndarray       # [S] int32
+    k_scale: Optional[jnp.ndarray] = None   # [L, NB, KH, BS] f32 | None
+    v_scale: Optional[jnp.ndarray] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @property
     def block_size(self) -> int:
@@ -206,15 +226,44 @@ class PagedKVCache:
 def init_paged_cache(num_layers: int, num_slots: int, num_blocks: int,
                      block_size: int, max_blocks_per_slot: int,
                      num_kv_heads: int, head_dim: int,
-                     dtype=jnp.bfloat16) -> PagedKVCache:
+                     dtype=jnp.bfloat16,
+                     quantized: bool = False) -> PagedKVCache:
     """``num_blocks`` INCLUDES the reserved null block 0, so the usable
-    pool is ``num_blocks - 1`` blocks."""
+    pool is ``num_blocks - 1`` blocks. ``quantized=True`` builds the
+    int8 pool (payload dtype int8 regardless of ``dtype``) with
+    all-ones scale tiles — unwritten garbage dequantizes to exact
+    zeros, the same dead-memory story as the fp pool."""
     shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    pool_dtype = jnp.int8 if quantized else dtype
+
+    def scales():
+        # one array PER field: aliasing k_scale/v_scale to the same
+        # buffer would donate it twice in the serving jits
+        if not quantized:
+            return None
+        return jnp.ones(
+            (num_layers, num_blocks, num_kv_heads, block_size),
+            jnp.float32)
+
     return PagedKVCache(
-        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        k=jnp.zeros(shape, pool_dtype), v=jnp.zeros(shape, pool_dtype),
         block_tables=jnp.zeros((num_slots, max_blocks_per_slot),
                                jnp.int32),
-        lengths=jnp.zeros((num_slots,), jnp.int32))
+        lengths=jnp.zeros((num_slots,), jnp.int32),
+        k_scale=scales(), v_scale=scales())
+
+
+def _quant_rows(cache: PagedKVCache, x: jnp.ndarray):
+    """Writer-side quantization seam: for an int8 pool, quantize
+    ``[..., KH, D]`` per (position, head) row along D → (int8 payload,
+    scales ``[..., KH]``); for an fp pool, cast and carry no scales.
+    Every paged writer routes through here so the write-side scale
+    semantics cannot drift between the prompt/append/chunk/verify
+    paths."""
+    if cache.k_scale is None:
+        return x.astype(cache.k.dtype), None
+    q, s = quantize_int8(x, -1)
+    return q, s[..., 0]
 
 
 def paged_write_prompt(cache: PagedKVCache, layer: int, k: jnp.ndarray,
@@ -231,11 +280,31 @@ def paged_write_prompt(cache: PagedKVCache, layer: int, k: jnp.ndarray,
     nb = T // BS
     idx = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, 0
                                        )[0, :nb]            # [nb]
-    newk = cache.k.at[layer, idx].set(
-        k.astype(cache.k.dtype).reshape(nb, BS, *k.shape[1:]))
-    newv = cache.v.at[layer, idx].set(
-        v.astype(cache.v.dtype).reshape(nb, BS, *v.shape[1:]))
-    return cache.replace(k=newk, v=newv)
+    return _scatter_blocks(cache, layer, idx, k, v)
+
+
+def _scatter_blocks(cache: PagedKVCache, layer: int, idx: jnp.ndarray,
+                    k: jnp.ndarray, v: jnp.ndarray) -> PagedKVCache:
+    """Whole-block scatter shared by the prompt and chunk writers:
+    ``[nb*BS, H, D]`` k/v into pool blocks ``idx [nb]`` (quantizing per
+    (position, head) row when the pool is int8 — the scale tile scatter
+    rides the same indices)."""
+    BS = cache.block_size
+    nb = idx.shape[0]
+    qk, sk = _quant_rows(cache, k)
+    qv, sv = _quant_rows(cache, v)
+    newk = cache.k.at[layer, idx].set(qk.reshape(nb, BS, *k.shape[1:]))
+    newv = cache.v.at[layer, idx].set(qv.reshape(nb, BS, *v.shape[1:]))
+    out = cache.replace(k=newk, v=newv)
+    if sk is not None:
+        # [T, KH] -> per-block [nb, KH, BS] scale tiles
+        KH = k.shape[1]
+        skt = sk.reshape(nb, BS, KH).transpose(0, 2, 1)
+        svt = sv.reshape(nb, BS, KH).transpose(0, 2, 1)
+        out = out.replace(
+            k_scale=cache.k_scale.at[layer, idx].set(skt),
+            v_scale=cache.v_scale.at[layer, idx].set(svt))
+    return out
 
 
 def paged_append_token(cache: PagedKVCache, layer: int, k: jnp.ndarray,
@@ -248,9 +317,28 @@ def paged_append_token(cache: PagedKVCache, layer: int, k: jnp.ndarray,
     blk = jnp.take_along_axis(cache.block_tables,
                               (pos // BS)[:, None], axis=1)[:, 0]  # [S]
     off = pos % BS
-    newk = cache.k.at[layer, blk, off].set(k.astype(cache.k.dtype))
-    newv = cache.v.at[layer, blk, off].set(v.astype(cache.v.dtype))
-    return cache.replace(k=newk, v=newv)
+    return _scatter_positions(cache, layer, blk, off, k, v)
+
+
+def _scatter_positions(cache: PagedKVCache, layer: int, blk: jnp.ndarray,
+                       off: jnp.ndarray, k: jnp.ndarray,
+                       v: jnp.ndarray) -> PagedKVCache:
+    """Per-position scatter shared by the append and verify writers:
+    k/v ``[..., H, D]`` with leading dims matching ``blk``/``off``
+    (``[S]`` or ``[S, K]``), quantizing rows when the pool is int8.
+    The scale scatter uses the same (block, offset) pairs — mixed
+    advanced/slice indexing puts the advanced dims first, which is
+    exactly the ``[..., KH]`` shape :func:`_quant_rows` returns."""
+    qk, sk = _quant_rows(cache, k)
+    qv, sv = _quant_rows(cache, v)
+    newk = cache.k.at[layer, blk, off].set(qk)
+    newv = cache.v.at[layer, blk, off].set(qv)
+    out = cache.replace(k=newk, v=newv)
+    if sk is not None:
+        out = out.replace(
+            k_scale=cache.k_scale.at[layer, blk, :, off].set(sk),
+            v_scale=cache.v_scale.at[layer, blk, :, off].set(sv))
+    return out
 
 
 def paged_write_tokens(cache: PagedKVCache, layer: int, k: jnp.ndarray,
@@ -279,9 +367,7 @@ def paged_write_tokens(cache: PagedKVCache, layer: int, k: jnp.ndarray,
                               jnp.clip(pb, 0, MB - 1), axis=1)
     blk = jnp.where(pb < MB, blk, 0)       # overshoot -> null block
     off = pos % BS
-    newk = cache.k.at[layer, blk, off].set(k.astype(cache.k.dtype))
-    newv = cache.v.at[layer, blk, off].set(v.astype(cache.v.dtype))
-    return cache.replace(k=newk, v=newv)
+    return _scatter_positions(cache, layer, blk, off, k, v)
 
 
 def paged_write_chunk(cache: PagedKVCache, layer: int, k: jnp.ndarray,
@@ -306,21 +392,25 @@ def paged_write_chunk(cache: PagedKVCache, layer: int, k: jnp.ndarray,
     # earlier (possibly shared) blocks
     row = jnp.concatenate([row, jnp.zeros((nb,), jnp.int32)])
     idx = jax.lax.dynamic_slice_in_dim(row, start // BS, nb, 0)   # [nb]
-    newk = cache.k.at[layer, idx].set(
-        k.astype(cache.k.dtype).reshape(nb, BS, *k.shape[1:]))
-    newv = cache.v.at[layer, idx].set(
-        v.astype(cache.v.dtype).reshape(nb, BS, *v.shape[1:]))
-    return cache.replace(k=newk, v=newv)
+    return _scatter_blocks(cache, layer, idx, k, v)
 
 
 def paged_gather_slot_kv(cache: PagedKVCache, layer: int, slot: jnp.ndarray):
     """Materialize ONE slot's cache ``[1, max_context, H, D]`` through
     its block table — the chunk-attends-over-table gather (chunked
     prefill needs only the prefilling slot's context, not the whole
-    pool's num_slots rows like :func:`paged_gather_kv`)."""
+    pool's num_slots rows like :func:`paged_gather_kv`). An int8 pool
+    dequantizes at the gather (f32 out — the fused multiply is free
+    next to the gather's HBM traffic)."""
     row = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, 0)[0]
     k = cache.k[layer][row]        # [MB, BS, H, D]
     v = cache.v[layer][row]
+    if cache.k_scale is not None:
+        # scale tiles [MB, KH, BS] -> [MB, BS, KH, 1] against the pool
+        k = dequantize_int8(
+            k, cache.k_scale[layer][row].transpose(0, 2, 1)[..., None])
+        v = dequantize_int8(
+            v, cache.v_scale[layer][row].transpose(0, 2, 1)[..., None])
     return (k.reshape(1, cache.max_context, *k.shape[2:]),
             v.reshape(1, cache.max_context, *v.shape[2:]))
 
@@ -348,10 +438,17 @@ def paged_gather_kv(cache: PagedKVCache, layer: int):
     """Materialize per-slot caches ``[S, max_context, H, D]`` through the
     block tables — the pure-JAX decode fallback (CPU / ALiBi / windowed).
     Gathered position j is logical position j, so downstream masked
-    attention is bit-identical to the dense-cache path."""
+    attention is bit-identical to the dense-cache path. An int8 pool
+    dequantizes at the gather (f32 out)."""
     S, MB = cache.block_tables.shape
     k = cache.k[layer][cache.block_tables]   # [S, MB, BS, H, D]
     v = cache.v[layer][cache.block_tables]
+    if cache.k_scale is not None:
+        # scale tiles [S, MB, KH, BS] -> [S, MB, BS, KH, 1]
+        ks = cache.k_scale[layer][cache.block_tables]
+        vs = cache.v_scale[layer][cache.block_tables]
+        k = dequantize_int8(k, ks.transpose(0, 1, 3, 2)[..., None])
+        v = dequantize_int8(v, vs.transpose(0, 1, 3, 2)[..., None])
     return (k.reshape(S, cache.max_context, *k.shape[3:]),
             v.reshape(S, cache.max_context, *v.shape[3:]))
 
@@ -361,6 +458,155 @@ def paged_advance(cache: PagedKVCache, active: jnp.ndarray) -> PagedKVCache:
     their appends keep landing in the null block."""
     return cache.replace(
         lengths=cache.lengths + active.astype(jnp.int32))
+
+
+# ------------------------------------------------------------- host tier
+# ZeRO-Offload for the serving pool (PAPER.md §7 mapped to paged blocks):
+# a demoted block's payload (k/v slabs across all layers, plus scale
+# tiles for an int8 pool) moves to host RAM keyed by its chain hash;
+# the device block recycles. A later match_prefix hit on the hash swaps
+# the payload back into a freshly allocated block through the jitted
+# staging writer below — ONE traced signature per pool geometry (the
+# block id is a traced scalar), so tier membership never retraces the
+# serving programs.
+
+
+@jax.jit
+def _read_block_impl(cache: PagedKVCache, block):
+    def cut(a):
+        return jax.lax.dynamic_slice_in_dim(a, block, 1, 1)[:, 0]
+
+    if cache.k_scale is not None:
+        return (cut(cache.k), cut(cache.v),
+                cut(cache.k_scale), cut(cache.v_scale))
+    return cut(cache.k), cut(cache.v)
+
+
+def paged_read_block(cache: PagedKVCache, block: int) -> Dict[str, Any]:
+    """Device→host copy of one pool block's payload across all layers:
+    ``{"k": [L, BS, H, D], "v": ..., ("k_scale"/"v_scale": [L, KH, BS])}``
+    as numpy arrays (the demotion copy — ``np.asarray`` forces the
+    transfer, so by return the content is host-durable and the device
+    block is safe to recycle). The gather is jitted with the block id
+    as TRACED data — the same one-executable-per-pool-geometry
+    contract as :func:`paged_swap_in`, so demotions never grow the
+    compile cache however many distinct blocks tier out."""
+    out = _read_block_impl(cache, jnp.int32(block))
+    if len(out) == 4:
+        return {"k": np.asarray(out[0]), "v": np.asarray(out[1]),
+                "k_scale": np.asarray(out[2]),
+                "v_scale": np.asarray(out[3])}
+    return {"k": np.asarray(out[0]), "v": np.asarray(out[1])}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _swap_in_impl(cache: PagedKVCache, block, k, v, ks, vs):
+    newk = jax.lax.dynamic_update_slice(cache.k, k[:, None],
+                                        (0, block, 0, 0, 0))
+    newv = jax.lax.dynamic_update_slice(cache.v, v[:, None],
+                                        (0, block, 0, 0, 0))
+    out = cache.replace(k=newk, v=newv)
+    if ks is not None:
+        out = out.replace(
+            k_scale=jax.lax.dynamic_update_slice(
+                cache.k_scale, ks[:, None], (0, block, 0, 0)),
+            v_scale=jax.lax.dynamic_update_slice(
+                cache.v_scale, vs[:, None], (0, block, 0, 0)))
+    return out
+
+
+def paged_swap_in(cache: PagedKVCache, block: int,
+                  payload: Dict[str, Any]) -> PagedKVCache:
+    """Host→device copy of a demoted payload into pool ``block``: the
+    staging write is a single jitted donated scatter (one executable
+    per pool geometry — ``block`` rides as a traced scalar), so
+    swap-ins never grow the compile cache however many blocks cycle
+    through the tier."""
+    return _swap_in_impl(cache, jnp.int32(block),
+                         jnp.asarray(payload["k"]),
+                         jnp.asarray(payload["v"]),
+                         (jnp.asarray(payload["k_scale"])
+                          if "k_scale" in payload else None),
+                         (jnp.asarray(payload["v_scale"])
+                          if "v_scale" in payload else None))
+
+
+class HostKVTier:
+    """Host-RAM residency for demoted KV blocks, keyed by chain hash.
+
+    Pure host storage + bookkeeping: the BlockAllocator decides WHEN to
+    demote/swap in (its ``on_demote``/``on_swap_in`` callbacks do the
+    copies — the server owns the device arrays), this class only holds
+    payloads. Insertion order doubles as host-LRU: past ``max_blocks``
+    the oldest payload drops for good (its hash index is forgotten by
+    the allocator-side miss, so a later identical prefix re-prefills,
+    exactly like a plain eviction).
+
+    ``put`` on a hash that is already host-resident raises — a double
+    demote means two device blocks claimed the same chain hash, which
+    the first-writer-wins ``register_prefix`` contract rules out; going
+    quiet here would mask refcount corruption."""
+
+    def __init__(self, max_blocks: Optional[int] = None):
+        if max_blocks is not None and max_blocks < 1:
+            raise ValueError(
+                f"host tier max_blocks must be >= 1 (or None for "
+                f"unbounded), got {max_blocks}")
+        self.max_blocks = max_blocks
+        self._store: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
+        self._block_nbytes = 0    # payload size, learned at first put
+        self.swap_outs = 0        # payloads demoted into the tier
+        self.swap_ins = 0         # payloads promoted back to device
+        self.dropped = 0          # host-LRU drops (content gone for good)
+        self.superseded = 0       # payloads purged by device re-registration
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    @property
+    def host_bytes(self) -> int:
+        """Bytes parked in host RAM (every payload is the same size —
+        one pool block across all layers)."""
+        return len(self._store) * self._block_nbytes
+
+    def has(self, h: bytes) -> bool:
+        return h in self._store
+
+    def put(self, h: bytes, payload: Dict[str, Any]) -> None:
+        if h in self._store:
+            raise ValueError(
+                "double demote: chain hash already host-resident — two "
+                "device blocks claimed the same prefix hash")
+        if not self._block_nbytes:
+            self._block_nbytes = sum(int(a.nbytes)
+                                     for a in payload.values())
+        self._store[h] = payload
+        self.swap_outs += 1
+        while (self.max_blocks is not None
+               and len(self._store) > self.max_blocks):
+            self._store.popitem(last=False)
+            self.dropped += 1
+
+    def take(self, h: bytes) -> Dict[str, Any]:
+        """Pop one payload for swap-in (the content becomes device-
+        resident again under a registered hash; keeping a host copy
+        would let the two go stale against each other)."""
+        payload = self._store.pop(h)
+        self.swap_ins += 1
+        return payload
+
+    def discard(self, h: bytes) -> bool:
+        """Drop a host payload that just became REDUNDANT — the same
+        hash re-registered device-side (a bounded tier's capacity drop
+        can strand a descendant hash host-resident after its ancestor
+        dropped; the re-prefilled chain then re-registers it, and
+        without this purge the block's NEXT demotion would trip the
+        double-demote alarm on perfectly healthy state). Returns True
+        when a payload was dropped."""
+        if self._store.pop(h, None) is None:
+            return False
+        self.superseded += 1
+        return True
 
 
 class BlockAllocator:
@@ -389,13 +635,33 @@ class BlockAllocator:
     ``b in self._free`` scan made it O(n²) per sequence."""
 
     def __init__(self, num_blocks: int, enable_prefix_caching: bool = False,
-                 accountant=None):
+                 accountant=None, host_tier: Optional[HostKVTier] = None):
         if num_blocks < 2:
             raise ValueError(
                 f"need >= 2 pool blocks (1 usable + the null block), "
                 f"got {num_blocks}")
+        if host_tier is not None and not enable_prefix_caching:
+            raise ValueError(
+                "host offload tiers demoted PREFIX blocks — it needs "
+                "enable_prefix_caching (a hashless block has no "
+                "identity to swap back in under)")
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
+        # host offload (docs/serving.md "KV quantization & host
+        # tiering"): when set, an LRU pop DEMOTES the parked block's
+        # payload to host RAM instead of destroying it, and a
+        # match_prefix hit on a demoted hash swaps it back in. The
+        # copies are the owner's (the server holds the device arrays):
+        # on_demote(block, hash) must make the payload host-durable
+        # before returning, on_swap_in(block, payload) must write the
+        # already-reserved payload into the freshly allocated block.
+        # Until both callbacks are bound, demotion falls back to plain
+        # eviction — never silent data teleportation.
+        self.host_tier = host_tier
+        self.on_demote = None
+        self.on_swap_in = None
+        self.demotions = 0     # LRU pops that preserved content on host
+        self.swap_ins = 0      # host hits promoted back to device
         # pool lifetime/fragmentation accounting (telemetry/memory.py
         # KVPoolAccountant) or None — every hook sits behind a None
         # check, so an unaccounted allocator costs nothing extra
@@ -459,16 +725,30 @@ class BlockAllocator:
             b = self._free.pop()
             self._free_set.discard(b)
             return b
-        # free list dry: evict the least-recently-released cached block
-        # — its content is gone for good (the hash index forgets it), so
-        # a later identical prefix re-prefills and re-registers
+        # free list dry: pop the least-recently-released cached block.
+        # With a host tier armed this is a DEMOTION — the payload moves
+        # to host RAM under its chain hash and a later match_prefix hit
+        # swaps it back — and it runs during admission's allocation,
+        # i.e. BEFORE the server's preemption rung ever fires: famine
+        # demotes coldest-parked blocks first. Without a tier the
+        # content is gone for good (the hash index forgets it), so a
+        # later identical prefix re-prefills and re-registers.
         b, _ = self._lru.popitem(last=False)
-        self._drop_hash(b)
-        self.evictions += 1
-        if self.accountant is not None:
-            self.accountant.on_evict(b)
-        if self.on_evict is not None:
-            self.on_evict(b)
+        h = self._block_hash.get(b)
+        if (h is not None and self.host_tier is not None
+                and self.on_demote is not None):
+            self._drop_hash(b)
+            self.on_demote(b, h)   # device->host, durable on return
+            self.demotions += 1
+            if self.accountant is not None:
+                self.accountant.on_demote(b)
+        else:
+            self._drop_hash(b)
+            self.evictions += 1
+            if self.accountant is not None:
+                self.accountant.on_evict(b)
+            if self.on_evict is not None:
+                self.on_evict(b)
         return b
 
     def _drop_hash(self, b: int) -> None:
@@ -507,6 +787,8 @@ class BlockAllocator:
             "cached_blocks": len(self._hash_to_block),
             "reserved_blocks": self.reserved_blocks,
             "usable_blocks": self.usable_blocks,
+            "host_blocks": (len(self.host_tier)
+                            if self.host_tier is not None else 0),
         }
 
     @property
@@ -568,15 +850,21 @@ class BlockAllocator:
     def match_prefix(self, hashes) -> list:
         """Walk a prompt's chain hashes in prefix order, acquiring every
         consecutive hit (refcount++ on resident blocks, resurrection out
-        of the LRU for evictable ones). Stops at the first miss — a
-        deeper block is only valid under its full prefix chain. Returns
-        the acquired block ids; the caller allocates the tail and, on
-        tail-allocation failure, must ``release`` these."""
+        of the LRU for evictable ones, and — host tier armed — swap-in
+        of demoted blocks through :meth:`_swap_in_hit`). Stops at the
+        first miss — a deeper block is only valid under its full prefix
+        chain. Returns the acquired block ids; the caller allocates the
+        tail and, on tail-allocation failure, must ``release`` these
+        (a rolled-back swap-in parks device-side, content intact)."""
         out = []
         for h in hashes:
             b = self._hash_to_block.get(h)
             if b is None:
-                break
+                b = self._swap_in_hit(h)
+                if b is None:
+                    break
+                out.append(b)
+                continue
             if b in self._lru:
                 del self._lru[b]
                 self._refcount[b] = 1
@@ -587,6 +875,31 @@ class BlockAllocator:
                 self._refcount[b] = self._refcount[b] + 1
             out.append(b)
         return out
+
+    def _swap_in_hit(self, h: bytes):
+        """Promote one demoted (host-resident) block back to the device
+        for a prefix hit: POP the payload first (the staging
+        allocation below may itself demote a colder parked block, and
+        on a bounded tier that demotion's capacity drop could evict
+        exactly this hash — reserving the payload up front makes the
+        swap-in immune to its own staging), then allocate a block off
+        the free list, copy the payload in via the owner's callback,
+        and re-register the hash. Returns the block id, or None when
+        the hash is not host-resident (a true miss) or no block can
+        stage the swap-in."""
+        if (self.host_tier is None or self.on_swap_in is None
+                or not self.host_tier.has(h) or self.free_blocks < 1):
+            return None
+        payload = self.host_tier.take(h)
+        b = self._pop_free()
+        self._refcount[b] = 1
+        self.on_swap_in(b, payload)   # host->device into block b
+        self._hash_to_block[h] = b
+        self._block_hash[b] = h
+        self.swap_ins += 1
+        if self.accountant is not None:
+            self.accountant.on_acquire(b)
+        return b
 
     def register_prefix(self, block: int, h: bytes) -> bool:
         """Publish a live, fully-written prefix block under its chain
@@ -603,6 +916,14 @@ class BlockAllocator:
             return False
         self._hash_to_block[h] = block
         self._block_hash[block] = h
+        if self.host_tier is not None:
+            # invariant: a hash is never BOTH device-registered and
+            # host-resident. A bounded tier's capacity drop can strand
+            # a descendant hash on host after its chain ancestor
+            # dropped; when the re-prefilled chain re-registers it
+            # here, the stale host copy must go — otherwise this
+            # block's next demotion reads as a double demote.
+            self.host_tier.discard(h)
         return True
 
     def block_hash(self, block: int):

@@ -137,7 +137,7 @@ class Scheduler:
                  registry: Optional[MetricRegistry] = None,
                  enable_prefix_caching: bool = False,
                  tracer=None, spec_margin: int = 0,
-                 pool_accountant=None):
+                 pool_accountant=None, host_tier=None):
         self.num_slots = num_slots
         # speculative-verify overshoot (speculation_tokens - 1): every
         # request's block span reserves this many extra cache positions
@@ -157,9 +157,14 @@ class Scheduler:
         # allocator, the fragmentation gauge refreshes with the level
         # gauges at admission-state transitions
         self.accountant = pool_accountant
+        # host offload (docs/serving.md "KV quantization & host
+        # tiering"): the tier changes only what an LRU pop DOES with a
+        # parked block (demote vs destroy) and what a prefix hash walk
+        # can hit (host-resident blocks swap back in) — admission logic
+        # above the allocator is untouched
         self.allocator = BlockAllocator(
             num_blocks, enable_prefix_caching=enable_prefix_caching,
-            accountant=pool_accountant)
+            accountant=pool_accountant, host_tier=host_tier)
         self.queue: Deque[Request] = deque()
         self.slots: Dict[int, SlotState] = {}   # slot id -> state
         self._free_slots = list(range(num_slots - 1, -1, -1))

@@ -47,8 +47,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.async_loop import InFlightStep, PublishWorker
 from deepspeed_tpu.inference.engine import InferenceEngine, _bucket
-from deepspeed_tpu.inference.kv_cache import (PagedKVCache,
-                                              init_paged_cache)
+from deepspeed_tpu.inference.kv_cache import (HostKVTier, PagedKVCache,
+                                              init_paged_cache,
+                                              paged_read_block,
+                                              paged_swap_in)
 from deepspeed_tpu.inference.scheduler import Request, Scheduler
 from deepspeed_tpu.inference.speculation import (LookupIndex,
                                                  greedy_accept_host)
@@ -313,6 +315,21 @@ class ContinuousBatchingServer:
             help="tokens committed per active slot per verify forward "
                  "(1 = speculation wins nothing; up to "
                  "speculation_tokens on full acceptance)")
+        # -------- KV tiering (docs/serving.md "KV quantization & host
+        # tiering"): int8 pool storage and/or a host tier for demoted
+        # prefix blocks. Both are DATA changes on the same traced
+        # programs — the pool dtype and scale tiles ride the donated
+        # cache pytree, tier membership lives in host bookkeeping.
+        self.kv_dtype = cfg.kv_cache_dtype
+        self.host_tier = (HostKVTier(cfg.kv_host_blocks)
+                          if cfg.kv_host_offload else None)
+        # swap-thrash detector: rolling window of per-step swap-in
+        # counts (the allocator's counter, sampled at step cadence)
+        self._swap_window: Deque[int] = deque(
+            maxlen=self._SWAP_WINDOW_STEPS)
+        self._swap_seen = 0
+        self._swap_alarm = False
+        self._host_mem_getter = None
         self._submit_ts: Dict[int, float] = {}
         # when the request last ENTERED the queue (submit or preemption
         # requeue) — the shed guard's notion of "how long has this
@@ -334,8 +351,33 @@ class ContinuousBatchingServer:
             enable_prefix_caching=self.prefix_caching,
             tracer=self.tracer,
             spec_margin=max(self.spec_tokens - 1, 0),
-            pool_accountant=self._pool_acct)
+            pool_accountant=self._pool_acct,
+            host_tier=self.host_tier)
         self._cache = self._make_pool(num_blocks)
+        if self.host_tier is not None:
+            # the allocator decides WHEN to tier; the server owns the
+            # device arrays, so the copies are its callbacks. Both run
+            # only inside admission-time allocation — the sync body
+            # after any pipeline flush — so a tier copy can never race
+            # an in-flight donated step.
+            alloc = self.scheduler.allocator
+            alloc.on_demote = self._demote_block
+            alloc.on_swap_in = self._swap_in_block
+            # /debug/memory accounts the tier's host-RAM bytes beside
+            # the HBM buckets (weakref: a dropped server must not pin
+            # its payloads through the process-wide monitor)
+            import weakref
+
+            from deepspeed_tpu.telemetry.memory import get_memory_monitor
+            tier_ref = weakref.ref(self.host_tier)
+
+            def _host_bytes():
+                tier = tier_ref()
+                return 0 if tier is None else tier.host_bytes
+
+            self._host_mem_getter = _host_bytes
+            get_memory_monitor().register_host_component(
+                "kv_host_tier", _host_bytes)
         # flight recorder (telemetry/compile_watch.py): the serving jits
         # are watched, so a prompt shape that defeats the geometric
         # buckets shows up as a `retrace` event naming the argument that
@@ -466,6 +508,14 @@ class ContinuousBatchingServer:
     _SPEC_COLLAPSE_RATE = 0.05
     _SPEC_RECOVER_RATE = 0.10
 
+    # swap-thrash detector (host tiering): over the last
+    # _SWAP_WINDOW_STEPS steps, a mean swap-in rate above
+    # _KV_THRASH_SWAPS_PER_STEP fires one kv_swap_thrash ring event;
+    # the alarm re-arms at or below _KV_THRASH_RECOVER
+    _SWAP_WINDOW_STEPS = 32
+    _KV_THRASH_SWAPS_PER_STEP = 0.5
+    _KV_THRASH_RECOVER = 0.125
+
     def _init_flight_recorder(self, tcfg) -> None:
         """Arm the config-gated flight-recorder surfaces (see
         docs/observability.md "Flight recorder") via the shared
@@ -479,7 +529,13 @@ class ContinuousBatchingServer:
 
         def _pool():
             srv = ref()
-            return None if srv is None else (srv._cache.k, srv._cache.v)
+            if srv is None:
+                return None
+            c = srv._cache
+            # int8 pools carry their scale tiles in the same bucket —
+            # the pool's HBM cost is payload + scales
+            return ((c.k, c.v) if c.k_scale is None
+                    else (c.k, c.v, c.k_scale, c.v_scale))
 
         def _params():
             srv = ref()
@@ -556,7 +612,8 @@ class ContinuousBatchingServer:
         cache = init_paged_cache(
             mcfg.n_layer, self.num_slots, num_blocks, self.block_size,
             self.max_blocks_per_slot, mcfg.kv_heads, mcfg.head_dim,
-            dtype=self.engine._act_dtype)
+            dtype=self.engine._act_dtype,
+            quantized=self.kv_dtype == "int8")
         mesh = self.engine.mesh
         if mesh is not None:
             # kv heads shard over `tensor` exactly like the dense cache
@@ -566,7 +623,69 @@ class ContinuousBatchingServer:
             cache = cache.replace(
                 k=jax.device_put(cache.k, sh),
                 v=jax.device_put(cache.v, sh))
+            if cache.k_scale is not None:
+                # scale tiles [L, NB, KH, BS]: head dim follows the pool
+                ssh = NamedSharding(mesh, P(None, None, "tensor", None))
+                cache = cache.replace(
+                    k_scale=jax.device_put(cache.k_scale, ssh),
+                    v_scale=jax.device_put(cache.v_scale, ssh))
         return cache
+
+    # -------------------------------------------------- host-tier copies
+
+    def _demote_block(self, block: int, h: bytes) -> None:
+        """Allocator demotion callback: copy one parked block's payload
+        device→host (durable on return — ``np.asarray`` completes the
+        fetch) and park it in the tier under its chain hash. Runs only
+        inside admission-time allocation, which the step loop only
+        reaches with no step in flight, so the read can never see a
+        donated buffer."""
+        t0 = self._clock()
+        self.host_tier.put(h, paged_read_block(self._cache, block))
+        if self._pool_acct is not None:
+            self._pool_acct.observe_swap("out", self._clock() - t0,
+                                         len(self.host_tier))
+
+    def _swap_in_block(self, block: int, payload: dict) -> None:
+        """Allocator swap-in callback: write the (already tier-popped —
+        the allocator reserves it before its staging allocation can
+        displace it) host payload back into a freshly allocated device
+        block through the jitted, donated staging scatter (one
+        executable per pool geometry — the block id is traced data).
+        The dispatch is async; the decode program that next reads the
+        block chains behind it."""
+        t0 = self._clock()
+        self._cache = paged_swap_in(self._cache, block, payload)
+        if self._pool_acct is not None:
+            self._pool_acct.observe_swap("in", self._clock() - t0,
+                                         len(self.host_tier))
+
+    def _check_swap_thrash(self) -> None:
+        """Ring-event a swap-in storm ONCE per episode: over the rolling
+        window, a sustained swap-in rate above the threshold means
+        blocks are cycling device<->host faster than they serve — the
+        device pool is undersized for the live working set and each
+        admission is paying tier copies instead of cache hits. Re-arms
+        after the rate recovers (same episode discipline as the
+        speculation-collapse detector)."""
+        if self.host_tier is None:
+            return
+        swaps = self.scheduler.allocator.swap_ins
+        self._swap_window.append(swaps - self._swap_seen)
+        self._swap_seen = swaps
+        if len(self._swap_window) < self._SWAP_WINDOW_STEPS:
+            return
+        rate = sum(self._swap_window) / len(self._swap_window)
+        if not self._swap_alarm and rate > self._KV_THRASH_SWAPS_PER_STEP:
+            self._swap_alarm = True
+            get_event_ring().record(
+                telemetry_events.KV_SWAP_THRASH,
+                swap_ins_per_step=round(rate, 4),
+                window_steps=len(self._swap_window),
+                host_blocks=len(self.host_tier),
+                free_blocks=self.scheduler.allocator.free_blocks)
+        elif self._swap_alarm and rate <= self._KV_THRASH_RECOVER:
+            self._swap_alarm = False
 
     # ------------------------------------------------------------ API
 
@@ -1299,6 +1418,10 @@ class ContinuousBatchingServer:
         while guard > 0 and self._preempt_for_head(finished):
             guard -= 1
             self._admit(finished, sp)
+        # tier health: sample the admission round's swap-in traffic
+        # into the thrash window (demotion/swap-in only ever runs
+        # inside the admissions above)
+        self._check_swap_thrash()
         sp.mark("admission")
         self._run_prefill_chunk(finished, sp)
         sp.mark("prefill_chunk")
@@ -2080,6 +2203,11 @@ class ContinuousBatchingServer:
         if self.http_server is not None:
             self.http_server.close()
             self.http_server = None
+        if self._host_mem_getter is not None:
+            from deepspeed_tpu.telemetry.memory import get_memory_monitor
+            get_memory_monitor().unregister_component(
+                "kv_host_tier", self._host_mem_getter)
+            self._host_mem_getter = None
         # commit whatever is still in flight: a close() without a
         # drain() must not silently drop a pipelined step's committed
         # tokens, finishes, or metrics
@@ -2161,6 +2289,27 @@ class ContinuousBatchingServer:
                 if self._spec_slot_steps else None,
                 "verify_traces": (_safe_cache_size(self._verify_jit)
                                   if self._verify_jit is not None else 0),
+            },
+            # KV tiering (docs/serving.md "KV quantization & host
+            # tiering"): storage dtype, device pool bytes (scales
+            # included), and the host tier's residency + swap traffic
+            "kv_tier": {
+                "kv_dtype": self.kv_dtype,
+                "pool_bytes": int(
+                    self._cache.k.nbytes + self._cache.v.nbytes
+                    + (self._cache.k_scale.nbytes
+                       + self._cache.v_scale.nbytes
+                       if self._cache.k_scale is not None else 0)),
+                "host_offload": self.host_tier is not None,
+                "host_blocks": (len(self.host_tier)
+                                if self.host_tier is not None else 0),
+                "host_bytes": (self.host_tier.host_bytes
+                               if self.host_tier is not None else 0),
+                "host_dropped": (self.host_tier.dropped
+                                 if self.host_tier is not None else 0),
+                "demotions": alloc.demotions,
+                "swap_ins": alloc.swap_ins,
+                "thrash_alarm": self._swap_alarm,
             },
             "fault_injection": (self._fi.snapshot()
                                 if self._fi is not None else None),

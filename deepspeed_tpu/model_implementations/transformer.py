@@ -491,9 +491,20 @@ def _paged_decode_attention(q, cache: PagedKVCache, layer_idx: int,
         return paged_decode_attention(q, cache.k[layer_idx],
                                       cache.v[layer_idx],
                                       cache.block_tables, live,
-                                      scale=cfg.scale)
+                                      scale=cfg.scale,
+                                      **_pool_scales(cache, layer_idx))
     k_cache, v_cache = paged_gather_kv(cache, layer_idx)
     return _decode_attention(q, k_cache, v_cache, live, cfg, window=window)
+
+
+def _pool_scales(cache: PagedKVCache, layer_idx: int) -> dict:
+    """The per-layer scale-tile kwargs an int8 pool adds to a Pallas
+    paged-attention call (empty for fp pools — the call, and therefore
+    the traced signature, is unchanged)."""
+    if cache.k_scale is None:
+        return {}
+    return {"k_scale": cache.k_scale[layer_idx],
+            "v_scale": cache.v_scale[layer_idx]}
 
 
 def _chunk_attention(q, k_cache, v_cache, lengths,
@@ -549,7 +560,8 @@ def _paged_verify_attention(q, cache: PagedKVCache, layer_idx: int,
         return paged_verify_attention(q, cache.k[layer_idx],
                                       cache.v[layer_idx],
                                       cache.block_tables, cache.lengths,
-                                      scale=cfg.scale)
+                                      scale=cfg.scale,
+                                      **_pool_scales(cache, layer_idx))
     k_cache, v_cache = paged_gather_kv(cache, layer_idx)
     return _chunk_attention(q, k_cache, v_cache, cache.lengths, cfg,
                             window=window)
@@ -578,7 +590,8 @@ def _paged_chunk_attention(q, cache: PagedKVCache, layer_idx: int,
                                            0)[0]
         return paged_chunk_attention(q[0], cache.k[layer_idx],
                                      cache.v[layer_idx], row, start,
-                                     scale=cfg.scale)[None]
+                                     scale=cfg.scale,
+                                     **_pool_scales(cache, layer_idx))[None]
     k_cache, v_cache = paged_gather_slot_kv(cache, layer_idx, slot)
     return _chunk_attention(q, k_cache, v_cache,
                             jnp.reshape(start, (1,)).astype(jnp.int32),

@@ -31,20 +31,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.ops.quant_core import quantize_int8 as _quant
 
-def _quant(x: jax.Array, axis):
-    """Symmetric int8 along ``axis`` (int, tuple, or None = one scale for
-    the whole tensor): returns (q int8, scale f32 broadcastable against
-    x). One definition of the clip/round/zero-amax pattern for this
-    module; the serving-side twin lives in ops/int8_gemm.py (separate on
-    purpose — it quantizes against STORED {"q","oscale"} trees, not live
-    bf16)."""
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=axis,
-                   keepdims=axis is not None)
-    s = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
-    return q, s
+# _quant: symmetric int8 along an axis with the amax/127 scale — the
+# shared definition lives in ops/quant_core.py (also the int8 paged KV
+# cache's writer quantizer, inference/kv_cache.py). The serving-side
+# weight path (ops/int8_gemm.py) stays separate on purpose — it
+# quantizes against STORED {"q","oscale"} trees, not live bf16.
 
 
 def _quant_lastdim(x: jax.Array):

@@ -33,6 +33,47 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 DEFAULT_BLOCK_K = 256
 
+# int8 paged pools (kv_cache_dtype: "int8", docs/serving.md "KV
+# quantization & host tiering"): the paged kernels take the pool in its
+# quantized storage layout plus per-block-per-head scale tiles
+# ``[NB, KH, BS]`` (one amax/127 scale per written (position, head) row,
+# block_size on the LANE dim so the scale block ``(1, 1, BS)`` loads
+# contiguous lanes). Dequantization happens on the tile already in VMEM
+# (int8 load * f32 scale), so the HBM stream is the int8 bytes — the
+# whole point: decode is KV-bandwidth-bound and the cache just halved.
+
+
+def _deq_tile(x_ref, s_ref, quantized: bool):
+    """One K/V tile ``[BS, D]`` in f32 — int8 tiles multiply by their
+    ``[BS]`` scale column in VMEM; fp tiles just upcast."""
+    x = x_ref[0, :, 0, :].astype(jnp.float32)
+    if quantized:
+        x = x * s_ref[0, 0, :][:, None]
+    return x
+
+
+def _dequant_pools(k_pool, v_pool, k_scale, v_scale):
+    """XLA-side pool dequantization for the reference oracles: scales
+    ``[NB, KH, BS]`` broadcast against the ``[NB, BS, KH, D]`` pool."""
+    from deepspeed_tpu.ops.quant_core import dequantize_int8
+    if k_scale is None:
+        return k_pool, v_pool
+    k = dequantize_int8(k_pool,
+                        jnp.transpose(k_scale, (0, 2, 1))[..., None])
+    v = dequantize_int8(v_pool,
+                        jnp.transpose(v_scale, (0, 2, 1))[..., None])
+    return k, v
+
+
+def _scale_specs(quantized: bool, BS: int, index_map):
+    """The two extra in_specs an int8 pool adds (k_scale, v_scale) —
+    empty for fp, so the fp kernel signature is byte-identical to the
+    pre-quantization one."""
+    if not quantized:
+        return []
+    spec = pl.BlockSpec((1, 1, BS), index_map)
+    return [spec, spec]
+
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
                    scale: float):
@@ -120,16 +161,22 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return og.reshape(B, H, D)
 
 
-def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_ref, l_ref, acc_ref, *, block_size: int,
-                         scale: float):
+def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, *rest,
+                         block_size: int, scale: float, quantized: bool):
     """Grid (slot, kv-head, block-table entry). The index maps gather K/V
     blocks straight out of the global pool through the scalar-prefetched
     block table — the kernel body only ever sees one ``[BS, D]`` block at
     logical position ``i*BS``, so no per-slot contiguous cache is ever
     materialized in HBM. Online-softmax state carries across the block
     dimension in VMEM scratch (the block axis is innermost, so one
-    (slot, head) program's blocks run back-to-back on the core)."""
+    (slot, head) program's blocks run back-to-back on the core). An int8
+    pool streams two extra ``[1, 1, BS]`` scale tiles per block and
+    dequantizes in VMEM (:func:`_deq_tile`)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     s, i = pl.program_id(0), pl.program_id(2)
     length = len_ref[s]
     nb = pl.num_programs(2)
@@ -144,8 +191,8 @@ def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     def _update():
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [R, D]
         R = q.shape[0]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)        # [BS, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        k = _deq_tile(k_ref, ks_ref, quantized)          # [BS, D]
+        v = _deq_tile(v_ref, vs_ref, quantized)
         sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         col = i * block_size + jax.lax.broadcasted_iota(
@@ -171,7 +218,9 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                            v_pool: jax.Array, block_tables: jax.Array,
                            lengths: jax.Array,
                            scale: float | None = None,
-                           interpret: bool | None = None) -> jax.Array:
+                           interpret: bool | None = None,
+                           k_scale: jax.Array | None = None,
+                           v_scale: jax.Array | None = None) -> jax.Array:
     """One-token attention through a paged KV pool, GQA-native.
 
     q: ``[S, H, D]`` (one query per slot); k_pool/v_pool:
@@ -179,6 +228,11 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     block_tables: ``[S, MB]`` int32 (entry j covers logical positions
     ``j*BS..(j+1)*BS-1``; dead entries must be valid ids — the null
     block); lengths: ``[S]`` int32 live lengths. Returns ``[S, H, D]``.
+
+    int8 pools pass ``k_scale``/``v_scale`` ``[NB, KH, BS]`` and the
+    kernel dequantizes each tile in VMEM — the grid, scratch, and
+    online-softmax recurrence are unchanged (scales are two more
+    streamed inputs, not a new program structure).
 
     Entirely-dead blocks (``i*BS >= lengths[s]``) are skipped by a
     ``pl.when`` guard, so an idle slot costs no VPU/MXU work beyond its
@@ -189,6 +243,10 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     MB = block_tables.shape[1]
     if H % KH:
         raise ValueError(f"q heads {H} not divisible by kv heads {KH}")
+    quantized = k_scale is not None
+    if (k_pool.dtype == jnp.int8) != quantized:
+        raise ValueError("int8 pools require k_scale/v_scale (and fp "
+                         "pools must not pass them)")
     R = H // KH
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -197,7 +255,7 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
 
     qg = q.reshape(S, KH, R, D)
     kernel = functools.partial(_paged_decode_kernel, block_size=BS,
-                               scale=float(scale))
+                               scale=float(scale), quantized=quantized)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, KH, MB),
@@ -208,7 +266,8 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                          (bt[s, i], 0, h, 0)),
             pl.BlockSpec((1, BS, 1, D), lambda s, h, i, lens, bt:
                          (bt[s, i], 0, h, 0)),
-        ],
+        ] + _scale_specs(quantized, BS, lambda s, h, i, lens, bt:
+                         (bt[s, i], h, 0)),
         out_specs=pl.BlockSpec((1, 1, R, D), lambda s, h, i, lens, bt:
                                (s, h, 0, 0)),
         scratch_shapes=[
@@ -217,19 +276,22 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
             pltpu.VMEM((R, D), jnp.float32),
         ],
     )
+    args = [lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
+            qg, k_pool, v_pool]
+    if quantized:
+        args += [k_scale, v_scale]
     og = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KH, R, D), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
-      qg, k_pool, v_pool)
+    )(*args)
     return og.reshape(S, H, D)
 
 
-def _paged_chunk_kernel(start_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                        m_ref, l_ref, acc_ref, *, block_size: int,
-                        rep: int, scale: float):
+def _paged_chunk_kernel(start_ref, bt_ref, q_ref, k_ref, v_ref, *rest,
+                        block_size: int, rep: int, scale: float,
+                        quantized: bool):
     """Chunked-prefill attention for ONE slot: grid (kv-head,
     block-table entry). Queries are the in-flight C-token chunk at
     absolute positions ``start..start+C-1``; keys stream out of the
@@ -241,6 +303,11 @@ def _paged_chunk_kernel(start_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     Online-softmax carry in VMEM scratch across the (innermost) block
     axis — the same recurrence as :func:`_paged_decode_kernel`, with
     the query dim widened from one token's head group to C·R rows."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     i = pl.program_id(1)
     nb = pl.num_programs(1)
     start = start_ref[0]
@@ -256,8 +323,8 @@ def _paged_chunk_kernel(start_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(i * block_size <= start + CR // rep - 1)
     def _update():
         q = q_ref[0].astype(jnp.float32) * scale         # [CR, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)        # [BS, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        k = _deq_tile(k_ref, ks_ref, quantized)          # [BS, D]
+        v = _deq_tile(v_ref, vs_ref, quantized)
         sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         col = i * block_size + jax.lax.broadcasted_iota(
@@ -284,7 +351,9 @@ def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
                           v_pool: jax.Array, block_table: jax.Array,
                           start: jax.Array,
                           scale: float | None = None,
-                          interpret: bool | None = None) -> jax.Array:
+                          interpret: bool | None = None,
+                          k_scale: jax.Array | None = None,
+                          v_scale: jax.Array | None = None) -> jax.Array:
     """Chunked-prefill attention for one slot through the paged pool,
     GQA-native.
 
@@ -293,13 +362,18 @@ def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
     into the pool); k_pool/v_pool: ``[NB, BS, KH, D]``; block_table:
     ``[MB]`` int32 (the prefilling slot's row; dead entries must be
     valid ids — the null block); start: scalar int32, block-aligned.
-    Returns ``[C, H, D]``.
+    int8 pools pass ``k_scale``/``v_scale`` ``[NB, KH, BS]`` (VMEM
+    dequant, same grid). Returns ``[C, H, D]``.
     """
     C, H, D = q.shape
     BS, KH = k_pool.shape[1], k_pool.shape[2]
     MB = block_table.shape[0]
     if H % KH:
         raise ValueError(f"q heads {H} not divisible by kv heads {KH}")
+    quantized = k_scale is not None
+    if (k_pool.dtype == jnp.int8) != quantized:
+        raise ValueError("int8 pools require k_scale/v_scale (and fp "
+                         "pools must not pass them)")
     R = H // KH
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -310,7 +384,8 @@ def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
     # query index recoverable in-kernel as row // R
     qg = q.reshape(C, KH, R, D).transpose(1, 0, 2, 3).reshape(KH, C * R, D)
     kernel = functools.partial(_paged_chunk_kernel, block_size=BS,
-                               rep=R, scale=float(scale))
+                               rep=R, scale=float(scale),
+                               quantized=quantized)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(KH, MB),
@@ -320,7 +395,8 @@ def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
                          (bt[i], 0, h, 0)),
             pl.BlockSpec((1, BS, 1, D), lambda h, i, st, bt:
                          (bt[i], 0, h, 0)),
-        ],
+        ] + _scale_specs(quantized, BS, lambda h, i, st, bt:
+                         (bt[i], h, 0)),
         out_specs=pl.BlockSpec((1, C * R, D), lambda h, i, st, bt:
                                (h, 0, 0)),
         scratch_shapes=[
@@ -329,19 +405,22 @@ def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
             pltpu.VMEM((C * R, D), jnp.float32),
         ],
     )
+    args = [jnp.reshape(start, (1,)).astype(jnp.int32),
+            block_table.astype(jnp.int32), qg, k_pool, v_pool]
+    if quantized:
+        args += [k_scale, v_scale]
     og = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((KH, C * R, D), q.dtype),
         interpret=interpret,
-    )(jnp.reshape(start, (1,)).astype(jnp.int32),
-      block_table.astype(jnp.int32), qg, k_pool, v_pool)
+    )(*args)
     return og.reshape(KH, C, R, D).transpose(1, 0, 2, 3).reshape(C, H, D)
 
 
-def _paged_verify_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_ref, l_ref, acc_ref, *, block_size: int,
-                         rep: int, spec: int, scale: float):
+def _paged_verify_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, *rest,
+                         block_size: int, rep: int, spec: int,
+                         scale: float, quantized: bool):
     """Speculative-verify attention for ALL slots: grid (slot, kv-head,
     block-table entry). Queries are each slot's K-token candidate chunk
     at absolute positions ``lengths[s]..lengths[s]+K-1`` (the chunk's
@@ -352,6 +431,11 @@ def _paged_verify_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     :func:`_paged_chunk_kernel`, with the per-slot ``lengths`` playing
     the chunk kernel's ``start`` role — so varying acceptance lengths
     ride as data, never as a new signature."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     s, i = pl.program_id(0), pl.program_id(2)
     nb = pl.num_programs(2)
     length = len_ref[s]
@@ -368,8 +452,8 @@ def _paged_verify_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(i * block_size <= length + spec - 1)
     def _update():
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [K*R, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)        # [BS, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        k = _deq_tile(k_ref, ks_ref, quantized)          # [BS, D]
+        v = _deq_tile(v_ref, vs_ref, quantized)
         sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         col = i * block_size + jax.lax.broadcasted_iota(
@@ -396,7 +480,9 @@ def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
                            v_pool: jax.Array, block_tables: jax.Array,
                            lengths: jax.Array,
                            scale: float | None = None,
-                           interpret: bool | None = None) -> jax.Array:
+                           interpret: bool | None = None,
+                           k_scale: jax.Array | None = None,
+                           v_scale: jax.Array | None = None) -> jax.Array:
     """Batched speculative-verify attention through a paged KV pool,
     GQA-native.
 
@@ -409,12 +495,18 @@ def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
 
     ONE kernel signature per ``(K, num_slots, block geometry)`` —
     per-slot acceptance state rides in ``lengths``, so varying
-    acceptance never retraces (the PR-8 trace-discipline contract)."""
+    acceptance never retraces (the PR-8 trace-discipline contract).
+    int8 pools pass ``k_scale``/``v_scale`` ``[NB, KH, BS]`` (VMEM
+    dequant, same grid)."""
     S, K, H, D = q.shape
     BS, KH = k_pool.shape[1], k_pool.shape[2]
     MB = block_tables.shape[1]
     if H % KH:
         raise ValueError(f"q heads {H} not divisible by kv heads {KH}")
+    quantized = k_scale is not None
+    if (k_pool.dtype == jnp.int8) != quantized:
+        raise ValueError("int8 pools require k_scale/v_scale (and fp "
+                         "pools must not pass them)")
     R = H // KH
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -426,7 +518,8 @@ def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
     qg = q.reshape(S, K, KH, R, D).transpose(0, 2, 1, 3, 4).reshape(
         S, KH, K * R, D)
     kernel = functools.partial(_paged_verify_kernel, block_size=BS,
-                               rep=R, spec=K, scale=float(scale))
+                               rep=R, spec=K, scale=float(scale),
+                               quantized=quantized)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, KH, MB),
@@ -437,7 +530,8 @@ def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
                          (bt[s, i], 0, h, 0)),
             pl.BlockSpec((1, BS, 1, D), lambda s, h, i, lens, bt:
                          (bt[s, i], 0, h, 0)),
-        ],
+        ] + _scale_specs(quantized, BS, lambda s, h, i, lens, bt:
+                         (bt[s, i], h, 0)),
         out_specs=pl.BlockSpec((1, 1, K * R, D), lambda s, h, i, lens, bt:
                                (s, h, 0, 0)),
         scratch_shapes=[
@@ -446,22 +540,27 @@ def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
             pltpu.VMEM((K * R, D), jnp.float32),
         ],
     )
+    args = [lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
+            qg, k_pool, v_pool]
+    if quantized:
+        args += [k_scale, v_scale]
     og = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KH, K * R, D), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
-      qg, k_pool, v_pool)
+    )(*args)
     return og.reshape(S, KH, K, R, D).transpose(0, 2, 1, 3, 4).reshape(
         S, K, H, D)
 
 
 def paged_verify_attention_reference(q, k_pool, v_pool, block_tables,
-                                     lengths):
+                                     lengths, k_scale=None, v_scale=None):
     """Numerics oracle for :func:`paged_verify_attention`: gather each
     slot's cache through its table, dense masked softmax with the
-    per-query causal bound ``col <= lengths[s] + qi``."""
+    per-query causal bound ``col <= lengths[s] + qi``. int8 pools
+    dequantize up front (:func:`_dequant_pools`)."""
+    k_pool, v_pool = _dequant_pools(k_pool, v_pool, k_scale, v_scale)
     S, K, H, D = q.shape
     BS, KH = k_pool.shape[1], k_pool.shape[2]
     MB = block_tables.shape[1]
@@ -480,10 +579,13 @@ def paged_verify_attention_reference(q, k_pool, v_pool, block_tables,
                       vc.astype(jnp.float32)).astype(q.dtype)
 
 
-def paged_chunk_attention_reference(q, k_pool, v_pool, block_table, start):
+def paged_chunk_attention_reference(q, k_pool, v_pool, block_table, start,
+                                    k_scale=None, v_scale=None):
     """Numerics oracle for :func:`paged_chunk_attention`: gather the
     slot's cache through its table, dense masked softmax with the
-    per-query causal bound ``col <= start + qi``."""
+    per-query causal bound ``col <= start + qi``. int8 pools
+    dequantize up front."""
+    k_pool, v_pool = _dequant_pools(k_pool, v_pool, k_scale, v_scale)
     C, H, D = q.shape
     BS, KH = k_pool.shape[1], k_pool.shape[2]
     MB = block_table.shape[0]
@@ -503,11 +605,12 @@ def paged_chunk_attention_reference(q, k_pool, v_pool, block_table, start):
 
 
 def paged_decode_attention_reference(q, k_pool, v_pool, block_tables,
-                                     lengths):
+                                     lengths, k_scale=None, v_scale=None):
     """Numerics oracle: gather each slot's cache through its block table
     (gathered position j IS logical position j), then run the dense
     masked-softmax reference. Same layouts as
-    :func:`paged_decode_attention`."""
+    :func:`paged_decode_attention`; int8 pools dequantize up front."""
+    k_pool, v_pool = _dequant_pools(k_pool, v_pool, k_scale, v_scale)
     S, MB = block_tables.shape
     BS = k_pool.shape[1]
     kc = k_pool[block_tables].reshape(S, MB * BS, *k_pool.shape[2:])

@@ -1,0 +1,53 @@
+"""Per-axis symmetric int8 quantization core — ONE home for the scale
+idiom.
+
+Three subsystems quantize along an axis with a symmetric amax/127 scale:
+SwitchBack int8 training (``ops/int8_training.py``, per-token activation
+and per-output-column weight scales), the serving weight path
+(``ops/int8_gemm.py`` / ``module_inject/quantize.py``, which quantize
+against STORED ``{"q", "scale"}`` trees and stay separate on purpose),
+and — as of the KV-tiering PR — the int8 paged KV cache
+(``inference/kv_cache.py``), whose writers quantize each written token's
+``[H, D]`` rows on the fly and whose attention kernels dequantize tiles
+in VMEM. This module is the single definition of the
+clip/round/zero-amax pattern the first and third share, so a numerics
+fix (the zero-amax guard, the 127-not-128 clip) lands once.
+
+Contract (pinned by tests/test_kv_tiering.py round-trip properties):
+
+* ``scale = amax / 127`` along ``axis`` (or one scale for the whole
+  tensor when ``axis=None``); an all-zero slice gets scale 1.0 so the
+  dequant is exact zero, never 0/0.
+* ``q = clip(round(x / scale), -127, 127)`` — symmetric, -128 unused
+  (the asymmetric extra level is not worth breaking negation symmetry).
+* round-trip error is elementwise bounded by ``scale / 2`` (round-
+  to-nearest of an in-range value), i.e. relative to the slice amax the
+  error never exceeds ``1/254``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+INT8_QMAX = 127.0
+
+
+def quantize_int8(x: jax.Array, axis):
+    """Symmetric int8 along ``axis`` (int, tuple, or None = one scale
+    for the whole tensor): returns ``(q int8, scale f32)`` with the
+    scale broadcastable against ``x`` (kept dims of size 1 along
+    ``axis`` when ``axis`` is not None)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=axis is not None)
+    s = jnp.where(amax > 0, amax / INT8_QMAX, 1.0)
+    q = jnp.clip(jnp.round(xf / s), -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array,
+                    dtype=jnp.float32) -> jax.Array:
+    """``q * scale`` in f32, cast to ``dtype`` — the inverse of
+    :func:`quantize_int8` up to the ``scale/2`` rounding bound. The
+    multiply fuses into a consuming matmul under XLA, so dequantizing
+    at a gather site costs no extra HBM round trip."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)

@@ -60,6 +60,12 @@ SERVER_STEP_PROFILE = "server_step_profile"
 # the pool could not cover froze the allocator state here — one event
 # per famine episode, re-armed by the next successful allocation
 POOL_FAMINE = "pool_famine"
+# KV host tiering (docs/serving.md "KV quantization & host tiering"):
+# the swap-in rate over the rolling window crossed the thrash
+# threshold — blocks are cycling device<->host faster than they serve,
+# so the pool is undersized for the working set; one event per
+# episode, re-armed when the rate recovers
+KV_SWAP_THRASH = "kv_swap_thrash"
 
 
 class EventRing:

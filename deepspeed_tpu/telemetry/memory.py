@@ -39,6 +39,10 @@ class MemoryMonitor:
     def __init__(self):
         self._lock = threading.RLock()
         self._components: Dict[str, Callable[[], object]] = {}
+        # host-RAM residents (numpy payloads — the KV host tier): they
+        # never appear in jax.live_arrays(), so they get their own
+        # bucket family instead of id-matching
+        self._host_components: Dict[str, Callable[[], int]] = {}
         self._sampler: Optional[threading.Thread] = None
         self._sampler_stop: Optional[threading.Event] = None
 
@@ -52,17 +56,31 @@ class MemoryMonitor:
         with self._lock:
             self._components[name] = getter
 
+    def register_host_component(self, name: str,
+                                bytes_getter: Callable[[], int]) -> None:
+        """Register (or replace) a HOST-memory component — something
+        holding plain numpy buffers (the serving KV host tier,
+        ``inference/kv_cache.py HostKVTier``) that device-array
+        accounting can never see. ``bytes_getter`` returns its current
+        byte count; snapshots report it under ``host_components`` and
+        the ``memory_host_component_bytes`` gauge so ``/debug/memory``
+        answers "who holds host RAM" the way it answers for HBM."""
+        with self._lock:
+            self._host_components[name] = bytes_getter
+
     def unregister_component(self, name: str,
                              getter: Optional[Callable] = None) -> None:
-        """Remove a component. Pass the ``getter`` you registered to
-        make the removal owner-safe: if another engine has since
-        re-registered the same name (two engines in one process both
-        claim ``params``), their registration is left alone."""
+        """Remove a component (device or host). Pass the ``getter`` you
+        registered to make the removal owner-safe: if another engine
+        has since re-registered the same name (two engines in one
+        process both claim ``params``), their registration is left
+        alone."""
         with self._lock:
-            if getter is not None and \
-                    self._components.get(name) is not getter:
-                return
-            self._components.pop(name, None)
+            for table in (self._components, self._host_components):
+                if name in table:
+                    if getter is None or table[name] is getter:
+                        del table[name]
+                    return
 
     @property
     def components(self) -> List[str]:
@@ -80,6 +98,7 @@ class MemoryMonitor:
         reg = registry or get_registry()
         with self._lock:
             getters = dict(self._components)
+            host_getters = dict(self._host_components)
         # leaf id -> component (first registration wins on overlap;
         # overlap means two components share a buffer — counted once)
         owner: Dict[int, str] = {}
@@ -122,8 +141,27 @@ class MemoryMonitor:
                   ).set(total_bytes)
         reg.gauge("memory_live_arrays_total",
                   help="count of live jax arrays").set(total_arrays)
+        # host-RAM residents (the KV host tier): numpy payloads never
+        # show up in live_arrays — their owners report byte counts
+        # directly, so /debug/memory accounts host-tier bytes beside
+        # the HBM buckets
+        host: Dict[str, dict] = {}
+        for name, bytes_getter in host_getters.items():
+            try:
+                nbytes = int(bytes_getter())
+            except Exception:  # noqa: BLE001 — a dead getter ≠ no snapshot
+                continue
+            host[name] = {"bytes": nbytes}
+            reg.gauge(
+                "memory_host_component_bytes",
+                help="host-RAM bytes by registered host component "
+                     "(numpy payloads outside jax.live_arrays — e.g. "
+                     "the serving KV host tier)",
+                labels={"component": name}).set(nbytes)
         out = {"components": buckets, "total_bytes": total_bytes,
                "total_arrays": total_arrays,
+               "host_components": host,
+               "host_bytes_total": sum(b["bytes"] for b in host.values()),
                "devices": self._device_stats(reg)}
         return out
 
@@ -250,6 +288,9 @@ class KVPoolAccountant:
         self._famine_armed = True
         self._frag_tick = 0
         self.famines = 0
+        self.swap_ins = 0       # host-tier promotions (mirrors)
+        self.swap_outs = 0      # host-tier demotions
+        self.last_host_blocks = 0
         self.last_fragmentation = 1.0
         self.last_longest_run = 0
         reg = self.registry
@@ -268,6 +309,29 @@ class KVPoolAccountant:
             help="per-request peak pool blocks held across all of the "
                  "request's residencies (observed at finish)",
             buckets=BLOCK_COUNT_BUCKETS)
+        # host tier (docs/serving.md "KV quantization & host tiering"):
+        # swap traffic + residency — the numbers that say whether the
+        # tier is extending capacity (occasional demote, rare swap-in)
+        # or thrashing (the kv_swap_thrash ring event's inputs)
+        self._c_swap_in = reg.counter(
+            "serve_kv_swap_in_total",
+            help="demoted blocks promoted back to the device on a "
+                 "prefix hit (host->device copy through the jitted "
+                 "staging writer)")
+        self._c_swap_out = reg.counter(
+            "serve_kv_swap_out_total",
+            help="parked blocks demoted to the host tier when the free "
+                 "list ran dry (device->host copy; content retained "
+                 "under its chain hash instead of evicted)")
+        self._g_host = reg.gauge(
+            "serve_kv_host_blocks",
+            help="blocks currently resident in the host tier")
+        self._h_swap = reg.histogram(
+            "serve_kv_swap_seconds",
+            help="one block's tier copy wall time, either direction "
+                 "(demotion: device->host fetch, synchronous by "
+                 "np.asarray; swap-in: host->device dispatch of the "
+                 "staging write)")
         self._g_frag = reg.gauge(
             "serve_kv_free_longest_run_ratio",
             help="longest contiguous run of free block ids / free-list "
@@ -310,6 +374,28 @@ class KVPoolAccountant:
         ts = self._parked.pop(block, None)
         if ts is not None:
             self._h_evict_age.observe(max(self.clock() - ts, 0.0))
+
+    def on_demote(self, block: int) -> None:
+        """LRU pop that DEMOTED the block to the host tier: the park
+        timestamp retires without an eviction-age observation (the
+        content survives — observing it as an eviction would tell the
+        operator the cache is churning when it is actually tiering)."""
+        self._parked.pop(block, None)
+
+    def observe_swap(self, direction: str, seconds: float,
+                     host_blocks: int) -> None:
+        """One tier copy, timed by the owner (the server's demote /
+        swap-in callbacks). ``direction``: "out" = device->host
+        demotion, "in" = host->device promotion."""
+        if direction == "out":
+            self._c_swap_out.inc()
+            self.swap_outs += 1
+        else:
+            self._c_swap_in.inc()
+            self.swap_ins += 1
+        self._h_swap.observe(max(seconds, 0.0))
+        self.last_host_blocks = int(host_blocks)
+        self._g_host.set(host_blocks)
 
     def on_alloc_ok(self) -> None:
         """A successful allocation re-arms the famine event."""
@@ -384,6 +470,9 @@ class KVPoolAccountant:
             "free_longest_run_ratio": self.last_fragmentation,
             "free_longest_run": self.last_longest_run,
             "famine_episodes": self.famines,
+            "swap_ins": self.swap_ins,
+            "swap_outs": self.swap_outs,
+            "host_blocks": self.last_host_blocks,
         }
 
 

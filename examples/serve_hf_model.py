@@ -78,6 +78,15 @@ def run_continuous(eng, prompt, args):
         print(f"chunked prefill: {st['prefill_chunks']} chunks of "
               f"{st['prefill_chunk_tokens']} tokens, "
               f"{st['chunk_traces']} trace(s)")
+    kt = st["kv_tier"]
+    if kt["kv_dtype"] != "fp" or kt["host_offload"]:
+        print(f"kv tier: {kt['kv_dtype']} pool "
+              f"({kt['pool_bytes'] / 2**20:.1f} MiB), host offload "
+              f"{'on' if kt['host_offload'] else 'off'} — "
+              f"{kt['demotions']} demoted / {kt['swap_ins']} swapped "
+              f"in, {kt['host_blocks']} blocks "
+              f"({kt['host_bytes'] / 2**20:.2f} MiB) on host"
+              + (", THRASHING" if kt["thrash_alarm"] else ""))
     if args.step_profile and st["step_profile"] is not None:
         spf = st["step_profile"]
         wall = max(spf["wall_s"], 1e-12)
@@ -170,6 +179,18 @@ def main():
                          "tokens per scheduler step instead of one "
                          "monolithic pass (multiple of --block-size; "
                          "continuous mode)")
+    ap.add_argument("--kv-dtype", default=None, choices=["fp", "int8"],
+                    help="paged KV pool storage dtype (continuous "
+                         "mode): int8 stores symmetric per-position-"
+                         "per-head int8 with scale tiles beside the "
+                         "pool — ~2x KV capacity at greedy parity "
+                         "(docs/serving.md 'KV quantization & host "
+                         "tiering')")
+    ap.add_argument("--kv-host-offload", action="store_true",
+                    help="tier cold prefix blocks to host RAM "
+                         "(continuous mode; implies --prefix-cache): "
+                         "LRU eviction becomes demotion, prefix hits "
+                         "on demoted blocks swap back in")
     ap.add_argument("--speculate", type=int, default=0, metavar="K",
                     help="per-slot speculative decoding: each active "
                          "slot proposes up to K-1 tokens per step by "
@@ -243,8 +264,12 @@ def main():
                             "error_rate": 0.05}
     if telemetry:
         knobs["telemetry"] = telemetry
-    if args.prefix_cache:
+    if args.prefix_cache or args.kv_host_offload:
         knobs["enable_prefix_caching"] = True
+    if args.kv_dtype:
+        knobs["kv_cache_dtype"] = args.kv_dtype
+    if args.kv_host_offload:
+        knobs["kv_host_offload"] = True
     if args.prefill_chunk is not None:
         knobs["prefill_chunk_tokens"] = args.prefill_chunk
     if args.speculate:

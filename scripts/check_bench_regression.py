@@ -61,6 +61,12 @@ METRICS = {
     # pipelined-leg device-idle p90 from the ON/OFF A/B — a regression
     # means the loop stopped closing the gap it exists to close
     "async_loop.dispatch_gap_p90_ms": "down",
+    # KV tiering (docs/serving.md "KV quantization & host tiering"):
+    # device KV bytes per resident slot, fp over int8 — how many more
+    # sequences the same HBM holds with the int8 pool; a regression
+    # means the quantized layout (or its scale overhead) grew back
+    # toward full precision
+    "kv_tiering.capacity_ratio": "up",
 }
 
 

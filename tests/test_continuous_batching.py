@@ -390,6 +390,31 @@ def test_bench_serve_continuous_smoke():
     assert al["on"]["retraces"] == 0
     assert al["on"]["decode_traces"] == 1     # zero new executables
     assert al["off"]["pipelined_steps"] == 0  # the off-leg never chains
+    # KV tiering A/B (auto int8+offload in smoke, docs/serving.md "KV
+    # quantization & host tiering"): the int8 pool at 2x the slots
+    # costs LESS device memory than the fp baseline (capacity ratio
+    # >= 2 bytes/slot), actually sustains 2x the concurrent residents
+    # at exact greedy parity with ONE decode executable — and the
+    # offload replay demotes cold blocks to host RAM, swaps them back
+    # on prefix hits (token-identical to a never-evicted pool, zero
+    # evictions, zero preemptions) with host-tier bytes visible the
+    # way /debug/memory reports them
+    kt = rec["kv_tiering"]
+    assert kt["kv_dtype"] == "int8"
+    assert kt["capacity_ratio"] >= 2.0
+    assert kt["pool_bytes_int8"] <= kt["pool_bytes_fp"]
+    assert kt["max_resident_int8"] >= 2 * kt["max_resident_fp"]
+    assert kt["parity_exact"] is True
+    assert kt["decode_traces_int8"] == 1
+    assert kt["retraces_int8"] == 0
+    off = kt["offload"]
+    assert off["parity_exact"] is True
+    assert off["demotions"] > 0
+    assert off["swap_ins"] > 0
+    assert off["evictions"] == 0
+    assert off["preempted"] == 0
+    assert off["host_bytes_visible"] is True
+    assert off["swap_outs_accounted"] == off["demotions"]
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm
