@@ -1701,6 +1701,132 @@ def phase_serve(args) -> dict:
         log(f"kv-tiering A/B: capacity ratio {blob['capacity_ratio']}x "
             f"bytes/slot, residents {i8_res} vs {fp_res}, parity="
             f"{blob['parity_exact']}{off_note}")
+
+    # ---- replicated-serving A/B (docs/serving.md "Replicated serving
+    # & failover"): the SAME request set burst-submitted through a
+    # ServingFrontend pool of N replicas, undisturbed vs a seeded
+    # mid-decode replica kill (fault_injection.replica_kill_step). The
+    # robustness claim: availability 1.0 — every submitted request
+    # still finishes eos/length, token-identical to the undisturbed
+    # leg, because the dead replica's queued + in-flight work fails
+    # over with its committed tokens folded into the replayed prompt.
+    # The blob records availability (gated "up" across rounds by
+    # check_bench_regression), failover count, the replay-token
+    # overhead failover paid, the accepted per-token p90 delta vs
+    # undisturbed, and the per-replica health/routing rows the tier-1
+    # smoke asserts.
+    n_repl = int(getattr(args, "replicas", 0) or 0)
+    chaos_kill = bool(getattr(args, "chaos_kill", False))
+    if smoke:
+        n_repl = n_repl or 2
+        chaos_kill = True
+    if n_repl:
+        from deepspeed_tpu.inference.config import ReplicationConfig
+        from deepspeed_tpu.inference.frontend import ServingFrontend
+        from deepspeed_tpu.telemetry import (FaultInjector,
+                                             TelemetryConfig)
+
+        kill_step = 3        # burst-loaded pool: both replicas hold
+        #                      mid-decode work this many ticks into the
+        #                      MEASURED burst (armed after warmup — the
+        #                      warm drain's tick consumption must never
+        #                      shift the kill off the burst)
+
+        def _repl_leg(kill):
+            cfg2 = scfg.model_copy(update={
+                "replication": ReplicationConfig(replicas=n_repl),
+                "telemetry": TelemetryConfig(trace_sample_rate=0.0)})
+            fi = FaultInjector(seed=0) if kill else None
+            f = ServingFrontend(InferenceEngine((mcfg, params), cfg2),
+                                registry=MetricRegistry(),
+                                fault_injector=fi)
+            # warm every replica's traces (least-loaded routing spreads
+            # one warm request per replica)
+            for _ in range(n_repl):
+                f.submit(reqs[0][0], max_new_tokens=2)
+            f.drain()
+            if fi is not None:
+                # seeded victim, kill tick RELATIVE to the burst start
+                fi.schedule_replica_kill(
+                    n_repl, at_tick=f.stats["tick"] + kill_step)
+            sub_t, fin_t = {}, {}
+            rids = []
+            t0 = time.time()
+            for prompt, budget in reqs:
+                rid = f.submit(prompt, max_new_tokens=budget)
+                rids.append(rid)
+                sub_t[rid] = time.time()
+            while not f.idle:
+                for rid in f.step():
+                    fin_t[rid] = time.time()
+            f.drain()
+            wall = time.time() - t0
+            outs = [f.result(r) for r in rids]
+            ok = [r for r in rids
+                  if f.finish_reason(r) in ("eos", "length")]
+            gen = {r: len(f.result(r)) - len(reqs[i][0])
+                   for i, r in enumerate(rids)}
+            lat = sorted((fin_t[r] - sub_t[r]) / max(gen[r], 1) * 1e3
+                         for r in rids if r in fin_t)
+            st = f.stats
+            f.close()
+            leg = {
+                "availability": round(len(ok) / len(rids), 4),
+                "failovers": st["failovers"],
+                "replay_tokens": st["failover_replay_tokens"],
+                "dead_replicas": st["dead_replicas"],
+                "generated_tokens": sum(gen.values()),
+                "token_p90_ms": (round(
+                    lat[min(int(len(lat) * 0.9), len(lat) - 1)], 3)
+                    if lat else None),
+                "wall_s": round(wall, 3),
+            }
+            return leg, outs, st
+
+        base, base_out, _ = _repl_leg(False)
+        rb = {
+            "replicas": n_repl, "requests": n_req,
+            "chaos_kill": chaos_kill,
+            "availability_undisturbed": base["availability"],
+            "token_p90_ms_undisturbed": base["token_p90_ms"],
+        }
+        if chaos_kill:
+            chaos, chaos_out, chaos_st = _repl_leg(True)
+            rb.update({
+                "kill_step": kill_step,
+                # THE headline: fraction of submitted requests that
+                # still finished eos/length despite the kill
+                "availability": chaos["availability"],
+                "failovers": chaos["failovers"],
+                "replay_tokens": chaos["replay_tokens"],
+                "replay_token_overhead": round(
+                    chaos["replay_tokens"]
+                    / max(chaos["generated_tokens"], 1), 4),
+                "dead_replicas": chaos["dead_replicas"],
+                "token_p90_ms": chaos["token_p90_ms"],
+                "token_p90_delta_ms": (round(
+                    chaos["token_p90_ms"] - base["token_p90_ms"], 3)
+                    if None not in (chaos["token_p90_ms"],
+                                    base["token_p90_ms"]) else None),
+                "parity_exact": bool(chaos_out == base_out),
+                "replicas_stats": [
+                    {k: r[k] for k in ("replica", "health", "routed",
+                                       "failovers_from", "steps")}
+                    for r in chaos_st["replicas"]],
+            })
+        else:
+            rb["availability"] = base["availability"]
+        out["replication"] = rb
+        if chaos_kill:
+            log(f"replication A/B ({n_repl} replicas, kill@"
+                f"{kill_step}): availability {rb['availability']}, "
+                f"{rb['failovers']} failovers, {rb['replay_tokens']} "
+                f"replay tokens, p90 {rb['token_p90_ms']} vs "
+                f"{rb['token_p90_ms_undisturbed']} ms undisturbed, "
+                f"parity={rb['parity_exact']}")
+        else:
+            log(f"replication ({n_repl} replicas, no chaos): "
+                f"availability {rb['availability']}")
     return out
 
 
@@ -2146,9 +2272,13 @@ PHASES = {
     # --speculate 4: TPU rounds record the speculation blob too, so
     # check_bench_regression can gate speculation.tokens_per_forward;
     # --kv-dtype int8 --kv-host-offload: the KV-tiering A/B rides along
-    # (capacity ratio, swap counts, parity) for the capacity_ratio gate
+    # (capacity ratio, swap counts, parity) for the capacity_ratio gate;
+    # --replicas 2 --chaos-kill: the replicated-serving A/B (seeded
+    # mid-decode replica kill) records the availability blob the
+    # replication.availability gate reads
     "serve-continuous": (["--requests", "24", "--speculate", "4",
-                          "--kv-dtype", "int8", "--kv-host-offload"],
+                          "--kv-dtype", "int8", "--kv-host-offload",
+                          "--replicas", "2", "--chaos-kill"],
                          900),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
@@ -2583,6 +2713,21 @@ def main() -> None:
                          "rotating shared-prefix trace on a tight pool "
                          "— records demotions, swap-ins, host-tier "
                          "bytes, and parity vs a never-evicted pool "
+                         "(auto in smoke mode)")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="serve-continuous: also run the replicated-"
+                         "serving A/B — a ServingFrontend pool of N "
+                         "replicas replaying the request set, recording "
+                         "availability, failovers, replay-token "
+                         "overhead and per-replica health/routing rows "
+                         "(auto 2 in smoke mode)")
+    ap.add_argument("--chaos-kill", dest="chaos_kill",
+                    action="store_true",
+                    help="serve-continuous: arm the seeded mid-decode "
+                         "replica kill on the replication A/B's chaos "
+                         "leg (fault_injection.replica_kill_step) — "
+                         "availability must stay 1.0 with outputs "
+                         "token-identical to the undisturbed leg "
                          "(auto in smoke mode)")
     ap.add_argument("--train-numerics", dest="train_numerics",
                     action="store_true",

@@ -153,6 +153,16 @@ def telemetry_info():
             "off (set kv_host_offload=true + enable_prefix_caching — "
             "demotion replaces eviction, swap-in restores on prefix "
             "hits)")
+        rc = icfg.replication
+        out["serve_replication"] = (
+            f"{rc.replicas} replicas by default config (health-checked "
+            f"routing, failover after {rc.heartbeat_dead_s}s heartbeat "
+            f"silence, {rc.max_failovers} retries)"
+            if rc.replicas > 1 else
+            "single replica (set replication.replicas > 1 for the "
+            "supervised pool — health-checked routing, mid-flight "
+            "failover, rolling drain; docs/serving.md 'Replicated "
+            "serving & failover')")
         fic = cfg.fault_injection
         out["fault_injection"] = (
             f"ARMED (seed {fic.seed}; step latency "

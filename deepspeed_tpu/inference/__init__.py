@@ -11,14 +11,18 @@ the inference package.
 """
 from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
                                             DeepSpeedMoEConfig,
-                                            DeepSpeedTPConfig)
+                                            DeepSpeedTPConfig,
+                                            ReplicationConfig)
 
 __all__ = ["DeepSpeedInferenceConfig", "DeepSpeedTPConfig",
-           "DeepSpeedMoEConfig", "InferenceEngine", "KVCache", "init_cache",
+           "DeepSpeedMoEConfig", "ReplicationConfig", "InferenceEngine",
+           "KVCache", "init_cache",
            "PagedKVCache", "init_paged_cache", "HostKVTier",
-           "ContinuousBatchingServer", "Request", "Scheduler"]
+           "ContinuousBatchingServer", "ServingFrontend", "Request",
+           "Scheduler"]
 
 _LAZY = {"InferenceEngine": "deepspeed_tpu.inference.engine",
+         "ServingFrontend": "deepspeed_tpu.inference.frontend",
          "KVCache": "deepspeed_tpu.inference.kv_cache",
          "init_cache": "deepspeed_tpu.inference.kv_cache",
          "PagedKVCache": "deepspeed_tpu.inference.kv_cache",

@@ -115,19 +115,48 @@ class PublishWorker:
         if depth > self.max_depth:
             self.max_depth = depth
 
+    def _run_pending_inline(self) -> None:
+        """Run whatever is still queued on the CALLER's thread — the
+        dead-worker escape hatch: ``Queue.join()`` against a thread that
+        already exited (crashed mid-teardown, reaped at interpreter
+        shutdown) would block forever on jobs no one will consume."""
+        while True:
+            try:
+                job = self._q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                if job is not _STOP:
+                    job()
+                    self.published += 1
+            except Exception:  # noqa: BLE001 — same contract as _loop
+                self.errors += 1
+            finally:
+                self._q.task_done()
+
     def drain(self) -> None:
-        """Block until every submitted job has run (owner thread)."""
+        """Block until every submitted job has run (owner thread). A
+        dead worker thread drains inline instead of hanging — a
+        supervisor closing replicas in arbitrary health states must
+        never wedge on a publisher corpse."""
         if self._thread is None:
+            return
+        if not self._thread.is_alive():
+            self._run_pending_inline()
             return
         self._q.join()
 
     def close(self) -> None:
         """Drain, then stop the thread. Idempotent; after close,
-        submits run inline."""
+        submits run inline. Safe against a dead worker thread (see
+        :meth:`drain`)."""
         if self._closed:
             return
         self._closed = True
         if self._thread is None:
+            return
+        if not self._thread.is_alive():
+            self._run_pending_inline()
             return
         self._q.put(_STOP)
         self._q.join()

@@ -63,6 +63,79 @@ class QuantizationConfig(DeepSpeedConfigModel):
     qkv: QKVQuantConfig = Field(default_factory=QKVQuantConfig)
 
 
+class ReplicationConfig(DeepSpeedConfigModel):
+    """Replicated serving (docs/serving.md "Replicated serving &
+    failover"): a :class:`~deepspeed_tpu.inference.frontend.
+    ServingFrontend` supervises ``replicas`` in-process
+    ``ContinuousBatchingServer`` replicas — each with its own paged
+    pool, scheduler, and traced programs over the shared weights —
+    behind one ``submit()/step()/drain()`` surface, with health-checked
+    least-loaded routing, mid-flight failover (committed tokens fold
+    into the replayed prompt, the PR-7 recompute idiom — greedy output
+    stays token-identical through a replica death), and rolling drain.
+    ``replicas: 1`` (the default) is byte-identical to a bare server."""
+    # replica pool size; 1 = a bare server behind the frontend surface
+    replicas: int = 1
+    # heartbeat age (seconds, on the frontend clock) past which a
+    # replica that missed step beats is DEGRADED: the breaker opens and
+    # no new work routes to it (residents keep decoding)
+    heartbeat_degraded_s: float = 2.0
+    # heartbeat age past which the replica is declared DEAD: its queued
+    # and in-flight requests fail over to survivors and it is never
+    # stepped again (item-3 process supervision restarts processes;
+    # in-process death is permanent)
+    heartbeat_dead_s: float = 10.0
+    # observed per-step wall (injected slow-step latency included) past
+    # which a replica is DEGRADED even while its heartbeat is fresh;
+    # null = no slow-step breaker
+    degraded_step_s: Optional[float] = None
+    # bounded failover retries per request: past this many failovers the
+    # request finishes 'failed' instead of bouncing between dying
+    # replicas forever
+    max_failovers: int = 3
+    # frontend ticks a failed-over request waits before resubmission
+    # (exponential: backoff * 2^(failovers-1), floored at one tick)
+    failover_backoff_steps: int = 1
+    # step every replica on its own dedicated worker thread (barrier at
+    # the end of each frontend step): replicas' device programs overlap
+    # within a step. Off = replicas step inline on the caller's thread,
+    # in index order — deterministic and contention-free on small hosts.
+    threaded_step: bool = False
+
+    @field_validator("replicas")
+    @classmethod
+    def _valid_replicas(cls, v):
+        if v < 1:
+            raise ValueError(f"replicas must be >= 1, got {v}")
+        return v
+
+    @field_validator("heartbeat_degraded_s", "heartbeat_dead_s",
+                     "degraded_step_s")
+    @classmethod
+    def _positive_seconds(cls, v, info):
+        if v is not None and v <= 0:
+            raise ValueError(
+                f"{info.field_name} must be > 0 seconds, got {v}")
+        return v
+
+    @field_validator("max_failovers", "failover_backoff_steps")
+    @classmethod
+    def _non_negative(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"{info.field_name} must be >= 0 (max_failovers=0 "
+                f"fails a request at its first replica death), got {v}")
+        return v
+
+    def model_post_init(self, _ctx) -> None:
+        if self.heartbeat_dead_s <= self.heartbeat_degraded_s:
+            raise ValueError(
+                f"heartbeat_dead_s ({self.heartbeat_dead_s}) must exceed "
+                f"heartbeat_degraded_s ({self.heartbeat_degraded_s}) — "
+                "a replica must pass through the breaker before the "
+                "failover deadline")
+
+
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     """Top-level inference config (reference: DeepSpeedInferenceConfig)."""
     replace_with_kernel_inject: bool = Field(default=False,
@@ -171,6 +244,11 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # loop (and to one-shot generate()). False = the PR-1 synchronous
     # loop, byte-identical to servers before this knob existed.
     async_loop: bool = True
+    # replicated serving (docs/serving.md "Replicated serving &
+    # failover"): pool sizing + health/failover knobs consumed by
+    # inference/frontend.py ServingFrontend
+    replication: ReplicationConfig = Field(
+        default_factory=ReplicationConfig)
     # metrics registry + optional scrape endpoint (docs/observability.md);
     # the shared section schema lives in telemetry/config.py
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)

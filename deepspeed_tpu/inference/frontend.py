@@ -1,0 +1,853 @@
+"""Replicated serving frontend: a supervised pool of server replicas.
+
+The robustness half of ROADMAP item 3 (docs/serving.md "Replicated
+serving & failover"): however hardened ONE ``ContinuousBatchingServer``
+is — lifecycle, fault injection, watchdog — it still dies wholesale with
+its process/thread: one wedged or killed server loses every queued and
+in-flight request. :class:`ServingFrontend` owns N in-process replicas
+(each with its own paged pool, scheduler, and traced programs over the
+SHARED engine weights; the process-per-replica jump with per-replica
+meshes is item 3 proper) behind one ``submit()/step()/drain()/result()``
+surface, built on three pillars:
+
+* **Health-checked routing** — a per-replica state machine (healthy →
+  degraded → dead) driven by step-completion heartbeats riding the
+  existing watchdog plumbing: every replica gets an (unstarted)
+  :class:`~deepspeed_tpu.telemetry.watchdog.Watchdog` installed on the
+  server's ``watchdog`` seam, so every site that already notifies
+  progress (decode, prefill chunk, lifecycle action, idle poll) feeds
+  the frontend's heartbeat for free. Admission is least-loaded (queue
+  depth + residents, ties to the most free blocks) over HEALTHY
+  replicas; a degraded replica trips the breaker — no new routing, its
+  residents keep decoding — and recovers when its beats return. The
+  breaker fails OPEN: with zero healthy replicas, degraded ones accept
+  work rather than deadlocking the pool.
+
+* **Mid-flight failover** — a replica whose step raises, or whose
+  heartbeat goes stale past ``replication.heartbeat_dead_s``, is
+  declared DEAD (permanent in-process; item 3's supervisor restarts
+  processes): every request it held — queued, mid-prefill, or
+  mid-decode — folds its committed tokens into the prompt
+  (``Request.committed → sched_prompt``, the PR-7 recompute-preemption
+  idiom) and resubmits to a survivor after a bounded exponential
+  backoff. Greedy output is token-identical to an uninterrupted
+  one-shot ``generate()`` through a mid-decode kill, because only
+  COMMITTED tokens replay and greedy continuation from a replayed
+  prefix is exact (the preempt→requeue oracle, now across replicas).
+  Retries exhausted → finish reason ``failed``, never a hang.
+
+* **Rolling drain** — :meth:`drain_replica` steers traffic away
+  (unroutable), re-routes its QUEUED work to peers immediately
+  (``server.reclaim`` — cancel-and-forget, so the id stays
+  resubmittable), lets residents finish in place (their prefix cache
+  stays warm), and re-admits the replica once idle: a config reload or
+  rolling restart loses zero requests.
+
+Determinism contract (the chaos suite depends on it): replicas step in
+index order on the caller's thread by default, every clock read goes
+through the injectable frontend clock, and the replica-scoped fault
+kinds (kill / wedge / heartbeat-loss / slow-step —
+telemetry/faultinject.py) are consulted at fixed points of ``step()``.
+``replication.threaded_step`` moves each replica's step onto its own
+dedicated worker thread with a barrier at the end of the frontend step —
+device programs overlap across replicas, while every health/routing
+decision still happens on the owner thread against joined results.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.server import (_LIFECYCLE_EVENTS,
+                                            ContinuousBatchingServer,
+                                            check_drain_timeout,
+                                            submit_rejection)
+from deepspeed_tpu.telemetry import (FaultInjector, MetricRegistry,
+                                     ReplicaKilled, Watchdog,
+                                     get_event_ring, get_registry,
+                                     start_http_server)
+from deepspeed_tpu.telemetry import events as telemetry_events
+
+# replica health states (the serve_replica_healthy gauge is 1 only for a
+# healthy, non-draining — i.e. routable — replica)
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DEAD = "dead"
+
+
+
+class _FrontRequest:
+    """Frontend-side record of one request across replica lifetimes."""
+
+    __slots__ = ("request_id", "prompt", "max_new_tokens", "eos_token_id",
+                 "priority", "deadline_ts", "submit_ts", "replica",
+                 "committed", "failovers", "retry_at_tick")
+
+    def __init__(self, request_id: int, prompt: List[int],
+                 max_new_tokens: int, eos_token_id: Optional[int],
+                 priority: int, deadline_ts: Optional[float],
+                 submit_ts: float):
+        self.request_id = request_id
+        self.prompt = list(prompt)       # the ORIGINAL prompt, immutable
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.priority = priority
+        self.deadline_ts = deadline_ts   # absolute, frontend clock
+        self.submit_ts = submit_ts
+        self.replica: Optional[int] = None   # resident replica, or None
+        # tokens recovered from dead/drained replicas: they fold into
+        # the resubmitted prompt (the recompute-replay prefix)
+        self.committed: List[int] = []
+        self.failovers = 0
+        self.retry_at_tick = 0           # frontend tick gating resubmit
+
+
+class _Replica:
+    """One supervised replica: the server plus its health bookkeeping."""
+
+    __slots__ = ("index", "server", "watchdog", "health", "draining",
+                 "dead_reason", "missed_beats", "last_beat_ts",
+                 "last_step_s", "routed", "failovers",
+                 "steps", "gauge", "stepped")
+
+    def __init__(self, index: int, server: ContinuousBatchingServer,
+                 watchdog: Watchdog, now: float, gauge):
+        self.index = index
+        self.server = server
+        self.watchdog = watchdog
+        self.health = HEALTHY
+        self.draining = False
+        self.dead_reason: Optional[str] = None
+        # beat bookkeeping: `missed_beats` counts consecutive frontend
+        # steps with no observed beat — requiring missed >= 1 alongside
+        # the wall threshold means a PAUSED frontend (nobody calling
+        # step() for a while) never mass-declares its replicas dead on
+        # resume: the first step back beats everyone before the sweep
+        self.missed_beats = 0
+        self.last_beat_ts = now
+        self.last_step_s: Optional[float] = None
+        self.routed = 0          # requests ever routed here
+        self.failovers = 0       # requests failed over AWAY from here
+        self.steps = 0
+        self.gauge = gauge       # serve_replica_healthy{replica=index}
+        self.stepped = False     # did this frontend tick step it?
+
+    @property
+    def routable(self) -> bool:
+        return self.health == HEALTHY and not self.draining
+
+    def load(self) -> tuple:
+        """Least-loaded admission key: fewest queued+resident requests,
+        ties to the most free pool blocks, then index (deterministic)."""
+        sched = self.server.scheduler
+        return (sched.pending_requests + sched.active_slots,
+                -sched.allocator.free_blocks, self.index)
+
+
+class ServingFrontend:
+    """N supervised ``ContinuousBatchingServer`` replicas behind one
+    ``submit()/step()/drain()/result()`` surface (see module doc).
+
+    ``engine`` is shared: replicas reuse its weights and mesh but build
+    their own paged pools and jits. ``clock`` (injectable) is the basis
+    for heartbeats, deadlines, and the drain timeout — the chaos tests
+    drive the whole health state machine with a fake clock and zero
+    real sleeps. ``fault_injector`` (or the config section) arms both
+    the per-server chaos sites and the replica-scoped kinds; ONE
+    injector is shared by the frontend and every replica so a seeded
+    chaos schedule is pool-level. With ``replication.replicas == 1``
+    the frontend is a pass-through: greedy output is byte-identical to
+    a bare server (test-pinned)."""
+
+    def __init__(self, engine: InferenceEngine,
+                 registry: Optional[MetricRegistry] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 fault_injector: Optional[FaultInjector] = None):
+        cfg = engine.config
+        rcfg = cfg.replication
+        self.engine = engine
+        self._clock = clock if clock is not None else time.perf_counter
+        self._degraded_s = rcfg.heartbeat_degraded_s
+        self._dead_s = rcfg.heartbeat_dead_s
+        self._degraded_step_s = rcfg.degraded_step_s
+        self.max_failovers = rcfg.max_failovers
+        self._backoff = rcfg.failover_backoff_steps
+        self._max_pending = cfg.max_queued_requests
+        tcfg = getattr(cfg, "telemetry", None)
+        enabled = tcfg is None or tcfg.enabled
+        self.telemetry = registry or (get_registry() if enabled
+                                      else MetricRegistry())
+        self._fi = fault_injector
+        if self._fi is None and tcfg is not None and enabled:
+            self._fi = FaultInjector.from_config(
+                tcfg.fault_injection, registry=self.telemetry)
+        reg = self.telemetry
+        self._c_failovers = reg.counter(
+            "serve_failovers_total",
+            help="requests failed over off a dead replica (committed "
+                 "tokens fold into the replayed prompt — docs/serving.md "
+                 "'Replicated serving & failover')")
+        self._c_replay = reg.counter(
+            "serve_failover_replay_tokens_total",
+            help="previously-committed tokens re-prefilled by failover "
+                 "and drain re-route resubmissions (the replay-compute "
+                 "overhead of surviving a replica death)")
+        self._h_retries = reg.histogram(
+            "serve_request_failovers",
+            help="failover count per finished request (0 for the "
+                 "undisturbed majority; the tail is the retry story)")
+        # finish-reason counters for finishes the FRONTEND decides
+        # (pending-queue deadline/cancel, retries exhausted, stranded
+        # work) — the same families every server-side equivalent
+        # ticks, so pool-level dashboards see the same lifecycle story
+        # a bare server would tell
+        self._c_finish = {
+            "cancelled": reg.counter(
+                "serve_cancelled_total",
+                help="requests finished by cancel() or a bounded drain "
+                     "(finish reason 'cancelled'; partial output "
+                     "returned)"),
+            "deadline": reg.counter(
+                "serve_deadline_expired_total",
+                help="requests reaped past their deadline_s (finish "
+                     "reason 'deadline'; queued expiries are never "
+                     "admitted)"),
+            "failed": reg.counter(
+                "serve_requests_failed_total",
+                help="requests failed by the frontend: failover "
+                     "retries exhausted, or every replica dead "
+                     "(finish reason 'failed')"),
+        }
+        # replicas: each gets its own private registry (per-replica
+        # serving histograms must not merge into one family) and an
+        # UNSTARTED heartbeat watchdog installed on the server's seam —
+        # every existing notify_progress site now beats the frontend
+        self.replicas: List[_Replica] = []
+        now = self._clock()
+        for i in range(rcfg.replicas):
+            srv = ContinuousBatchingServer(
+                engine, registry=MetricRegistry(), clock=self._clock,
+                fault_injector=self._fi, supervised=True)
+            wd = Watchdog(self._dead_s, registry=reg, clock=self._clock,
+                          name=f"serve_replica{i}")
+            srv.watchdog = wd
+            gauge = reg.gauge(
+                "serve_replica_healthy",
+                help="1 = replica is routable (healthy, not draining); "
+                     "0 = breaker open (degraded/draining) or dead",
+                labels={"replica": str(i)})
+            gauge.set(1.0)
+            self.replicas.append(_Replica(i, srv, wd, now, gauge))
+        if self._fi is not None:
+            # seeded kill schedule: pick the victim now that the pool
+            # size is known (telemetry.fault_injection.replica_kill_step)
+            self._fi.schedule_replica_kill(len(self.replicas))
+        # dedicated per-replica step threads (replication.threaded_step):
+        # single-worker executors so each replica's steps always run on
+        # ITS thread; the frontend joins the barrier before any health
+        # or routing decision
+        self._pools = None
+        if rcfg.threaded_step:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pools = [
+                ThreadPoolExecutor(1, thread_name_prefix=f"serve-rep{i}")
+                for i in range(rcfg.replicas)]
+        self._pending: Deque[_FrontRequest] = deque()
+        self._requests: Dict[int, _FrontRequest] = {}  # outstanding
+        self._results: Dict[int, List[int]] = {}
+        self.finish_reasons: Dict[int, str] = {}
+        self._deferred_finished: List[int] = []
+        self._next_id = 0
+        self._tick = 0
+        self._failovers = 0
+        self._replay_tokens = 0
+        self._drain_reroutes = 0
+        self._closed = False
+        self.http_server = None
+        if tcfg is not None and enabled and tcfg.http_port is not None:
+            self.http_server = start_http_server(
+                tcfg.http_port, host=tcfg.http_host, registry=reg,
+                replicas=self._debug_snapshot)
+
+    # ------------------------------------------------------------ API
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> int:
+        """Queue one request with the server's submit contract (same
+        validation, same finish-reason vocabulary); the frontend routes
+        it to the least-loaded healthy replica, holding it in a bounded
+        frontend queue only when no replica can take it right now."""
+        rej = submit_rejection(prompt, max_new_tokens,
+                               max(1, self.engine.config.min_out_tokens),
+                               deadline_s)
+        if rej is not None:
+            self._count_rejection(rej[0], request_id)
+            raise ValueError(rej[1])
+        if request_id is None:
+            request_id = self._next_id
+        elif request_id in self._requests or request_id in self._results:
+            self._count_rejection("duplicate_id", request_id)
+            raise ValueError(
+                f"request_id {request_id} is already outstanding or "
+                "finished — a duplicate would silently overwrite its "
+                "output")
+        self._next_id = max(self._next_id, request_id) + 1
+        now = self._clock()
+        fr = _FrontRequest(
+            request_id, prompt, max_new_tokens, eos_token_id, priority,
+            None if deadline_s is None else now + deadline_s, now)
+        self._requests[request_id] = fr
+        try:
+            routed = self._route(fr)
+        except ValueError:
+            # permanent refusal (span/pool/...): identical on every
+            # replica — the frontend has nothing to hold
+            del self._requests[request_id]
+            raise
+        if not routed:
+            if all(r.health == DEAD for r in self.replicas):
+                del self._requests[request_id]
+                self._count_rejection("replicas_dead", request_id)
+                raise RuntimeError(
+                    "every replica is dead — the pool can never serve "
+                    "this request (restart the frontend)")
+            if len(self._pending) >= self._max_pending:
+                del self._requests[request_id]
+                self._count_rejection("queue_full", request_id)
+                raise RuntimeError(
+                    f"frontend queue is full ({self._max_pending}); "
+                    "step() the pool before submitting more, or raise "
+                    "max_queued_requests")
+            self._pending.append(fr)
+        return request_id
+
+    def _count_rejection(self, reason: str,
+                         request_id: Optional[int] = None) -> None:
+        """Pool-level refusals mirror the server's accounting (same
+        counter family, same ring event) so a frontend rejection is as
+        visible as a bare server's."""
+        self.telemetry.counter(
+            "serve_admission_rejections_total",
+            help="refused submit() calls, by reason",
+            labels={"reason": reason}).inc()
+        get_event_ring().record(telemetry_events.ADMISSION_REJECT,
+                                reason=reason, source="frontend")
+
+    def result(self, request_id: int) -> Optional[List[int]]:
+        """Finished output (prompt + generated) or None — the same
+        contract as the server's, whatever replica (or replicas) the
+        request lived on."""
+        return self._results.get(request_id)
+
+    def finish_reason(self, request_id: int) -> Optional[str]:
+        return self.finish_reasons.get(request_id)
+
+    @property
+    def idle(self) -> bool:
+        return not self._requests
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel one request wherever it lives — frontend-queued or
+        resident on any replica. False when finished or unknown."""
+        fr = self._requests.get(request_id)
+        if fr is None:
+            return False
+        if fr.replica is None:
+            try:
+                self._pending.remove(fr)
+            except ValueError:
+                pass
+            self._finalize(fr, list(fr.prompt) + list(fr.committed),
+                           "cancelled", self._deferred_finished,
+                           frontend_decided=True)
+            return True
+        rep = self.replicas[fr.replica]
+        if not rep.server.cancel(request_id):
+            # the replica already finished it — e.g. a pipeline flush
+            # inside an EARLIER cancel committed this request's final
+            # token server-side before the frontend's next step could
+            # collect it. Collect that finish NOW: returning False
+            # while leaving the record outstanding would strand a
+            # computed result forever (drain(timeout_s)'s cancel-all
+            # straggler loop would drop it on the floor).
+            why = rep.server.finish_reason(request_id)
+            if why is not None:
+                self._finalize(fr, rep.server.result(request_id), why,
+                               self._deferred_finished)
+            return False
+        self._finalize(fr, rep.server.result(request_id), "cancelled",
+                       self._deferred_finished)
+        return True
+
+    # ------------------------------------------------------------ step
+
+    def step(self) -> List[int]:
+        """One supervision round: reap frontend-held deadline expiries,
+        route eligible pending work (failover resubmits past their
+        backoff included), step every non-dead replica (skipping
+        injected wedges — no step, no heartbeat), collect finishes,
+        run the health state machine (breaker transitions, heartbeat
+        deadlines → failover), and complete any finished drains.
+        Returns the frontend request ids that got a result this round."""
+        finished: List[int] = []
+        if self._deferred_finished:
+            finished.extend(self._deferred_finished)
+            self._deferred_finished.clear()
+        self._tick += 1
+        now = self._clock()
+        self._reap_pending_deadlines(finished, now)
+        self._route_pending(finished)
+        self._step_replicas(finished)
+        self._health_sweep(finished)
+        self._finish_drains()
+        self._fail_stranded(finished)
+        return finished
+
+    def _step_replicas(self, finished: List[int]) -> None:
+        """Step every live replica, inline (index order) or fanned out
+        to the dedicated per-replica threads with a join barrier.
+        Injected kills are checked on the owner thread BEFORE the step
+        dispatch; a step that raises — injected or real — declares the
+        replica dead and fails its work over."""
+        live: List[_Replica] = []
+        for rep in self.replicas:
+            rep.stepped = False
+            if rep.health == DEAD:
+                continue
+            if self._fi is not None \
+                    and self._fi.is_replica_wedged(rep.index):
+                continue          # no step, no beat — deadline will see
+            try:
+                if self._fi is not None:
+                    self._fi.check_replica_step(rep.index, self._tick)
+            except ReplicaKilled as e:
+                self._kill_replica(rep, str(e), finished)
+                continue
+            live.append(rep)
+        if self._pools is None:
+            results = [(rep, self._timed_step(rep)) for rep in live]
+        else:
+            futs = [(rep, self._pools[rep.index].submit(
+                self._timed_step, rep)) for rep in live]
+            results = [(rep, f.result()) for rep, f in futs]
+        for rep, res in results:
+            err, dt, done = res
+            if err is not None:
+                self._kill_replica(rep, f"step raised: {err!r}", finished)
+                continue
+            rep.stepped = True
+            rep.steps += 1
+            rep.last_step_s = dt + (
+                self._fi.replica_step_latency(rep.index)
+                if self._fi is not None else 0.0)
+            self._collect(rep, done, finished)
+
+    def _timed_step(self, rep: _Replica):
+        """(error, seconds, finished ids) for one replica step — the
+        exception is CAPTURED (threaded mode must deliver it to the
+        owner thread, not kill the worker)."""
+        t0 = self._clock()
+        try:
+            done = rep.server.step()
+        except Exception as e:  # noqa: BLE001 — any step death is final
+            return e, self._clock() - t0, []
+        return None, self._clock() - t0, done
+
+    def _collect(self, rep: _Replica, done: List[int],
+                 finished: List[int]) -> None:
+        for rid in done:
+            fr = self._requests.get(rid)
+            if fr is None:
+                continue          # already finalized (e.g. via cancel)
+            self._finalize(fr, rep.server.result(rid),
+                           rep.server.finish_reason(rid), finished)
+
+    # ------------------------------------------------------- lifecycle
+
+    def _finalize(self, fr: _FrontRequest, tokens: List[int],
+                  reason: str, finished: List[int],
+                  frontend_decided: bool = False) -> None:
+        rid = fr.request_id
+        # the budget-floor clamp on a resubmission can over-generate a
+        # token or two past the request's true budget — truncate, so
+        # the caller sees exactly prompt + <= max_new_tokens (and the
+        # one-shot parity oracle compares like for like)
+        limit = len(fr.prompt) + fr.max_new_tokens
+        self._results[rid] = list(tokens)[:limit]
+        self.finish_reasons[rid] = reason
+        self._requests.pop(rid, None)
+        finished.append(rid)
+        self._h_retries.observe(fr.failovers)
+        if frontend_decided:
+            # a finish the FRONTEND itself decided (the request never
+            # reached — or no longer has — a replica to count it):
+            # tick the same lifecycle counter family and ring event a
+            # bare server would, so chaos forensics stay
+            # incident-identical at the pool level
+            self._c_finish[reason].inc()
+            get_event_ring().record(
+                _LIFECYCLE_EVENTS[reason], request_id=rid,
+                generated=len(tokens) - len(fr.prompt),
+                preemptions=0, source="frontend")
+
+    def _route(self, fr: _FrontRequest,
+               finished: Optional[List[int]] = None) -> bool:
+        """Least-loaded admission over routable replicas; the breaker
+        fails OPEN (degraded accepted) only when nothing is healthy.
+        Returns True when the request was placed — or terminally
+        handled (expired / permanently refused at re-route time)."""
+        now = self._clock()
+        if fr.deadline_ts is not None and now >= fr.deadline_ts:
+            self._finalize(fr, list(fr.prompt) + list(fr.committed),
+                           "deadline",
+                           finished if finished is not None
+                           else self._deferred_finished,
+                           frontend_decided=True)
+            return True
+        cands = sorted((r for r in self.replicas if r.routable),
+                       key=_Replica.load)
+        if not cands:
+            # breaker fail-open: a pool with zero healthy replicas
+            # prefers a degraded one over deadlocking the queue
+            cands = sorted((r for r in self.replicas
+                            if r.health == DEGRADED and not r.draining),
+                           key=_Replica.load)
+        floor = max(1, self.engine.config.min_out_tokens)
+        for rep in cands:
+            try:
+                rep.server.submit(
+                    list(fr.prompt) + list(fr.committed),
+                    max_new_tokens=max(
+                        fr.max_new_tokens - len(fr.committed), floor),
+                    eos_token_id=fr.eos_token_id,
+                    request_id=fr.request_id,
+                    deadline_s=(None if fr.deadline_ts is None
+                                else fr.deadline_ts - now),
+                    priority=fr.priority)
+            except RuntimeError:
+                continue          # that queue is full — try the next
+            except ValueError:
+                if finished is None:
+                    raise         # submit()-time: propagate to caller
+                # re-route time: a refusal here is unexpected (config
+                # is identical pool-wide) — fail loudly, never hang
+                self._finalize(fr,
+                               list(fr.prompt) + list(fr.committed),
+                               "failed", finished,
+                               frontend_decided=True)
+                return True
+            fr.replica = rep.index
+            rep.routed += 1
+            if fr.committed:
+                self._replay_tokens += len(fr.committed)
+                self._c_replay.inc(len(fr.committed))
+            return True
+        return False
+
+    def _route_pending(self, finished: List[int]) -> None:
+        held: List[_FrontRequest] = []
+        while self._pending:
+            fr = self._pending.popleft()
+            if fr.retry_at_tick > self._tick:
+                held.append(fr)
+                continue
+            if not self._route(fr, finished):
+                held.append(fr)
+        self._pending.extend(held)
+
+    def _reap_pending_deadlines(self, finished: List[int],
+                                now: float) -> None:
+        for fr in [f for f in self._pending
+                   if f.deadline_ts is not None and now >= f.deadline_ts]:
+            self._pending.remove(fr)
+            self._finalize(fr, list(fr.prompt) + list(fr.committed),
+                           "deadline", finished, frontend_decided=True)
+
+    def _failover(self, fr: _FrontRequest, partial: List[int],
+                  finished: List[int], cause: str) -> None:
+        """One request off a dead replica: fold its committed tokens,
+        bound the retries, and schedule the backed-off resubmission."""
+        fr.committed = list(partial)[len(fr.prompt):]
+        fr.replica = None
+        fr.failovers += 1
+        self._failovers += 1
+        self._c_failovers.inc()
+        get_event_ring().record(
+            telemetry_events.REPLICA_FAILOVER,
+            request_id=fr.request_id, committed=len(fr.committed),
+            failovers=fr.failovers, cause=cause)
+        if fr.failovers > self.max_failovers:
+            self._finalize(fr, list(fr.prompt) + list(fr.committed),
+                           "failed", finished, frontend_decided=True)
+            return
+        fr.retry_at_tick = self._tick + max(
+            1, self._backoff * (2 ** (fr.failovers - 1)))
+        self._pending.append(fr)
+
+    def _kill_replica(self, rep: _Replica, reason: str,
+                      finished: List[int]) -> None:
+        """Declare one replica dead: transition + ring event, fail over
+        everything it held (scheduler state is pure host data — safe to
+        scrape even when the step just raised), close it best-effort."""
+        self._transition(rep, DEAD, reason)
+        rep.dead_reason = reason
+        srv = rep.server
+        moved: List[tuple] = []
+        seen: set = set()
+        for state in list(srv.scheduler.slots.values()):
+            rid = state.request.request_id
+            fr = self._requests.get(rid)
+            if fr is None:
+                continue
+            # prompt here is the REPLICA's prompt (original + any
+            # earlier-failover fold); generated starts pre-seeded with
+            # any within-replica preemption fold — together they are
+            # the full committed output so far
+            moved.append((fr, list(state.request.prompt)
+                          + list(state.generated)))
+            seen.add(rid)
+        for req in list(srv.scheduler.queue):
+            fr = self._requests.get(req.request_id)
+            if fr is None:
+                continue
+            moved.append((fr, list(req.prompt) + list(req.committed)))
+            seen.add(req.request_id)
+        # anything routed here the scheduler no longer holds: a finish
+        # that never surfaced (collected now) or a request lost whole
+        # (replayed from the frontend's last knowledge)
+        for rid, fr in list(self._requests.items()):
+            if fr.replica != rep.index or rid in seen:
+                continue
+            why = srv.finish_reasons.get(rid)
+            if why is not None:
+                self._finalize(fr, srv.result(rid), why, finished)
+            else:
+                moved.append((fr, list(fr.prompt) + list(fr.committed)))
+        for fr, partial in moved:
+            rep.failovers += 1
+            self._failover(fr, partial, finished, cause=reason)
+        try:
+            srv.close()
+        except Exception:  # noqa: BLE001 — a dead replica's teardown
+            pass           # must never take the supervisor with it
+
+    def _health_sweep(self, finished: List[int]) -> None:
+        """The state machine: beats come from steps the frontend itself
+        observed (an injected heartbeat loss hides them); wall-clock
+        staleness plus at least one MISSED beat drives degraded → dead,
+        so a paused frontend never mass-kills healthy replicas, while
+        the slow-step breaker can degrade a beating replica."""
+        now = self._clock()
+        for rep in self.replicas:
+            if rep.health == DEAD:
+                continue
+            hb_lost = (self._fi is not None
+                       and self._fi.replica_heartbeat_lost(rep.index))
+            beat = rep.stepped and not hb_lost
+            if beat:
+                rep.missed_beats = 0
+                rep.last_beat_ts = now
+            else:
+                rep.missed_beats += 1
+            stale = now - rep.last_beat_ts
+            slow = (self._degraded_step_s is not None
+                    and rep.last_step_s is not None
+                    and rep.last_step_s > self._degraded_step_s)
+            if rep.missed_beats and stale > self._dead_s:
+                # the installed watchdog fires the standard one-per-
+                # stall forensic dump (ring + thread stacks) on the way
+                # out — a replica death looks exactly like a server
+                # stall in the flight recorder
+                rep.watchdog.check()
+                self._kill_replica(
+                    rep, f"no heartbeat for {stale:.3f}s "
+                         f"(heartbeat_dead_s={self._dead_s})", finished)
+            elif (rep.missed_beats and stale > self._degraded_s) or slow:
+                self._transition(
+                    rep, DEGRADED,
+                    "slow step" if slow and not rep.missed_beats
+                    else f"heartbeat stale {stale:.3f}s")
+            else:
+                self._transition(rep, HEALTHY, "beats resumed")
+
+    def _transition(self, rep: _Replica, to: str, reason: str) -> None:
+        if rep.health == to:
+            return
+        get_event_ring().record(
+            telemetry_events.REPLICA_HEALTH, replica=rep.index,
+            frm=rep.health, to=to, reason=reason)
+        rep.health = to
+        rep.gauge.set(1.0 if rep.routable else 0.0)
+
+    def _fail_stranded(self, finished: List[int]) -> None:
+        """With every replica dead nothing pending can ever run — fail
+        it loudly instead of letting drain() spin forever."""
+        if not self._requests:
+            return
+        if any(r.health != DEAD for r in self.replicas):
+            return
+        for fr in list(self._requests.values()):
+            try:
+                self._pending.remove(fr)
+            except ValueError:
+                pass
+            self._finalize(fr, list(fr.prompt) + list(fr.committed),
+                           "failed", finished, frontend_decided=True)
+
+    # ---------------------------------------------------- rolling drain
+
+    def drain_replica(self, index: int) -> None:
+        """Start a rolling drain of one replica: traffic steers away
+        immediately, its QUEUED work re-routes to peers (reclaimed —
+        cancel-and-forget, so the ids stay resubmittable anywhere),
+        residents finish in place on their warm caches, and the replica
+        re-admits itself once idle (watch ``stats['replicas']``). Zero
+        requests are lost (test-pinned)."""
+        rep = self.replicas[index]
+        if rep.health == DEAD:
+            raise ValueError(
+                f"replica {index} is dead ({rep.dead_reason}) — there "
+                "is nothing to drain")
+        if rep.draining:
+            return
+        rep.draining = True
+        rep.gauge.set(0.0)
+        get_event_ring().record(
+            telemetry_events.REPLICA_HEALTH, replica=index,
+            frm=rep.health, to="draining", reason="drain_replica")
+        for req in list(rep.server.scheduler.queue):
+            fr = self._requests.get(req.request_id)
+            if fr is None:
+                continue
+            partial = rep.server.reclaim(req.request_id)
+            if partial is None:
+                continue
+            fr.committed = list(partial)[len(fr.prompt):]
+            fr.replica = None
+            fr.retry_at_tick = self._tick   # immediately eligible
+            self._drain_reroutes += 1
+            self._pending.append(fr)
+
+    def _finish_drains(self) -> None:
+        for rep in self.replicas:
+            if not rep.draining or rep.health == DEAD:
+                continue
+            if rep.server.scheduler.idle:
+                rep.draining = False
+                rep.gauge.set(1.0 if rep.routable else 0.0)
+                get_event_ring().record(
+                    telemetry_events.REPLICA_HEALTH, replica=rep.index,
+                    frm="draining", to=rep.health,
+                    reason="drain_complete")
+
+    # ------------------------------------------------------------ drain
+
+    def drain(self, timeout_s: Optional[float] = None
+              ) -> Dict[int, List[int]]:
+        """Step the pool until every outstanding request finished (any
+        reason). ``timeout_s`` bounds the drain on the frontend clock:
+        past it, stragglers are cancelled with their partials — one
+        wedged REPLICA can no longer spin the pool forever (its work
+        fails over and finishes; this bound covers pathological cases
+        like every replica dead-and-beyond-retries)."""
+        check_drain_timeout(timeout_s)
+        deadline = None if timeout_s is None \
+            else self._clock() + timeout_s
+        while self._requests:
+            if deadline is not None and self._clock() >= deadline:
+                for rid in list(self._requests):
+                    self.cancel(rid)
+                break
+            self.step()
+        # flush each live replica's async remnant + publish worker so a
+        # drained pool has no device work outstanding (a drain() on an
+        # idle server is exactly that flush)
+        for rep in self.replicas:
+            if rep.health != DEAD:
+                rep.server.drain()
+        if self._deferred_finished:
+            self._deferred_finished.clear()
+        return dict(self._results)
+
+    def close(self) -> None:
+        """Release the scrape endpoint, the step threads, and every
+        live replica (dead ones were closed at declaration)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.http_server is not None:
+            self.http_server.close()
+            self.http_server = None
+        if self._pools is not None:
+            for pool in self._pools:
+                pool.shutdown(wait=True)
+        for rep in self.replicas:
+            if rep.health != DEAD:
+                try:
+                    rep.server.close()
+                except Exception:  # noqa: BLE001 — arbitrary states
+                    pass
+            rep.watchdog.disarm()
+
+    # ------------------------------------------------------------ stats
+
+    def _replica_row(self, rep: _Replica) -> dict:
+        sched = rep.server.scheduler
+        row = {
+            "replica": rep.index,
+            "health": rep.health,
+            "draining": rep.draining,
+            "routable": rep.routable,
+            "routed": rep.routed,
+            "failovers_from": rep.failovers,
+            "steps": rep.steps,
+            "dead_reason": rep.dead_reason,
+            "last_step_s": rep.last_step_s,
+            "heartbeat_idle_s": round(rep.watchdog.idle_seconds(), 6),
+            "missed_beats": rep.missed_beats,
+        }
+        try:
+            row.update({
+                "queued": sched.pending_requests,
+                "active_slots": sched.active_slots,
+                "free_blocks": sched.allocator.free_blocks,
+                "decode_steps": rep.server._step_clock,
+            })
+        except Exception:  # noqa: BLE001 — a dead replica's books may
+            pass           # be mid-teardown; health is the story then
+        return row
+
+    def _debug_snapshot(self) -> dict:
+        """``GET /debug/replicas`` payload (scrape thread: host-side
+        bookkeeping only, no device reads)."""
+        return {
+            "replicas": [self._replica_row(r) for r in self.replicas],
+            "pending": len(self._pending),
+            "outstanding": len(self._requests),
+            "failovers": self._failovers,
+            "failover_replay_tokens": self._replay_tokens,
+            "drain_reroutes": self._drain_reroutes,
+            "tick": self._tick,
+        }
+
+    @property
+    def stats(self) -> dict:
+        """Pool-level supervision stats. ``replicas`` carries one row
+        per replica (health, routing counts, failovers, heartbeat age);
+        per-replica serving detail lives on each replica's own private
+        registry/stats."""
+        snap = self._debug_snapshot()
+        snap.update({
+            "healthy_replicas": sum(
+                1 for r in self.replicas if r.health == HEALTHY),
+            "dead_replicas": sum(
+                1 for r in self.replicas if r.health == DEAD),
+            "fault_injection": (self._fi.snapshot()
+                                if self._fi is not None else None),
+        })
+        return snap

@@ -75,6 +75,34 @@ _LIFECYCLE_EVENTS = {
 }
 
 
+def submit_rejection(prompt, max_new_tokens: int, floor: int,
+                     deadline_s) -> Optional[tuple]:
+    """``(reason, message)`` when these submit() arguments can never be
+    served, else None — ONE predicate for the server and the
+    supervising :class:`~deepspeed_tpu.inference.frontend.
+    ServingFrontend` (which promises the server's submit contract;
+    sharing the check keeps that true by construction)."""
+    if not prompt:
+        return "empty_prompt", "empty prompt"
+    if max_new_tokens < floor:
+        return "budget_floor", (
+            f"max_new_tokens={max_new_tokens} is below the "
+            f"schedulable floor {floor} (min_out_tokens)")
+    if deadline_s is not None and deadline_s <= 0:
+        return "bad_deadline", (
+            f"deadline_s must be > 0 seconds (or None for no "
+            f"deadline), got {deadline_s}")
+    return None
+
+
+def check_drain_timeout(timeout_s) -> None:
+    """Shared ``drain(timeout_s=...)`` validation (server + frontend)."""
+    if timeout_s is not None and timeout_s < 0:
+        raise ValueError(
+            f"drain timeout_s must be >= 0 (or None for unbounded), "
+            f"got {timeout_s}")
+
+
 def _safe_cache_size(fn) -> int:
     """``_cache_size`` is private JAX API; a JAX upgrade must degrade the
     trace-count stat (-1), never crash step telemetry."""
@@ -121,7 +149,8 @@ class ContinuousBatchingServer:
     def __init__(self, engine: InferenceEngine,
                  registry: Optional[MetricRegistry] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 fault_injector: Optional[FaultInjector] = None):
+                 fault_injector: Optional[FaultInjector] = None,
+                 supervised: bool = False):
         if engine.model_config.head == "none":
             raise ValueError("continuous batching needs an LM head — "
                              "encoder models have nothing to decode")
@@ -131,6 +160,14 @@ class ContinuousBatchingServer:
                 "unsupported — the paged pool is already the "
                 "long-context memory lever")
         self.engine = engine
+        # supervised = this server is ONE REPLICA under a ServingFrontend
+        # (inference/frontend.py): the frontend owns the scrape port and
+        # installs its own heartbeat watchdog on self.watchdog, so the
+        # config-armed endpoint and stall-dump thread stay off here —
+        # everything else (tracing, SLO, step profile, fault sites) is
+        # per-replica as usual
+        self._supervised = supervised
+        self._closed = False
         cfg = engine.config
         mcfg = engine.model_config
         self.block_size = cfg.block_size
@@ -222,7 +259,8 @@ class ContinuousBatchingServer:
             self._pool_acct = KVPoolAccountant(
                 registry=self.telemetry, clock=self._clock)
         self.http_server = None
-        if tcfg is not None and enabled and tcfg.http_port is not None:
+        if (tcfg is not None and enabled and tcfg.http_port is not None
+                and not supervised):
             self.http_server = start_http_server(
                 tcfg.http_port, host=tcfg.http_host,
                 registry=self.telemetry, tracer=self.tracer,
@@ -525,6 +563,12 @@ class ContinuousBatchingServer:
         import weakref
 
         from deepspeed_tpu.telemetry.flight import arm_flight_recorder
+        if (self._supervised and tcfg is not None
+                and tcfg.watchdog_deadline_s is not None):
+            # the supervising frontend's per-replica heartbeat watchdog
+            # replaces the config-armed stall thread (it will be
+            # installed on self.watchdog right after construction)
+            tcfg = tcfg.model_copy(update={"watchdog_deadline_s": None})
         ref = weakref.ref(self)
 
         def _pool():
@@ -704,20 +748,11 @@ class ContinuousBatchingServer:
         mid-prefill/decode with its partial output if resident — and is
         never admitted past its deadline. ``priority`` (higher wins)
         orders preemption and shedding victims; FIFO breaks ties."""
-        if not prompt:
-            self._count_rejection("empty_prompt", request_id)
-            raise ValueError("empty prompt")
         floor = max(1, self.engine.config.min_out_tokens)
-        if max_new_tokens < floor:
-            self._count_rejection("budget_floor", request_id)
-            raise ValueError(
-                f"max_new_tokens={max_new_tokens} is below the "
-                f"schedulable floor {floor} (min_out_tokens)")
-        if deadline_s is not None and deadline_s <= 0:
-            self._count_rejection("bad_deadline", request_id)
-            raise ValueError(
-                f"deadline_s must be > 0 seconds (or None for no "
-                f"deadline), got {deadline_s}")
+        rej = submit_rejection(prompt, max_new_tokens, floor, deadline_s)
+        if rej is not None:
+            self._count_rejection(rej[0], request_id)
+            raise ValueError(rej[1])
         if request_id is None:
             request_id = self._next_id
         elif (request_id in self._results
@@ -900,6 +935,29 @@ class ContinuousBatchingServer:
                        list(state.request.prompt) + list(state.generated),
                        reason)
         return True
+
+    def reclaim(self, request_id: int) -> Optional[List[int]]:
+        """Take an UNFINISHED request away from this server without
+        leaving a terminal record: cancel it (blocks release through
+        the normal refcount path), then forget its result and finish
+        reason so the SAME id can be resubmitted here later. The
+        supervising frontend's rolling-drain re-route uses this — a
+        plain ``cancel()`` would leave a ``cancelled`` entry that the
+        duplicate-id guard treats as "already finished", blocking the
+        id's return after the replica re-admits. Returns the partial
+        output (prompt + committed tokens) the caller resubmits from,
+        or None when the request is unknown or already finished (a
+        finished request is a result, not reclaimable work). The
+        cancellation still counts on this server's lifecycle books —
+        from the replica's view it IS one; the supervisor's own
+        accounting tells the re-route story."""
+        if request_id in self._results:
+            return None
+        if not self.cancel(request_id):
+            return None
+        out = self._results.pop(request_id)
+        self.finish_reasons.pop(request_id, None)
+        return out
 
     def _fail_request(self, req: Request, tokens: List[int],
                       error: str, finished: Optional[list]) -> None:
@@ -2148,10 +2206,7 @@ class ContinuousBatchingServer:
         ``cancelled``, partial results returned) — a single wedged slot
         can no longer spin the process forever. ``timeout_s=0`` cancels
         immediately; None preserves the unbounded behavior."""
-        if timeout_s is not None and timeout_s < 0:
-            raise ValueError(
-                f"drain timeout_s must be >= 0 (or None for unbounded), "
-                f"got {timeout_s}")
+        check_drain_timeout(timeout_s)
         deadline = None if timeout_s is None \
             else self._clock() + timeout_s
         while not self.scheduler.idle:
@@ -2199,7 +2254,22 @@ class ContinuousBatchingServer:
 
     def close(self) -> None:
         """Release the scrape endpoint, the watchdog thread, and the
-        memory-monitor registrations (if config armed them)."""
+        memory-monitor registrations (if config armed them). Idempotent,
+        and safe on a server in ANY health state — a supervising
+        frontend tears replicas down wedged, stalled, or mid-pipeline
+        (docs/serving.md "Replicated serving & failover")."""
+        if self._closed:
+            return
+        self._closed = True
+        # detach + disarm the stall watchdog BEFORE the teardown flush:
+        # committing the stale in-flight step below notifies progress,
+        # which would RE-ARM a watchdog that already fired on this very
+        # stall — its checker thread (alive until stopped) could then
+        # dump the same stall's event ring a second time mid-teardown
+        wd, self.watchdog = self.watchdog, None
+        if wd is not None:
+            wd.disarm()
+            wd.stop()
         if self.http_server is not None:
             self.http_server.close()
             self.http_server = None
@@ -2214,7 +2284,6 @@ class ContinuousBatchingServer:
         self._flush_pipeline(self._deferred_finished, reason="close")
         self._worker.close()
         self._flight.close()
-        self.watchdog = None
 
     # ------------------------------------------------------------ stats
 

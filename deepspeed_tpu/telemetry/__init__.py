@@ -28,7 +28,9 @@ from deepspeed_tpu.telemetry.events import (EventRing, dump_ring,
                                             get_event_ring,
                                             install_fault_dump,
                                             record_event, set_event_ring)
-from deepspeed_tpu.telemetry.faultinject import FaultInjector, PrefillFault
+from deepspeed_tpu.telemetry.faultinject import (FaultInjector,
+                                                 PrefillFault,
+                                                 ReplicaKilled)
 from deepspeed_tpu.telemetry.goodput import GoodputMeter
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
                                               start_http_server)
@@ -80,6 +82,7 @@ __all__ = [
     "set_tracer", "SLOMonitor",
     # fault injection (chaos hooks for the serving lifecycle layer)
     "FaultInjector", "FaultInjectionConfig", "PrefillFault",
+    "ReplicaKilled",
     # serving step observatory + KV-pool accounting
     "StepProfiler", "NULL_STEP_HANDLE", "KVPoolAccountant",
 ]

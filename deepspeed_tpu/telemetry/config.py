@@ -78,9 +78,14 @@ class FaultInjectionConfig(DeepSpeedConfigModel):
     # every Nth submitted request never finishes (decodes until a
     # deadline / drain timeout reaps it); 0 = off
     wedge_nth_request: int = 0
+    # replicated serving (inference/frontend.py): at this frontend tick,
+    # ONE seeded-chosen replica's step raises — the supervisor must
+    # declare it dead and fail its requests over without losing a
+    # token. 0 = off; only a ServingFrontend consults it.
+    replica_kill_step: int = 0
 
     @field_validator("step_latency_s", "famine_blocks",
-                     "wedge_nth_request")
+                     "wedge_nth_request", "replica_kill_step")
     @classmethod
     def _non_negative(cls, v, info):
         if v < 0:

@@ -60,6 +60,15 @@ SERVER_STEP_PROFILE = "server_step_profile"
 # the pool could not cover froze the allocator state here — one event
 # per famine episode, re-armed by the next successful allocation
 POOL_FAMINE = "pool_famine"
+# replicated serving (docs/serving.md "Replicated serving & failover"):
+# every replica health transition (healthy <-> degraded -> dead, plus
+# draining/re-admission) leaves one entry naming the replica, the edge,
+# and the reason the state machine took it
+REPLICA_HEALTH = "replica_health"
+# one entry per failed-over request: which replica lost it, how many
+# committed tokens fold into the replayed prompt, and the running
+# failover count the bounded-retry policy judges
+REPLICA_FAILOVER = "replica_failover"
 # KV host tiering (docs/serving.md "KV quantization & host tiering"):
 # the swap-in rate over the rolling window crossed the thrash
 # threshold — blocks are cycling device<->host faster than they serve,

@@ -44,6 +44,8 @@ ROUTES = {
                      "(telemetry/tracing.py; see also dump_timeline)",
     "/debug/goodput": "serving step-profile phase/goodput totals + "
                       "KV-pool accounting (telemetry/step_profile.py)",
+    "/debug/replicas": "replica-pool health/routing/failover state "
+                       "(inference/frontend.py ServingFrontend)",
 }
 
 
@@ -61,7 +63,7 @@ class TelemetryHTTPServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[MetricRegistry] = None,
                  event_ring=None, memory=None, tracer=None,
-                 goodput=None,
+                 goodput=None, replicas=None,
                  handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S):
         if handler_timeout_s is not None and handler_timeout_s <= 0:
             raise ValueError(
@@ -131,6 +133,18 @@ class TelemetryHTTPServer:
                                         "(telemetry.step_profile)"})
                     body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
+                elif path == "/debug/replicas":
+                    # ``replicas`` is the owner's zero-arg snapshot
+                    # callable (a ServingFrontend's pool view); a bare
+                    # server's endpoint still answers self-describingly
+                    payload = (replicas() if replicas is not None else
+                               {"enabled": False,
+                                "hint": "owner is not a ServingFrontend "
+                                        "(set replication.replicas > 1 "
+                                        "— docs/serving.md 'Replicated "
+                                        "serving & failover')"})
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(
                         404, "unknown path (try " +
@@ -185,11 +199,12 @@ class TelemetryHTTPServer:
 def start_http_server(port: int, host: str = "127.0.0.1",
                       registry: Optional[MetricRegistry] = None,
                       event_ring=None, memory=None, tracer=None,
-                      goodput=None,
+                      goodput=None, replicas=None,
                       handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
     return TelemetryHTTPServer(port=port, host=host, registry=registry,
                                event_ring=event_ring, memory=memory,
                                tracer=tracer, goodput=goodput,
+                               replicas=replicas,
                                handler_timeout_s=handler_timeout_s)

@@ -10,7 +10,8 @@ fault schedule every run), and with zero hot-path cost when off (the
 server holds ``None`` and never calls in here).
 
 Injection sites (all consulted by ``inference/server.py`` /
-``inference/scheduler.py``):
+``inference/scheduler.py``, plus the replica-scoped kinds consulted by
+``inference/frontend.py``):
 
 * **step latency** — extra seconds *accounted into* the decode-step and
   per-token histograms (and any injected clock), never slept: the SLO /
@@ -25,6 +26,27 @@ Injection sites (all consulted by ``inference/server.py`` /
   finish check: it decodes forever until a deadline or a bounded
   ``drain(timeout_s=...)`` reaps it — the watchdog-clears scenario.
 
+Replica-scoped kinds (docs/serving.md "Replicated serving & failover";
+consulted by the :class:`~deepspeed_tpu.inference.frontend.
+ServingFrontend` supervisor, never by a bare server):
+
+* **replica kill** — the replica's next ``step()`` raises
+  :class:`ReplicaKilled` mid-decode; the frontend declares it dead and
+  fails its queued + in-flight requests over to survivors (targeted
+  :meth:`kill_replica`, or the seeded ``replica_kill_step`` schedule —
+  one seeded-chosen victim at a configured frontend tick).
+* **replica wedge** — the replica stops being stepped (no progress, no
+  heartbeat) until unwedged: the deterministic stand-in for a step call
+  that never returns. Drives the heartbeat-deadline → failover path.
+* **replica heartbeat loss** — the replica keeps serving but the
+  frontend stops seeing its beats: the breaker opens (degraded, no new
+  routing) and past the dead deadline the frontend fails over a replica
+  that was actually fine — failover replay keeps even that false
+  positive exact.
+* **replica slow step** — extra seconds ACCOUNTED into the replica's
+  observed step wall (never slept): drives the slow-step degraded
+  breaker without real delay.
+
 Every injection is counted (``fault_injections_total`` by kind) and
 recorded into the flight-recorder event ring, so a chaos run's forensics
 look exactly like a real incident's.
@@ -32,7 +54,7 @@ look exactly like a real incident's.
 from __future__ import annotations
 
 import random
-from typing import Optional, Set
+from typing import Dict, Optional, Set
 
 from deepspeed_tpu.telemetry import events as _ev
 from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
@@ -43,11 +65,22 @@ STEP_LATENCY = "step_latency"
 PREFILL_FAILURE = "prefill_failure"
 FAMINE = "famine"
 WEDGED_SLOT = "wedged_slot"
+# replica-scoped kinds (inference/frontend.py ServingFrontend)
+REPLICA_KILL = "replica_kill"
+REPLICA_WEDGE = "replica_wedge"
+REPLICA_HEARTBEAT_LOSS = "replica_heartbeat_loss"
+REPLICA_SLOW_STEP = "replica_slow_step"
 
 
 class PrefillFault(RuntimeError):
     """Raised by the injector at the prefill site — distinct from real
     prefill errors so tests can assert the injected one specifically."""
+
+
+class ReplicaKilled(RuntimeError):
+    """Raised by the injector at a replica's step site — the in-process
+    stand-in for a replica process crashing mid-decode. Distinct from
+    real step errors so chaos tests can assert the injected one."""
 
 
 class FaultInjector:
@@ -59,14 +92,17 @@ class FaultInjector:
     def __init__(self, seed: int = 0, step_latency_s: float = 0.0,
                  prefill_failure_rate: float = 0.0,
                  famine_blocks: int = 0, wedge_nth_request: int = 0,
+                 replica_kill_step: int = 0,
                  registry: Optional[MetricRegistry] = None):
         if not 0.0 <= prefill_failure_rate <= 1.0:
             raise ValueError(
                 f"prefill_failure_rate must be in [0, 1], got "
                 f"{prefill_failure_rate}")
-        if famine_blocks < 0 or wedge_nth_request < 0:
-            raise ValueError("famine_blocks / wedge_nth_request must be "
-                             ">= 0 (0 = fault off)")
+        if famine_blocks < 0 or wedge_nth_request < 0 \
+                or replica_kill_step < 0:
+            raise ValueError("famine_blocks / wedge_nth_request / "
+                             "replica_kill_step must be >= 0 "
+                             "(0 = fault off)")
         if step_latency_s < 0:
             raise ValueError(
                 f"step_latency_s must be >= 0, got {step_latency_s}")
@@ -76,10 +112,16 @@ class FaultInjector:
         self.prefill_failure_rate = float(prefill_failure_rate)
         self.famine_blocks = int(famine_blocks)
         self.wedge_nth_request = int(wedge_nth_request)
+        self.replica_kill_step = int(replica_kill_step)
         self._registry = registry
         self._wedged: Set[int] = set()        # request ids, targeted
         self._fail_prefill: Set[int] = set()  # request ids, targeted
         self._submitted = 0                   # wedge_nth counter
+        # replica-scoped arms (keys are replica INDICES, not request ids)
+        self._replica_kills: Dict[int, int] = {}  # replica -> kill tick
+        self._replica_wedged: Set[int] = set()
+        self._replica_hb_lost: Set[int] = set()
+        self._replica_slow: Dict[int, float] = {}
         self.injected: dict = {}              # kind -> count (host stats)
 
     @classmethod
@@ -93,6 +135,7 @@ class FaultInjector:
                    prefill_failure_rate=cfg.prefill_failure_rate,
                    famine_blocks=cfg.famine_blocks,
                    wedge_nth_request=cfg.wedge_nth_request,
+                   replica_kill_step=cfg.replica_kill_step,
                    registry=registry)
 
     # ------------------------------------------------------------ account
@@ -174,9 +217,100 @@ class FaultInjector:
                 # a transition to 0 is the chaos ENDING, not a fault
                 self._count(FAMINE, blocks=target)
 
+    # ------------------------------------------------ replica-scoped sites
+    # consulted by the ServingFrontend supervisor (inference/frontend.py)
+    # — a bare server never calls these; keys are replica indices
+
+    def schedule_replica_kill(self, num_replicas: int,
+                              at_tick: Optional[int] = None
+                              ) -> Optional[int]:
+        """Arm the seeded kill schedule against a pool of this size:
+        ONE seeded-chosen replica is killed at ``at_tick`` (default:
+        the configured ``replica_kill_step``; 0/None = schedule off).
+        Returns the victim index (or None when off) so chaos forensics
+        can name it up front. Callers that know their own tick clock
+        (the bench A/B arms the kill RELATIVE to its measured burst,
+        not to whatever warmup consumed) pass ``at_tick`` explicitly."""
+        if at_tick is None:
+            at_tick = self.replica_kill_step
+        if not at_tick or num_replicas < 1:
+            return None
+        victim = self._rng.randrange(num_replicas)
+        self.kill_replica(victim, at_tick=at_tick)
+        return victim
+
+    def kill_replica(self, replica: int,
+                     at_tick: Optional[int] = None) -> None:
+        """Arm a targeted kill: the replica's step raises
+        :class:`ReplicaKilled` at frontend tick ``at_tick`` (None = its
+        very next step)."""
+        self._replica_kills[replica] = 0 if at_tick is None \
+            else int(at_tick)
+
+    def check_replica_step(self, replica: int, tick: int) -> None:
+        """Replica step site: raises :class:`ReplicaKilled` when this
+        replica's kill tick has arrived. One-shot — the arm is consumed
+        (a restarted replica index is not re-killed)."""
+        due = self._replica_kills.get(replica)
+        if due is not None and tick >= due:
+            del self._replica_kills[replica]
+            self._count(REPLICA_KILL, replica=replica, tick=tick)
+            raise ReplicaKilled(
+                f"injected kill of replica {replica} at tick {tick}")
+
+    def wedge_replica(self, replica: int) -> None:
+        """Arm a replica wedge: the frontend stops stepping it (no
+        progress, no heartbeat) until :meth:`unwedge_replica`."""
+        if replica not in self._replica_wedged:
+            self._replica_wedged.add(replica)
+            self._count(REPLICA_WEDGE, replica=replica)
+
+    def unwedge_replica(self, replica: int) -> None:
+        self._replica_wedged.discard(replica)
+
+    def is_replica_wedged(self, replica: int) -> bool:
+        return replica in self._replica_wedged
+
+    def lose_heartbeat(self, replica: int) -> None:
+        """Arm heartbeat loss: the replica keeps serving but the
+        frontend stops seeing its beats (degraded, then a false-positive
+        failover past the dead deadline — which replay keeps exact)."""
+        if replica not in self._replica_hb_lost:
+            self._replica_hb_lost.add(replica)
+            self._count(REPLICA_HEARTBEAT_LOSS, replica=replica)
+
+    def restore_heartbeat(self, replica: int) -> None:
+        self._replica_hb_lost.discard(replica)
+
+    def replica_heartbeat_lost(self, replica: int) -> bool:
+        return replica in self._replica_hb_lost
+
+    def slow_replica(self, replica: int, extra_s: float) -> None:
+        """Arm (or with 0.0 clear) accounted slow-step latency for one
+        replica — never slept, drives the slow-step degraded breaker."""
+        if extra_s < 0:
+            raise ValueError(f"extra_s must be >= 0, got {extra_s}")
+        if extra_s:
+            if replica not in self._replica_slow:
+                self._count(REPLICA_SLOW_STEP, replica=replica,
+                            seconds=extra_s)
+            self._replica_slow[replica] = float(extra_s)
+        else:
+            self._replica_slow.pop(replica, None)
+
+    def replica_step_latency(self, replica: int) -> float:
+        """Extra seconds to ACCOUNT into this replica's observed step
+        wall (0.0 when unarmed)."""
+        return self._replica_slow.get(replica, 0.0)
+
     def snapshot(self) -> dict:
         return {"seed": self.seed, "injected": dict(self.injected),
                 "wedged": sorted(self._wedged),
                 "famine_blocks": self.famine_blocks,
                 "step_latency_s": self.step_latency_s,
-                "prefill_failure_rate": self.prefill_failure_rate}
+                "prefill_failure_rate": self.prefill_failure_rate,
+                "replica_kill_step": self.replica_kill_step,
+                "replica_kills_armed": dict(self._replica_kills),
+                "replicas_wedged": sorted(self._replica_wedged),
+                "replicas_heartbeat_lost": sorted(self._replica_hb_lost),
+                "replicas_slow": dict(self._replica_slow)}

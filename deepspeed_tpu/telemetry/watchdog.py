@@ -65,6 +65,7 @@ class Watchdog:
         self._lock = threading.Lock()
         self._last_progress = clock()
         self._fired = False
+        self._disarmed = False
         self.stalls = 0
         self.last_dump: Optional[dict] = None
         self._thread: Optional[threading.Thread] = None
@@ -79,11 +80,31 @@ class Watchdog:
             self._last_progress = self._clock()
             self._fired = False
 
+    def idle_seconds(self) -> float:
+        """Seconds since the last :meth:`notify_progress` — the heartbeat
+        age a supervisor (inference/frontend.py) reads to drive its
+        replica health state machine without touching the dump path."""
+        with self._lock:
+            return self._clock() - self._last_progress
+
+    def disarm(self) -> None:
+        """Permanently silence :meth:`check` (until a future
+        :meth:`start`): an owner tearing itself down calls this FIRST,
+        so neither the checker thread nor a late manual check can fire
+        a fresh dump against teardown-time idleness. ``stop()`` alone
+        deliberately does not disarm — tests drive a stopped watchdog's
+        ``check()`` by hand."""
+        with self._lock:
+            self._disarmed = True
+
     def check(self) -> bool:
-        """Evaluate the deadline now; returns True if a dump fired."""
+        """Evaluate the deadline now; returns True if a dump fired. A
+        disarmed watchdog never fires: teardown of an already-stalled
+        owner (a supervisor closing a dead replica) must not race the
+        checker thread into a second dump for the same stall."""
         with self._lock:
             idle = self._clock() - self._last_progress
-            if self._fired or idle <= self.deadline_s:
+            if self._disarmed or self._fired or idle <= self.deadline_s:
                 return False
             self._fired = True
             self.stalls += 1
@@ -141,6 +162,8 @@ class Watchdog:
         deadline/4 capped at 5 s — late enough to be cheap, early enough
         that a stall is reported within ~1.25 deadlines."""
         self.stop()
+        with self._lock:
+            self._disarmed = False
         interval = check_interval_s or min(self.deadline_s / 4.0, 5.0)
         stop = threading.Event()
 

@@ -15,6 +15,54 @@ ContinuousBatchingServer — docs/serving.md "Continuous batching"):
 import argparse
 
 
+def run_replicated(eng, prompt, args):
+    """Drive a --replicas N pool end-to-end through the ServingFrontend
+    (docs/serving.md "Replicated serving & failover"): staggered
+    arrivals, optional seeded chaos (a mid-decode replica kill plus the
+    per-server wedge/prefill faults), bounded drain, and the per-replica
+    health/routing/failover report."""
+    from deepspeed_tpu.inference.frontend import ServingFrontend
+    fi = None
+    if args.chaos:
+        # seeded pool-level chaos: one seeded-chosen replica is killed
+        # mid-decode at frontend tick 6 (its work fails over and still
+        # finishes exactly), every 5th request wedges, prefills
+        # occasionally die — the pool degrades; nothing is lost
+        from deepspeed_tpu.telemetry import FaultInjector
+        fi = FaultInjector(seed=0, wedge_nth_request=5,
+                           prefill_failure_rate=0.1, replica_kill_step=6)
+    front = ServingFrontend(eng, fault_injector=fi)
+    ids = []
+    for i in range(args.continuous):
+        p = prompt[: 1 + i % len(prompt)]
+        ids.append(front.submit(p, max_new_tokens=2 + args.max_new_tokens
+                                * (i % 3) // 2,
+                                deadline_s=args.deadline_s,
+                                priority=i % 2 if args.chaos else 0))
+        front.step()
+    out = front.drain(timeout_s=60.0 if args.chaos else None)
+    for rid in ids:
+        reason = front.finish_reason(rid)
+        tag = "" if reason in ("eos", "length") else f"  [{reason}]"
+        print(f"request {rid}: {out.get(rid)}{tag}")
+    st = front.stats
+    print(f"pool: {st['healthy_replicas']}/{len(st['replicas'])} "
+          f"replicas healthy, {st['failovers']} failovers, "
+          f"{st['failover_replay_tokens']} replay tokens, "
+          f"{st['drain_reroutes']} drain re-routes")
+    for row in st["replicas"]:
+        dead = (f" ({row['dead_reason']})"
+                if row["dead_reason"] else "")
+        print(f"  replica {row['replica']}: {row['health']}{dead} — "
+              f"routed {row['routed']}, steps {row['steps']}, "
+              f"failovers-from {row['failovers_from']}")
+    if front.http_server is not None:
+        port = front.http_server.port
+        input(f"pool state at http://127.0.0.1:{port}/debug/replicas "
+              "— press Enter to exit")
+    front.close()
+
+
 def run_continuous(eng, prompt, args):
     """Replay --continuous staggered arrivals: submit a new request
     every other scheduler step, drain, report per-request outputs and
@@ -191,6 +239,14 @@ def main():
                          "(continuous mode; implies --prefix-cache): "
                          "LRU eviction becomes demotion, prefix hits "
                          "on demoted blocks swap back in")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="replicated serving: drive N supervised server "
+                         "replicas through the ServingFrontend instead "
+                         "of one bare server (continuous mode; combine "
+                         "with --chaos for a seeded mid-decode replica "
+                         "kill that fails over losslessly — "
+                         "docs/serving.md 'Replicated serving & "
+                         "failover')")
     ap.add_argument("--speculate", type=int, default=0, metavar="K",
                     help="per-slot speculative decoding: each active "
                          "slot proposes up to K-1 tokens per step by "
@@ -275,10 +331,15 @@ def main():
     if args.speculate:
         knobs["speculation_tokens"] = args.speculate
     knobs["async_loop"] = args.async_loop
+    if args.replicas and args.replicas > 1:
+        knobs["replication"] = {"replicas": args.replicas}
     eng = deepspeed_tpu.init_inference(args.path, **knobs)
     prompt = [int(t) for t in args.prompt_ids.split(",")]
     if args.continuous:
-        run_continuous(eng, prompt, args)
+        if args.replicas and args.replicas > 1:
+            run_replicated(eng, prompt, args)
+        else:
+            run_continuous(eng, prompt, args)
         return
     out = eng.generate([prompt], max_new_tokens=args.max_new_tokens,
                        num_beams=args.num_beams,

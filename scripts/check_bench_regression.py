@@ -61,6 +61,11 @@ METRICS = {
     # pipelined-leg device-idle p90 from the ON/OFF A/B — a regression
     # means the loop stopped closing the gap it exists to close
     "async_loop.dispatch_gap_p90_ms": "down",
+    # replicated serving (docs/serving.md "Replicated serving &
+    # failover"): fraction of submitted requests that still finish
+    # eos/length under the seeded mid-decode replica kill — anything
+    # below 1.0 means failover started LOSING requests
+    "replication.availability": "up",
     # KV tiering (docs/serving.md "KV quantization & host tiering"):
     # device KV bytes per resident slot, fp over int8 — how many more
     # sequences the same HBM holds with the int8 pool; a regression

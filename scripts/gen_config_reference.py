@@ -230,7 +230,8 @@ def generate() -> str:
                      "SLOs\" for the evaluation semantics and metric "
                      "names."))
 
-    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
+                                                ReplicationConfig)
     buf.write("## Inference config (`init_inference`)\n\n")
     emit_model(
         buf, "DeepSpeedInferenceConfig", DeepSpeedInferenceConfig,
@@ -240,6 +241,12 @@ def generate() -> str:
               "`num_slots`, `enable_prefix_caching`, "
               "`prefill_chunk_tokens`, ...) are documented in "
               "docs/serving.md; `telemetry` shares the schema above."))
+    emit_model(
+        buf, "replication", ReplicationConfig,
+        note=("Consumed by `inference/frontend.py` `ServingFrontend` — "
+              "see docs/serving.md \"Replicated serving & failover\" "
+              "for the health state machine, failover semantics, and "
+              "drain protocol these knobs drive."))
 
     buf.write(
         "## Subsystem configs documented elsewhere\n\n"

@@ -415,6 +415,28 @@ def test_bench_serve_continuous_smoke():
     assert off["preempted"] == 0
     assert off["host_bytes_visible"] is True
     assert off["swap_outs_accounted"] == off["demotions"]
+    # replicated-serving A/B (auto 2 replicas + seeded kill in smoke,
+    # docs/serving.md "Replicated serving & failover"): with a replica
+    # killed mid-decode, EVERY submitted request still finishes
+    # eos/length (availability 1.0 — the replication.availability
+    # regression gate's input) token-identical to the undisturbed leg,
+    # failover demonstrably fired with bounded replay-token overhead,
+    # and the per-replica stats rows name exactly one dead replica
+    rp = rec["replication"]
+    assert rp["replicas"] == 2
+    assert rp["chaos_kill"] is True
+    assert rp["availability"] == 1.0
+    assert rp["availability_undisturbed"] == 1.0
+    assert rp["parity_exact"] is True
+    assert rp["failovers"] >= 1
+    assert rp["dead_replicas"] == 1
+    assert rp["replay_tokens"] >= 1
+    assert 0.0 < rp["replay_token_overhead"] < 1.0
+    assert rp["token_p90_ms"] is not None
+    rows = rp["replicas_stats"]
+    assert len(rows) == 2
+    assert sum(1 for r in rows if r["health"] == "dead") == 1
+    assert all(r["routed"] >= 1 for r in rows)
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm

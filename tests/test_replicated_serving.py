@@ -1,0 +1,615 @@
+"""Replicated serving — the supervisor chaos suite (ISSUE 13).
+
+A :class:`ServingFrontend` over N in-process server replicas must
+survive any SINGLE replica crashing, wedging, losing its heartbeat, or
+draining — without losing a request or a token. Everything here runs on
+the injectable frontend clock and the replica-scoped fault kinds
+(telemetry/faultinject.py) — ZERO real sleeps. The oracles:
+
+* a request killed MID-DECODE on its replica finishes on a survivor
+  with greedy output token-identical to one-shot ``generate()`` (the
+  committed tokens fold into the replayed prompt — PR-7's recompute
+  idiom, now across replicas);
+* a single-replica frontend is byte-identical to a bare server (the
+  no-overhead oracle);
+* retries exhausted → ``failed``, never a hang; ``drain_replica``
+  loses nothing and re-admits.
+
+Plus the supervisor-teardown pins: ``server.close()`` is idempotent,
+cannot double-dump a fired watchdog's ring, and survives a dead
+publish-worker thread.
+"""
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference import (ContinuousBatchingServer,
+                                     DeepSpeedInferenceConfig,
+                                     InferenceEngine, ServingFrontend)
+from deepspeed_tpu.inference.async_loop import _STOP, PublishWorker
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, init_params)
+from deepspeed_tpu.telemetry import (EventRing, FaultInjector,
+                                     MetricRegistry, ReplicaKilled,
+                                     Watchdog, get_event_ring,
+                                     get_registry, set_event_ring,
+                                     set_registry, start_http_server)
+from deepspeed_tpu.telemetry import events as ev
+
+
+@pytest.fixture()
+def fresh_telemetry():
+    prev_reg = set_registry(MetricRegistry())
+    prev_ring = set_event_ring(EventRing(512))
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev_reg)
+        set_event_ring(prev_ring)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0, auto: float = 0.0):
+        self.t = t
+        self.auto = auto
+
+    def __call__(self) -> float:
+        v = self.t
+        self.t += self.auto
+        return v
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+_MCFG = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+             n_head=4, dtype=jnp.float32)
+
+
+def make_engine(seed=0, max_out_tokens=256, block_size=32, num_slots=2,
+                replicas=2, repl_knobs=None, **knobs):
+    cfg = InferenceTransformerConfig(**_MCFG)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    repl = {"replicas": replicas}
+    repl.update(repl_knobs or {})
+    return InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=max_out_tokens,
+        block_size=block_size, num_slots=num_slots,
+        replication=repl, **knobs))
+
+
+def events_of(kind):
+    return [e for e in get_event_ring().snapshot() if e["kind"] == kind]
+
+
+def replica_of(front, rid):
+    return front._requests[rid].replica
+
+
+# ------------------------------------------------------- no-overhead oracle
+
+def test_single_replica_frontend_byte_identical(fresh_telemetry):
+    """replicas=1 is a pass-through: same prompts, same finish reasons,
+    byte-identical tokens vs a bare server on the same weights."""
+    eng = make_engine(replicas=1)
+    prompts = [[1, 2, 3], [9, 8, 7, 6, 5], [4, 4], [10, 20, 30]]
+    srv = ContinuousBatchingServer(eng)
+    bare_ids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    bare = srv.drain()
+    srv.close()
+    front = ServingFrontend(eng)
+    ids = [front.submit(p, max_new_tokens=6) for p in prompts]
+    out = front.drain()
+    assert [out[i] for i in ids] == [bare[i] for i in bare_ids]
+    assert [front.finish_reason(i) for i in ids] == \
+        [srv.finish_reason(i) for i in bare_ids]
+    assert front.stats["failovers"] == 0
+    front.close()
+
+
+# ------------------------------------------------------ kill → failover
+
+def test_kill_mid_decode_exact_parity(fresh_telemetry):
+    """THE chaos oracle: a replica killed mid-decode loses nothing —
+    every affected request resumes on a survivor from its committed
+    prefix and finishes token-identical to one-shot generate()."""
+    eng = make_engine(replicas=2)
+    fi = FaultInjector()
+    front = ServingFrontend(eng, fault_injector=fi)
+    prompts = [[1, 2, 3], [9, 8, 7, 6, 5], [4, 4]]
+    ids = [front.submit(p, max_new_tokens=8) for p in prompts]
+    for _ in range(3):
+        front.step()              # tokens committed on both replicas
+    victim = replica_of(front, ids[0])
+    held = [r for r in ids if replica_of(front, r) == victim]
+    assert held                   # the kill hits live work
+    committed = len(front.replicas[victim].server.scheduler.slots[
+        0].generated) if 0 in front.replicas[victim].server.scheduler.slots \
+        else 1
+    assert committed >= 1         # genuinely mid-decode
+    fi.kill_replica(victim)
+    out = front.drain()
+    for rid, p in zip(ids, prompts):
+        ref = eng.generate([p], max_new_tokens=8)[0]
+        assert out[rid] == ref[:len(out[rid])]
+        assert len(out[rid]) == len(p) + 8
+        assert front.finish_reason(rid) in ("eos", "length")
+    st = front.stats
+    assert st["failovers"] == len(held)
+    assert st["failover_replay_tokens"] >= 1
+    assert st["dead_replicas"] == 1
+    row = st["replicas"][victim]
+    assert row["health"] == "dead"
+    assert "injected kill" in row["dead_reason"]
+    assert row["failovers_from"] == len(held)
+    # forensics: one health transition to dead + one failover event per
+    # moved request; the failover counters are on the frontend registry
+    deads = [e for e in events_of(ev.REPLICA_HEALTH)
+             if e["data"]["to"] == "dead"]
+    assert len(deads) == 1 and deads[0]["data"]["replica"] == victim
+    assert len(events_of(ev.REPLICA_FAILOVER)) == len(held)
+    snap = fresh_telemetry.snapshot()
+    assert snap["serve_failovers_total"]["series"][0]["value"] == \
+        len(held)
+    assert snap["serve_failover_replay_tokens_total"]["series"][0][
+        "value"] >= 1
+    front.close()
+
+
+def test_seeded_kill_schedule_deterministic(fresh_telemetry):
+    """The config-armed seeded kill (fault_injection.replica_kill_step)
+    replays the same victim and the same outputs run to run."""
+    def run():
+        eng = make_engine(replicas=2, telemetry={
+            "fault_injection": {"enabled": True, "seed": 3,
+                                "replica_kill_step": 3}})
+        front = ServingFrontend(eng, registry=MetricRegistry())
+        ids = [front.submit([1 + i, 2, 3], max_new_tokens=6)
+               for i in range(4)]
+        out = front.drain()
+        st = front.stats
+        dead = [r["replica"] for r in st["replicas"]
+                if r["health"] == "dead"]
+        front.close()
+        return [out[r] for r in ids], \
+            [front.finish_reason(r) for r in ids], dead, st["failovers"]
+
+    r1, r2 = run(), run()
+    assert r1 == r2
+    assert r1[2] and len(r1[2]) == 1          # exactly one seeded death
+    assert all(x in ("eos", "length") for x in r1[1])
+
+
+def test_kill_replica_holding_queue_requeues_lost_nothing(
+        fresh_telemetry):
+    """Queued work on the dead replica re-routes — never lost."""
+    eng = make_engine(replicas=2, num_slots=1)
+    fi = FaultInjector()
+    front = ServingFrontend(eng, fault_injector=fi)
+    a = front.submit([1, 2, 3], max_new_tokens=8)     # resident rep 0
+    b = front.submit([4, 5, 6], max_new_tokens=8)     # resident rep 1
+    c = front.submit([7, 8], max_new_tokens=5)        # queued on rep 0
+    front.step()
+    assert replica_of(front, a) == 0
+    assert replica_of(front, c) == 0
+    assert front.replicas[0].server.scheduler.pending_requests == 1
+    fi.kill_replica(0)
+    out = front.drain()
+    for rid, p in ((a, [1, 2, 3]), (b, [4, 5, 6]), (c, [7, 8])):
+        ref = eng.generate([p], max_new_tokens=8 if rid != c else 5)[0]
+        assert out[rid] == ref[:len(out[rid])]
+        assert front.finish_reason(rid) in ("eos", "length")
+    assert front.stats["failovers"] == 2              # a and c moved
+    front.close()
+
+
+def test_retries_exhausted_failed_not_hang(fresh_telemetry):
+    """Failover retries are bounded: past max_failovers the request is
+    failed loudly; with every replica dead, stranded work fails too and
+    drain() terminates instead of spinning."""
+    eng = make_engine(replicas=2, repl_knobs={"max_failovers": 0})
+    fi = FaultInjector()
+    front = ServingFrontend(eng, fault_injector=fi)
+    a = front.submit([1, 2, 3], max_new_tokens=8)
+    front.step()
+    fi.kill_replica(replica_of(front, a))
+    front.step()
+    assert front.finish_reason(a) == "failed"         # 1 failover > 0
+    assert front.result(a)[:3] == [1, 2, 3]           # partial returned
+    # now kill the survivor with work outstanding: stranded → failed
+    b = front.submit([4, 5], max_new_tokens=6)
+    front.step()
+    fi.kill_replica(replica_of(front, b))
+    out = front.drain()                               # terminates
+    assert front.finish_reason(b) == "failed"
+    assert out[b][:2] == [4, 5]
+    assert front.stats["dead_replicas"] == 2
+    with pytest.raises(RuntimeError, match="every replica is dead"):
+        front.submit([9, 9], max_new_tokens=4)
+    # frontend-decided finishes and refusals count like a bare
+    # server's: the failed finishes ticked the lifecycle family and
+    # left REQUEST_FAILED ring events, the dead-pool refusal landed in
+    # the admission-rejection family
+    snap = fresh_telemetry.snapshot()
+    assert snap["serve_requests_failed_total"]["series"][0]["value"] == 2
+    assert any(e["data"].get("source") == "frontend"
+               for e in events_of(ev.REQUEST_FAILED))
+    rej = snap["serve_admission_rejections_total"]["series"]
+    assert any(s["labels"].get("reason") == "replicas_dead" for s in rej)
+    front.close()
+
+
+# ------------------------------------------------- wedge → deadline → move
+
+def test_wedge_degrades_then_deadline_failover(fresh_telemetry):
+    """A wedged replica (no steps, no beats) passes through the breaker
+    (degraded — no new routing) and past heartbeat_dead_s is declared
+    dead: its resident fails over and finishes EXACT, and the installed
+    watchdog fired the standard one-per-stall forensic dump."""
+    clock = FakeClock()
+    eng = make_engine(replicas=2, repl_knobs={
+        "heartbeat_degraded_s": 2.0, "heartbeat_dead_s": 10.0})
+    fi = FaultInjector()
+    front = ServingFrontend(eng, clock=clock, fault_injector=fi)
+    a = front.submit([1, 2, 3], max_new_tokens=10)    # → replica 0
+    b = front.submit([4, 5, 6], max_new_tokens=10)    # → replica 1
+    for _ in range(2):
+        front.step()
+    fi.wedge_replica(0)
+    clock.advance(3.0)                    # stale past degraded_s
+    front.step()
+    assert front.replicas[0].health == "degraded"
+    assert front.replicas[1].health == "healthy"
+    # breaker: new work avoids the degraded replica
+    c = front.submit([7, 7], max_new_tokens=4)
+    assert replica_of(front, c) == 1
+    clock.advance(9.0)                    # stale past dead_s
+    front.step()
+    assert front.replicas[0].health == "dead"
+    assert "no heartbeat" in front.replicas[0].dead_reason
+    # the heartbeat watchdog fired its forensic dump exactly once
+    assert front.replicas[0].watchdog.stalls == 1
+    assert events_of(ev.WATCHDOG_DUMP)
+    out = front.drain()
+    for rid, p, n in ((a, [1, 2, 3], 10), (b, [4, 5, 6], 10),
+                      (c, [7, 7], 4)):
+        ref = eng.generate([p], max_new_tokens=n)[0]
+        assert out[rid] == ref[:len(out[rid])]
+        assert front.finish_reason(rid) in ("eos", "length")
+    # health transitions in order: degraded then dead for replica 0
+    trans = [(e["data"]["frm"], e["data"]["to"])
+             for e in events_of(ev.REPLICA_HEALTH)
+             if e["data"]["replica"] == 0]
+    assert trans == [("healthy", "degraded"), ("degraded", "dead")]
+    front.close()
+
+
+def test_wedge_recovery_closes_breaker(fresh_telemetry):
+    """Unwedged before the deadline: beats resume, degraded → healthy,
+    routing returns — no failover ever happens."""
+    clock = FakeClock()
+    eng = make_engine(replicas=2)
+    fi = FaultInjector()
+    front = ServingFrontend(eng, clock=clock, fault_injector=fi)
+    a = front.submit([1, 2, 3], max_new_tokens=12)
+    front.step()
+    fi.wedge_replica(0)
+    clock.advance(3.0)
+    front.step()
+    assert front.replicas[0].health == "degraded"
+    fi.unwedge_replica(0)
+    front.step()
+    assert front.replicas[0].health == "healthy"
+    out = front.drain()
+    assert front.stats["failovers"] == 0
+    ref = eng.generate([[1, 2, 3]], max_new_tokens=12)[0]
+    assert out[a] == ref[:len(out[a])]
+    front.close()
+
+
+def test_heartbeat_loss_false_positive_failover_still_exact(
+        fresh_telemetry):
+    """Heartbeat loss on a HEALTHY replica: the breaker opens, and past
+    the deadline the frontend fails over a replica that was actually
+    fine — the replay keeps even that false positive token-exact."""
+    clock = FakeClock()
+    eng = make_engine(replicas=2)
+    fi = FaultInjector()
+    front = ServingFrontend(eng, clock=clock, fault_injector=fi)
+    a = front.submit([1, 2, 3], max_new_tokens=10)
+    b = front.submit([4, 5, 6], max_new_tokens=10)
+    for _ in range(2):
+        front.step()
+    fi.lose_heartbeat(0)
+    clock.advance(3.0)
+    front.step()                          # still STEPPED, beats unseen
+    assert front.replicas[0].health == "degraded"
+    # the replica kept serving while degraded (residents decode on)
+    steps_before = front.replicas[0].steps
+    front.step()
+    assert front.replicas[0].steps > steps_before
+    clock.advance(9.0)
+    front.step()
+    assert front.replicas[0].health == "dead"
+    out = front.drain()
+    for rid, p in ((a, [1, 2, 3]), (b, [4, 5, 6])):
+        ref = eng.generate([p], max_new_tokens=10)[0]
+        assert out[rid] == ref[:len(out[rid])]
+    assert front.stats["failovers"] >= 1
+    front.close()
+
+
+def test_slow_step_trips_and_clears_breaker(fresh_telemetry):
+    """Accounted slow-step latency past degraded_step_s opens the
+    breaker while beats stay fresh; clearing it closes the breaker."""
+    eng = make_engine(replicas=2, repl_knobs={"degraded_step_s": 0.5})
+    fi = FaultInjector()
+    front = ServingFrontend(eng, clock=FakeClock(), fault_injector=fi)
+    a = front.submit([1, 2, 3], max_new_tokens=8)
+    front.step()
+    fi.slow_replica(0, 2.0)               # accounted, never slept
+    front.step()
+    assert front.replicas[0].health == "degraded"
+    b = front.submit([4, 4], max_new_tokens=4)
+    assert replica_of(front, b) == 1      # breaker steers away
+    fi.slow_replica(0, 0.0)
+    front.step()
+    assert front.replicas[0].health == "healthy"
+    out = front.drain()
+    assert front.stats["failovers"] == 0
+    ref = eng.generate([[1, 2, 3]], max_new_tokens=8)[0]
+    assert out[a] == ref[:len(out[a])]
+    front.close()
+
+
+# ------------------------------------------------------- rolling drain
+
+def test_drain_replica_loses_nothing_and_readmits(fresh_telemetry):
+    """Rolling drain: queued work re-routes immediately, residents
+    finish in place, the replica re-admits once idle and takes new
+    traffic — zero requests lost, all outputs exact."""
+    eng = make_engine(replicas=2, num_slots=1)
+    front = ServingFrontend(eng)
+    a = front.submit([1, 2, 3], max_new_tokens=10)    # resident rep 0
+    b = front.submit([4, 5, 6], max_new_tokens=10)    # resident rep 1
+    c = front.submit([7, 8], max_new_tokens=5)        # queued on rep 0
+    front.step()
+    front.drain_replica(0)
+    assert front.replicas[0].draining
+    assert front.stats["drain_reroutes"] == 1
+    front.step()
+    assert replica_of(front, c) == 1                  # re-routed
+    # new traffic avoids the drainer while it drains
+    d = front.submit([9, 9, 9], max_new_tokens=4)
+    assert replica_of(front, d) == 1
+    out = front.drain()                               # a finishes on 0
+    assert not front.replicas[0].draining             # re-admitted
+    assert front.replicas[0].routable
+    for rid, p, n in ((a, [1, 2, 3], 10), (b, [4, 5, 6], 10),
+                      (c, [7, 8], 5), (d, [9, 9, 9], 4)):
+        ref = eng.generate([p], max_new_tokens=n)[0]
+        assert out[rid] == ref[:len(out[rid])]
+        assert front.finish_reason(rid) in ("eos", "length")
+    assert front.stats["failovers"] == 0              # drain ≠ failure
+    # the re-admitted replica serves again
+    e = front.submit([2, 2], max_new_tokens=3)
+    assert replica_of(front, e) == 0
+    front.drain()
+    # drain events bracket the episode
+    drains = [(x["data"]["frm"], x["data"]["to"])
+              for x in events_of(ev.REPLICA_HEALTH)
+              if x["data"]["replica"] == 0]
+    assert ("healthy", "draining") in drains
+    assert ("draining", "healthy") in drains
+    front.close()
+
+
+def test_drain_replica_dead_is_an_error(fresh_telemetry):
+    eng = make_engine(replicas=2)
+    fi = FaultInjector()
+    front = ServingFrontend(eng, fault_injector=fi)
+    fi.kill_replica(0)
+    front.step()
+    with pytest.raises(ValueError, match="dead"):
+        front.drain_replica(0)
+    front.close()
+
+
+# --------------------------------------------------- lifecycle pass-through
+
+def test_deadline_and_cancel_through_the_pool(fresh_telemetry):
+    """Per-request deadlines ride to the replica (remaining budget on
+    resubmit) and cancel() works frontend-queued or resident."""
+    clock = FakeClock()
+    eng = make_engine(replicas=2, num_slots=1)
+    front = ServingFrontend(eng, clock=clock)
+    a = front.submit([1, 2, 3], max_new_tokens=40, deadline_s=5.0)
+    front.step()
+    clock.advance(10.0)                   # expires resident on replica
+    front.step()
+    assert front.finish_reason(a) == "deadline"
+    # cancel a resident
+    b = front.submit([4, 5, 6], max_new_tokens=40)
+    front.step()
+    assert front.cancel(b) is True
+    assert front.finish_reason(b) == "cancelled"
+    assert front.result(b)[:3] == [4, 5, 6]
+    assert front.cancel(b) is False       # idempotent
+    # cancel frontend-held work: fill every slot+queue... simpler, a
+    # request whose replica died waits out its backoff in the frontend
+    fi = front._fi = FaultInjector()
+    c = front.submit([7, 7, 7], max_new_tokens=8)
+    front.step()
+    fi.kill_replica(replica_of(front, c))
+    front.step()                          # failover → pending (backoff)
+    assert front._requests[c].replica is None
+    assert front.cancel(c) is True
+    assert front.finish_reason(c) == "cancelled"
+    front.drain()
+    front.close()
+
+
+def test_cancel_collects_flush_committed_finish(fresh_telemetry):
+    """A flush inside one request's cancel can commit ANOTHER request's
+    final in-flight token server-side before the frontend's next step
+    collects it. Cancelling that already-finished request must collect
+    the finish (result preserved, record closed) — returning False and
+    leaving it outstanding stranded it forever: drain(timeout_s)'s
+    cancel-all straggler loop dropped a computed result on the floor
+    (review-found, regression-pinned)."""
+    eng = make_engine(replicas=1)
+    front = ServingFrontend(eng)
+    a = front.submit([1, 2, 3], max_new_tokens=10)
+    b = front.submit([4, 5, 6], max_new_tokens=3)
+    # step until b's FINAL token is the in-flight pipelined step: token
+    # 1 lands at the admission prefill, then the async loop dispatches
+    # token 2 (pipeline start) and token 3 rides in flight beside the
+    # commit of token 2
+    for _ in range(3):
+        front.step()
+    assert front.cancel(a) is True        # flush commits b's finish
+    rep = front.replicas[0].server
+    assert rep.finish_reason(b) in ("eos", "length")   # server-side
+    assert front.cancel(b) is False       # already finished — but the
+    assert front.finish_reason(b) is not None          # finish is
+    assert front.result(b) is not None                 # COLLECTED
+    assert b not in front._requests
+    assert front.idle
+    out = front.drain()                   # terminates; b's result kept
+    ref = eng.generate([[4, 5, 6]], max_new_tokens=3)[0]
+    assert out[b] == ref[:len(out[b])]
+    front.close()
+
+
+# ------------------------------------------------------- threaded pump
+
+def test_threaded_step_matches_inline(fresh_telemetry):
+    """replication.threaded_step fans replica steps onto dedicated
+    worker threads with a join barrier — outputs identical to inline."""
+    prompts = [[1, 2, 3], [9, 8, 7, 6, 5], [4, 4], [6, 6, 6]]
+
+    def run(threaded):
+        eng = make_engine(replicas=2,
+                          repl_knobs={"threaded_step": threaded})
+        front = ServingFrontend(eng, registry=MetricRegistry())
+        ids = [front.submit(p, max_new_tokens=6) for p in prompts]
+        out = front.drain()
+        res = [out[i] for i in ids]
+        front.close()
+        return res
+
+    assert run(True) == run(False)
+
+
+# ------------------------------------------------- supervisor teardown pins
+
+def test_server_close_idempotent_no_watchdog_double_dump(
+        fresh_telemetry):
+    """A server whose watchdog already FIRED is closed by a supervisor:
+    the teardown flush notifies progress, which used to RE-ARM the
+    fired stall detector — a racing checker could dump the same stall's
+    ring twice. close() now detaches and disarms the watchdog FIRST,
+    and is idempotent."""
+    cfg = InferenceTransformerConfig(**_MCFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=256, block_size=32, num_slots=2))
+    srv = ContinuousBatchingServer(eng)
+    wd_clock = FakeClock()
+    srv.watchdog = Watchdog(deadline_s=5.0, clock=wd_clock,
+                            name="test_close")
+    srv.submit([1, 2, 3], max_new_tokens=20)
+    for _ in range(3):
+        srv.step()                        # async pipeline in flight
+    wd_clock.advance(10.0)
+    wd = srv.watchdog
+    assert wd.check() is True             # the stall fired once
+    assert wd.stalls == 1
+    srv.close()                           # flush commits + notifies —
+    assert srv.watchdog is None           # — but the detector is gone
+    wd_clock.advance(100.0)
+    assert wd.check() is False            # disarmed: no second dump
+    assert wd.stalls == 1
+    srv.close()                           # idempotent
+    assert wd.stalls == 1
+
+
+def test_publish_worker_survives_dead_thread(fresh_telemetry):
+    """drain()/close() against a worker whose thread died with jobs
+    still queued must run them inline, not hang on Queue.join()."""
+    w = PublishWorker()
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    w._thread = t                         # a corpse holding the seat
+    ran = []
+    w._q.put(lambda: ran.append(1))
+    w.drain()                             # would hang before the fix
+    assert ran == [1]
+    w._q.put(lambda: ran.append(2))
+    w._q.put(_STOP)                       # stale stop marker: ignored
+    w.close()                             # would hang before the fix
+    assert ran == [1, 2]
+    w.close()                             # idempotent
+    assert w.errors == 0
+
+
+# ---------------------------------------------------------- scrape surface
+
+def test_debug_replicas_endpoint(fresh_telemetry):
+    """GET /debug/replicas serves the pool view from the frontend's
+    endpoint; a bare server's endpoint self-describes instead."""
+    eng = make_engine(replicas=2, telemetry={"http_port": 0})
+    front = ServingFrontend(eng)
+    assert front.http_server is not None
+    a = front.submit([1, 2, 3], max_new_tokens=4)
+    front.step()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front.http_server.port}"
+                "/debug/replicas", timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert len(body["replicas"]) == 2
+        assert body["replicas"][0]["health"] == "healthy"
+        assert body["replicas"][0]["routed"] == 1
+        assert {"failovers", "pending", "drain_reroutes"} <= set(body)
+    finally:
+        front.drain()
+        front.close()
+    http = start_http_server(0, registry=fresh_telemetry)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/debug/replicas",
+                timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["enabled"] is False
+    finally:
+        http.close()
+
+
+# ------------------------------------------------------------- config
+
+def test_replication_config_validation():
+    with pytest.raises(ValueError, match="replicas"):
+        DeepSpeedInferenceConfig(replication={"replicas": 0})
+    with pytest.raises(ValueError, match="heartbeat_dead_s"):
+        DeepSpeedInferenceConfig(replication={
+            "heartbeat_degraded_s": 5.0, "heartbeat_dead_s": 5.0})
+    with pytest.raises(ValueError, match="replica_kill_step"):
+        FaultInjector(replica_kill_step=-1)
+
+
+def test_injected_kill_is_distinct_and_counted(fresh_telemetry):
+    fi = FaultInjector(registry=fresh_telemetry)
+    fi.kill_replica(1)
+    with pytest.raises(ReplicaKilled, match="replica 1"):
+        fi.check_replica_step(1, tick=7)
+    fi.check_replica_step(1, tick=8)      # one-shot: arm consumed
+    assert fi.injected["replica_kill"] == 1
+    snap = fresh_telemetry.snapshot()
+    fam = snap["fault_injections_total"]["series"]
+    assert any(s["labels"].get("kind") == "replica_kill" for s in fam)
