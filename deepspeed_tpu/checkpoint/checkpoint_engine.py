@@ -38,6 +38,11 @@ class CheckpointEngine(ABC):
     def makedirs(self, path: str, exist_ok: bool = True) -> None:
         os.makedirs(path, exist_ok=exist_ok)
 
+    def close(self) -> None:
+        """Release background resources (async writer threads). Called
+        from ``engine.destroy()`` after the pending finalize joined —
+        idempotent, and a no-op for synchronous engines."""
+
 
 class OrbaxCheckpointEngine(CheckpointEngine):
     """Synchronous save/restore (TorchCheckpointEngine analog)."""
@@ -96,9 +101,25 @@ class AsyncCheckpointEngine(CheckpointEngine):
             args=ocp.args.StandardRestore(abstract_state))
 
     def commit(self, tag: str) -> bool:
-        self._ensure().wait_until_finished()
+        try:
+            self._ensure().wait_until_finished()
+        except Exception as e:
+            # orbax surfaces background-write failures here; name the
+            # tag so the finalize error (stashed and re-raised at the
+            # next save/load) says WHICH checkpoint is not durable
+            raise RuntimeError(
+                f"async checkpoint persist for tag {tag!r} failed: "
+                f"{e}") from e
         log_dist(f"[ckpt-engine] committed {tag}", ranks=[0])
         return True
+
+    def close(self) -> None:
+        """Join + release the AsyncCheckpointer's worker threads — an
+        abandoned writer would keep the process alive (non-daemon) and
+        its in-flight save unobservable."""
+        cp, self._cp = self._cp, None
+        if cp is not None:
+            cp.close()
 
 
 def make_checkpoint_engine(kind: str = "sync",

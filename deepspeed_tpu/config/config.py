@@ -217,12 +217,68 @@ class DataTypesConfig(DeepSpeedConfigModel):
 
 
 class CheckpointConfig(DeepSpeedConfigModel):
+    """``checkpoint`` section. Beyond the reference keys, the integrity
+    knobs drive the verified atomic-commit protocol
+    (runtime/checkpointing.py; docs/training.md "Fault-tolerant training
+    & verified checkpoints"): every published tag carries a per-file
+    sha256 manifest, ``latest`` advances only after the manifest
+    verifies, and load walks a fallback ladder past corrupted tags."""
     tag_validation: Literal["Ignore", "Warn", "Fail", "ignore", "warn", "fail"] = "Warn"
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
     # "sync" (Torch engine analog) | "async"/"nebula" (background persist)
     engine: Literal["sync", "async", "nebula", "orbax", "torch"] = "sync"
+    # integrity manifest: hash every file at publish, re-verify before
+    # 'latest' advances, verify again (deep) before any load; false
+    # restores the reference's trust-the-directory behavior
+    verify: bool = True
+    # bounded retention: keep the newest N committed tags, GC the rest
+    # after each publish (reclaimed bytes -> ckpt_gc_reclaimed_total);
+    # 0 keeps everything
+    keep_last: int = Field(0, ge=0)
+
+    @model_validator(mode="after")
+    def _keep_last_needs_verify(self):
+        # retention GC walks committed (manifest-bearing) tags; with
+        # verify=false no manifest is ever written, so keep_last would
+        # silently never delete anything — reject the inert combination
+        if self.keep_last > 0 and not self.verify:
+            raise ValueError(
+                "checkpoint.keep_last requires checkpoint.verify: "
+                "retention GC only considers committed (manifest-"
+                "bearing) tags, and verify=false writes no manifests")
+        return self
+
+
+class ResilienceConfig(DeepSpeedConfigModel):
+    """``resilience`` section — the TrainingSupervisor's policy
+    (runtime/resilience.py; docs/training.md "Fault-tolerant training &
+    verified checkpoints"): checkpoint cadence, bounded restart budget
+    with exponential backoff, and the NaN/data-stall tripwires. The
+    supervisor guarantees forward progress or a loud terminal
+    ``failed`` — never a hang. Opt-in is by CONSTRUCTION — wrapping the
+    loop in a ``TrainingSupervisor`` arms it; there is deliberately no
+    ``enabled`` flag here, because the engine does not own the train
+    loop and a config bit that silently did nothing would be worse
+    than none."""
+    # save a verified checkpoint every N supervised steps (an initial
+    # one is always written before step 0 so rollback always has a rung)
+    checkpoint_every: int = Field(50, ge=1)
+    # restarts allowed across the whole run before the supervisor ends
+    # in 'failed' (each fault kind counts against the same budget)
+    max_restarts: int = Field(3, ge=0)
+    # exponential backoff between a fault and its restart:
+    # min(backoff_base_s * 2**(restart-1), backoff_max_s)
+    backoff_base_s: float = Field(0.5, ge=0.0)
+    backoff_max_s: float = Field(30.0, ge=0.0)
+    # a batch fetch slower than this is a data_stall fault (None = no
+    # data tripwire)
+    data_stall_timeout_s: Optional[float] = Field(None, gt=0.0)
+    # treat a non-finite loss (or a numerics-watch non-finite step) as a
+    # nan_burst fault and roll back; false lets NaN steps through to the
+    # caller unchanged
+    restart_on_nan: bool = True
 
 
 class DeepSpeedConfig:
@@ -275,6 +331,8 @@ class DeepSpeedConfig:
         self.activation_checkpointing = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}))
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
+        # fault-tolerant training supervisor (runtime/resilience.py)
+        self.resilience = ResilienceConfig(**pd.get("resilience", {}))
         self.mesh = MeshConfig(**pd.get("mesh", {}))
         self.compile_cache_dir: Optional[str] = pd.get("compile_cache_dir")
         self.flops_profiler = FlopsProfilerConfig(
@@ -360,6 +418,7 @@ class DeepSpeedConfig:
         "zero_allow_untested_optimizer", "communication_data_type",
         "sparse_gradients", "amp", "pipeline", "inference", "data_types",
         "eigenvalue", "progressive_layer_drop", "nebula", "telemetry",
+        "resilience",
     })
 
     @classmethod

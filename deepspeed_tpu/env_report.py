@@ -170,7 +170,35 @@ def telemetry_info():
             f"{fic.prefill_failure_rate}, famine {fic.famine_blocks} "
             f"blocks, wedge every {fic.wedge_nth_request})"
             if fic.enabled
-            else "off (chaos hooks; telemetry.fault_injection)")
+            else "off (chaos hooks; telemetry.fault_injection — "
+                 "training kinds: step_crash / nan_burst / data_stall / "
+                 "preempt_step / ckpt_write_failure / ckpt_corrupt)")
+        from deepspeed_tpu.config.config import (CheckpointConfig,
+                                                 ResilienceConfig)
+        ckpt = CheckpointConfig()
+        out["ckpt_integrity"] = (
+            f"verified atomic commit by default config (per-file sha256 "
+            f"manifest, 'latest' advances post-verify, load fallback "
+            f"ladder; retention keep_last="
+            f"{ckpt.keep_last or 'unbounded'})"
+            if ckpt.verify else
+            "off (set checkpoint.verify — docs/training.md "
+            "'Fault-tolerant training & verified checkpoints')")
+        res = ResilienceConfig()
+        from deepspeed_tpu.runtime.resilience import resilience_snapshot
+        live = resilience_snapshot()
+        state = (
+            f"defaults: checkpoint every {res.checkpoint_every} steps, "
+            f"{res.max_restarts} restarts, backoff "
+            f"{res.backoff_base_s}-{res.backoff_max_s}s "
+            "(wrap the loop with runtime/resilience.py "
+            "TrainingSupervisor; GET /debug/resilience)")
+        if live.get("enabled"):
+            sups = live["supervisors"]
+            state = (f"{len(sups)} supervisor(s) live: " + "; ".join(
+                f"{s.get('status')} step {s.get('step')} "
+                f"restarts {s.get('restarts')}" for s in sups))
+        out["train_resilience"] = state
     except Exception as e:  # pragma: no cover - env specific
         out["telemetry"] = f"unavailable: {e}"
         return out

@@ -1128,6 +1128,14 @@ class DeepSpeedEngine:
             tcfg, self.telemetry, "train_watchdog",
             [("params", _params), ("optimizer_state", _opt_state)])
         self.watchdog = self._flight.watchdog
+        # training-scoped chaos hooks (telemetry/faultinject.py):
+        # consulted by the TrainingSupervisor (runtime/resilience.py)
+        # and the checkpoint write path; None when the config section is
+        # off — the train loop never branches on it then
+        from deepspeed_tpu.telemetry import FaultInjector
+        self.fault_injector = FaultInjector.from_config(
+            tcfg.fault_injection if tcfg is not None else None,
+            registry=self.telemetry)
 
     @staticmethod
     def _accept_numerics_flag(step3):
@@ -2046,7 +2054,33 @@ class DeepSpeedEngine:
     def destroy(self) -> None:
         """Release compiled executables, pending state, monitor file
         handles, the telemetry endpoint, and the flight-recorder
-        watchdog/memory registrations (engine.destroy)."""
+        watchdog/memory registrations (engine.destroy). Joins an
+        in-flight async checkpoint finalize FIRST — a teardown must
+        never abandon a checkpoint mid-publication, and a finalize that
+        failed must surface here rather than die with the engine."""
+        from deepspeed_tpu.runtime.checkpointing import (
+            _join_pending_finalize)
+        ckpt_err = None
+        try:
+            _join_pending_finalize(self)
+        except RuntimeError as e:
+            # surface AFTER the full teardown below — raising here would
+            # leak the scrape port, monitor handles, and watchdog thread
+            ckpt_err = e
+        finally:
+            ce = getattr(self, "_ckpt_engine", None)
+            if ce is not None:
+                self._ckpt_engine = None
+                try:
+                    ce.close()
+                except Exception as e:  # noqa: BLE001
+                    # close() performing its own final wait can raise
+                    # the same stashed failure — it must not abort the
+                    # teardown below (port/monitor/watchdog would leak)
+                    # or shadow the join's error
+                    if ckpt_err is None:
+                        ckpt_err = RuntimeError(
+                            f"checkpoint engine close failed: {e!r}")
         self._step_fn = None
         self._grad_fn = None
         self._apply_fn = None
@@ -2064,6 +2098,8 @@ class DeepSpeedEngine:
             from deepspeed_tpu.telemetry.numerics import (
                 unregister_numerics_watch)
             unregister_numerics_watch("train", self.numerics)
+        if ckpt_err is not None:
+            raise ckpt_err
 
     def fp32_master_params(self):
         """Consolidated fp32 weights (analog of

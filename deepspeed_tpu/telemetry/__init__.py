@@ -28,9 +28,11 @@ from deepspeed_tpu.telemetry.events import (EventRing, dump_ring,
                                             get_event_ring,
                                             install_fault_dump,
                                             record_event, set_event_ring)
-from deepspeed_tpu.telemetry.faultinject import (FaultInjector,
+from deepspeed_tpu.telemetry.faultinject import (CkptWriteFault, DataStall,
+                                                 FaultInjector,
                                                  PrefillFault,
-                                                 ReplicaKilled)
+                                                 ReplicaKilled, StepCrash,
+                                                 TrainingPreempted)
 from deepspeed_tpu.telemetry.goodput import GoodputMeter
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
                                               start_http_server)

@@ -83,9 +83,31 @@ class FaultInjectionConfig(DeepSpeedConfigModel):
     # declare it dead and fail its requests over without losing a
     # token. 0 = off; only a ServingFrontend consults it.
     replica_kill_step: int = 0
+    # -- training-scoped faults (runtime/resilience.py
+    # TrainingSupervisor; a bare engine never consults these; all
+    # 0 = off; the *_step knobs are one-shot when they fire —
+    # ckpt_write_failure_save is NOT: it re-fires on every Nth save,
+    # including a recovery's re-save, so it exhausts max_restarts
+    # unless the cadence lets saves in between succeed) --
+    # the train step whose body raises (mid-step worker death)
+    step_crash_step: int = 0
+    # the train step at which the seeded preemption fires (the
+    # preemptible-pod eviction, deterministically)
+    preempt_step: int = 0
+    # the train step whose params are poisoned to NaN before the step —
+    # the burst flows through the real numerics watch, not a flag
+    nan_burst_step: int = 0
+    # the train step whose batch fetch stalls past the supervisor's
+    # data timeout (raised, never actually waited)
+    data_stall_step: int = 0
+    # every Nth checkpoint save dies mid-write (after the state write,
+    # before the manifest publishes) — the crash-consistency case
+    ckpt_write_failure_save: int = 0
 
     @field_validator("step_latency_s", "famine_blocks",
-                     "wedge_nth_request", "replica_kill_step")
+                     "wedge_nth_request", "replica_kill_step",
+                     "step_crash_step", "preempt_step", "nan_burst_step",
+                     "data_stall_step", "ckpt_write_failure_save")
     @classmethod
     def _non_negative(cls, v, info):
         if v < 0:

@@ -69,6 +69,20 @@ REPLICA_HEALTH = "replica_health"
 # committed tokens fold into the replayed prompt, and the running
 # failover count the bounded-retry policy judges
 REPLICA_FAILOVER = "replica_failover"
+# fault-tolerant training (docs/training.md "Fault-tolerant training &
+# verified checkpoints"): the loader rejected a tag (corruption, missing
+# manifest, stale `latest`) and fell back to the previous good one —
+# one entry per rejected tag, naming the verify reason
+CKPT_FALLBACK = "ckpt_fallback"
+# bounded checkpoint retention reclaimed old tags (runtime/
+# checkpointing.py; one entry per GC pass that deleted something)
+CKPT_GC = "ckpt_gc"
+# TrainingSupervisor (runtime/resilience.py): one entry per caught
+# training fault (kind, step, restart count)…
+TRAIN_FAULT = "train_fault"
+# …and one per completed recovery (rollback tag, replayed-from step,
+# recovery seconds) — the pair brackets every restart in the ring
+TRAIN_RESUME = "train_resume"
 # KV host tiering (docs/serving.md "KV quantization & host tiering"):
 # the swap-in rate over the rolling window crossed the thrash
 # threshold — blocks are cycling device<->host faster than they serve,

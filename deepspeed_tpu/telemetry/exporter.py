@@ -46,6 +46,9 @@ ROUTES = {
                       "KV-pool accounting (telemetry/step_profile.py)",
     "/debug/replicas": "replica-pool health/routing/failover state "
                        "(inference/frontend.py ServingFrontend)",
+    "/debug/resilience": "training-supervisor restart/recovery state + "
+                         "checkpoint-integrity report "
+                         "(runtime/resilience.py TrainingSupervisor)",
 }
 
 
@@ -63,7 +66,7 @@ class TelemetryHTTPServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[MetricRegistry] = None,
                  event_ring=None, memory=None, tracer=None,
-                 goodput=None, replicas=None,
+                 goodput=None, replicas=None, resilience=None,
                  handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S):
         if handler_timeout_s is not None and handler_timeout_s <= 0:
             raise ValueError(
@@ -133,6 +136,20 @@ class TelemetryHTTPServer:
                                         "(telemetry.step_profile)"})
                     body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
+                elif path == "/debug/resilience":
+                    # ``resilience`` is the owner's zero-arg snapshot
+                    # callable; without one, fall through to the
+                    # process-wide supervisor registry (the supervisor
+                    # is usually built AFTER the engine opened this
+                    # endpoint, so the registry is the common path)
+                    if resilience is not None:
+                        payload = resilience()
+                    else:
+                        from deepspeed_tpu.runtime.resilience import \
+                            resilience_snapshot
+                        payload = resilience_snapshot()
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 elif path == "/debug/replicas":
                     # ``replicas`` is the owner's zero-arg snapshot
                     # callable (a ServingFrontend's pool view); a bare
@@ -199,12 +216,12 @@ class TelemetryHTTPServer:
 def start_http_server(port: int, host: str = "127.0.0.1",
                       registry: Optional[MetricRegistry] = None,
                       event_ring=None, memory=None, tracer=None,
-                      goodput=None, replicas=None,
+                      goodput=None, replicas=None, resilience=None,
                       handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
     return TelemetryHTTPServer(port=port, host=host, registry=registry,
                                event_ring=event_ring, memory=memory,
                                tracer=tracer, goodput=goodput,
-                               replicas=replicas,
+                               replicas=replicas, resilience=resilience,
                                handler_timeout_s=handler_timeout_s)

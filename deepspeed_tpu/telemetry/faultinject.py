@@ -70,6 +70,16 @@ REPLICA_KILL = "replica_kill"
 REPLICA_WEDGE = "replica_wedge"
 REPLICA_HEARTBEAT_LOSS = "replica_heartbeat_loss"
 REPLICA_SLOW_STEP = "replica_slow_step"
+# training-scoped kinds (runtime/resilience.py TrainingSupervisor +
+# runtime/checkpointing.py — docs/training.md "Fault-tolerant training
+# & verified checkpoints"; a bare engine without a supervisor never
+# consults these)
+STEP_CRASH = "step_crash"
+NAN_BURST = "nan_burst"
+CKPT_WRITE_FAILURE = "ckpt_write_failure"
+CKPT_CORRUPT = "ckpt_corrupt"
+DATA_STALL = "data_stall"
+TRAIN_PREEMPT = "preempt_step"
 
 
 class PrefillFault(RuntimeError):
@@ -83,6 +93,34 @@ class ReplicaKilled(RuntimeError):
     real step errors so chaos tests can assert the injected one."""
 
 
+class StepCrash(RuntimeError):
+    """Raised at the train-step site: the in-process stand-in for a
+    worker process dying mid-step (XLA abort, OOM kill). The
+    TrainingSupervisor rolls back to the last verified checkpoint."""
+
+
+class TrainingPreempted(RuntimeError):
+    """Raised at the train-step site at the seeded ``preempt_step``
+    tick — the preemptible-TPU-pod eviction, deterministically. Same
+    recovery path as :class:`StepCrash`, distinct so forensics (and the
+    restart counter's ``kind`` label) name the real-world cause."""
+
+
+class DataStall(RuntimeError):
+    """Raised at the batch-fetch site: stands in for a dataloader whose
+    next() exceeded the supervisor's ``data_stall_timeout_s`` (the
+    deterministic equivalent of the watchdog reaping a hung input
+    pipeline — zero real waiting in tests)."""
+
+
+class CkptWriteFault(OSError):
+    """Raised at the checkpoint write site (runtime/checkpointing.py,
+    after the state write, before the manifest publishes) — the
+    mid-save crash. The tag dir is left half-written WITHOUT a
+    manifest, so ``latest`` never advances to it and the loader's
+    fallback ladder skips it."""
+
+
 class FaultInjector:
     """Seeded fault schedule. Built from ``telemetry.fault_injection``
     config (:meth:`from_config`) or constructed directly by chaos tests,
@@ -93,6 +131,9 @@ class FaultInjector:
                  prefill_failure_rate: float = 0.0,
                  famine_blocks: int = 0, wedge_nth_request: int = 0,
                  replica_kill_step: int = 0,
+                 step_crash_step: int = 0, preempt_step: int = 0,
+                 nan_burst_step: int = 0, data_stall_step: int = 0,
+                 ckpt_write_failure_save: int = 0,
                  registry: Optional[MetricRegistry] = None):
         if not 0.0 <= prefill_failure_rate <= 1.0:
             raise ValueError(
@@ -103,6 +144,12 @@ class FaultInjector:
             raise ValueError("famine_blocks / wedge_nth_request / "
                              "replica_kill_step must be >= 0 "
                              "(0 = fault off)")
+        if min(step_crash_step, preempt_step, nan_burst_step,
+               data_stall_step, ckpt_write_failure_save) < 0:
+            raise ValueError(
+                "step_crash_step / preempt_step / nan_burst_step / "
+                "data_stall_step / ckpt_write_failure_save must be "
+                ">= 0 (0 = fault off)")
         if step_latency_s < 0:
             raise ValueError(
                 f"step_latency_s must be >= 0, got {step_latency_s}")
@@ -122,6 +169,24 @@ class FaultInjector:
         self._replica_wedged: Set[int] = set()
         self._replica_hb_lost: Set[int] = set()
         self._replica_slow: Dict[int, float] = {}
+        # training-scoped arms (keys are GLOBAL STEP numbers); each is
+        # one-shot — consumed when it fires, so a post-recovery replay
+        # of the same step is not re-killed
+        self._crash_steps: Set[int] = set()
+        self._preempt_steps: Set[int] = set()
+        self._nan_steps: Set[int] = set()
+        self._data_stall_steps: Set[int] = set()
+        self._fail_ckpt_writes = 0            # pending targeted arms
+        self.ckpt_write_failure_save = int(ckpt_write_failure_save)
+        self._ckpt_saves_seen = 0
+        if step_crash_step:
+            self._crash_steps.add(int(step_crash_step))
+        if preempt_step:
+            self._preempt_steps.add(int(preempt_step))
+        if nan_burst_step:
+            self._nan_steps.add(int(nan_burst_step))
+        if data_stall_step:
+            self._data_stall_steps.add(int(data_stall_step))
         self.injected: dict = {}              # kind -> count (host stats)
 
     @classmethod
@@ -136,6 +201,12 @@ class FaultInjector:
                    famine_blocks=cfg.famine_blocks,
                    wedge_nth_request=cfg.wedge_nth_request,
                    replica_kill_step=cfg.replica_kill_step,
+                   step_crash_step=getattr(cfg, "step_crash_step", 0),
+                   preempt_step=getattr(cfg, "preempt_step", 0),
+                   nan_burst_step=getattr(cfg, "nan_burst_step", 0),
+                   data_stall_step=getattr(cfg, "data_stall_step", 0),
+                   ckpt_write_failure_save=getattr(
+                       cfg, "ckpt_write_failure_save", 0),
                    registry=registry)
 
     # ------------------------------------------------------------ account
@@ -216,6 +287,111 @@ class FaultInjector:
             if target:
                 # a transition to 0 is the chaos ENDING, not a fault
                 self._count(FAMINE, blocks=target)
+
+    # ----------------------------------------------- training-scoped sites
+    # consulted by the TrainingSupervisor (runtime/resilience.py) and the
+    # checkpoint layer (runtime/checkpointing.py); keys are global steps
+
+    def crash_at(self, step: int) -> None:
+        """Arm a one-shot step crash: ``check_train_step(step)`` raises
+        :class:`StepCrash` — the mid-step worker death."""
+        self._crash_steps.add(int(step))
+
+    def preempt_at(self, step: int) -> None:
+        """Arm a one-shot preemption at ``step`` (the seeded
+        ``preempt_step`` schedule's targeted sibling)."""
+        self._preempt_steps.add(int(step))
+
+    def nan_burst_at(self, step: int) -> None:
+        """Arm a one-shot NaN burst: ``nan_burst_due(step)`` tells the
+        supervisor to poison the step's gradients/params so the PR-4
+        numerics watch sees a real non-finite step."""
+        self._nan_steps.add(int(step))
+
+    def stall_data_at(self, step: int) -> None:
+        """Arm a one-shot dataloader stall at ``step``'s batch fetch."""
+        self._data_stall_steps.add(int(step))
+
+    def check_train_step(self, step: int) -> None:
+        """Train-step site: raises :class:`TrainingPreempted` or
+        :class:`StepCrash` when this step's arm is due. One-shot — the
+        replayed step after recovery runs clean."""
+        if step in self._preempt_steps:
+            self._preempt_steps.discard(step)
+            self._count(TRAIN_PREEMPT, step=step)
+            raise TrainingPreempted(
+                f"injected preemption at train step {step}")
+        if step in self._crash_steps:
+            self._crash_steps.discard(step)
+            self._count(STEP_CRASH, step=step)
+            raise StepCrash(f"injected crash at train step {step}")
+
+    def nan_burst_due(self, step: int) -> bool:
+        """True exactly once when the NaN burst for ``step`` is armed —
+        the supervisor then poisons the live params so the burst flows
+        through the real numerics detection, not a simulated flag."""
+        if step in self._nan_steps:
+            self._nan_steps.discard(step)
+            self._count(NAN_BURST, step=step)
+            return True
+        return False
+
+    def check_data(self, step: int) -> None:
+        """Batch-fetch site: raises :class:`DataStall` when this step's
+        fetch is scheduled to hang past the supervisor's timeout."""
+        if step in self._data_stall_steps:
+            self._data_stall_steps.discard(step)
+            self._count(DATA_STALL, step=step)
+            raise DataStall(
+                f"injected dataloader stall at train step {step}")
+
+    def fail_next_ckpt_write(self, n: int = 1) -> None:
+        """Arm the next ``n`` checkpoint writes to die mid-save (after
+        the state write, before the manifest) — the crash-consistency
+        case the atomic-commit protocol exists for."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        self._fail_ckpt_writes += int(n)
+
+    def check_ckpt_write(self, tag: str) -> None:
+        """Checkpoint write site: raises :class:`CkptWriteFault` for a
+        targeted arm or on the configured Nth save."""
+        self._ckpt_saves_seen += 1
+        due = self._fail_ckpt_writes > 0 or (
+            self.ckpt_write_failure_save
+            and self._ckpt_saves_seen % self.ckpt_write_failure_save == 0)
+        if due:
+            if self._fail_ckpt_writes > 0:
+                self._fail_ckpt_writes -= 1
+            self._count(CKPT_WRITE_FAILURE, tag=str(tag))
+            raise CkptWriteFault(
+                f"injected checkpoint write failure for tag {tag!r}")
+
+    def corrupt_checkpoint(self, ckpt_dir: str) -> str:
+        """Flip one mid-file byte in a seeded-chosen content file of a
+        committed tag dir — the bit-rot / torn-write case the manifest
+        checksums exist to catch. Returns the corrupted path."""
+        import os
+        files = []
+        for dirpath, _, names in os.walk(ckpt_dir):
+            for fname in sorted(names):
+                if fname == "manifest.json" or fname.endswith(".tmp"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                if os.path.getsize(full) > 0:
+                    files.append(full)
+        if not files:
+            raise ValueError(f"no content files under {ckpt_dir!r}")
+        victim = self._rng.choice(sorted(files))
+        size = os.path.getsize(victim)
+        offset = self._rng.randrange(size)
+        with open(victim, "r+b") as f:
+            f.seek(offset)
+            byte = f.read(1)
+            f.seek(offset)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        self._count(CKPT_CORRUPT, path=victim, offset=offset)
+        return victim
 
     # ------------------------------------------------ replica-scoped sites
     # consulted by the ServingFrontend supervisor (inference/frontend.py)
@@ -313,4 +489,10 @@ class FaultInjector:
                 "replica_kills_armed": dict(self._replica_kills),
                 "replicas_wedged": sorted(self._replica_wedged),
                 "replicas_heartbeat_lost": sorted(self._replica_hb_lost),
-                "replicas_slow": dict(self._replica_slow)}
+                "replicas_slow": dict(self._replica_slow),
+                "train_crash_steps": sorted(self._crash_steps),
+                "train_preempt_steps": sorted(self._preempt_steps),
+                "train_nan_steps": sorted(self._nan_steps),
+                "train_data_stall_steps": sorted(self._data_stall_steps),
+                "ckpt_write_failures_armed": self._fail_ckpt_writes,
+                "ckpt_write_failure_save": self.ckpt_write_failure_save}

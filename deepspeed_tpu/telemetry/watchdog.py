@@ -96,6 +96,40 @@ class Watchdog:
         ``check()`` by hand."""
         with self._lock:
             self._disarmed = True
+            # a disarm issued DURING an active suspend() must survive
+            # the suspension exit's restore of the entry-time flag
+            self._suspend_prev_disarmed = True
+
+    def suspend(self):
+        """Context manager for known-long legitimate pauses — a
+        checkpoint save/verify or a supervised recovery rollback stops
+        step progress for real seconds, and the deadline must not read
+        that as a hang. Entering disarms the checker; exiting re-arms it
+        AND counts the whole pause as progress (the deadline restarts
+        from now, not from the last pre-pause step). Re-entrant: nested
+        suspensions re-arm only when the outermost one exits."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            with self._lock:
+                depth = getattr(self, "_suspend_depth", 0)
+                if depth == 0:
+                    # a watchdog its owner already disarmed (teardown)
+                    # must stay disarmed after the suspension exits
+                    self._suspend_prev_disarmed = self._disarmed
+                self._suspend_depth = depth + 1
+                self._disarmed = True
+            try:
+                yield self
+            finally:
+                with self._lock:
+                    self._suspend_depth -= 1
+                    if self._suspend_depth == 0:
+                        self._disarmed = self._suspend_prev_disarmed
+                        self._last_progress = self._clock()
+                        self._fired = False
+        return _scope()
 
     def check(self) -> bool:
         """Evaluate the deadline now; returns True if a dump fired. A
@@ -164,6 +198,7 @@ class Watchdog:
         self.stop()
         with self._lock:
             self._disarmed = False
+            self._suspend_prev_disarmed = False
         interval = check_interval_s or min(self.deadline_s / 4.0, 5.0)
         stop = threading.Event()
 

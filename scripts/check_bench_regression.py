@@ -74,6 +74,30 @@ METRICS = {
     "kv_tiering.capacity_ratio": "up",
 }
 
+# same contract against the newest TRAIN phase record carrying a
+# `resilience` blob (docs/training.md "Fault-tolerant training &
+# verified checkpoints"); rounds that predate the blob skip the gate
+TRAIN_METRICS = {
+    # 1.0 = the chaos leg (seeded preemption + mid-save kill) resumed
+    # to a loss trajectory and final params bit-identical to the
+    # undisturbed run — anything below 1.0 means recovery started
+    # CHANGING training results
+    "resilience.parity": "up",
+    # productive share of supervised wall time under the injected
+    # faults — a regression means recovery (rollback + replay +
+    # backoff) got more expensive relative to training
+    "resilience.goodput_under_chaos": "up",
+}
+
+# metrics with an ABSOLUTE expectation, gated on the NEWEST round alone:
+# the ratio-vs-previous comparison goes blind once the previous round is
+# already at zero (compare() skips a <= 0), so a parity stuck at 0.0 for
+# two rounds would read green — a bit-identity break must keep failing
+# every round until it is fixed
+TRAIN_FLOORS = {
+    "resilience.parity": 1.0,
+}
+
 
 def _metric(rec: dict, key: str):
     """Resolve a (possibly dotted) metric key against one record."""
@@ -95,26 +119,37 @@ def bench_rounds(directory: str) -> List[Tuple[int, str]]:
     return sorted(rounds)
 
 
-def _phase_records(obj) -> List[dict]:
-    """serve-continuous records inside one parsed bench JSON value
+def _is_serve_record(rec: dict) -> bool:
+    return rec.get("phase") == "serve-continuous"
+
+
+def _is_train_record(rec: dict) -> bool:
+    """A train-phase record carrying the chaos blob (any train phase —
+    the smoke's ``train-smoke`` or a TPU round's ``train-*``)."""
+    return (str(rec.get("phase", "")).startswith("train")
+            and isinstance(rec.get("resilience"), dict))
+
+
+def _phase_records(obj, match=_is_serve_record) -> List[dict]:
+    """Matching phase records inside one parsed bench JSON value
     (the final merged dict, a phase list, or a single record)."""
     if isinstance(obj, dict):
-        if obj.get("phase") == "serve-continuous":
+        if match(obj):
             return [obj]
         out = []
         for v in obj.values():
-            out.extend(_phase_records(v))
+            out.extend(_phase_records(v, match))
         return out
     if isinstance(obj, list):
         out = []
         for v in obj:
-            out.extend(_phase_records(v))
+            out.extend(_phase_records(v, match))
         return out
     return []
 
 
-def extract_serve_record(path: str) -> Optional[dict]:
-    """The round's serve-continuous record, preferring the fully-parsed
+def _extract_record(path: str, match, tail_token: str) -> Optional[dict]:
+    """One round's matching phase record, preferring the fully-parsed
     result over tail-salvaged JSON lines (a later salvage line would be
     the same record's ``partial: True`` echo)."""
     try:
@@ -123,15 +158,15 @@ def extract_serve_record(path: str) -> Optional[dict]:
     except (OSError, json.JSONDecodeError):
         return None
     found: List[dict] = []
-    found.extend(_phase_records(data.get("parsed")))
+    found.extend(_phase_records(data.get("parsed"), match))
     tail = data.get("tail")
     if isinstance(tail, str):
         for line in tail.splitlines():
             line = line.strip()
-            if not (line.startswith("{") and "serve-continuous" in line):
+            if not (line.startswith("{") and tail_token in line):
                 continue
             try:
-                found.extend(_phase_records(json.loads(line)))
+                found.extend(_phase_records(json.loads(line), match))
             except json.JSONDecodeError:
                 continue
     full = [r for r in found if not r.get("partial")]
@@ -139,10 +174,33 @@ def extract_serve_record(path: str) -> Optional[dict]:
     return pool[-1] if pool else None
 
 
-def compare(prev: dict, new: dict, tolerance: float) -> List[str]:
+def extract_serve_record(path: str) -> Optional[dict]:
+    return _extract_record(path, _is_serve_record, "serve-continuous")
+
+
+def extract_train_record(path: str) -> Optional[dict]:
+    return _extract_record(path, _is_train_record, "resilience")
+
+
+def compare(prev: dict, new: dict, tolerance: float,
+            metrics=None, floors=None) -> List[str]:
     """Human-readable regression lines (empty = within tolerance)."""
     errors = []
-    for metric, direction in METRICS.items():
+    for metric, floor in (floors or {}).items():
+        b = _metric(new, metric)
+        if b is None:
+            # a record selected for the floor gate that lacks the
+            # floor metric IS the broken-blob case the gate exists
+            # for — a silent skip would read green
+            errors.append(
+                f"{metric}: missing from the newest record "
+                f"(required floor {floor})")
+        elif b < floor:
+            errors.append(
+                f"{metric}: {b} below required floor {floor} "
+                "(absolute gate — newest round alone)")
+    for metric, direction in \
+            (METRICS if metrics is None else metrics).items():
         a, b = _metric(prev, metric), _metric(new, metric)
         if a is None or b is None or a <= 0:
             continue
@@ -179,27 +237,64 @@ def main(argv=None) -> int:
     rounds = bench_rounds(args.dir)
     with_data = [(n, path, rec) for n, path in rounds
                  if (rec := extract_serve_record(path)) is not None]
-    if len(with_data) < 2:
+    # train chaos gate rides the same run but stands on its own data:
+    # the two newest rounds carrying a resilience blob (older rounds
+    # predate it — skipped, the serve gate's contract for new blobs).
+    # It must run even when the serve records are missing (a serve
+    # phase crashing two rounds running must not ungate recovery).
+    train_rounds = [(n, path, rec) for n, path in rounds
+                    if (rec := extract_train_record(path)) is not None]
+    serve_cmp = with_data[-2:] if len(with_data) >= 2 else None
+    train_cmp = train_rounds[-2:] if len(train_rounds) >= 2 else None
+    # the absolute floors gate the newest round ALONE — the very first
+    # round carrying a broken blob (parity 0.0) must fail, not wait for
+    # a second round to accumulate before the ratio comparison arms
+    train_newest = train_rounds[-1] if train_rounds else None
+    if serve_cmp is None and train_newest is None:
         have = [f"r{n:02d}" for n, _, _ in with_data]
         print(f"check_bench_regression: {len(rounds)} round(s) found, "
               f"{len(with_data)} with a serve-continuous record "
               f"({', '.join(have) or 'none'}) — nothing to compare")
         return 2 if args.require_data else 0
-    (pn, _, prev), (nn, npath, new) = with_data[-2], with_data[-1]
-    errors = compare(prev, new, args.tolerance)
+
+    errors = []
+    summaries = []
+    if serve_cmp is not None:
+        (pn, _, prev), (nn, npath, new) = serve_cmp
+        errors += compare(prev, new, args.tolerance)
+        summaries.append(
+            f"r{pn:02d} -> r{nn:02d}: " + ", ".join(
+                f"{m}={_metric(new, m)} (prev {_metric(prev, m)})"
+                for m in METRICS))
+    if train_cmp is not None:
+        (tpn, _, tprev), (tnn, _, tnew) = train_cmp
+        errors += compare(tprev, tnew, args.tolerance,
+                          metrics=TRAIN_METRICS, floors=TRAIN_FLOORS)
+        summaries.append(
+            f"train r{tpn:02d} -> r{tnn:02d}: " + ", ".join(
+                f"{m}={_metric(tnew, m)} (prev {_metric(tprev, m)})"
+                for m in TRAIN_METRICS))
+    elif train_newest is not None:
+        tnn, _, tnew = train_newest
+        errors += compare({}, tnew, args.tolerance,
+                          metrics={}, floors=TRAIN_FLOORS)
+        summaries.append(
+            f"train r{tnn:02d} (first round, floors only): " + ", ".join(
+                f"{m}={_metric(tnew, m)}" for m in TRAIN_FLOORS))
     if errors:
-        print(f"check_bench_regression: serve-continuous REGRESSION "
-              f"r{pn:02d} -> r{nn:02d} ({os.path.basename(npath)}):",
+        print("check_bench_regression: REGRESSION "
+              f"({'; '.join(summaries) or 'see below'}):",
               file=sys.stderr)
         for e in errors:
             print(f"  {e}", file=sys.stderr)
         return 1
-    summary = ", ".join(
-        f"{m}={_metric(new, m)} (prev {_metric(prev, m)})"
-        for m in METRICS)
-    print(f"check_bench_regression: r{pn:02d} -> r{nn:02d} within "
-          f"{args.tolerance * 100:.0f}% tolerance: {summary}")
-    return 0
+    if serve_cmp is None:
+        print(f"check_bench_regression: {len(with_data)} round(s) with "
+              "a serve-continuous record — serve gate skipped")
+    print(f"check_bench_regression: within "
+          f"{args.tolerance * 100:.0f}% tolerance: "
+          f"{'; '.join(summaries)}")
+    return 2 if (args.require_data and serve_cmp is None) else 0
 
 
 if __name__ == "__main__":

@@ -69,6 +69,7 @@ ROOT_KEYS = {
     "progressive_layer_drop": "PLD schedule (runtime/progressive_layer_drop.py)",
     "nebula": "async checkpoint-engine alias (checkpoint.engine='async')",
     "telemetry": "section — see below (metrics registry + scrape endpoint, docs/observability.md)",
+    "resilience": "section — see below (fault-tolerant training supervisor, docs/training.md)",
 }
 
 
@@ -190,7 +191,19 @@ def generate() -> str:
               + "."))
     emit_model(buf, "activation_checkpointing",
                C.ActivationCheckpointingConfig)
-    emit_model(buf, "checkpoint", C.CheckpointConfig)
+    emit_model(
+        buf, "checkpoint", C.CheckpointConfig,
+        note=("`verify`/`keep_last` drive the verified atomic-commit "
+              "protocol and bounded retention (runtime/checkpointing.py, "
+              "checkpoint/integrity.py) — see docs/training.md "
+              "\"Fault-tolerant training & verified checkpoints\"."))
+    emit_model(
+        buf, "resilience", C.ResilienceConfig,
+        note=("Consumed by `runtime/resilience.py` `TrainingSupervisor` "
+              "— see docs/training.md \"Fault-tolerant training & "
+              "verified checkpoints\" for the recovery semantics, fault "
+              "kinds, and the bit-identical resume oracle these knobs "
+              "drive."))
     emit_dataclass(
         buf, "mesh", MeshConfig,
         note=("TPU-specific: explicit parallel-axis degrees replace the "

@@ -107,3 +107,81 @@ def test_single_round_reports_no_data(mod, tmp_path):
     _write_round(tmp_path, 1, 1000.0, 5.0)
     assert mod.main(["--dir", str(tmp_path)]) == 0
     assert mod.main(["--dir", str(tmp_path), "--require-data"]) == 2
+
+
+def _write_train_round(directory, n, parity, goodput):
+    rec = {"phase": "train-smoke", "smoke": True,
+           "resilience": {"parity": parity,
+                          "goodput_under_chaos": goodput}}
+    path = os.path.join(directory, f"BENCH_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n, "rc": 0, "parsed": [rec]}, f)
+    return path
+
+
+def test_train_resilience_gate(mod, tmp_path):
+    """The train chaos gate: recovery parity falling below 1.0 (or
+    goodput-under-chaos collapsing) between the two newest rounds
+    carrying a resilience blob fails the gate — and it runs even when
+    NO round carries a serve-continuous record (a crashed serve phase
+    must not ungate recovery)."""
+    _write_train_round(tmp_path, 1, 1.0, 0.93)
+    _write_train_round(tmp_path, 2, 0.0, 0.93)   # parity broke
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    _write_train_round(tmp_path, 3, 1.0, 0.93)
+    assert mod.main(["--dir", str(tmp_path)]) == 0   # recovered
+    _write_train_round(tmp_path, 4, 1.0, 0.70)   # recovery got pricey
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    # --require-data still refers to SERVE data: train-only rounds
+    # satisfy the train gate but exit 2 under the flag
+    _write_train_round(tmp_path, 5, 1.0, 0.70)
+    assert mod.main(["--dir", str(tmp_path), "--require-data"]) == 2
+
+
+def test_train_parity_floor_gates_stuck_at_zero(mod, tmp_path):
+    """Parity is an absolute 0/1 expectation, not a throughput ratio:
+    two consecutive rounds BOTH at 0.0 must keep failing (the
+    ratio-vs-previous comparison skips prev <= 0, which used to read a
+    persistently-broken recovery as green from the second round on)."""
+    _write_train_round(tmp_path, 1, 0.0, 0.93)
+    _write_train_round(tmp_path, 2, 0.0, 0.93)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    errors = mod.compare({"resilience": {"parity": 0.0}},
+                         {"resilience": {"parity": 0.0}},
+                         0.10, metrics=mod.TRAIN_METRICS,
+                         floors=mod.TRAIN_FLOORS)
+    assert any("floor" in e for e in errors)
+
+
+def test_train_floor_missing_metric_fails(mod, tmp_path):
+    """A record selected for the floor gate whose blob LACKS the floor
+    metric is the broken-blob case the gate exists for — it must fail,
+    not silently skip."""
+    rec = {"phase": "train-smoke", "smoke": True,
+           "resilience": {"goodput_under_chaos": 0.93}}   # no parity
+    with open(os.path.join(tmp_path, "BENCH_r01.json"), "w") as f:
+        json.dump({"n": 1, "rc": 0, "parsed": [rec]}, f)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_train_floor_gates_the_very_first_round(mod, tmp_path):
+    """The absolute floors gate the newest round ALONE: the first round
+    ever carrying a broken blob (parity 0.0) must fail, not wait for a
+    second round before the ratio comparison arms."""
+    _write_train_round(tmp_path, 1, 0.0, 0.93)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    os.unlink(os.path.join(tmp_path, "BENCH_r01.json"))
+    _write_train_round(tmp_path, 1, 1.0, 0.93)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_train_and_serve_gates_compose(mod, tmp_path):
+    """Both gates in one directory: a serve regression fails even when
+    the train blob is healthy, and vice versa."""
+    _write_round(tmp_path, 1, 1000.0, 5.0)
+    _write_round(tmp_path, 2, 1000.0, 5.0)
+    _write_train_round(tmp_path, 3, 1.0, 0.93)
+    _write_train_round(tmp_path, 4, 0.5, 0.93)   # train parity broke
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    _write_train_round(tmp_path, 5, 1.0, 0.93)   # train healthy again
+    assert mod.main(["--dir", str(tmp_path)]) == 0
