@@ -1950,6 +1950,168 @@ def phase_serve(args) -> dict:
         else:
             log(f"replication ({n_repl} replicas, no chaos): "
                 f"availability {rb['availability']}")
+
+    # ---- disaggregated prefill/decode A/B (docs/serving.md
+    # "Disaggregated prefill/decode"): the SAME long-prompt +
+    # resident-decoder interference mix through a colocated pool (2
+    # mixed replicas) vs a role-split pool (1 prefill + 1 decode) at
+    # EQUAL total slots. The claim: resident decoders stop paying for
+    # strangers' prompt chunks — on the colocated pool every chunked
+    # prefill steals one device program per step from the replica's
+    # decoders, on the role-split pool chunks run on the prefill
+    # replica and the decode replica's steps stay pure decode (the
+    # handoff warms the prefix in via paged_swap_in; only the short
+    # sub-block tail chunk ever runs there). Decode per-token latency
+    # is sampled as the SERVING replica's own step wall during decode
+    # residency — per-token cost as deployed with one replica per
+    # chip, which inline CPU stepping would otherwise mask by summing
+    # both replicas' work into one wall interval. Wall-clock p90s on a
+    # loaded box are noisy, so the verdict uses the established
+    # attempts/best-of discipline (see the async-loop A/B): a losing
+    # attempt re-runs both legs (bounded 3), and the final fallback
+    # judges best-of-attempts against best-of-attempts with a 10%
+    # noise allowance. Parity (exact) and handoff accounting (bytes/
+    # request, nothing stranded) are structural and stay strict.
+    disagg = bool(getattr(args, "disaggregate", False)) or smoke
+    if disagg:
+        from deepspeed_tpu.inference.config import ReplicationConfig
+        from deepspeed_tpu.inference.frontend import ServingFrontend
+        from deepspeed_tpu.telemetry import TelemetryConfig
+        bs = scfg.block_size
+        S = scfg.num_slots
+        dec_budget = 28 if smoke else 48
+        dec_reqs = [[3 + j, 5, 7] for j in range(S)]
+        n_long = 8 if smoke else 16
+        long_reqs = [[2 + (5 * j + t) % (mcfg.vocab_size - 2)
+                      for t in range(3 * bs)] for j in range(n_long)]
+
+        def _dis_leg(roles):
+            cfg2 = scfg.model_copy(update={
+                "enable_prefix_caching": True,
+                "replication": ReplicationConfig(replicas=2,
+                                                 roles=roles),
+                "telemetry": TelemetryConfig(trace_sample_rate=0.0)})
+            f = ServingFrontend(InferenceEngine((mcfg, params), cfg2),
+                                registry=MetricRegistry())
+            # warm every replica's chunk AND decode executables (two
+            # long-prompt requests spread across the colocated pool;
+            # on the role-split pool they warm the prefill replica's
+            # chunk program and — through the handoff — the decode
+            # replica's tail-chunk + decode programs)
+            w = [f.submit(long_reqs[0], max_new_tokens=4,
+                          request_id=10_000 + k) for k in range(2)]
+            f.drain()
+            for rid in w:
+                f.finish_reasons.pop(rid, None)
+                f._results.pop(rid, None)
+            t0 = time.time()
+            dec_ids = [f.submit(p, max_new_tokens=dec_budget)
+                       for p in dec_reqs]
+            all_ids = list(dec_ids)
+            lat = []   # decoder per-token: serving replica's step wall
+            li, tick = 0, 0
+            while not f.idle or li < n_long:
+                if li < n_long and tick % 2 == 0:
+                    all_ids.append(f.submit(long_reqs[li],
+                                            max_new_tokens=2))
+                    li += 1
+                f.step()
+                tick += 1
+                for rid in dec_ids:
+                    fr = f._requests.get(rid)
+                    if fr is None or fr.replica is None:
+                        continue
+                    rep = f.replicas[fr.replica]
+                    srv_ = rep.server
+                    slot = srv_.scheduler.find_slot(rid)
+                    if (slot is None or slot in srv_._mid_prefill
+                            or not rep.stepped
+                            or rep.last_step_s is None):
+                        continue   # queued / mid-prefill: not a decode
+                    lat.append(rep.last_step_s * 1e3)
+            res_ = f.drain()
+            wall = time.time() - t0
+            st = f.stats
+            dec_role_stats = (f.replicas[1].server.stats
+                              if roles else None)
+            outs = [res_[r] for r in all_ids]
+            f.close()
+            lat.sort()
+            p90 = (round(lat[min(int(len(lat) * 0.9), len(lat) - 1)], 4)
+                   if lat else None)
+            leg = {"decode_p90_ms": p90,
+                   "decode_token_samples": len(lat),
+                   "wall_s": round(wall, 3), "handoffs": st["handoffs"]}
+            if roles:
+                hf = st["handoff"]
+                leg.update({
+                    "handoff_blocks_published": hf["published"],
+                    "handoff_blocks_consumed": hf["consumed"],
+                    "handoff_blocks_expired": hf["expired"],
+                    "handoff_stranded_blocks": hf["blocks"],
+                    "handoff_bytes_per_request": round(
+                        hf["bytes_published"] / max(st["handoffs"], 1)),
+                    "decode_traces": dec_role_stats["decode_traces"],
+                    "retraces": dec_role_stats["retraces"],
+                    "decode_swap_ins":
+                        dec_role_stats["kv_tier"]["swap_ins"],
+                })
+            return leg, outs
+
+        best_colo, best_dis = float("inf"), float("inf")
+        for attempt in range(3):
+            colo, colo_out = _dis_leg(None)
+            dis_leg, dis_out = _dis_leg(["prefill", "decode"])
+            if None in (colo["decode_p90_ms"],
+                        dis_leg["decode_p90_ms"]):
+                # structurally broken leg (no decode samples): record
+                # a failing verdict instead of crashing the phase —
+                # the smoke assertions make it loud, the TPU round
+                # keeps its record
+                ratio, basis, p90_ok = None, "no_samples", False
+                break
+            best_colo = min(best_colo, colo["decode_p90_ms"])
+            best_dis = min(best_dis, dis_leg["decode_p90_ms"])
+            ratio = round(dis_leg["decode_p90_ms"]
+                          / max(colo["decode_p90_ms"], 1e-9), 4)
+            basis = "single_attempt"
+            p90_ok = ratio <= 1.0
+            if p90_ok:
+                break
+        if not p90_ok and basis != "no_samples":
+            # attempts exhausted on the wall-clock verdict: symmetric
+            # best-of-attempts with a bounded noise allowance (the
+            # tier-1 box runs this inside a loaded one-core process —
+            # scheduler contention moves step walls ~10%)
+            ratio = round(best_dis / max(best_colo, 1e-9), 4)
+            basis = "best_of_attempts"
+            p90_ok = ratio <= 1.1
+        out["disaggregation"] = {
+            "roles": ["prefill", "decode"], "replicas": 2,
+            "total_slots": 2 * S, "decoders": S,
+            "interferers": n_long, "attempts": attempt + 1,
+            "decode_p90_basis": basis,
+            "decode_p90_ms_colocated": colo["decode_p90_ms"],
+            "decode_p90_ms_disaggregated": dis_leg["decode_p90_ms"],
+            "decode_p90_best_colocated": (
+                best_colo if best_colo != float("inf") else None),
+            "decode_p90_best_disaggregated": (
+                best_dis if best_dis != float("inf") else None),
+            # THE headline: role-split decode per-token p90 over
+            # colocated (< 1.0 = disaggregation removed interference),
+            # gated "down" across rounds by check_bench_regression
+            "decode_p90_ratio": ratio,
+            "decode_p90_improved": bool(p90_ok),
+            "parity_exact": bool(dis_out == colo_out),
+            "colocated": colo, "disaggregated": dis_leg,
+        }
+        log(f"disaggregation A/B: decode p90 "
+            f"{dis_leg['decode_p90_ms']} vs {colo['decode_p90_ms']} ms "
+            f"colocated (ratio {ratio}, {basis}), "
+            f"{dis_leg['handoffs']} handoffs, "
+            f"{dis_leg['handoff_blocks_published']} blocks published / "
+            f"{dis_leg['handoff_blocks_consumed']} consumed, parity="
+            f"{out['disaggregation']['parity_exact']}")
     return out
 
 
@@ -2403,9 +2565,13 @@ PHASES = {
     # --replicas 2 --chaos-kill: the replicated-serving A/B (seeded
     # mid-decode replica kill) records the availability blob the
     # replication.availability gate reads
+    # --disaggregate: the prefill/decode role-split A/B rides along
+    # (decode per-token p90 colocated vs role-split at equal slots,
+    # handoff bytes/request, parity) for the decode_p90_ratio gate
     "serve-continuous": (["--requests", "24", "--speculate", "4",
                           "--kv-dtype", "int8", "--kv-host-offload",
-                          "--replicas", "2", "--chaos-kill"],
+                          "--replicas", "2", "--chaos-kill",
+                          "--disaggregate"],
                          900),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
@@ -2856,6 +3022,17 @@ def main() -> None:
                          "availability must stay 1.0 with outputs "
                          "token-identical to the undisturbed leg "
                          "(auto in smoke mode)")
+    ap.add_argument("--disaggregate", dest="disaggregate",
+                    action="store_true",
+                    help="serve-continuous: also run the disaggregated "
+                         "prefill/decode A/B — a role-split pool (1 "
+                         "prefill + 1 decode replica, chain-hash KV "
+                         "handoff) vs a colocated 2-replica pool at "
+                         "equal total slots under a long-prompt + "
+                         "resident-decoder interference mix, recording "
+                         "decode per-token p90 ratio, handoff bytes/"
+                         "request, and the exact-parity flag (auto in "
+                         "smoke mode)")
     ap.add_argument("--train-numerics", dest="train_numerics",
                     action="store_true",
                     help="train phases: arm the in-graph numerics "

@@ -163,6 +163,16 @@ def telemetry_info():
             "supervised pool — health-checked routing, mid-flight "
             "failover, rolling drain; docs/serving.md 'Replicated "
             "serving & failover')")
+        out["serve_disaggregation"] = (
+            f"role topology {rc.roles} by default config (chain-hash "
+            f"KV handoff, telemetry-routed decode admission, handoff "
+            f"tier cap {rc.handoff_blocks or 'unbounded'} blocks)"
+            if rc.disaggregated else
+            "colocated (set replication.roles, e.g. "
+            "['prefill','decode'] — prefill replicas chunk-prefill "
+            "only and hand KV off by chain hash to telemetry-picked "
+            "decode replicas; docs/serving.md 'Disaggregated "
+            "prefill/decode')")
         fic = cfg.fault_injection
         out["fault_injection"] = (
             f"ARMED (seed {fic.seed}; step latency "

@@ -18,6 +18,7 @@ __all__ = ["DeepSpeedInferenceConfig", "DeepSpeedTPConfig",
            "DeepSpeedMoEConfig", "ReplicationConfig", "InferenceEngine",
            "KVCache", "init_cache",
            "PagedKVCache", "init_paged_cache", "HostKVTier",
+           "HandoffTier",
            "ContinuousBatchingServer", "ServingFrontend", "Request",
            "Scheduler"]
 
@@ -28,6 +29,7 @@ _LAZY = {"InferenceEngine": "deepspeed_tpu.inference.engine",
          "PagedKVCache": "deepspeed_tpu.inference.kv_cache",
          "init_paged_cache": "deepspeed_tpu.inference.kv_cache",
          "HostKVTier": "deepspeed_tpu.inference.kv_cache",
+         "HandoffTier": "deepspeed_tpu.inference.disagg",
          "ContinuousBatchingServer": "deepspeed_tpu.inference.server",
          "Request": "deepspeed_tpu.inference.scheduler",
          "Scheduler": "deepspeed_tpu.inference.scheduler"}

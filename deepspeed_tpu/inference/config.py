@@ -10,7 +10,7 @@ compatibility.
 """
 from __future__ import annotations
 
-from typing import Any, Literal, Optional, Union
+from typing import Any, List, Literal, Optional, Union
 
 from pydantic import Field, field_validator
 
@@ -101,6 +101,24 @@ class ReplicationConfig(DeepSpeedConfigModel):
     # within a step. Off = replicas step inline on the caller's thread,
     # in index order — deterministic and contention-free on small hosts.
     threaded_step: bool = False
+    # disaggregated prefill/decode serving (docs/serving.md
+    # "Disaggregated prefill/decode"): one role per replica. None (the
+    # default) = every replica "mixed" — byte-identical to a pool
+    # without this knob. With roles, a new request routes to a
+    # "prefill" replica which runs chunked prefill ONLY (budget one
+    # token); its block-aligned KV publishes into a shared handoff
+    # tier keyed by the prefix chain hash, and the request resubmits
+    # to a "decode" replica whose admission warms the prefix through
+    # match_prefix -> paged_swap_in (the sub-block tail recomputes as
+    # one short chunk). "mixed" replicas serve either phase colocated.
+    # Requires enable_prefix_caching (the handoff identity IS the
+    # chain hash) and replicas == len(roles).
+    roles: Optional[List[Literal["prefill", "decode", "mixed"]]] = None
+    # handoff-tier capacity in blocks (None = unbounded): past it the
+    # OLDEST published request's blocks expire whole (its decode-side
+    # admission falls back to recomputing the prefix — exact either
+    # way). Only meaningful with roles.
+    handoff_blocks: Optional[int] = None
 
     @field_validator("replicas")
     @classmethod
@@ -134,6 +152,43 @@ class ReplicationConfig(DeepSpeedConfigModel):
                 f"heartbeat_degraded_s ({self.heartbeat_degraded_s}) — "
                 "a replica must pass through the breaker before the "
                 "failover deadline")
+        if self.roles is not None:
+            if len(self.roles) != self.replicas:
+                raise ValueError(
+                    f"replication.roles names {len(self.roles)} "
+                    f"replica(s) but replicas={self.replicas} — one "
+                    "role per replica")
+            if any(r != "mixed" for r in self.roles):
+                # a role-split pool must be able to run BOTH phases:
+                # prefill-only replicas with nothing to decode on (or
+                # the reverse) would strand every request
+                if not any(r in ("prefill", "mixed") for r in self.roles):
+                    raise ValueError(
+                        "replication.roles has no prefill-capable "
+                        "replica ('prefill' or 'mixed') — nothing "
+                        "could ever admit a new prompt")
+                if not any(r in ("decode", "mixed") for r in self.roles):
+                    raise ValueError(
+                        "replication.roles has no decode-capable "
+                        "replica ('decode' or 'mixed') — prefilled "
+                        "requests could never generate")
+        if self.handoff_blocks is not None:
+            if self.roles is None or all(r == "mixed" for r in self.roles):
+                raise ValueError(
+                    "replication.handoff_blocks bounds the prefill->"
+                    "decode handoff tier — it needs replication.roles "
+                    "with at least one non-mixed role")
+            if self.handoff_blocks < 1:
+                raise ValueError(
+                    f"replication.handoff_blocks must be >= 1 (or None "
+                    f"for unbounded), got {self.handoff_blocks}")
+
+    @property
+    def disaggregated(self) -> bool:
+        """True when the pool splits prefill/decode roles (any
+        non-mixed role configured)."""
+        return (self.roles is not None
+                and any(r != "mixed" for r in self.roles))
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
@@ -325,6 +380,12 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
                 raise ValueError(
                     f"speculation_tokens ({self.speculation_tokens}) "
                     f"must not exceed block_size ({self.block_size})")
+        if self.replication.disaggregated and not self.enable_prefix_caching:
+            raise ValueError(
+                "replication.roles (disaggregated prefill/decode) "
+                "hands KV off by prefix chain hash — it requires "
+                "enable_prefix_caching (docs/serving.md 'Disaggregated "
+                "prefill/decode')")
         if self.kv_host_offload and not self.enable_prefix_caching:
             raise ValueError(
                 "kv_host_offload demotes PREFIX blocks — it requires "

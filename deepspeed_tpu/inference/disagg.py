@@ -1,0 +1,190 @@
+"""Disaggregated prefill/decode serving: the KV handoff tier.
+
+DistServe/Splitwise-style phase separation for the replica pool
+(docs/serving.md "Disaggregated prefill/decode"): a request routed to a
+``prefill``-role replica runs chunked prefill only, then its
+block-aligned KV — payload plus int8 scale tiles, all layers, read out
+via :func:`~deepspeed_tpu.inference.kv_cache.paged_read_block` — is
+published HERE, keyed by the prefix chain hash, and the request
+resubmits to a ``decode``-role replica whose admission warms the prefix
+back in through the existing ``match_prefix`` → ``paged_swap_in``
+machinery (the sub-block tail recomputes as one short chunk).
+
+:class:`HandoffTier` is the shared staging ground between those two
+replicas: pure host storage + bookkeeping, grouped by REQUEST so the
+stranded-entry invariant is enforceable — every published request is
+eventually ``consume``d (imported into the chosen decode replica),
+``abandon``ed (the request finished or failed before a decode replica
+took it), or ``expired`` (the bounded tier dropped the oldest
+publication whole; its decode admission recomputes the prefix cold —
+exact either way, the chaos suite pins it). The frontend owns the
+counters (``serve_handoff_{published,consumed,expired}_total``,
+``serve_handoff_blocks``, ``serve_handoff_seconds``); this class only
+holds payloads and totals.
+
+Unlike :class:`~deepspeed_tpu.inference.kv_cache.HostKVTier` (hash →
+one payload, LRU per block), entries here live and die as one
+publication: a half-available prefix chain is useless to the consumer
+(``match_prefix`` stops at the first miss), so whole-request
+granularity is both simpler and strictly better.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+# replica roles (replication.roles); MIXED serves both phases colocated
+PREFILL = "prefill"
+DECODE = "decode"
+MIXED = "mixed"
+
+# one publication: ordered (chain hash, payload) pairs for the prefix's
+# consecutive full blocks, in prefix order
+Entries = List[Tuple[bytes, Dict[str, Any]]]
+
+
+class HandoffTier:
+    """Bounded host-RAM staging for prefill→decode KV publications,
+    grouped by request id. ``max_blocks`` caps the total parked blocks:
+    past it the OLDEST publication expires whole (content gone; its
+    consumer recomputes). Owner-thread only — the frontend publishes,
+    consumes, and abandons between replica steps."""
+
+    def __init__(self, max_blocks: Optional[int] = None):
+        if max_blocks is not None and max_blocks < 1:
+            raise ValueError(
+                f"handoff tier max_blocks must be >= 1 (or None for "
+                f"unbounded), got {max_blocks}")
+        self.max_blocks = max_blocks
+        # rid -> {"entries": Entries, "ts": publish time}; insertion
+        # order doubles as expiry order (oldest first)
+        self._store: "OrderedDict[int, dict]" = OrderedDict()
+        # chain hash -> [payload, refcount, nbytes]: publications that
+        # share a prefix chain share ONE payload object (the payload
+        # for an identical chain hash is identical by construction),
+        # and the frontend consults this index BEFORE exporting — a
+        # shared system prompt is read off the prefill device once,
+        # not once per request (review-found)
+        self._by_hash: Dict[bytes, list] = {}
+        self._blocks = 0
+        self._bytes = 0        # UNIQUE parked bytes (shared counted once)
+        self.published = 0     # blocks ever published
+        self.consumed = 0      # blocks handed to a decode replica
+        self.expired = 0       # blocks dropped: capacity + abandons
+        self.dedup_reuses = 0  # published blocks that reused a payload
+        self.bytes_published = 0
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    @property
+    def blocks(self) -> int:
+        """Blocks currently parked (the ``serve_handoff_blocks`` gauge)."""
+        return self._blocks
+
+    @property
+    def host_bytes(self) -> int:
+        return self._bytes
+
+    @staticmethod
+    def _payload_bytes(payload: Dict[str, Any]) -> int:
+        return sum(int(a.nbytes) for a in payload.values())
+
+    def _drop(self, rid: int) -> int:
+        rec = self._store.pop(rid, None)
+        if rec is None:
+            return 0
+        for h, _ in rec["entries"]:
+            ref = self._by_hash[h]
+            ref[1] -= 1
+            if ref[1] == 0:
+                del self._by_hash[h]
+                self._bytes -= ref[2]
+        n = len(rec["entries"])
+        self._blocks -= n
+        return n
+
+    def payloads_for(self, hashes) -> Entries:
+        """The LEADING run of ``hashes`` whose payloads are already
+        parked (another request published the same chain) — the
+        frontend prepends these to its export instead of re-reading
+        identical blocks off the prefill device. Leading-run only: a
+        gap mid-chain would be useless to the consumer's
+        ``match_prefix`` walk anyway."""
+        out: Entries = []
+        for h in hashes:
+            ref = self._by_hash.get(h)
+            if ref is None:
+                break
+            out.append((h, ref[0]))
+        return out
+
+    def publish(self, rid: int, entries: Entries, now: float) -> int:
+        """Park one request's prefix payloads. Hashes already indexed
+        share the existing payload object (refcounted — one host copy
+        per distinct chain hash however many requests park it). A
+        re-publication (the request failed over and re-prefilled
+        elsewhere) replaces the stale one. Returns how many blocks the
+        capacity bound EXPIRED to make room (oldest publications
+        first; a publication larger than the whole bound expires
+        itself — the bound is strict)."""
+        if not entries:
+            return 0
+        self.expired += self._drop(rid)   # stale re-publication
+        stored: Entries = []
+        for h, payload in entries:
+            ref = self._by_hash.get(h)
+            if ref is not None:
+                ref[1] += 1
+                self.dedup_reuses += 1
+                payload = ref[0]          # share the parked copy
+            else:
+                nb = self._payload_bytes(payload)
+                self._by_hash[h] = [payload, 1, nb]
+                self._bytes += nb
+            stored.append((h, payload))
+            self.bytes_published += self._payload_bytes(payload)
+        self._store[rid] = {"entries": stored, "ts": now}
+        self._blocks += len(stored)
+        self.published += len(stored)
+        dropped = 0
+        while (self.max_blocks is not None
+               and self._blocks > self.max_blocks and self._store):
+            old_rid = next(iter(self._store))
+            dropped += self._drop(old_rid)
+        self.expired += dropped
+        return dropped
+
+    def consume(self, rid: int) -> Optional[Tuple[Entries, float]]:
+        """Pop one request's publication for import into its decode
+        replica: ``(entries, publish_ts)``, or None when nothing is
+        parked for it (never published, expired, or already taken —
+        the consumer recomputes the prefix, exact either way)."""
+        rec = self._store.get(rid)
+        if rec is None:
+            return None
+        self._drop(rid)
+        self.consumed += len(rec["entries"])
+        return rec["entries"], rec["ts"]
+
+    def abandon(self, rid: int) -> int:
+        """Drop a publication whose request finished (or failed) before
+        any decode replica consumed it — the path that keeps the tier
+        free of stranded entries. Returns the blocks released."""
+        n = self._drop(rid)
+        self.expired += n
+        return n
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": len(self._store),
+            "blocks": self._blocks,
+            "unique_payloads": len(self._by_hash),
+            "host_bytes": self._bytes,
+            "max_blocks": self.max_blocks,
+            "published": self.published,
+            "consumed": self.consumed,
+            "expired": self.expired,
+            "dedup_reuses": self.dedup_reuses,
+            "bytes_published": self.bytes_published,
+        }

@@ -43,6 +43,29 @@ surface, built on three pillars:
   stays warm), and re-admits the replica once idle: a config reload or
   rolling restart loses zero requests.
 
+* **Disaggregated prefill/decode** (``replication.roles`` —
+  docs/serving.md "Disaggregated prefill/decode"): DistServe/Splitwise-
+  style phase separation over the same supervision substrate. A request
+  routes first to a ``prefill``-role replica with a ONE-token budget:
+  it chunk-prefills, commits the first token, retires — and its
+  block-aligned KV (payload + int8 scale tiles, all layers, via
+  ``paged_read_block``) publishes into a shared
+  :class:`~deepspeed_tpu.inference.disagg.HandoffTier` keyed by the
+  prefix chain hash. The request then resubmits (committed token
+  folded into the prompt) to a ``decode``-role replica picked by
+  TELEMETRY — load, then the step observatory's recent dispatch-gap
+  mean, then free blocks — whose admission warms every published
+  block back in through the existing ``match_prefix`` →
+  ``paged_swap_in`` machinery (one jitted donated scatter per block,
+  zero new executables) and recomputes only the sub-block tail as one
+  short chunk. Chunked prefill thus never steals a device program
+  from resident decoders, which is the entire point. Every failure
+  mode degrades to the recompute idiom above (a dead prefill replica
+  mid-publish, an expired bounded tier, a wrong-role last-resort
+  route) — greedy output is token-identical to a single mixed server
+  through every path, and a terminal finish abandons any unconsumed
+  publication so the bounded tier never strands an entry.
+
 Determinism contract (the chaos suite depends on it): replicas step in
 index order on the caller's thread by default, every clock read goes
 through the injectable frontend clock, and the replica-scoped fault
@@ -59,7 +82,10 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
+from deepspeed_tpu.inference.disagg import (DECODE, MIXED, PREFILL,
+                                            HandoffTier)
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.kv_cache import prefix_block_hashes
 from deepspeed_tpu.inference.server import (_LIFECYCLE_EVENTS,
                                             ContinuousBatchingServer,
                                             check_drain_timeout,
@@ -83,7 +109,8 @@ class _FrontRequest:
 
     __slots__ = ("request_id", "prompt", "max_new_tokens", "eos_token_id",
                  "priority", "deadline_ts", "submit_ts", "replica",
-                 "committed", "failovers", "retry_at_tick")
+                 "committed", "failovers", "retry_at_tick",
+                 "prefill_only", "replay", "imported")
 
     def __init__(self, request_id: int, prompt: List[int],
                  max_new_tokens: int, eos_token_id: Optional[int],
@@ -97,11 +124,28 @@ class _FrontRequest:
         self.deadline_ts = deadline_ts   # absolute, frontend clock
         self.submit_ts = submit_ts
         self.replica: Optional[int] = None   # resident replica, or None
-        # tokens recovered from dead/drained replicas: they fold into
-        # the resubmitted prompt (the recompute-replay prefix)
+        # tokens recovered from dead/drained replicas (and folded by
+        # the prefill->decode handoff): they fold into the resubmitted
+        # prompt (the recompute-replay prefix)
         self.committed: List[int] = []
         self.failovers = 0
         self.retry_at_tick = 0           # frontend tick gating resubmit
+        # disaggregation (docs/serving.md "Disaggregated prefill/
+        # decode"): True while the current residency is the prefill-
+        # only leg (budget one token on a prefill-role replica) — its
+        # "length" finish is the handoff point, not a real finish
+        self.prefill_only = False
+        # True when the NEXT successful routing replays recomputed
+        # tokens (failover / drain re-route — counted into the replay
+        # overhead metric; a handoff's by-design one-token fold is not
+        # failure replay and stays out of it)
+        self.replay = False
+        # (replica index, [chain hashes]) per consumed handoff: the
+        # terminal finish purges still-parked payloads from those
+        # replicas' import tiers — a request that dies QUEUED (cancel/
+        # deadline/failed) never runs the admission that would consume
+        # them, and an unpurged import-only tier leaks host RAM
+        self.imported: List[tuple] = []
 
 
 class _Replica:
@@ -110,12 +154,14 @@ class _Replica:
     __slots__ = ("index", "server", "watchdog", "health", "draining",
                  "dead_reason", "missed_beats", "last_beat_ts",
                  "last_step_s", "routed", "failovers",
-                 "steps", "gauge", "stepped")
+                 "steps", "gauge", "stepped", "role")
 
     def __init__(self, index: int, server: ContinuousBatchingServer,
-                 watchdog: Watchdog, now: float, gauge):
+                 watchdog: Watchdog, now: float, gauge,
+                 role: str = MIXED):
         self.index = index
         self.server = server
+        self.role = role
         self.watchdog = watchdog
         self.health = HEALTHY
         self.draining = False
@@ -144,6 +190,25 @@ class _Replica:
         sched = self.server.scheduler
         return (sched.pending_requests + sched.active_slots,
                 -sched.allocator.free_blocks, self.index)
+
+    def gap_s(self) -> float:
+        """Recent mean dispatch gap from this replica's own step
+        observatory (0.0 when telemetry.step_profile is off) — how
+        host-bound the replica is right now."""
+        prof = self.server._profiler
+        return prof.recent_gap_s() if prof is not None else 0.0
+
+    def decode_load(self) -> tuple:
+        """Telemetry-routed decode admission key (docs/serving.md
+        'Disaggregated prefill/decode'): queue+residents first (an
+        empty replica always beats a loaded one), then the step
+        observatory's recent dispatch-gap mean (the replica whose
+        device is waiting on its host LEAST takes the next decoder),
+        then free blocks, then index — richer than queue depth, still
+        deterministic under a fake clock."""
+        sched = self.server.scheduler
+        return (sched.pending_requests + sched.active_slots,
+                self.gap_s(), -sched.allocator.free_blocks, self.index)
 
 
 class ServingFrontend:
@@ -184,6 +249,45 @@ class ServingFrontend:
             self._fi = FaultInjector.from_config(
                 tcfg.fault_injection, registry=self.telemetry)
         reg = self.telemetry
+        # disaggregated prefill/decode (docs/serving.md "Disaggregated
+        # prefill/decode"): per-replica roles + the shared handoff
+        # tier. No roles (or all-mixed) = self._handoff is None and
+        # every routing/collection seam below short-circuits — the
+        # pool is byte-identical to one without this layer (pinned).
+        self._roles = (list(rcfg.roles) if rcfg.roles
+                       else [MIXED] * rcfg.replicas)
+        self._disagg = any(r != MIXED for r in self._roles)
+        self._handoff = (HandoffTier(rcfg.handoff_blocks)
+                         if self._disagg else None)
+        self._handoffs = 0            # prefill->decode transitions
+        if self._disagg:
+            self._c_handoff_pub = reg.counter(
+                "serve_handoff_published_total",
+                help="prefix blocks published into the prefill->decode "
+                     "handoff tier (payload + int8 scale tiles, all "
+                     "layers, keyed by chain hash — docs/serving.md "
+                     "'Disaggregated prefill/decode')")
+            self._c_handoff_con = reg.counter(
+                "serve_handoff_consumed_total",
+                help="handoff blocks imported into a decode replica at "
+                     "routing (its admission warms them via "
+                     "match_prefix -> paged_swap_in, one jitted donated "
+                     "scatter per block)")
+            self._c_handoff_exp = reg.counter(
+                "serve_handoff_expired_total",
+                help="handoff blocks dropped unconsumed: capacity-"
+                     "expired (bounded tier, oldest publication first) "
+                     "or abandoned at a terminal finish — either way "
+                     "the decode side recomputes, and nothing strands")
+            self._g_handoff_blocks = reg.gauge(
+                "serve_handoff_blocks",
+                help="blocks currently parked in the prefill->decode "
+                     "handoff tier awaiting a decode replica")
+            self._h_handoff = reg.histogram(
+                "serve_handoff_seconds",
+                help="publish-to-consume latency of one request's KV "
+                     "handoff (prefill replica finished -> decode "
+                     "replica imported)")
         self._c_failovers = reg.counter(
             "serve_failovers_total",
             help="requests failed over off a dead replica (committed "
@@ -227,9 +331,14 @@ class ServingFrontend:
         self.replicas: List[_Replica] = []
         now = self._clock()
         for i in range(rcfg.replicas):
+            role = self._roles[i]
             srv = ContinuousBatchingServer(
                 engine, registry=MetricRegistry(), clock=self._clock,
-                fault_injector=self._fi, supervised=True)
+                fault_injector=self._fi, supervised=True, role=role,
+                # decode-capable replicas in a role-split pool receive
+                # handoffs — they need the import tier the admission
+                # swap-in reads from; prefill replicas never do
+                handoff_import=self._disagg and role != PREFILL)
             wd = Watchdog(self._dead_s, registry=reg, clock=self._clock,
                           name=f"serve_replica{i}")
             srv.watchdog = wd
@@ -239,7 +348,7 @@ class ServingFrontend:
                      "0 = breaker open (degraded/draining) or dead",
                 labels={"replica": str(i)})
             gauge.set(1.0)
-            self.replicas.append(_Replica(i, srv, wd, now, gauge))
+            self.replicas.append(_Replica(i, srv, wd, now, gauge, role))
         if self._fi is not None:
             # seeded kill schedule: pick the victim now that the pool
             # size is known (telemetry.fault_injection.replica_kill_step)
@@ -377,7 +486,16 @@ class ServingFrontend:
             # straggler loop would drop it on the floor).
             why = rep.server.finish_reason(request_id)
             if why is not None:
-                self._finalize(fr, rep.server.result(request_id), why,
+                tokens = rep.server.result(request_id)
+                if self._handoff_point(fr, why, tokens):
+                    # the replica finished only the prefill-only LEG —
+                    # pool-wise the request is still mid-flight, so
+                    # the cancel wins: partial out, no handoff
+                    self._finalize(fr, tokens, "cancelled",
+                                   self._deferred_finished,
+                                   frontend_decided=True)
+                    return True
+                self._finalize(fr, tokens, why,
                                self._deferred_finished)
             return False
         self._finalize(fr, rep.server.result(request_id), "cancelled",
@@ -464,8 +582,163 @@ class ServingFrontend:
             fr = self._requests.get(rid)
             if fr is None:
                 continue          # already finalized (e.g. via cancel)
-            self._finalize(fr, rep.server.result(rid),
-                           rep.server.finish_reason(rid), finished)
+            why = rep.server.finish_reason(rid)
+            if why is None:
+                # no terminal record left server-side: this finish was
+                # already collected through another path in THIS round
+                # (a mid-collect _kill_replica sweeps the dying
+                # replica's uncollected finishes, and a handoff's
+                # forget() wipes the record while the request lives on
+                # mid-flight) — finalizing from the stale `done` entry
+                # would pass tokens=None into _finalize and crash the
+                # whole frontend step
+                continue
+            self._collect_finish(rep, fr, rep.server.result(rid), why,
+                                 finished)
+
+    @staticmethod
+    def _handoff_point(fr: _FrontRequest, reason: str,
+                       tokens: List[int]) -> bool:
+        """True when a replica-side finish is the prefill→decode
+        handoff point: the prefill-only leg ran out its one-token
+        budget with output still owed. ONE predicate for
+        :meth:`_collect_finish` and :meth:`cancel` — the two sites
+        must never drift on what counts as a real finish."""
+        return (fr.prefill_only and reason == "length"
+                and len(tokens) < len(fr.prompt) + fr.max_new_tokens)
+
+    def _collect_finish(self, rep: _Replica, fr: _FrontRequest,
+                        tokens: List[int], reason: str,
+                        finished: List[int]) -> None:
+        """One replica-side finish, phase-aware: a prefill-only leg
+        that ran out its one-token budget with output still owed is
+        the HANDOFF point, not a finish — everything else (real
+        finishes, a first-token EOS, lifecycle terminations, and a
+        prefill leg that already satisfied the whole request) finalizes
+        as before."""
+        if self._handoff_point(fr, reason, tokens):
+            self._handoff_request(rep, fr, tokens, finished)
+            return
+        self._finalize(fr, tokens, reason, finished)
+
+    def _handoff_request(self, rep: _Replica, fr: _FrontRequest,
+                         tokens: List[int], finished: List[int]) -> None:
+        """The disaggregation seam (docs/serving.md "Disaggregated
+        prefill/decode"): the prefill-only leg finished, so fold its
+        committed token(s) into the scheduling prompt, publish the
+        prompt's block-aligned KV into the shared handoff tier under
+        its prefix chain hashes (the blocks ``commit_prefix``
+        registered at the final chunk, read out block by block via
+        ``paged_read_block``), and resubmit toward a decode replica —
+        whose admission warms every published block back in through
+        ``match_prefix`` → ``paged_swap_in`` and recomputes only the
+        sub-block tail as one short chunk (the "prompt capped one
+        token short" idiom). A publish that dies partway (the
+        injected mid-publish replica kill, or a real export death)
+        publishes NOTHING — the decode replica falls back to
+        recomputing the whole prefix from the folded prompt, exact by
+        the PR-7/PR-13 recompute oracle."""
+        rid = fr.request_id
+        fr.committed = list(tokens)[len(fr.prompt):]
+        fr.replica = None
+        fr.prefill_only = False
+        self._handoffs += 1
+        # the prefill leg's terminal record must not block the id's
+        # decode-leg resubmission — which on a role-degraded pool can
+        # land back on this very replica (last-resort colocation)
+        rep.server.forget(rid)
+        bs = self.engine.config.block_size
+        sched_prompt = list(fr.prompt) + list(fr.committed)
+        # cap one token short of the decode-side scheduling prompt —
+        # exactly the blocks its admission can take by hash (the tail
+        # must re-run through the chunk program to produce logits)
+        reusable = (len(sched_prompt) - 1) // bs
+        hashes = prefix_block_hashes(sched_prompt, bs)[:reusable]
+        entries: List[tuple] = []
+        killed = None
+        warm = 0
+        t0 = self._clock()
+        if hashes:
+            # leading chain blocks already warm on EVERY live decode-
+            # capable replica (device-registered, or parked in its
+            # import tier) need no handoff at all: whichever replica
+            # the request routes to, its admission walk hits them
+            # before ever reaching the published tail — the shared-
+            # system-prompt prefix is read off the prefill device
+            # ONCE, then never again while it stays warm
+            targets = [r for r in self.replicas
+                       if r.role != PREFILL and r.health != DEAD
+                       and r.server.host_tier is not None]
+            for h in hashes:
+                if targets and all(
+                        r.server.scheduler.allocator.lookup_prefix(h)
+                        is not None or r.server.host_tier.has(h)
+                        for r in targets):
+                    warm += 1
+                else:
+                    break
+            hashes = hashes[warm:]
+        if hashes:
+            # identical leading chains another request already parked
+            # need no device read — reuse the tier's payload objects
+            # and export only the cold tail of the chain
+            cached = self._handoff.payloads_for(hashes)
+            rest = hashes[len(cached):]
+            on_block = None
+            if self._fi is not None:
+                fi = self._fi
+                on_block = (lambda i, n:
+                            fi.check_handoff_block(rid, i, n))
+            try:
+                entries = cached + (
+                    rep.server.export_prefix(rest, on_block=on_block)
+                    if rest else [])
+            except Exception as e:  # noqa: BLE001 — export death IS
+                killed, entries = e, []   # replica death (mid-publish)
+        if killed is None and self._fi is not None:
+            try:
+                self._fi.check_handoff_published(rid)
+            except ReplicaKilled as e:
+                # publish COMPLETED before the death: the payloads are
+                # host-durable numpy — the handoff outlives its
+                # publisher, only the replica dies
+                killed = e
+        if entries:
+            expired = self._handoff.publish(rid, entries, t0)
+            self._c_handoff_pub.inc(len(entries))
+            if expired:
+                self._c_handoff_exp.inc(expired)
+            self._g_handoff_blocks.set(self._handoff.blocks)
+            get_event_ring().record(
+                telemetry_events.KV_HANDOFF, stage="published",
+                request_id=rid, replica=rep.index,
+                blocks=len(entries), warm_skipped=warm,
+                expired=expired)
+        elif killed is not None:
+            # the export died mid-publish: the decode side recomputes
+            # the prefix from the folded prompt — slower, never wrong
+            get_event_ring().record(
+                telemetry_events.KV_HANDOFF, stage="fallback",
+                request_id=rid, replica=rep.index, cause=repr(killed))
+        else:
+            # nothing left to publish: the whole chain is already warm
+            # on every decode-capable replica, or the prompt has no
+            # full block — either way the decode side's own admission
+            # serves it (warm hit / short recompute)
+            get_event_ring().record(
+                telemetry_events.KV_HANDOFF, stage="skipped",
+                request_id=rid, replica=rep.index,
+                cause="already_warm" if warm else "no_full_blocks")
+        if killed is not None and rep.health != DEAD:
+            self._kill_replica(
+                rep, f"died during handoff publish: {killed!r}",
+                finished)
+        # route toward a decode replica NOW (no failure happened — no
+        # backoff); an unroutable pool holds it pending, immediately
+        # eligible
+        if not self._route(fr, finished):
+            fr.retry_at_tick = self._tick
+            self._pending.append(fr)
 
     # ------------------------------------------------------- lifecycle
 
@@ -482,6 +755,22 @@ class ServingFrontend:
         self.finish_reasons[rid] = reason
         self._requests.pop(rid, None)
         finished.append(rid)
+        if self._handoff is not None:
+            # a terminal finish releases any unconsumed publication —
+            # the invariant that keeps the bounded tier free of
+            # stranded entries (chaos-pinned)
+            n = self._handoff.abandon(rid)
+            if n:
+                self._c_handoff_exp.inc(n)
+                self._g_handoff_blocks.set(self._handoff.blocks)
+            # ...and any replica-side IMPORTS the request never lived
+            # to consume at admission (a still-queued cancel/deadline/
+            # failed death — the unbounded import tier would hold them
+            # forever; already-swapped-in hashes are no-ops)
+            for idx, hashes in fr.imported:
+                rep = self.replicas[idx]
+                if rep.health != DEAD:
+                    rep.server.purge_import(hashes)
         self._h_retries.observe(fr.failovers)
         if frontend_decided:
             # a finish the FRONTEND itself decided (the request never
@@ -495,12 +784,58 @@ class ServingFrontend:
                 generated=len(tokens) - len(fr.prompt),
                 preemptions=0, source="frontend")
 
+    def _candidates(self, fr: _FrontRequest) -> List[tuple]:
+        """``(replica, as_prefill)`` admission order. Without roles:
+        least-loaded routable, breaker failing OPEN (degraded) only
+        when nothing is healthy — unchanged from the replicated pool.
+        With roles the request routes by PHASE: a request with no
+        committed tokens wants a prefill replica (telemetry-blind
+        least-loaded — prefill replicas are queue-bound), one with
+        committed tokens wants a decode replica ranked by the
+        telemetry key (load, recent dispatch gap, free blocks); mixed
+        replicas back both phases colocated, and wrong-role replicas
+        are the availability-over-purity last resort (a pool with
+        every prefill-capable replica dead still serves, colocated).
+        ``as_prefill`` is True only for a prefill-role target taking a
+        prefill-phase request — THAT submission is the one-token
+        prefill-only leg whose finish hands off."""
+        if not self._disagg:
+            cands = sorted((r for r in self.replicas if r.routable),
+                           key=_Replica.load)
+            if not cands:
+                # breaker fail-open: a pool with zero healthy replicas
+                # prefers a degraded one over deadlocking the queue
+                cands = sorted(
+                    (r for r in self.replicas
+                     if r.health == DEGRADED and not r.draining),
+                    key=_Replica.load)
+            return [(r, False) for r in cands]
+        want = DECODE if fr.committed else PREFILL
+        prim_key = (_Replica.decode_load if want == DECODE
+                    else _Replica.load)
+
+        def tiers(pool: List[_Replica]) -> List[_Replica]:
+            return (sorted((r for r in pool if r.role == want),
+                           key=prim_key)
+                    + sorted((r for r in pool if r.role == MIXED),
+                             key=_Replica.load)
+                    + sorted((r for r in pool
+                              if r.role not in (want, MIXED)),
+                             key=_Replica.load))
+
+        pool = [r for r in self.replicas if r.routable]
+        if not pool:
+            pool = [r for r in self.replicas
+                    if r.health == DEGRADED and not r.draining]
+        return [(r, want == PREFILL and r.role == PREFILL)
+                for r in tiers(pool)]
+
     def _route(self, fr: _FrontRequest,
                finished: Optional[List[int]] = None) -> bool:
-        """Least-loaded admission over routable replicas; the breaker
-        fails OPEN (degraded accepted) only when nothing is healthy.
-        Returns True when the request was placed — or terminally
-        handled (expired / permanently refused at re-route time)."""
+        """Admission over the phase-aware candidate order (see
+        :meth:`_candidates`). Returns True when the request was
+        placed — or terminally handled (expired / permanently refused
+        at re-route time)."""
         now = self._clock()
         if fr.deadline_ts is not None and now >= fr.deadline_ts:
             self._finalize(fr, list(fr.prompt) + list(fr.committed),
@@ -509,21 +844,18 @@ class ServingFrontend:
                            else self._deferred_finished,
                            frontend_decided=True)
             return True
-        cands = sorted((r for r in self.replicas if r.routable),
-                       key=_Replica.load)
-        if not cands:
-            # breaker fail-open: a pool with zero healthy replicas
-            # prefers a degraded one over deadlocking the queue
-            cands = sorted((r for r in self.replicas
-                            if r.health == DEGRADED and not r.draining),
-                           key=_Replica.load)
         floor = max(1, self.engine.config.min_out_tokens)
-        for rep in cands:
+        for rep, as_prefill in self._candidates(fr):
+            # the prefill-only leg budgets exactly the floor (one
+            # token normally): the replica chunk-prefills, commits the
+            # first token, and retires — the finish is the handoff
+            budget = (floor if as_prefill
+                      else max(fr.max_new_tokens - len(fr.committed),
+                               floor))
             try:
                 rep.server.submit(
                     list(fr.prompt) + list(fr.committed),
-                    max_new_tokens=max(
-                        fr.max_new_tokens - len(fr.committed), floor),
+                    max_new_tokens=budget,
                     eos_token_id=fr.eos_token_id,
                     request_id=fr.request_id,
                     deadline_s=(None if fr.deadline_ts is None
@@ -542,12 +874,40 @@ class ServingFrontend:
                                frontend_decided=True)
                 return True
             fr.replica = rep.index
+            fr.prefill_only = as_prefill
             rep.routed += 1
-            if fr.committed:
+            if fr.replay and fr.committed:
                 self._replay_tokens += len(fr.committed)
                 self._c_replay.inc(len(fr.committed))
+            fr.replay = False
+            if (self._handoff is not None and fr.committed
+                    and not as_prefill):
+                self._consume_handoff(fr, rep)
             return True
         return False
+
+    def _consume_handoff(self, fr: _FrontRequest, rep: _Replica) -> None:
+        """Hand a routed decode-phase request its published KV: pop the
+        publication and park it in the target replica's import tier,
+        where the coming admission's ``match_prefix`` walk swaps each
+        block in. A target without a tier (wrong-role last resort)
+        leaves the publication parked — the terminal finish abandons
+        it, and the replica simply recomputes (exact either way)."""
+        if rep.server.host_tier is None:
+            return
+        got = self._handoff.consume(fr.request_id)
+        if got is None:
+            return                # never published / expired: cold
+        entries, t_pub = got
+        imported = rep.server.import_prefix(entries)
+        fr.imported.append((rep.index, [h for h, _ in entries]))
+        self._c_handoff_con.inc(len(entries))
+        self._h_handoff.observe(self._clock() - t_pub)
+        self._g_handoff_blocks.set(self._handoff.blocks)
+        get_event_ring().record(
+            telemetry_events.KV_HANDOFF, stage="consumed",
+            request_id=fr.request_id, replica=rep.index,
+            blocks=len(entries), imported=imported)
 
     def _route_pending(self, finished: List[int]) -> None:
         held: List[_FrontRequest] = []
@@ -574,6 +934,8 @@ class ServingFrontend:
         bound the retries, and schedule the backed-off resubmission."""
         fr.committed = list(partial)[len(fr.prompt):]
         fr.replica = None
+        fr.prefill_only = False
+        fr.replay = True          # the resubmission replays recompute
         fr.failovers += 1
         self._failovers += 1
         self._c_failovers.inc()
@@ -625,7 +987,11 @@ class ServingFrontend:
                 continue
             why = srv.finish_reasons.get(rid)
             if why is not None:
-                self._finalize(fr, srv.result(rid), why, finished)
+                # phase-aware: an uncollected prefill-only finish on
+                # the dying replica still hands off (its KV is intact
+                # in-process until close — publish before losing it)
+                self._collect_finish(rep, fr, srv.result(rid), why,
+                                     finished)
             else:
                 moved.append((fr, list(fr.prompt) + list(fr.committed)))
         for fr, partial in moved:
@@ -729,6 +1095,8 @@ class ServingFrontend:
                 continue
             fr.committed = list(partial)[len(fr.prompt):]
             fr.replica = None
+            fr.prefill_only = False
+            fr.replay = True
             fr.retry_at_tick = self._tick   # immediately eligible
             self._drain_reroutes += 1
             self._pending.append(fr)
@@ -800,6 +1168,7 @@ class ServingFrontend:
         sched = rep.server.scheduler
         row = {
             "replica": rep.index,
+            "role": rep.role,
             "health": rep.health,
             "draining": rep.draining,
             "routable": rep.routable,
@@ -818,6 +1187,20 @@ class ServingFrontend:
                 "free_blocks": sched.allocator.free_blocks,
                 "decode_steps": rep.server._step_clock,
             })
+            if self._disagg:
+                # per-replica host-tier view (handoff imports parked
+                # for the next admission + swap-ins already warmed —
+                # with kv_host_offload ALSO armed the same tier and
+                # counter carry plain offload traffic too, hence the
+                # neutral names) and the recent dispatch-gap mean the
+                # decode router ranks by
+                row.update({
+                    "host_tier_blocks": (
+                        len(rep.server.host_tier)
+                        if rep.server.host_tier is not None else 0),
+                    "host_tier_swap_ins": sched.allocator.swap_ins,
+                    "recent_gap_ms": round(rep.gap_s() * 1e3, 3),
+                })
         except Exception:  # noqa: BLE001 — a dead replica's books may
             pass           # be mid-teardown; health is the story then
         return row
@@ -833,6 +1216,13 @@ class ServingFrontend:
             "failover_replay_tokens": self._replay_tokens,
             "drain_reroutes": self._drain_reroutes,
             "tick": self._tick,
+            # disaggregation (docs/serving.md "Disaggregated prefill/
+            # decode"): role topology + the shared handoff tier's view
+            "roles": list(self._roles),
+            "disaggregated": self._disagg,
+            "handoffs": self._handoffs,
+            "handoff": (self._handoff.snapshot()
+                        if self._handoff is not None else None),
         }
 
     @property

@@ -929,3 +929,11 @@ class BlockAllocator:
     def block_hash(self, block: int):
         """The chain hash a block is registered under, or None."""
         return self._block_hash.get(block)
+
+    def lookup_prefix(self, h: bytes) -> Optional[int]:
+        """The block id currently registered under a chain hash —
+        resident OR parked in the evictable LRU — without touching
+        refcounts or LRU order (a pure read: the disaggregation
+        handoff export walks a just-retired prompt's registered
+        blocks through this). None = not device-registered."""
+        return self._hash_to_block.get(h)

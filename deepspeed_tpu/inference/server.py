@@ -150,7 +150,8 @@ class ContinuousBatchingServer:
                  registry: Optional[MetricRegistry] = None,
                  clock: Optional[Callable[[], float]] = None,
                  fault_injector: Optional[FaultInjector] = None,
-                 supervised: bool = False):
+                 supervised: bool = False, role: str = "mixed",
+                 handoff_import: bool = False):
         if engine.model_config.head == "none":
             raise ValueError("continuous batching needs an LM head — "
                              "encoder models have nothing to decode")
@@ -167,6 +168,14 @@ class ContinuousBatchingServer:
         # everything else (tracing, SLO, step profile, fault sites) is
         # per-replica as usual
         self._supervised = supervised
+        # disaggregated serving (docs/serving.md "Disaggregated
+        # prefill/decode"): the ROLE is routing metadata owned by the
+        # frontend — the server itself serves whatever it is handed
+        # (a "prefill" replica just only ever receives one-token
+        # budgets). handoff_import arms an import-only host tier on a
+        # decode-capable replica so consumed handoff payloads park
+        # where the next admission's match_prefix walk swaps them in.
+        self.role = role
         self._closed = False
         cfg = engine.config
         mcfg = engine.model_config
@@ -361,6 +370,23 @@ class ContinuousBatchingServer:
         self.kv_dtype = cfg.kv_cache_dtype
         self.host_tier = (HostKVTier(cfg.kv_host_blocks)
                           if cfg.kv_host_offload else None)
+        # import-only tier: holds handoff payloads the frontend parked
+        # for this replica's next admission (import_prefix). Unbounded
+        # — the frontend's HandoffTier is the bounded stage; entries
+        # here are already committed to a specific routed request.
+        # Demotion is NOT wired for an import-only tier (on_demote
+        # stays None below), so this replica's LRU pops remain plain
+        # evictions — byte-identical eviction behavior to a server
+        # without the handoff layer.
+        self._import_only_tier = False
+        self._handoff_import = handoff_import
+        if handoff_import and self.host_tier is None:
+            if not self.prefix_caching:
+                raise ValueError(
+                    "handoff_import needs enable_prefix_caching — a "
+                    "hashless block has no identity to import under")
+            self.host_tier = HostKVTier(None)
+            self._import_only_tier = True
         # swap-thrash detector: rolling window of per-step swap-in
         # counts (the allocator's counter, sampled at step cadence)
         self._swap_window: Deque[int] = deque(
@@ -397,25 +423,34 @@ class ContinuousBatchingServer:
             # device arrays, so the copies are its callbacks. Both run
             # only inside admission-time allocation — the sync body
             # after any pipeline flush — so a tier copy can never race
-            # an in-flight donated step.
+            # an in-flight donated step. An import-only tier wires the
+            # swap-in side ONLY: handoff payloads swap in on prefix
+            # hits, but this replica's own LRU pops stay plain
+            # evictions (on_demote None — see _pop_free).
             alloc = self.scheduler.allocator
-            alloc.on_demote = self._demote_block
+            if not self._import_only_tier:
+                alloc.on_demote = self._demote_block
             alloc.on_swap_in = self._swap_in_block
             # /debug/memory accounts the tier's host-RAM bytes beside
             # the HBM buckets (weakref: a dropped server must not pin
-            # its payloads through the process-wide monitor)
-            import weakref
+            # its payloads through the process-wide monitor). Import-
+            # only tiers skip it: N decode replicas would clobber one
+            # process-wide getter, and their parked bytes are already
+            # visible on the frontend's handoff gauge + /debug/replicas
+            if not self._import_only_tier:
+                import weakref
 
-            from deepspeed_tpu.telemetry.memory import get_memory_monitor
-            tier_ref = weakref.ref(self.host_tier)
+                from deepspeed_tpu.telemetry.memory import \
+                    get_memory_monitor
+                tier_ref = weakref.ref(self.host_tier)
 
-            def _host_bytes():
-                tier = tier_ref()
-                return 0 if tier is None else tier.host_bytes
+                def _host_bytes():
+                    tier = tier_ref()
+                    return 0 if tier is None else tier.host_bytes
 
-            self._host_mem_getter = _host_bytes
-            get_memory_monitor().register_host_component(
-                "kv_host_tier", _host_bytes)
+                self._host_mem_getter = _host_bytes
+                get_memory_monitor().register_host_component(
+                    "kv_host_tier", _host_bytes)
         # flight recorder (telemetry/compile_watch.py): the serving jits
         # are watched, so a prompt shape that defeats the geometric
         # buckets shows up as a `retrace` event naming the argument that
@@ -712,7 +747,13 @@ class ContinuousBatchingServer:
         admission is paying tier copies instead of cache hits. Re-arms
         after the rate recovers (same episode discipline as the
         speculation-collapse detector)."""
-        if self.host_tier is None:
+        if self.host_tier is None or self._handoff_import:
+            # a handoff-importing replica swaps in BY DESIGN (one
+            # handoff per routed request — that is traffic, not
+            # thrash), and with kv_host_offload armed beside roles the
+            # two streams share one allocator counter the detector
+            # cannot tell apart: it stands down rather than latching a
+            # false alarm on a healthy disaggregated pool
             return
         swaps = self.scheduler.allocator.swap_ins
         self._swap_window.append(swaps - self._swap_seen)
@@ -730,6 +771,70 @@ class ContinuousBatchingServer:
                 free_blocks=self.scheduler.allocator.free_blocks)
         elif self._swap_alarm and rate <= self._KV_THRASH_RECOVER:
             self._swap_alarm = False
+
+    # ----------------------------------------------- prefill/decode handoff
+
+    def export_prefix(self, hashes, on_block=None):
+        """Read the payloads of the consecutively-registered prefix
+        blocks under ``hashes`` (chain order): ``[(hash, payload),
+        ...]``, stopping at the first unregistered hash — a deeper
+        block is only valid under its whole chain. Each payload is one
+        :func:`~deepspeed_tpu.inference.kv_cache.paged_read_block`
+        result (k/v slabs + int8 scale tiles, all layers, host-durable
+        numpy on return). The disaggregating frontend calls this right
+        after a prefill-only request finishes: the blocks were
+        registered by ``commit_prefix`` at the final chunk and parked
+        in the LRU at retirement, content intact — and the read
+        targets ``self._cache``, which chains after any in-flight
+        dispatch, so it can never observe a donated buffer.
+        ``on_block(index, total)`` is the chaos seam (it may raise —
+        the mid-publish replica-kill injection)."""
+        alloc = self.scheduler.allocator
+        out = []
+        total = len(hashes)
+        for i, h in enumerate(hashes):
+            b = alloc.lookup_prefix(h)
+            if b is None:
+                break
+            if on_block is not None:
+                on_block(i, total)
+            out.append((h, paged_read_block(self._cache, b)))
+        return out
+
+    def import_prefix(self, entries) -> int:
+        """Park handoff payloads in this replica's host tier so the
+        next admission's ``match_prefix`` walk swaps them in (one
+        jitted donated scatter per block — zero new executables).
+        Hashes already warm here — device-registered, or already
+        host-resident — are skipped: a hash must never be BOTH
+        device-registered and host-resident (the register_prefix
+        invariant), and the warmer copy wins anyway. Returns how many
+        payloads were parked."""
+        if self.host_tier is None:
+            return 0
+        alloc = self.scheduler.allocator
+        n = 0
+        for h, payload in entries:
+            if alloc.lookup_prefix(h) is not None or self.host_tier.has(h):
+                continue
+            self.host_tier.put(h, payload)
+            n += 1
+        return n
+
+    def purge_import(self, hashes) -> int:
+        """Drop still-parked host-tier payloads under ``hashes`` — the
+        frontend calls this when a request whose handoff it imported
+        here reaches a TERMINAL finish without ever being admitted
+        (cancelled / deadline-expired / failed while queued): nothing
+        else would ever consume the entries, and an import-only tier
+        is unbounded — without the purge they leak host RAM for the
+        server's lifetime. Hashes already swapped in (gone from the
+        tier) or re-registered device-side are no-ops; tier content is
+        always recomputable, so an over-eager purge can only cost a
+        recompute, never correctness. Returns how many were dropped."""
+        if self.host_tier is None:
+            return 0
+        return sum(1 for h in hashes if self.host_tier.discard(h))
 
     # ------------------------------------------------------------ API
 
@@ -958,6 +1063,18 @@ class ContinuousBatchingServer:
         out = self._results.pop(request_id)
         self.finish_reasons.pop(request_id, None)
         return out
+
+    def forget(self, request_id: int) -> None:
+        """Drop a FINISHED request's terminal record so the same id is
+        resubmittable HERE again. The disaggregating frontend calls
+        this after collecting a prefill-only leg's finish: the id is
+        about to resubmit for its decode leg, and on a role-degraded
+        pool (every decode replica dead) the last-resort target can be
+        this very server — whose duplicate-id guard would otherwise
+        refuse the id it just served (the ``reclaim()`` forget step,
+        for work that FINISHED its leg instead of being taken away)."""
+        self._results.pop(request_id, None)
+        self.finish_reasons.pop(request_id, None)
 
     def _fail_request(self, req: Request, tokens: List[int],
                       error: str, finished: Optional[list]) -> None:
@@ -2322,6 +2439,7 @@ class ContinuousBatchingServer:
                    if self._verify_jit is not None else 0)),
             "num_slots": self.num_slots,
             "block_size": self.block_size,
+            "role": self.role,
             "free_blocks": alloc.free_blocks,
             "queued": self.scheduler.pending_requests,
             "prefix_caching": self.prefix_caching,
@@ -2369,7 +2487,8 @@ class ContinuousBatchingServer:
                     + (self._cache.k_scale.nbytes
                        + self._cache.v_scale.nbytes
                        if self._cache.k_scale is not None else 0)),
-                "host_offload": self.host_tier is not None,
+                "host_offload": (self.host_tier is not None
+                                 and not self._import_only_tier),
                 "host_blocks": (len(self.host_tier)
                                 if self.host_tier is not None else 0),
                 "host_bytes": (self.host_tier.host_bytes

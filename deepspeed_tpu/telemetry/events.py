@@ -83,6 +83,15 @@ TRAIN_FAULT = "train_fault"
 # …and one per completed recovery (rollback tag, replayed-from step,
 # recovery seconds) — the pair brackets every restart in the ring
 TRAIN_RESUME = "train_resume"
+# disaggregated prefill/decode (docs/serving.md "Disaggregated
+# prefill/decode"): one entry per handoff stage — "published" (the
+# prefill replica's block-aligned KV landed in the shared tier),
+# "consumed" (a decode replica imported it at routing), "fallback"
+# (publication failed — the prefill replica died mid-export — and the
+# decode replica recomputes the prefix from the folded prompt), or
+# "skipped" (nothing worth publishing: the chain is already warm on
+# every decode-capable replica, or the prompt has no full block)
+KV_HANDOFF = "kv_handoff"
 # KV host tiering (docs/serving.md "KV quantization & host tiering"):
 # the swap-in rate over the rolling window crossed the thrash
 # threshold — blocks are cycling device<->host faster than they serve,

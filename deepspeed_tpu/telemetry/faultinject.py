@@ -70,6 +70,13 @@ REPLICA_KILL = "replica_kill"
 REPLICA_WEDGE = "replica_wedge"
 REPLICA_HEARTBEAT_LOSS = "replica_heartbeat_loss"
 REPLICA_SLOW_STEP = "replica_slow_step"
+# handoff-scoped kind (disaggregated prefill/decode — docs/serving.md
+# "Disaggregated prefill/decode"): kill the PREFILL replica mid-publish
+# (the export dies partway — nothing publishes, the decode replica
+# recomputes the prefix from the folded prompt) or right after publish
+# (the payloads are already host-durable — the handoff survives its
+# publisher). Keys are request ids; one-shot arms.
+HANDOFF_KILL = "handoff_kill"
 # training-scoped kinds (runtime/resilience.py TrainingSupervisor +
 # runtime/checkpointing.py — docs/training.md "Fault-tolerant training
 # & verified checkpoints"; a bare engine without a supervisor never
@@ -169,6 +176,8 @@ class FaultInjector:
         self._replica_wedged: Set[int] = set()
         self._replica_hb_lost: Set[int] = set()
         self._replica_slow: Dict[int, float] = {}
+        # handoff-scoped arms: request id -> "mid" | "after" (one-shot)
+        self._handoff_kills: Dict[int, str] = {}
         # training-scoped arms (keys are GLOBAL STEP numbers); each is
         # one-shot — consumed when it fires, so a post-recovery replay
         # of the same step is not re-killed
@@ -461,6 +470,44 @@ class FaultInjector:
     def replica_heartbeat_lost(self, replica: int) -> bool:
         return replica in self._replica_hb_lost
 
+    def kill_prefill_mid_publish(self, request_id: int) -> None:
+        """Arm a mid-publish kill: the prefill replica dies halfway
+        through exporting this request's handoff blocks — nothing
+        publishes, and the decode replica must recompute the prefix
+        from the folded prompt (exact, chaos-pinned)."""
+        self._handoff_kills[request_id] = "mid"
+
+    def kill_prefill_after_publish(self, request_id: int) -> None:
+        """Arm a post-publish kill: the prefill replica dies the moment
+        this request's handoff publication completes — the payloads are
+        already host-durable, so the decode replica still warms from
+        them (the handoff must survive its publisher)."""
+        self._handoff_kills[request_id] = "after"
+
+    def check_handoff_block(self, request_id: int, index: int,
+                            total: int) -> None:
+        """Per-block export site: raises :class:`ReplicaKilled` at the
+        midpoint block of an armed mid-publish kill. One-shot."""
+        if (self._handoff_kills.get(request_id) == "mid"
+                and index >= total // 2):
+            del self._handoff_kills[request_id]
+            self._count(HANDOFF_KILL, request_id=request_id,
+                        when="mid_publish", block=index, total=total)
+            raise ReplicaKilled(
+                f"injected kill of the prefill replica mid-publish "
+                f"(request {request_id}, block {index}/{total})")
+
+    def check_handoff_published(self, request_id: int) -> None:
+        """Publish-complete site: raises :class:`ReplicaKilled` for an
+        armed after-publish kill. One-shot."""
+        if self._handoff_kills.get(request_id) == "after":
+            del self._handoff_kills[request_id]
+            self._count(HANDOFF_KILL, request_id=request_id,
+                        when="after_publish")
+            raise ReplicaKilled(
+                f"injected kill of the prefill replica after the "
+                f"handoff publish (request {request_id})")
+
     def slow_replica(self, replica: int, extra_s: float) -> None:
         """Arm (or with 0.0 clear) accounted slow-step latency for one
         replica — never slept, drives the slow-step degraded breaker."""
@@ -490,6 +537,7 @@ class FaultInjector:
                 "replicas_wedged": sorted(self._replica_wedged),
                 "replicas_heartbeat_lost": sorted(self._replica_hb_lost),
                 "replicas_slow": dict(self._replica_slow),
+                "handoff_kills_armed": dict(self._handoff_kills),
                 "train_crash_steps": sorted(self._crash_steps),
                 "train_preempt_steps": sorted(self._preempt_steps),
                 "train_nan_steps": sorted(self._nan_steps),

@@ -80,7 +80,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
 
 from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
 
@@ -310,6 +311,12 @@ class StepProfiler:
         self.outstanding = 0
         self.pipelined_dispatches = 0   # dispatches issued into a busy device
         self.pipelined_steps = 0        # steps credited via pipelined()
+        # rolling window of the most recent gap observations (pipelined
+        # 0-gaps included) — the cheap "how host-bound is this server
+        # RIGHT NOW" signal the disaggregated frontend's telemetry
+        # routing reads per admission (recomputing a histogram quantile
+        # per routing decision would not be)
+        self._recent_gaps: Deque[float] = deque(maxlen=32)
         self._handle = _StepHandle(self)
         reg = self.registry
         self._h_wall = reg.histogram(
@@ -351,6 +358,7 @@ class StepProfiler:
             with self._lock:
                 self.gap_count += 1
                 self.pipelined_dispatches += 1
+                self._recent_gaps.append(0.0)
             return
         self.outstanding = 1
         if self._last_fetch is None:
@@ -362,6 +370,7 @@ class StepProfiler:
             self.gap_count += 1
             self.gap_total += gap
             self.gap_max = max(self.gap_max, gap)
+            self._recent_gaps.append(gap)
 
     def _note_fetch(self, now: float) -> None:
         self.outstanding = max(self.outstanding - 1, 0)
@@ -375,6 +384,16 @@ class StepProfiler:
         outstanding-dispatch pairing exact when no step handle is
         live."""
         self._note_fetch(now)
+
+    def recent_gap_s(self) -> float:
+        """Mean of the last ≤32 dispatch-gap observations (0.0 with no
+        history) — the per-replica host-bound signal the disaggregated
+        frontend ranks decode replicas by (telemetry-routed admission:
+        docs/serving.md 'Disaggregated prefill/decode')."""
+        with self._lock:
+            if not self._recent_gaps:
+                return 0.0
+            return sum(self._recent_gaps) / len(self._recent_gaps)
 
     def _phase_h(self, phase: str):
         h = self._phase_hist.get(phase)

@@ -34,7 +34,14 @@ def run_replicated(eng, prompt, args):
     front = ServingFrontend(eng, fault_injector=fi)
     ids = []
     for i in range(args.continuous):
-        p = prompt[: 1 + i % len(prompt)]
+        if args.roles:
+            # disaggregation demo: full-length distinct prompts — the
+            # handoff publishes FULL blocks (a sub-block prompt has
+            # nothing block-aligned to hand off and recomputes on the
+            # decode side, exact but unspectacular)
+            p = [1 + (i + j) % 90 for j in range(len(prompt))]
+        else:
+            p = prompt[: 1 + i % len(prompt)]
         ids.append(front.submit(p, max_new_tokens=2 + args.max_new_tokens
                                 * (i % 3) // 2,
                                 deadline_s=args.deadline_s,
@@ -50,12 +57,23 @@ def run_replicated(eng, prompt, args):
           f"replicas healthy, {st['failovers']} failovers, "
           f"{st['failover_replay_tokens']} replay tokens, "
           f"{st['drain_reroutes']} drain re-routes")
+    if st["disaggregated"]:
+        hf = st["handoff"]
+        print(f"  roles {st['roles']}: {st['handoffs']} handoffs, "
+              f"{hf['published']} blocks published / {hf['consumed']} "
+              f"consumed / {hf['expired']} expired, "
+              f"{hf['blocks']} parked")
     for row in st["replicas"]:
         dead = (f" ({row['dead_reason']})"
                 if row["dead_reason"] else "")
-        print(f"  replica {row['replica']}: {row['health']}{dead} — "
-              f"routed {row['routed']}, steps {row['steps']}, "
-              f"failovers-from {row['failovers_from']}")
+        extra = ""
+        if st["disaggregated"]:
+            extra = (f", swap-ins {row.get('host_tier_swap_ins', 0)}, "
+                     f"gap {row.get('recent_gap_ms', 0.0)} ms")
+        print(f"  replica {row['replica']} [{row['role']}]: "
+              f"{row['health']}{dead} — routed {row['routed']}, "
+              f"steps {row['steps']}, "
+              f"failovers-from {row['failovers_from']}{extra}")
     if front.http_server is not None:
         port = front.http_server.port
         input(f"pool state at http://127.0.0.1:{port}/debug/replicas "
@@ -247,6 +265,16 @@ def main():
                          "kill that fails over losslessly — "
                          "docs/serving.md 'Replicated serving & "
                          "failover')")
+    ap.add_argument("--roles", default=None, metavar="R1,R2,...",
+                    help="disaggregated prefill/decode serving: one "
+                         "role per replica from {prefill,decode,mixed} "
+                         "(e.g. 'prefill,decode' — implies --replicas "
+                         "len(roles) and --prefix-cache). New requests "
+                         "chunk-prefill on a prefill replica, hand "
+                         "their KV off by chain hash, and decode on a "
+                         "telemetry-picked decode replica "
+                         "(docs/serving.md 'Disaggregated prefill/"
+                         "decode')")
     ap.add_argument("--speculate", type=int, default=0, metavar="K",
                     help="per-slot speculative decoding: each active "
                          "slot proposes up to K-1 tokens per step by "
@@ -331,7 +359,13 @@ def main():
     if args.speculate:
         knobs["speculation_tokens"] = args.speculate
     knobs["async_loop"] = args.async_loop
-    if args.replicas and args.replicas > 1:
+    roles = None
+    if args.roles:
+        roles = [r.strip() for r in args.roles.split(",") if r.strip()]
+        knobs["replication"] = {"replicas": len(roles), "roles": roles}
+        knobs["enable_prefix_caching"] = True   # the handoff identity
+        args.replicas = len(roles)
+    elif args.replicas and args.replicas > 1:
         knobs["replication"] = {"replicas": args.replicas}
     eng = deepspeed_tpu.init_inference(args.path, **knobs)
     prompt = [int(t) for t in args.prompt_ids.split(",")]

@@ -66,6 +66,12 @@ METRICS = {
     # eos/length under the seeded mid-decode replica kill — anything
     # below 1.0 means failover started LOSING requests
     "replication.availability": "up",
+    # disaggregated prefill/decode (docs/serving.md "Disaggregated
+    # prefill/decode"): role-split decode per-token p90 over colocated
+    # at equal total slots — a regression means prompt chunks started
+    # leaking back into the decode replica's step walls (the
+    # interference the role split exists to remove)
+    "disaggregation.decode_p90_ratio": "down",
     # KV tiering (docs/serving.md "KV quantization & host tiering"):
     # device KV bytes per resident slot, fp over int8 — how many more
     # sequences the same HBM holds with the int8 pool; a regression

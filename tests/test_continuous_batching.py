@@ -422,6 +422,30 @@ def test_bench_serve_continuous_smoke():
     # regression gate's input) token-identical to the undisturbed leg,
     # failover demonstrably fired with bounded replay-token overhead,
     # and the per-replica stats rows name exactly one dead replica
+    # disaggregated prefill/decode A/B (auto in smoke, docs/serving.md
+    # "Disaggregated prefill/decode"): under the long-prompt +
+    # resident-decoder interference mix, role-split decode per-token
+    # p90 must not exceed colocated at equal total slots (the attempts/
+    # best-of noise discipline rides in decode_p90_improved), outputs
+    # token-identical, every handoff block consumed (none stranded),
+    # handoff volume per request recorded, and the decode replica kept
+    # ONE decode executable with zero retraces — the handoff reuses
+    # the existing match_prefix -> paged_swap_in machinery
+    dg = rec["disaggregation"]
+    assert dg["roles"] == ["prefill", "decode"]
+    assert dg["parity_exact"] is True
+    assert dg["decode_p90_improved"] is True
+    assert dg["decode_p90_ratio"] <= 1.1
+    assert dg["disaggregated"]["handoffs"] >= dg["interferers"]
+    assert dg["disaggregated"]["handoff_blocks_published"] > 0
+    assert dg["disaggregated"]["handoff_blocks_consumed"] == \
+        dg["disaggregated"]["handoff_blocks_published"]
+    assert dg["disaggregated"]["handoff_stranded_blocks"] == 0
+    assert dg["disaggregated"]["handoff_bytes_per_request"] > 0
+    assert dg["disaggregated"]["decode_swap_ins"] > 0
+    assert dg["disaggregated"]["decode_traces"] == 1
+    assert dg["disaggregated"]["retraces"] == 0
+    assert dg["colocated"]["handoffs"] == 0    # the baseline never splits
     rp = rec["replication"]
     assert rp["replicas"] == 2
     assert rp["chaos_kill"] is True

@@ -1,0 +1,575 @@
+"""Disaggregated prefill/decode serving — the handoff chaos suite.
+
+``replication.roles`` splits the replica pool into prefill and decode
+workers (docs/serving.md "Disaggregated prefill/decode"): a request
+chunk-prefills on a prefill replica, its block-aligned KV publishes
+into the shared :class:`HandoffTier` keyed by prefix chain hash, and a
+decode replica warms it back in through ``match_prefix`` →
+``paged_swap_in``. The oracles, all fake-clock / zero real sleeps:
+
+* greedy output through the prefill→decode handoff is token-IDENTICAL
+  to a single mixed server — across rotary/GQA/ALiBi/windowed/TP=2 and
+  the int8 KV pool, at every prompt-length alignment (the sub-block
+  tail recomputes as one short chunk), with ZERO new decode
+  executables (``_cache_size()`` pinned);
+* every failure mode degrades to the recompute idiom and stays exact:
+  a prefill replica killed mid-publish (nothing published — cold
+  fold), killed after publish (the host-durable handoff outlives its
+  publisher), a wrong-role last resort (every decode replica dead);
+* the bounded tier never strands an entry: whatever the path —
+  consumed, abandoned at a terminal finish, capacity-expired — the
+  tier drains to zero blocks (chaos-pinned);
+* decode routing is telemetry-driven: under a crafted dispatch-gap
+  skew the idle decode replica takes the work, not just the
+  shortest queue.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference import (ContinuousBatchingServer,
+                                     DeepSpeedInferenceConfig,
+                                     InferenceEngine, ServingFrontend)
+from deepspeed_tpu.inference.disagg import HandoffTier
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, init_params)
+from deepspeed_tpu.telemetry import (EventRing, FaultInjector,
+                                     MetricRegistry, get_event_ring,
+                                     get_registry, set_event_ring,
+                                     set_registry)
+from deepspeed_tpu.telemetry import events as ev
+
+
+@pytest.fixture()
+def fresh_telemetry():
+    prev_reg = set_registry(MetricRegistry())
+    prev_ring = set_event_ring(EventRing(512))
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev_reg)
+        set_event_ring(prev_ring)
+
+
+_MCFG = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+             n_head=4, dtype=jnp.float32)
+BS = 32
+
+
+def make_engine(seed=0, roles=None, replicas=None, num_slots=2,
+                tp_size=1, repl_knobs=None, **knobs):
+    base = dict(_MCFG)
+    base.update(knobs.pop("model", {}))
+    cfg = InferenceTransformerConfig(**base)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    repl = {"replicas": (len(roles) if roles and replicas is None
+                         else (replicas or 1)), "roles": roles}
+    repl.update(repl_knobs or {})
+    return InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=256, block_size=BS,
+        num_slots=num_slots, enable_prefix_caching=True,
+        tensor_parallel={"tp_size": tp_size}, replication=repl, **knobs))
+
+
+# prompts > one block so a real handoff has full blocks to publish
+PROMPTS = [[1 + i, 2, 3] + [4 + (7 * i + t) % 100 for t in range(36)]
+           for i in range(4)]
+
+
+def serve_single(eng, prompts, n=6):
+    """The oracle: the SAME config on one mixed server (like-vs-like —
+    the int8-chunked numeric path stays identical on both sides)."""
+    srv = ContinuousBatchingServer(eng, registry=MetricRegistry())
+    outs = []
+    for p in prompts:
+        rid = srv.submit(p, max_new_tokens=n)
+        outs.append(srv.drain()[rid])
+    srv.close()
+    return outs
+
+
+_ORACLE6 = {}
+
+
+def oracle6(k=4):
+    """Default-config single-server oracle for PROMPTS[:k] at budget 6,
+    computed once per session (several tests slice it — recompiling the
+    same tiny model per test would be pure tier-1 wall)."""
+    if not _ORACLE6:
+        _ORACLE6["out"] = serve_single(make_engine(), PROMPTS, n=6)
+    return _ORACLE6["out"][:k]
+
+
+def serve_pool(front, prompts, n=6):
+    ids = [front.submit(p, max_new_tokens=n) for p in prompts]
+    out = front.drain()
+    return [out[i] for i in ids], [front.finish_reason(i) for i in ids]
+
+
+def events_of(kind):
+    return [e for e in get_event_ring().snapshot() if e["kind"] == kind]
+
+
+# --------------------------------------------------------------- parity
+
+def test_disaggregated_parity_and_warm_handoff(fresh_telemetry):
+    """THE headline oracle: greedy output through a prefill-replica →
+    decode-replica handoff is token-identical to a single mixed
+    server — and the handoff actually ran WARM (published blocks
+    swapped into the decode replica, prefix hits at its admission),
+    with zero new decode executables."""
+    want = oracle6(len(PROMPTS))
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]))
+    got, reasons = serve_pool(front, PROMPTS)
+    st = front.stats
+    dec = front.replicas[1].server
+    dec_stats = dec.stats
+    front.close()
+    assert got == want
+    assert all(r in ("eos", "length") for r in reasons)
+    assert st["handoffs"] == len(PROMPTS)
+    hf = st["handoff"]
+    assert hf["published"] == len(PROMPTS)      # 39-token prompt = 1 block
+    assert hf["consumed"] == hf["published"]
+    assert hf["blocks"] == 0                    # nothing stranded
+    assert hf["expired"] == 0
+    # the decode replica imported every block through the existing
+    # swap-in machinery and hit the warmed prefix at admission
+    assert dec_stats["kv_tier"]["swap_ins"] == hf["published"]
+    assert dec_stats["prefix_cache_hits"] >= len(PROMPTS)
+    # zero new executables: ONE decode trace, ONE chunk trace (the
+    # tail chunk reuses the standard signature), zero retraces
+    assert dec_stats["decode_traces"] == 1
+    assert dec_stats["chunk_traces"] == 1
+    assert dec_stats["retraces"] == 0
+    assert dec_stats["role"] == "decode"
+    # the prefill replica never decoded (its budget is one token)
+    assert front._roles == ["prefill", "decode"]
+    # registry families ticked
+    snap = fresh_telemetry.snapshot()
+    assert snap["serve_handoff_published_total"]["series"][0]["value"] \
+        == hf["published"]
+    assert snap["serve_handoff_consumed_total"]["series"][0]["value"] \
+        == hf["consumed"]
+    assert snap["serve_handoff_seconds"]["series"][0]["count"] \
+        == len(PROMPTS)
+
+
+# fast-lane policy (see tests/conftest.py curation): the acceptance
+# criterion pins int8 KV and TP=2 by name, so those stay tier-1; the
+# llama/ALiBi/windowed layout classes already have their prefix-cached
+# parity representative in test_prefix_caching and run full-suite-only
+@pytest.mark.parametrize("knobs", [
+    pytest.param(dict(model=dict(positional="rotary",
+                                 norm_type="rmsnorm", gated_mlp=True,
+                                 activation="silu", n_kv_head=2,
+                                 tied_lm_head=False)),
+                 marks=pytest.mark.slow),            # llama/GQA
+    dict(tp_size=2),                                 # tensor parallel
+    pytest.param(dict(model=dict(positional="alibi")),
+                 marks=pytest.mark.slow),            # bloom (XLA path)
+    pytest.param(dict(model=dict(local_windows=(None, 8))),
+                 marks=pytest.mark.slow),            # windowed layers
+    dict(kv_cache_dtype="int8"),                     # int8 KV + scales
+])
+def test_disaggregated_parity_across_architectures(knobs,
+                                                   fresh_telemetry):
+    """The handoff payload carries position-dependent KV (rotary/
+    ALiBi), sharded heads (TP=2), and int8 scale tiles — every variant
+    must replay token-identical through the role-split path."""
+    want = serve_single(make_engine(seed=1, **knobs), PROMPTS[:3], n=5)
+    front = ServingFrontend(
+        make_engine(seed=1, roles=["prefill", "decode"], **knobs),
+        registry=MetricRegistry())
+    got, _ = serve_pool(front, PROMPTS[:3], n=5)
+    st = front.stats
+    front.close()
+    assert got == want
+    assert st["handoffs"] == 3
+    assert st["handoff"]["consumed"] > 0        # warm, not recompute
+    assert st["handoff"]["blocks"] == 0
+
+
+def test_tail_chunk_recompute_at_every_alignment(fresh_telemetry):
+    """Non-block-aligned prompt lengths: the decode side takes exactly
+    the publishable full blocks warm and recomputes the sub-block tail
+    as one short chunk (the 'prompt capped one token short' idiom) —
+    exact at every alignment, including the all-aligned case where the
+    handed-off first token itself completes a block. One pool serves
+    every alignment back to back (swap-in counts assert per request —
+    served sequentially so the deltas are attributable)."""
+    plens = [BS - 1, BS, BS + 1, 2 * BS, 2 * BS + 7]
+    prompts = [[1 + (3 * t + 7 * i) % 100 for t in range(plen)]
+               for i, plen in enumerate(plens)]
+    want = serve_single(make_engine(), prompts, n=5)
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]),
+                            registry=MetricRegistry())
+    dec_srv = front.replicas[1].server
+    published = consumed = swapped = 0
+    for prompt, plen, ref in zip(prompts, plens, want):
+        rid = front.submit(prompt, max_new_tokens=5)
+        out = front.drain()
+        assert out[rid] == ref
+        assert front.finish_reason(rid) in ("eos", "length")
+        # decode-side sched prompt = plen + 1 tokens; its admission
+        # can take (plen+1-1)//BS = plen//BS blocks by hash — exactly
+        # what the prefill side had registered (full blocks of plen)
+        st = front.stats
+        expect = plen // BS
+        assert st["handoff"]["published"] - published == expect
+        assert st["handoff"]["consumed"] - consumed == expect
+        assert dec_srv.stats["kv_tier"]["swap_ins"] - swapped == expect
+        assert st["handoff"]["blocks"] == 0
+        published += expect
+        consumed += expect
+        swapped += expect
+    front.close()
+
+
+def test_all_mixed_roles_pool_identical_to_roleless(fresh_telemetry):
+    """roles all-'mixed' (or absent) is byte-identical to the PR-13
+    pool — no handoff tier, no import tiers, no handoff metric
+    families, same outputs."""
+    want, _ = serve_pool(
+        ServingFrontend(make_engine(replicas=2),
+                        registry=MetricRegistry()), PROMPTS[:3])
+    reg = MetricRegistry()
+    front = ServingFrontend(
+        make_engine(roles=["mixed", "mixed"]), registry=reg)
+    got, _ = serve_pool(front, PROMPTS[:3])
+    st = front.stats
+    assert got == want
+    assert st["disaggregated"] is False
+    assert st["handoff"] is None
+    assert front._handoff is None
+    assert all(r.server.host_tier is None for r in front.replicas)
+    assert not any(k.startswith("serve_handoff") for k in reg.snapshot())
+    front.close()
+
+
+# ------------------------------------------------------- telemetry routing
+
+def test_routing_picks_idle_decode_replica_under_gap_skew(
+        fresh_telemetry):
+    """Telemetry-routed admission: with two decode replicas at equal
+    queue depth and free blocks, the one whose step observatory shows
+    the LOWER recent dispatch-gap mean (its device is not waiting on
+    its host) takes the next decoder — queue depth alone cannot see
+    the difference."""
+    def run(slow_replica):
+        front = ServingFrontend(
+            make_engine(roles=["prefill", "decode", "decode"]),
+            registry=MetricRegistry())
+        # crafted skew: one decode replica's profiler reports a
+        # host-bound recent gap history, the other stays clean
+        front.replicas[slow_replica].server._profiler._recent_gaps \
+            .extend([0.5] * 8)
+        rid = front.submit(PROMPTS[0], max_new_tokens=12)
+        for _ in range(12):
+            front.step()
+            fr = front._requests.get(rid)
+            if fr is not None and fr.committed and fr.replica is not None:
+                picked = fr.replica
+                break
+        else:
+            raise AssertionError("handoff never routed")
+        front.drain()
+        front.close()
+        return picked
+
+    assert run(slow_replica=1) == 2
+    assert run(slow_replica=2) == 1
+
+
+# ------------------------------------------------------------ chaos
+
+def test_mid_publish_kill_falls_back_to_recompute_exact(
+        fresh_telemetry):
+    """The prefill replica dies halfway through exporting the handoff
+    blocks: nothing publishes, the replica is declared dead, and the
+    decode replica recomputes the prefix from the folded prompt —
+    token-identical, with no stranded handoff entries."""
+    want = oracle6(2)
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]),
+                            registry=MetricRegistry(), fault_injector=fi)
+    ids = [front.submit(p, max_new_tokens=6) for p in PROMPTS[:2]]
+    fi.kill_prefill_mid_publish(ids[0])
+    out = front.drain()
+    st = front.stats
+    front.close()
+    assert [out[i] for i in ids] == want
+    assert all(front.finish_reason(i) in ("eos", "length") for i in ids)
+    assert st["replicas"][0]["health"] == "dead"
+    assert "handoff publish" in st["replicas"][0]["dead_reason"]
+    assert st["handoff"]["blocks"] == 0         # nothing stranded
+    falls = [e for e in events_of(ev.KV_HANDOFF)
+             if e["data"]["stage"] == "fallback"]
+    assert any(e["data"]["request_id"] == ids[0] for e in falls)
+    assert fi.injected["handoff_kill"] == 1
+    # request 1 (killed victim) recomputed cold on the decode side;
+    # request 0... whichever order — at least one consumed nothing
+    # for the killed request: its publication never existed
+    assert st["handoff"]["published"] < 2
+
+
+def test_after_publish_kill_handoff_survives_publisher(fresh_telemetry):
+    """The prefill replica dies the instant the publish completes: the
+    payloads are already host-durable, so the decode replica still
+    warms from them — the handoff outlives its publisher, exact."""
+    want = oracle6(2)
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]),
+                            registry=MetricRegistry(), fault_injector=fi)
+    ids = [front.submit(p, max_new_tokens=6) for p in PROMPTS[:2]]
+    fi.kill_prefill_after_publish(ids[0])
+    out = front.drain()
+    st = front.stats
+    dec = front.replicas[1].server.stats
+    front.close()
+    assert [out[i] for i in ids] == want
+    assert st["replicas"][0]["health"] == "dead"
+    # the killed request's publication WAS consumed warm
+    assert st["handoff"]["consumed"] >= 1
+    assert dec["kv_tier"]["swap_ins"] >= 1
+    assert st["handoff"]["blocks"] == 0
+    assert fi.injected["handoff_kill"] == 1
+
+
+def test_all_decode_dead_wrong_role_last_resort_and_abandon(
+        fresh_telemetry):
+    """Every decode-capable replica dead: the prefill replica serves
+    colocated as the availability-over-purity last resort. Its
+    publication has no consumer with an import tier — the terminal
+    finish ABANDONS it (expired counter, tier empty), never strands."""
+    want = oracle6(1)
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]),
+                            registry=MetricRegistry(), fault_injector=fi)
+    fi.kill_replica(1)
+    front.step()                       # the decode replica dies idle
+    assert front.replicas[1].health == "dead"
+    rid = front.submit(PROMPTS[0], max_new_tokens=6)
+    out = front.drain()
+    st = front.stats
+    front.close()
+    assert out[rid] == want[0]
+    assert front.finish_reason(rid) in ("eos", "length")
+    assert st["handoff"]["published"] >= 1      # publish still ran
+    assert st["handoff"]["consumed"] == 0       # no importer left
+    assert st["handoff"]["expired"] >= 1        # abandoned at finish
+    assert st["handoff"]["blocks"] == 0
+
+
+def test_drain_timeout_with_inflight_handoffs_abandons_everything(
+        fresh_telemetry):
+    """A bounded drain slamming the door mid-flight — some requests
+    mid-prefill, some just handed off — cancels stragglers with
+    partials and leaves ZERO handoff blocks parked; close() tears the
+    pool down afterward without error."""
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]),
+                            registry=MetricRegistry())
+    ids = [front.submit(p, max_new_tokens=30) for p in PROMPTS]
+    for _ in range(3):
+        front.step()                   # at least one handoff in flight
+    out = front.drain(timeout_s=0.0)   # immediate cancel-all
+    st = front.stats
+    assert all(front.finish_reason(i) is not None for i in ids)
+    for i, p in zip(ids, PROMPTS):
+        assert out[i][:len(p)] == p    # partial at worst, never lost
+    assert st["handoff"]["blocks"] == 0
+    front.close()
+    front.close()                      # idempotent
+
+
+def test_shared_prefix_exports_once(fresh_telemetry):
+    """The shared-system-prompt workload: a second request whose whole
+    chain is already warm on the decode replica publishes NOTHING (the
+    admission walk there hits it anyway) — the prefix is read off the
+    prefill device once, not once per request (review-found). A third
+    request extending the prefix exports only the cold tail."""
+    shared = [1 + (3 * t) % 90 for t in range(2 * BS + 5)]
+    ext = shared + [7 + t for t in range(BS)]
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]))
+    a = front.submit(shared, max_new_tokens=5)
+    front.drain()
+    st = front.stats
+    assert st["handoff"]["published"] == 2      # 2 full blocks, cold
+    b = front.submit(shared, max_new_tokens=5, request_id=77)
+    front.drain()
+    st = front.stats
+    assert st["handoffs"] == 2
+    assert st["handoff"]["published"] == 2      # nothing re-exported
+    skips = [e for e in events_of(ev.KV_HANDOFF)
+             if e["data"]["stage"] == "skipped"]
+    assert any(e["data"]["cause"] == "already_warm" for e in skips)
+    c = front.submit(ext, max_new_tokens=5)
+    front.drain()
+    st = front.stats
+    # ext's sched prompt spans 3 full blocks; the 2 shared ones stay
+    # warm on the decode replica — only the cold tail block publishes
+    assert st["handoff"]["published"] == 3
+    assert front.result(a)[:len(shared)] == shared
+    assert front.result(b) == front.result(a)
+    assert front.finish_reason(c) in ("eos", "length")
+    assert st["handoff"]["blocks"] == 0
+    front.close()
+
+
+def test_handoff_tier_shares_payloads_by_hash():
+    import numpy as np
+    tier = HandoffTier()
+    p1, p2 = {"k": np.zeros(8)}, {"k": np.ones(8)}
+    tier.publish(1, [(b"a", p1)], now=0.0)
+    tier.publish(2, [(b"a", {"k": np.full(8, 9.0)}), (b"b", p2)],
+                 now=1.0)
+    assert tier.dedup_reuses == 1
+    assert tier.snapshot()["unique_payloads"] == 2
+    assert tier.host_bytes == p1["k"].nbytes + p2["k"].nbytes  # shared
+    ent2, _ = tier.consume(2)
+    assert ent2[0][1] is p1        # request 2 shares request 1's copy
+    assert tier.blocks == 1        # request 1's entry still parked
+    assert tier.host_bytes == p1["k"].nbytes
+    assert tier.abandon(1) == 1
+    assert tier.host_bytes == 0 and len(tier._by_hash) == 0
+
+
+def test_queued_death_purges_replica_import_tier(fresh_telemetry):
+    """A consumed handoff is imported into the decode replica's tier
+    and normally swapped in at admission — but a request that dies
+    QUEUED there (cancel before a slot frees) never runs that
+    admission. The terminal finish must purge its parked payloads from
+    the replica's (unbounded) import tier, or they leak host RAM for
+    the server's lifetime (review-found, regression-pinned)."""
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"],
+                                        num_slots=1),
+                            registry=MetricRegistry())
+    dec = front.replicas[1].server
+    a = front.submit(PROMPTS[0], max_new_tokens=20)   # takes the slot
+    b = front.submit(PROMPTS[1], max_new_tokens=20)   # queues behind
+    for _ in range(8):
+        front.step()
+        fb = front._requests.get(b)
+        if (fb is not None and fb.committed
+                and dec.scheduler.find_slot(b) is None
+                and len(dec.host_tier) > 0):
+            break                     # b handed off, queued, imported
+    else:
+        raise AssertionError("b never reached the queued-import state")
+    assert front.cancel(b) is True
+    assert len(dec.host_tier) == 0    # purged, not leaked
+    out = front.drain()
+    assert front.finish_reason(b) == "cancelled"
+    ref = oracle6(1)[0]               # budget-6 prefix of a's output
+    assert out[a][:len(ref)] == ref
+    assert len(dec.host_tier) == 0
+    assert front.stats["handoff"]["blocks"] == 0
+    front.close()
+
+
+def test_eos_on_prefill_leg_finishes_without_handoff(fresh_telemetry):
+    """A first token that IS the eos id finishes the request on the
+    prefill replica — nothing publishes, nothing resubmits."""
+    tok0 = oracle6(1)[0][len(PROMPTS[0])]
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]),
+                            registry=MetricRegistry())
+    rid = front.submit(PROMPTS[0], max_new_tokens=6, eos_token_id=tok0)
+    out = front.drain()
+    st = front.stats
+    front.close()
+    assert front.finish_reason(rid) == "eos"
+    assert out[rid] == PROMPTS[0] + [tok0]
+    assert st["handoffs"] == 0
+    assert st["handoff"]["published"] == 0
+
+
+# --------------------------------------------------------- HandoffTier unit
+
+def test_handoff_tier_bounded_oldest_first():
+    import numpy as np
+    tier = HandoffTier(max_blocks=3)
+    pay = lambda: {"k": np.zeros(4), "v": np.zeros(4)}
+    assert tier.publish(1, [(b"a", pay()), (b"b", pay())], now=0.0) == 0
+    assert tier.publish(2, [(b"c", pay())], now=1.0) == 0
+    assert tier.blocks == 3
+    # over capacity: the OLDEST publication expires whole
+    assert tier.publish(3, [(b"d", pay()), (b"e", pay())], now=2.0) == 2
+    assert tier.blocks == 3
+    assert tier.consume(1) is None              # expired whole
+    entries, ts = tier.consume(3)
+    assert ts == 2.0 and len(entries) == 2
+    assert tier.abandon(2) == 1
+    assert tier.blocks == 0 and len(tier) == 0
+    assert (tier.published, tier.consumed, tier.expired) == (5, 2, 3)
+    # a publication larger than the whole bound expires itself (strict)
+    assert tier.publish(9, [(h, pay()) for h in (b"p", b"q", b"r",
+                                                 b"s")], now=3.0) == 4
+    assert tier.blocks == 0
+    # re-publication replaces the stale entries
+    tier2 = HandoffTier()
+    tier2.publish(5, [(b"x", pay())], now=0.0)
+    tier2.publish(5, [(b"y", pay()), (b"z", pay())], now=1.0)
+    assert tier2.blocks == 2 and tier2.expired == 1
+    assert len(tier2.consume(5)[0]) == 2
+    with pytest.raises(ValueError, match="max_blocks"):
+        HandoffTier(max_blocks=0)
+
+
+# ------------------------------------------------------------- config
+
+def test_roles_config_validation():
+    ok = dict(dtype="float32", enable_prefix_caching=True)
+    with pytest.raises(ValueError, match="one role per replica"):
+        DeepSpeedInferenceConfig(
+            replication={"replicas": 3, "roles": ["prefill", "decode"]},
+            **ok)
+    with pytest.raises(ValueError, match="decode-capable"):
+        DeepSpeedInferenceConfig(
+            replication={"replicas": 2,
+                         "roles": ["prefill", "prefill"]}, **ok)
+    with pytest.raises(ValueError, match="prefill-capable"):
+        DeepSpeedInferenceConfig(
+            replication={"replicas": 2,
+                         "roles": ["decode", "decode"]}, **ok)
+    with pytest.raises(ValueError, match="enable_prefix_caching"):
+        DeepSpeedInferenceConfig(
+            dtype="float32",
+            replication={"replicas": 2,
+                         "roles": ["prefill", "decode"]})
+    with pytest.raises(ValueError, match="handoff_blocks"):
+        DeepSpeedInferenceConfig(
+            replication={"replicas": 2, "handoff_blocks": 4}, **ok)
+    with pytest.raises(ValueError, match="handoff_blocks"):
+        DeepSpeedInferenceConfig(
+            replication={"replicas": 2,
+                         "roles": ["prefill", "decode"],
+                         "handoff_blocks": 0}, **ok)
+    # mixed-only roles are the explicit default — valid without disagg
+    cfg = DeepSpeedInferenceConfig(
+        replication={"replicas": 2, "roles": ["mixed", "mixed"]},
+        dtype="float32")
+    assert cfg.replication.disaggregated is False
+    cfg = DeepSpeedInferenceConfig(
+        replication={"replicas": 3,
+                     "roles": ["prefill", "decode", "mixed"],
+                     "handoff_blocks": 8}, **ok)
+    assert cfg.replication.disaggregated is True
+
+
+def test_debug_snapshot_rows_grow_role_and_handoff_gauges(
+        fresh_telemetry):
+    front = ServingFrontend(make_engine(roles=["prefill", "decode"]),
+                            registry=MetricRegistry())
+    rid = front.submit(PROMPTS[0], max_new_tokens=4)
+    front.drain()
+    snap = front._debug_snapshot()
+    assert snap["roles"] == ["prefill", "decode"]
+    assert snap["disaggregated"] is True
+    assert snap["handoff"]["blocks"] == 0
+    rows = snap["replicas"]
+    assert [r["role"] for r in rows] == ["prefill", "decode"]
+    assert rows[1]["host_tier_swap_ins"] >= 1
+    assert "host_tier_blocks" in rows[1]
+    assert "recent_gap_ms" in rows[0]
+    assert front.finish_reason(rid) in ("eos", "length")
+    front.close()
