@@ -2112,6 +2112,120 @@ def phase_serve(args) -> dict:
             f"{dis_leg['handoff_blocks_published']} blocks published / "
             f"{dis_leg['handoff_blocks_consumed']} consumed, parity="
             f"{out['disaggregation']['parity_exact']}")
+
+    # ---- fleet observability leg (docs/observability.md "Fleet
+    # observability"): a role-split pool with request tracing ON and a
+    # seeded mid-burst replica kill, so every stitching path fires in
+    # one run — prefill legs, handoff continuations, and failover
+    # replays each land as a hop span on ONE frontend-owned trace, and
+    # a single _fleet_registry() scrape merges both replicas'
+    # instruments under bounded replica labels. The blob records the
+    # federated-scrape wall (p90 gated "down" across rounds by
+    # check_bench_regression — the fleet view must stay cheap enough
+    # to sit on a Prometheus scrape path), hop counts by cause, and
+    # stitched-trace coverage: of the requests whose root trace says
+    # they crossed legs (hops >= 2), the fraction whose kept trace
+    # actually carries >= 2 hop spans. Anything below 1.0 means a leg
+    # routed without its hop being stitched on.
+    fleet_on = bool(getattr(args, "fleet_obs", False)) or smoke \
+        or bool(n_repl)
+    if fleet_on:
+        from deepspeed_tpu.inference.config import ReplicationConfig
+        from deepspeed_tpu.inference.frontend import ServingFrontend
+        from deepspeed_tpu.telemetry import (FaultInjector,
+                                             TelemetryConfig)
+        bsF = scfg.block_size
+        cfgF = scfg.model_copy(update={
+            "enable_prefix_caching": True,
+            "replication": ReplicationConfig(
+                replicas=2, roles=["prefill", "decode"]),
+            "telemetry": TelemetryConfig(trace_sample_rate=1.0,
+                                         trace_ring_capacity=256)})
+        fiF = FaultInjector(seed=0)
+        fro = ServingFrontend(InferenceEngine((mcfg, params), cfgF),
+                              registry=MetricRegistry(),
+                              fault_injector=fiF)
+        # warm both roles' executables through one full handoff so the
+        # measured burst's tick budget is stepping, not compiling
+        fro.submit([2, 3, 5], max_new_tokens=2)
+        fro.drain()
+        # load shape makes BOTH hop causes deterministic: the shorts
+        # hand off to the decode replica within a few ticks and decode
+        # well past the kill tick; the longs keep the prefill replica
+        # chunk-prefilling across it — whichever replica the seeded
+        # victim turns out to be, it holds in-flight work when it dies
+        shortsF = [[2 + (3 * j + t) % (mcfg.vocab_size - 2)
+                    for t in range(bsF + 3)] for j in range(3)]
+        longsF = [[2 + (5 * j + t) % (mcfg.vocab_size - 2)
+                   for t in range(3 * bsF)] for j in range(2)]
+        fiF.schedule_replica_kill(2, at_tick=fro.stats["tick"] + 5)
+        ridsF = [fro.submit(p, max_new_tokens=12) for p in shortsF]
+        ridsF += [fro.submit(p, max_new_tokens=4) for p in longsF]
+        fro.drain()
+        okF = sum(1 for r in ridsF
+                  if fro.finish_reason(r) in ("eos", "length"))
+        n_scrapes = 5
+        t0 = time.time()
+        for _ in range(n_scrapes):
+            view = fro._fleet_registry()
+        scrape_wall = time.time() - t0
+        # merged-totals parity straight off the federated view: the
+        # replica="pool" rollup of every counter must equal the sum of
+        # its per-replica series (dead replica included — its last
+        # snapshot still merges, that is the staleness contract)
+        state = view.export_state()
+        per_r = pool_tot = 0.0
+        for s in state.get("serve_requests_finished_total",
+                           {}).get("series", []):
+            lab = dict(s["labels"])
+            if lab.get("replica", "").startswith("r"):
+                per_r += s["value"]
+            elif lab.get("replica") == "pool":
+                pool_tot += s["value"]
+        repl_labels = sorted(
+            {dict(s["labels"]).get("replica")
+             for fam in state.values() for s in fam["series"]}
+            - {None})
+        kept = fro.tracer.traces()
+
+        def _hop_spans(t):
+            return sum(1 for c in t.root.children if c.name == "hop")
+
+        multi_expected = [t for t in kept
+                          if int(t.root.attributes.get("hops", 0)) >= 2]
+        multi_spanned = sum(1 for t in multi_expected
+                            if _hop_spans(t) >= 2)
+        stF = fro.stats
+        hopsF = stF["hops_by_cause"]
+        p90_s = fro._h_fleet_scrape.quantile(0.9)
+        out["fleet_obs"] = {
+            "replicas": 2, "requests": len(ridsF),
+            "finished_ok": okF,
+            "scrapes": n_scrapes,
+            "scrape_wall_s": round(scrape_wall, 4),
+            # THE gated headline: one federated scrape's p90 wall
+            "scrape_p90_ms": (round(p90_s * 1e3, 3)
+                              if p90_s is not None else None),
+            "hops_total": sum(hopsF.values()),
+            "hops_by_cause": hopsF,
+            "stitched_traces_kept": len(kept),
+            "multi_leg_requests": len(multi_expected),
+            "stitched_coverage": (
+                round(multi_spanned / len(multi_expected), 4)
+                if multi_expected else None),
+            "merged_parity": bool(abs(per_r - pool_tot) < 1e-9),
+            "replica_label_values": repl_labels,
+            "dead_replicas": stF["dead_replicas"],
+        }
+        fro.close()
+        fo = out["fleet_obs"]
+        log(f"fleet obs: scrape p90 {fo['scrape_p90_ms']} ms over "
+            f"{n_scrapes} scrapes, {fo['hops_total']} hops "
+            f"{fo['hops_by_cause']}, stitched coverage "
+            f"{fo['stitched_coverage']} across "
+            f"{fo['multi_leg_requests']} multi-leg requests, "
+            f"merged parity={fo['merged_parity']}, labels "
+            f"{fo['replica_label_values']}")
     return out
 
 

@@ -173,6 +173,19 @@ def telemetry_info():
             "only and hand KV off by chain hash to telemetry-picked "
             "decode replicas; docs/serving.md 'Disaggregated "
             "prefill/decode')")
+        out["serve_fleet_obs"] = (
+            f"{rc.replicas} replicas federated into one /metrics "
+            f"scrape (replica-labeled merge, staleness-marked "
+            f"snapshots), trace stitching "
+            f"{'on' if cfg.trace_sample_rate > 0 else 'off'} "
+            f"(sample rate {cfg.trace_sample_rate})"
+            if rc.replicas > 1 else
+            "single replica — fleet plane idle (with "
+            "replication.replicas > 1 the frontend merges every "
+            "replica's instruments under replica labels, stitches "
+            "cross-replica request legs into one trace, and serves "
+            "/debug/fleet + a merged timeline; docs/observability.md "
+            "'Fleet observability')")
         fic = cfg.fault_injection
         out["fault_injection"] = (
             f"ARMED (seed {fic.seed}; step latency "

@@ -78,7 +78,10 @@ decision still happens on the owner thread against joined results.
 """
 from __future__ import annotations
 
+import json
+import threading
 import time
+import weakref
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
@@ -91,10 +94,18 @@ from deepspeed_tpu.inference.server import (_LIFECYCLE_EVENTS,
                                             check_drain_timeout,
                                             submit_rejection)
 from deepspeed_tpu.telemetry import (FaultInjector, MetricRegistry,
-                                     ReplicaKilled, Watchdog,
+                                     ReplicaKilled, Tracer, Watchdog,
                                      get_event_ring, get_registry,
                                      start_http_server)
 from deepspeed_tpu.telemetry import events as telemetry_events
+from deepspeed_tpu.telemetry.memory import get_memory_monitor
+from deepspeed_tpu.telemetry.tracing import (ring_timeline_events,
+                                             span_events_from_dict)
+
+# hop causes (the bounded label set of serve_trace_hops_total): why a
+# request's NEXT leg opened — first routing, the prefill->decode
+# handoff, a failover off a dead replica, or a rolling-drain re-route
+HOP_CAUSES = ("submit", "handoff", "failover", "drain_reroute")
 
 # replica health states (the serve_replica_healthy gauge is 1 only for a
 # healthy, non-draining — i.e. routable — replica)
@@ -110,7 +121,8 @@ class _FrontRequest:
     __slots__ = ("request_id", "prompt", "max_new_tokens", "eos_token_id",
                  "priority", "deadline_ts", "submit_ts", "replica",
                  "committed", "failovers", "retry_at_tick",
-                 "prefill_only", "replay", "imported")
+                 "prefill_only", "replay", "imported", "trace", "hop",
+                 "hops", "next_cause")
 
     def __init__(self, request_id: int, prompt: List[int],
                  max_new_tokens: int, eos_token_id: Optional[int],
@@ -146,6 +158,14 @@ class _FrontRequest:
         # deadline/failed) never runs the admission that would consume
         # them, and an unpurged import-only tier leaks host RAM
         self.imported: List[tuple] = []
+        # cross-replica trace stitching (docs/observability.md "Fleet
+        # observability"): the frontend-owned logical trace, the
+        # currently-open hop span (one per replica leg), the hop count,
+        # and the cause the NEXT leg will carry
+        self.trace = None
+        self.hop = None
+        self.hops = 0
+        self.next_cause = "submit"
 
 
 class _Replica:
@@ -324,6 +344,41 @@ class ServingFrontend:
                      "retries exhausted, or every replica dead "
                      "(finish reason 'failed')"),
         }
+        # fleet observability plane (docs/observability.md "Fleet
+        # observability"): the frontend-owned stitched tracer (same
+        # arming condition and knobs as a replica's own — the stitched
+        # layer costs nothing when tracing is off), the hop counter by
+        # cause, the federated-scrape wall histogram, and the
+        # per-replica snapshot cache every fleet surface reads
+        self.tracer = None
+        if tcfg is not None and enabled and tcfg.trace_sample_rate > 0:
+            self.tracer = Tracer(
+                sample_rate=tcfg.trace_sample_rate,
+                ring_capacity=tcfg.trace_ring_capacity,
+                seed=tcfg.trace_seed,
+                slow_threshold_s=tcfg.trace_slow_threshold_s,
+                registry=reg)
+        self._c_hops = {cause: reg.counter(
+            "serve_trace_hops_total",
+            help="replica legs routed, by cause (submit/handoff/"
+                 "failover/drain_reroute) — each is one hop span on "
+                 "the stitched frontend trace",
+            labels={"cause": cause}) for cause in HOP_CAUSES}
+        self._h_fleet_scrape = reg.histogram(
+            "serve_fleet_scrape_seconds",
+            help="wall time of one federated fleet scrape: refresh + "
+                 "merge of every replica's registry snapshot into the "
+                 "frontend's /metrics view")
+        # per-replica observability snapshots, ALWAYS round-tripped
+        # through json bytes (no cross-replica object sharing — the
+        # process-per-replica transport ships the same bytes): index ->
+        # (state dict, capture ts on the frontend clock). Dead and
+        # draining replicas keep serving their last snapshot; the age
+        # gauge is the staleness mark.
+        self._obs_lock = threading.Lock()
+        self._obs_cache: Dict[int, tuple] = {}
+        self._g_scrape_age: Dict[int, object] = {}
+        self._mem_components: List[tuple] = []
         # replicas: each gets its own private registry (per-replica
         # serving histograms must not merge into one family) and an
         # UNSTARTED heartbeat watchdog installed on the server's seam —
@@ -338,7 +393,11 @@ class ServingFrontend:
                 # decode-capable replicas in a role-split pool receive
                 # handoffs — they need the import tier the admission
                 # swap-in reads from; prefill replicas never do
-                handoff_import=self._disagg and role != PREFILL)
+                handoff_import=self._disagg and role != PREFILL,
+                # tag the replica's step-profile ring events so the
+                # merged fleet timeline can partition the SHARED event
+                # ring into per-replica host-phase tracks
+                profile_source=f"replica{i}")
             wd = Watchdog(self._dead_s, registry=reg, clock=self._clock,
                           name=f"serve_replica{i}")
             srv.watchdog = wd
@@ -348,6 +407,27 @@ class ServingFrontend:
                      "0 = breaker open (degraded/draining) or dead",
                 labels={"replica": str(i)})
             gauge.set(1.0)
+            self._g_scrape_age[i] = reg.gauge(
+                "serve_replica_scrape_age_seconds",
+                help="age of the replica's last observability snapshot "
+                     "on the frontend clock — the staleness mark on a "
+                     "dead/draining/wedged replica's federated series",
+                labels={"replica": f"r{i}"})
+            # each replica's private registry is host RAM the memory
+            # monitor would otherwise never see (the PR-15 import-tier
+            # leak-blindness class): a weakref getter on the REGISTRY
+            # (it outlives server.close(), so a dead replica's last
+            # snapshot stays accounted) under /debug/memory
+            mem_name = f"replica{i}_telemetry"
+            reg_ref = weakref.ref(srv.telemetry)
+
+            def _reg_bytes(ref=reg_ref):
+                r = ref()
+                return 0 if r is None else r.approx_bytes()
+
+            get_memory_monitor().register_host_component(
+                mem_name, _reg_bytes)
+            self._mem_components.append((mem_name, _reg_bytes))
             self.replicas.append(_Replica(i, srv, wd, now, gauge, role))
         if self._fi is not None:
             # seeded kill schedule: pick the victim now that the pool
@@ -378,7 +458,9 @@ class ServingFrontend:
         if tcfg is not None and enabled and tcfg.http_port is not None:
             self.http_server = start_http_server(
                 tcfg.http_port, host=tcfg.http_host, registry=reg,
-                replicas=self._debug_snapshot)
+                replicas=self._debug_snapshot, tracer=self.tracer,
+                fleet=self._fleet_snapshot,
+                metrics_view=self._fleet_registry)
 
     # ------------------------------------------------------------ API
 
@@ -410,24 +492,37 @@ class ServingFrontend:
         fr = _FrontRequest(
             request_id, prompt, max_new_tokens, eos_token_id, priority,
             None if deadline_s is None else now + deadline_s, now)
+        if self.tracer is not None:
+            # the STITCHED trace is born at the pool boundary: every
+            # replica leg the request ever runs becomes a hop span
+            # under this one root, whatever replicas it crosses
+            fr.trace = self.tracer.start_trace(
+                "request", trace_id=request_id,
+                prompt_tokens=len(prompt),
+                max_new_tokens=max_new_tokens)
         self._requests[request_id] = fr
         try:
             routed = self._route(fr)
-        except ValueError:
+        except ValueError as e:
             # permanent refusal (span/pool/...): identical on every
             # replica — the frontend has nothing to hold
             del self._requests[request_id]
+            if fr.trace is not None:
+                fr.trace.root.set("error", str(e))
+                self.tracer.finish(fr.trace, status="rejected")
             raise
         if not routed:
             if all(r.health == DEAD for r in self.replicas):
                 del self._requests[request_id]
-                self._count_rejection("replicas_dead", request_id)
+                self._count_rejection("replicas_dead", request_id,
+                                      trace=fr.trace)
                 raise RuntimeError(
                     "every replica is dead — the pool can never serve "
                     "this request (restart the frontend)")
             if len(self._pending) >= self._max_pending:
                 del self._requests[request_id]
-                self._count_rejection("queue_full", request_id)
+                self._count_rejection("queue_full", request_id,
+                                      trace=fr.trace)
                 raise RuntimeError(
                     f"frontend queue is full ({self._max_pending}); "
                     "step() the pool before submitting more, or raise "
@@ -436,16 +531,52 @@ class ServingFrontend:
         return request_id
 
     def _count_rejection(self, reason: str,
-                         request_id: Optional[int] = None) -> None:
+                         request_id: Optional[int] = None,
+                         trace=None) -> None:
         """Pool-level refusals mirror the server's accounting (same
-        counter family, same ring event) so a frontend rejection is as
-        visible as a bare server's."""
+        counter family, same ring event, same always-kept error trace)
+        so a frontend rejection is as visible as a bare server's."""
         self.telemetry.counter(
             "serve_admission_rejections_total",
             help="refused submit() calls, by reason",
             labels={"reason": reason}).inc()
         get_event_ring().record(telemetry_events.ADMISSION_REJECT,
                                 reason=reason, source="frontend")
+        if self.tracer is not None:
+            if trace is not None:
+                # the refusal happened AFTER the stitched trace opened
+                # (replicas_dead / queue_full): close that trace as the
+                # error record rather than minting a second one
+                trace.root.set("error", reason)
+                self.tracer.finish(trace, status="rejected")
+            else:
+                attrs = ({} if request_id is None
+                         else {"request_id": request_id})
+                self.tracer.record_rejected("request", reason, **attrs)
+
+    # ------------------------------------------- trace-stitching hops
+
+    def _open_hop(self, fr: _FrontRequest, rep: _Replica,
+                  cause: str) -> None:
+        """One replica leg = one hop span on the stitched trace,
+        carrying replica/role/cause; the hop counter ticks even with
+        tracing off (leg routing is load-bearing fleet telemetry)."""
+        self._c_hops[cause].inc()
+        if fr.trace is None:
+            return
+        self._close_hop(fr)      # invariant: at most one open hop
+        fr.hop = fr.trace.begin(
+            "hop", replica=rep.index, role=rep.role, cause=cause,
+            hop=fr.hops, committed=len(fr.committed))
+        fr.hops += 1
+
+    def _close_hop(self, fr: _FrontRequest, **attrs) -> None:
+        if fr.hop is None:
+            return
+        for k, v in attrs.items():
+            fr.hop.set(k, v)
+        fr.trace.end_span(fr.hop)
+        fr.hop = None
 
     def result(self, request_id: int) -> Optional[List[int]]:
         """Finished output (prompt + generated) or None — the same
@@ -642,6 +773,11 @@ class ServingFrontend:
         fr.committed = list(tokens)[len(fr.prompt):]
         fr.replica = None
         fr.prefill_only = False
+        # the prefill leg's hop closes HERE; the decode leg's hop opens
+        # at its routing, carrying the explicit handoff cause
+        self._close_hop(fr, outcome="handoff",
+                        committed_out=len(fr.committed))
+        fr.next_cause = "handoff"
         self._handoffs += 1
         # the prefill leg's terminal record must not block the id's
         # decode-leg resubmission — which on a role-degraded pool can
@@ -755,6 +891,26 @@ class ServingFrontend:
         self.finish_reasons[rid] = reason
         self._requests.pop(rid, None)
         finished.append(rid)
+        if fr.trace is not None:
+            # close the stitched trace: an eos/length finish is "ok"
+            # (head-sampling decides retention); everything else —
+            # frontend-decided included (stranded pools, retries
+            # exhausted) — carries its reason as the status, which the
+            # tracer always keeps (same contract as a replica's own
+            # lifecycle finishes)
+            self._close_hop(fr, outcome=reason)
+            fr.trace.root.set("finish_reason", reason)
+            fr.trace.root.set("failovers", fr.failovers)
+            fr.trace.root.set("hops", fr.hops)
+            fr.trace.root.set(
+                "generated_tokens",
+                max(0, len(self._results[rid]) - len(fr.prompt)))
+            if frontend_decided:
+                fr.trace.root.set("decided_by", "frontend")
+            self.tracer.finish(
+                fr.trace,
+                status="ok" if reason in ("eos", "length") else reason)
+            fr.trace = None
         if self._handoff is not None:
             # a terminal finish releases any unconsumed publication —
             # the invariant that keeps the bounded tier free of
@@ -860,7 +1016,16 @@ class ServingFrontend:
                     request_id=fr.request_id,
                     deadline_s=(None if fr.deadline_ts is None
                                 else fr.deadline_ts - now),
-                    priority=fr.priority)
+                    priority=fr.priority,
+                    # the propagated trace-context: the replica's own
+                    # trace root records these as link_* attributes, so
+                    # a replica-side tree names the stitched frontend
+                    # tree (and leg) it belongs to — a plain dict, so
+                    # it crosses a process boundary unchanged
+                    trace_context=(None if fr.trace is None else
+                                   {"trace_id": fr.trace.trace_id,
+                                    "hop": fr.hops,
+                                    "cause": fr.next_cause}))
             except RuntimeError:
                 continue          # that queue is full — try the next
             except ValueError:
@@ -876,6 +1041,7 @@ class ServingFrontend:
             fr.replica = rep.index
             fr.prefill_only = as_prefill
             rep.routed += 1
+            self._open_hop(fr, rep, fr.next_cause)
             if fr.replay and fr.committed:
                 self._replay_tokens += len(fr.committed)
                 self._c_replay.inc(len(fr.committed))
@@ -935,6 +1101,11 @@ class ServingFrontend:
         fr.committed = list(partial)[len(fr.prompt):]
         fr.replica = None
         fr.prefill_only = False
+        # the dead leg's hop closes as an error; the replayed leg's
+        # hop opens at resubmission with cause="failover"
+        self._close_hop(fr, outcome="failover", error=cause,
+                        committed_out=len(fr.committed))
+        fr.next_cause = "failover"
         fr.replay = True          # the resubmission replays recompute
         fr.failovers += 1
         self._failovers += 1
@@ -997,6 +1168,11 @@ class ServingFrontend:
         for fr, partial in moved:
             rep.failovers += 1
             self._failover(fr, partial, finished, cause=reason)
+        # final observability capture BEFORE teardown: the dead
+        # replica's last registry/trace state keeps serving from the
+        # frontend's cache (with a growing staleness mark) instead of
+        # vanishing from the fleet scrape
+        self._capture_obs(rep)
         try:
             srv.close()
         except Exception:  # noqa: BLE001 — a dead replica's teardown
@@ -1065,6 +1241,172 @@ class ServingFrontend:
             self._finalize(fr, list(fr.prompt) + list(fr.committed),
                            "failed", finished, frontend_decided=True)
 
+    # ------------------------------------------- fleet observability
+
+    def _capture_obs(self, rep: _Replica) -> None:
+        """Refresh one replica's cached observability snapshot, ALWAYS
+        round-tripped through json bytes: the fleet plane never holds a
+        reference into a replica's live telemetry objects, so the
+        process-per-replica split (ROADMAP item 1) ships the same bytes
+        over a pipe and nothing above this line changes. A replica
+        mid-teardown keeps its previous snapshot (last-known-good)."""
+        try:
+            blob = json.dumps(rep.server.observability_state(),
+                              default=str).encode()
+            state = json.loads(blob.decode())
+        except Exception:  # noqa: BLE001 — dying replica: keep the last
+            return
+        with self._obs_lock:
+            self._obs_cache[rep.index] = (state, self._clock())
+
+    def _obs_age(self, rep: _Replica) -> Optional[float]:
+        """Seconds since the replica's snapshot was captured (frontend
+        clock); None before the first capture."""
+        with self._obs_lock:
+            ent = self._obs_cache.get(rep.index)
+        if ent is None:
+            return None
+        return max(0.0, self._clock() - ent[1])
+
+    def _fleet_states(self) -> List[tuple]:
+        """(replica, snapshot state, staleness seconds) per replica with
+        a snapshot. Live beating replicas refresh now; dead, draining,
+        and beat-missing (wedged) replicas serve their LAST snapshot —
+        its growing age, mirrored into the
+        ``serve_replica_scrape_age_seconds`` gauge, is the staleness
+        mark a dashboard sees before the breaker ever trips."""
+        out = []
+        for rep in self.replicas:
+            if (rep.health != DEAD and not rep.draining
+                    and rep.missed_beats == 0):
+                self._capture_obs(rep)
+            with self._obs_lock:
+                ent = self._obs_cache.get(rep.index)
+            if ent is None:
+                continue
+            state, ts = ent
+            age = max(0.0, self._clock() - ts)
+            self._g_scrape_age[rep.index].set(age)
+            out.append((rep, state, age))
+        return out
+
+    def _fleet_registry(self) -> MetricRegistry:
+        """The federated ``/metrics`` view, built fresh per scrape into
+        a scratch registry (live registries are never mutated): the
+        frontend's own instruments unlabeled, every replica's under
+        ``replica="r<i>"``, and pool-merged totals (counters summed,
+        histogram buckets summed; gauges stay per-source) under
+        ``replica="pool"`` — label cardinality is replicas + 1, however
+        big the pool's request volume. One scrape, the whole fleet."""
+        t0 = self._clock()
+        view = MetricRegistry()
+        view.import_state(self.telemetry.export_state())
+        for rep, state, _age in self._fleet_states():
+            metrics = state.get("metrics") or {}
+            view.import_state(metrics,
+                              extra_labels={"replica": f"r{rep.index}"})
+            pooled = {n: f for n, f in metrics.items()
+                      if f.get("type") != "gauge"}
+            view.import_state(pooled, extra_labels={"replica": "pool"})
+        self._h_fleet_scrape.observe(max(0.0, self._clock() - t0))
+        return view
+
+    def _fleet_snapshot(self) -> dict:
+        """``GET /debug/fleet``: health, roles, per-replica goodput and
+        recent dispatch gap, scrape staleness, handoff gauges, and the
+        trace-stitching state — the whole pool in one JSON."""
+        rows = []
+        for rep, state, age in self._fleet_states():
+            rows.append({
+                "replica": f"r{rep.index}",
+                "role": rep.role,
+                "health": rep.health,
+                "draining": rep.draining,
+                "goodput_fraction": state.get("goodput_fraction"),
+                "recent_gap_ms": round(
+                    (state.get("recent_gap_s") or 0.0) * 1e3, 3),
+                "scrape_staleness_s": round(age, 6),
+                "tracing": bool(state.get("tracing")),
+                "kept_traces": len(state.get("traces") or ()),
+            })
+        return {
+            "replicas": rows,
+            "stitching": self.tracer is not None,
+            "stitched_kept": (self.tracer.kept
+                              if self.tracer is not None else 0),
+            "hops_by_cause": {c: int(self._c_hops[c].value)
+                              for c in HOP_CAUSES},
+            "handoffs": self._handoffs,
+            "handoff": (self._handoff.snapshot()
+                        if self._handoff is not None else None),
+            "failovers": self._failovers,
+            "drain_reroutes": self._drain_reroutes,
+            "tick": self._tick,
+        }
+
+    def dump_timeline(self, path: str) -> int:
+        """One merged Perfetto file for the whole fleet: the stitched
+        frontend traces (pid 1) with flow-arrows between consecutive
+        hop spans, the shared device track (pid 2), and one process
+        group per replica (pid 10+i) holding its step-phase track
+        (partitioned out of the shared ring by profiler source) plus
+        its own kept traces — rendered from the SERIALIZED snapshots,
+        the same bytes a process-split replica would ship. Returns the
+        event count."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "tracing is off (telemetry.trace_sample_rate == 0) — "
+                "arm it to dump the fleet timeline")
+        events = self.tracer.trace_events()
+        for tr in self.tracer.traces():
+            tid = tr.trace_id if isinstance(tr.trace_id, int) \
+                else abs(hash(tr.trace_id)) % (1 << 31)
+            hops = [sp for sp in tr.root.children if sp.name == "hop"]
+            for a, b in zip(hops, hops[1:]):
+                # flow-arrow from the end of one leg to the start of
+                # the next — Perfetto draws the handoff/failover jump
+                fid = f"{tr.trace_id}/h{a.attributes.get('hop')}"
+                events.append({
+                    "name": "hop", "ph": "s", "cat": "hop", "id": fid,
+                    "pid": 1, "tid": tid,
+                    "ts": round((a.end if a.end is not None
+                                 else a.start) * 1e6, 3)})
+                events.append({
+                    "name": "hop", "ph": "f", "bp": "e", "cat": "hop",
+                    "id": fid, "pid": 1, "tid": tid,
+                    "ts": round(b.start * 1e6, 3)})
+        source_pids: Dict[str, int] = {}
+        for rep, state, _age in self._fleet_states():
+            pid = 10 + rep.index
+            source_pids[f"replica{rep.index}"] = pid
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"replica r{rep.index} "
+                                 f"({state.get('role', rep.role)}, "
+                                 f"{rep.health})"}})
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+                "args": {"name": "step phases (sampled)"}})
+            for tdict in state.get("traces") or ():
+                rid = tdict.get("trace_id")
+                tid = 100 + (rid if isinstance(rid, int)
+                             else abs(hash(str(rid))) % (1 << 20))
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid,
+                    "args": {"name": f"request {rid} "
+                                     f"[{tdict.get('keep_reason')}]"}})
+                span_events_from_dict(
+                    events, tdict["root"], pid, tid,
+                    extra_args={"status": tdict.get("status"),
+                                "keep_reason": tdict.get("keep_reason")})
+        events.extend(ring_timeline_events(get_event_ring(),
+                                           source_pids=source_pids))
+        payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(payload, f, default=str)
+        return len(events)
+
     # ---------------------------------------------------- rolling drain
 
     def drain_replica(self, index: int) -> None:
@@ -1081,6 +1423,9 @@ class ServingFrontend:
                 "is nothing to drain")
         if rep.draining:
             return
+        # drain freezes the replica's federated series at this snapshot
+        # (staleness mark grows until drain completes and beats resume)
+        self._capture_obs(rep)
         rep.draining = True
         rep.gauge.set(0.0)
         get_event_ring().record(
@@ -1096,6 +1441,9 @@ class ServingFrontend:
             fr.committed = list(partial)[len(fr.prompt):]
             fr.replica = None
             fr.prefill_only = False
+            self._close_hop(fr, outcome="drain_reroute",
+                            committed_out=len(fr.committed))
+            fr.next_cause = "drain_reroute"
             fr.replay = True
             fr.retry_at_tick = self._tick   # immediately eligible
             self._drain_reroutes += 1
@@ -1161,6 +1509,10 @@ class ServingFrontend:
                 except Exception:  # noqa: BLE001 — arbitrary states
                     pass
             rep.watchdog.disarm()
+        mon = get_memory_monitor()
+        for name, getter in self._mem_components:
+            mon.unregister_component(name, getter)
+        self._mem_components.clear()
 
     # ------------------------------------------------------------ stats
 
@@ -1179,6 +1531,12 @@ class ServingFrontend:
             "last_step_s": rep.last_step_s,
             "heartbeat_idle_s": round(rep.watchdog.idle_seconds(), 6),
             "missed_beats": rep.missed_beats,
+            # age of the last federated-metrics snapshot (None before
+            # the first fleet scrape): a wedged replica's series going
+            # stale is visible here before the breaker trips
+            "scrape_staleness_s": (
+                None if (age := self._obs_age(rep)) is None
+                else round(age, 6)),
         }
         try:
             row.update({
@@ -1223,6 +1581,11 @@ class ServingFrontend:
             "handoffs": self._handoffs,
             "handoff": (self._handoff.snapshot()
                         if self._handoff is not None else None),
+            # fleet observability: stitching state + leg routing by
+            # cause (the serve_trace_hops_total counter's view)
+            "stitching": self.tracer is not None,
+            "hops_by_cause": {c: int(self._c_hops[c].value)
+                              for c in HOP_CAUSES},
         }
 
     @property

@@ -151,7 +151,8 @@ class ContinuousBatchingServer:
                  clock: Optional[Callable[[], float]] = None,
                  fault_injector: Optional[FaultInjector] = None,
                  supervised: bool = False, role: str = "mixed",
-                 handoff_import: bool = False):
+                 handoff_import: bool = False,
+                 profile_source: str = "serve"):
         if engine.model_config.head == "none":
             raise ValueError("continuous batching needs an LM head — "
                              "encoder models have nothing to decode")
@@ -264,7 +265,8 @@ class ContinuousBatchingServer:
             self._profiler = StepProfiler(
                 registry=self.telemetry, clock=self._clock,
                 events_every=(tcfg.step_profile_events_every
-                              if tcfg is not None else 32))
+                              if tcfg is not None else 32),
+                source=profile_source)
             self._pool_acct = KVPoolAccountant(
                 registry=self.telemetry, clock=self._clock)
         self.http_server = None
@@ -651,6 +653,28 @@ class ContinuousBatchingServer:
                         else {"enabled": False}),
         }
 
+    def observability_state(self) -> dict:
+        """One replica's complete observability export: registry state
+        (``MetricRegistry.export_state`` — the mergeable accumulator
+        form), kept traces as serialized dicts, and the step
+        observatory's goodput/dispatch-gap view. This is the fleet
+        plane's ONLY read path into a replica — pure builtins, JSON
+        round-trippable, and scrape-thread-safe (every piece reads
+        lock-guarded telemetry structures, never scheduler internals),
+        so ROADMAP item 1's process transport ships it verbatim."""
+        prof = (self._profiler.snapshot() if self._profiler is not None
+                else {"enabled": False})
+        return {
+            "role": self.role,
+            "metrics": self.telemetry.export_state(),
+            "traces": ([t.to_dict() for t in self.tracer.traces()]
+                       if self.tracer is not None else []),
+            "tracing": self.tracer is not None,
+            "goodput_fraction": prof.get("goodput_fraction"),
+            "recent_gap_s": (self._profiler.recent_gap_s()
+                             if self._profiler is not None else None),
+        }
+
     def _pool_snapshot(self) -> dict:
         """Fresh pool-accounting view for :attr:`stats` (OWNER-thread
         callers only — between steps, never from the scrape thread):
@@ -842,7 +866,8 @@ class ContinuousBatchingServer:
                eos_token_id: Optional[int] = None,
                request_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               trace_context: Optional[dict] = None) -> int:
         """Queue one request; returns its id. Raises when the request can
         never be scheduled (block span beyond a slot) or the queue is
         full — admission control instead of a silent deadlock.
@@ -852,7 +877,15 @@ class ContinuousBatchingServer:
         finish reason ``deadline`` — dequeued if still waiting, retired
         mid-prefill/decode with its partial output if resident — and is
         never admitted past its deadline. ``priority`` (higher wins)
-        orders preemption and shedding victims; FIFO breaks ties."""
+        orders preemption and shedding victims; FIFO breaks ties.
+
+        ``trace_context`` is the fleet-tracing link-back (docs/
+        observability.md "Fleet observability"): a JSON-able dict of
+        caller trace coordinates (``trace_id``/``hop``/``cause``) the
+        frontend propagates per leg; it lands as ``link_*`` attributes
+        on this replica's trace root, so a replica-side tree names the
+        stitched frontend tree it belongs to even once replicas are
+        separate processes."""
         floor = max(1, self.engine.config.min_out_tokens)
         rej = submit_rejection(prompt, max_new_tokens, floor, deadline_s)
         if rej is not None:
@@ -892,6 +925,9 @@ class ContinuousBatchingServer:
                 tr.root.set("priority", priority)
             if deadline_s is not None:
                 tr.root.set("deadline_s", deadline_s)
+            if trace_context:
+                for k, v in trace_context.items():
+                    tr.root.set(f"link_{k}", v)
             rt = _RequestTrace(tr)
             rt.queue = tr.begin("queue_wait")
             self._rt[request_id] = rt

@@ -46,6 +46,10 @@ ROUTES = {
                       "KV-pool accounting (telemetry/step_profile.py)",
     "/debug/replicas": "replica-pool health/routing/failover state "
                        "(inference/frontend.py ServingFrontend)",
+    "/debug/fleet": "fleet observability rollup — per-replica health/"
+                    "role/goodput/dispatch-gap, scrape staleness, "
+                    "handoff gauges, trace-stitching state "
+                    "(docs/observability.md 'Fleet observability')",
     "/debug/resilience": "training-supervisor restart/recovery state + "
                          "checkpoint-integrity report "
                          "(runtime/resilience.py TrainingSupervisor)",
@@ -67,6 +71,7 @@ class TelemetryHTTPServer:
                  registry: Optional[MetricRegistry] = None,
                  event_ring=None, memory=None, tracer=None,
                  goodput=None, replicas=None, resilience=None,
+                 fleet=None, metrics_view=None,
                  handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S):
         if handler_timeout_s is not None and handler_timeout_s <= 0:
             raise ValueError(
@@ -87,10 +92,19 @@ class TelemetryHTTPServer:
                     body = _help_text().encode()
                     ctype = "text/plain; charset=utf-8"
                 elif path == "/metrics":
-                    body = reg.prometheus_text().encode()
+                    # ``metrics_view`` is the owner's zero-arg federated
+                    # registry builder (a ServingFrontend merging every
+                    # replica's snapshot under replica="r<i>" labels);
+                    # without one, the endpoint's own registry is the
+                    # whole story — one scrape, either way
+                    view = (metrics_view() if metrics_view is not None
+                            else reg)
+                    body = view.prometheus_text().encode()
                     ctype = PROMETHEUS_CONTENT_TYPE
                 elif path in ("/metrics.json", "/snapshot"):
-                    body = json.dumps(reg.snapshot()).encode()
+                    view = (metrics_view() if metrics_view is not None
+                            else reg)
+                    body = json.dumps(view.snapshot()).encode()
                     ctype = "application/json"
                 elif path == "/debug/events":
                     # resolve the ring per request so set_event_ring
@@ -162,6 +176,18 @@ class TelemetryHTTPServer:
                                         "serving & failover')"})
                     body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
+                elif path == "/debug/fleet":
+                    # ``fleet`` is the owner's zero-arg rollup callable
+                    # (a ServingFrontend's one-JSON fleet view); a bare
+                    # server's endpoint answers self-describingly
+                    payload = (fleet() if fleet is not None else
+                               {"enabled": False,
+                                "hint": "owner is not a ServingFrontend "
+                                        "(set replication.replicas > 1 "
+                                        "— docs/observability.md "
+                                        "'Fleet observability')"})
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(
                         404, "unknown path (try " +
@@ -217,6 +243,7 @@ def start_http_server(port: int, host: str = "127.0.0.1",
                       registry: Optional[MetricRegistry] = None,
                       event_ring=None, memory=None, tracer=None,
                       goodput=None, replicas=None, resilience=None,
+                      fleet=None, metrics_view=None,
                       handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
@@ -224,4 +251,5 @@ def start_http_server(port: int, host: str = "127.0.0.1",
                                event_ring=event_ring, memory=memory,
                                tracer=tracer, goodput=goodput,
                                replicas=replicas, resilience=resilience,
+                               fleet=fleet, metrics_view=metrics_view,
                                handler_timeout_s=handler_timeout_s)

@@ -338,6 +338,105 @@ class MetricRegistry:
                               "series": series}
         return snap
 
+    # ------------------------------------------------- federate (merge)
+
+    def export_state(self) -> dict:
+        """Raw mergeable state — the federation wire format. Unlike
+        ``snapshot()`` (which derives quantiles for human consumers),
+        this carries the *accumulator* state (counter values, gauge
+        values, histogram bucket counts + min/max) so a peer registry
+        can fold it in via ``import_state`` without losing precision.
+        Pure builtins, so ``json.dumps`` round-trips it byte-exactly —
+        the process-per-replica transport serializes this verbatim."""
+        state: dict = {}
+        with self._lock:
+            for name, fam in self._families.items():
+                series = []
+                for key in sorted(fam.series):
+                    inst = fam.series[key]
+                    entry: dict = {"labels": [list(kv) for kv in key]}
+                    if fam.kind in ("counter", "gauge"):
+                        entry["value"] = inst.value
+                    else:
+                        entry.update({
+                            "count": inst.count, "sum": inst.sum,
+                            "bucket_counts": list(inst.bucket_counts),
+                            "min": (None if inst.count == 0
+                                    else inst._min),
+                            "max": (None if inst.count == 0
+                                    else inst._max),
+                        })
+                    series.append(entry)
+                state[name] = {"type": fam.kind, "help": fam.help,
+                               "bounds": (None if fam.bounds is None
+                                          else list(fam.bounds)),
+                               "series": series}
+        return state
+
+    def import_state(self, state: dict,
+                     extra_labels: Optional[Dict[str, str]] = None) -> None:
+        """Fold an ``export_state()`` dict into this registry. Merge
+        semantics per kind: counters and histograms ACCUMULATE (values
+        sum, bucket counts sum — safe because a name's bucket geometry
+        is pinned by ``_family``'s mismatch check), gauges SET
+        (last-write-wins; federate gauges under distinguishing
+        ``extra_labels`` to keep them per-source). ``extra_labels`` are
+        appended to every imported series — the federation layer uses
+        ``replica="r<i>"`` so per-replica series stay distinct and
+        label cardinality is bounded by pool size."""
+        extra = sorted((extra_labels or {}).items())
+        for k, _ in extra:
+            if not _LABEL_RE.match(k):
+                raise ValueError(f"invalid label name {k!r}")
+        for name, fam_state in state.items():
+            kind = fam_state["type"]
+            fam = self._family(name, kind, fam_state.get("help", ""),
+                               fam_state.get("bounds"))
+            for entry in fam_state["series"]:
+                key = tuple(sorted(
+                    [(k, str(v)) for k, v in entry["labels"]] +
+                    [(k, str(v)) for k, v in extra]))
+                inst = fam.get(key)
+                with self._lock:
+                    if kind == "counter":
+                        inst._value += float(entry["value"])
+                    elif kind == "gauge":
+                        inst._value = float(entry["value"])
+                    else:
+                        counts = entry["bucket_counts"]
+                        if len(counts) != len(inst.bucket_counts):
+                            raise ValueError(
+                                f"histogram {name!r} import has "
+                                f"{len(counts)} buckets, expected "
+                                f"{len(inst.bucket_counts)}")
+                        for i, c in enumerate(counts):
+                            inst.bucket_counts[i] += int(c)
+                        inst.count += int(entry["count"])
+                        inst.sum += float(entry["sum"])
+                        if entry.get("min") is not None:
+                            inst._min = min(inst._min, float(entry["min"]))
+                        if entry.get("max") is not None:
+                            inst._max = max(inst._max, float(entry["max"]))
+
+    def approx_bytes(self) -> int:
+        """Deterministic structural estimate of the registry's resident
+        size (families + label keys + instrument accumulators) for the
+        memory monitor's host-component ledger — an audit of where host
+        RAM goes, not an exact ``sys.getsizeof`` walk."""
+        total = 0
+        with self._lock:
+            for name, fam in self._families.items():
+                total += 64 + len(name) + len(fam.help)
+                if fam.bounds:
+                    total += 8 * len(fam.bounds)
+                for key, inst in fam.series.items():
+                    total += 48 + sum(len(k) + len(v) for k, v in key)
+                    if isinstance(inst, Histogram):
+                        total += 48 + 8 * len(inst.bucket_counts)
+                    else:
+                        total += 16
+        return total
+
     def reset(self) -> None:
         """Drop every family — test isolation only; production metrics
         are append-only for the life of the process."""

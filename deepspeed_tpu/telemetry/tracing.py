@@ -194,7 +194,9 @@ class Trace:
 #   pid 3 "server host": tid 1 serving-step phase slices
 #                        (telemetry/step_profile.py ring samples)
 
-def ring_timeline_events(event_ring) -> List[dict]:
+def ring_timeline_events(event_ring,
+                         source_pids: Optional[Dict[str, int]] = None
+                         ) -> List[dict]:
     """Convert the event ring into Chrome trace-event slices, in ONE
     place (the r8 export rebuilt device slices inline, so a second
     consumer would have re-implemented — and drifted from — the
@@ -202,7 +204,14 @@ def ring_timeline_events(event_ring) -> List[dict]:
     timestamp. Slices are deduped by ``(pid, tid, ts)``: a ring that
     recorded the same instant twice (fake clocks collapse timestamps;
     a re-recorded step) must not emit overlapping duplicates that break
-    the timeline validator's non-overlap invariant."""
+    the timeline validator's non-overlap invariant.
+
+    ``source_pids`` maps a step-profile ``source`` tag (the profiler's
+    ``source=`` constructor arg, e.g. ``"replica0"``) to a dedicated
+    Chrome pid, so a replicated frontend renders each replica's host
+    phases as its own process group; the caller owns those pids' meta
+    events. Untagged/unmapped sources keep the classic pid-3 "server
+    host" track, so single-server dumps are unchanged."""
     slices: List[dict] = []
     seen = set()
     have_server = False
@@ -230,12 +239,13 @@ def ring_timeline_events(event_ring) -> List[dict]:
             # contiguous phase slices reconstructed backwards from the
             # record timestamp (the step's finish boundary): the last
             # phase ends at ts, each earlier one abuts the next
-            have_server = True
+            pid = (source_pids or {}).get(data.get("source"), 3)
+            have_server = have_server or pid == 3
             end = ts
             step = data.get("step", "?")
             for entry in reversed(data.get("slices", [])):
                 name, pdur = entry[0], float(entry[1])
-                _slice(f"{name}", 3, 1, "server_host",
+                _slice(f"{name}", pid, 1, "server_host",
                        end - pdur, pdur,
                        {"step": step, "phase": name})
                 end -= pdur
@@ -262,6 +272,29 @@ def ring_timeline_events(event_ring) -> List[dict]:
              "args": {"name": "step phases (sampled)"}},
         ])
     return meta + slices
+
+
+def span_events_from_dict(events: List[dict], span: dict, pid: int,
+                          tid, extra_args: Optional[dict] = None) -> None:
+    """Emit Chrome complete events from a SERIALIZED span tree (the
+    ``TraceSpan.to_dict()`` form) — the renderer the fleet timeline
+    uses for replica-side traces, which cross the replica boundary as
+    JSON snapshots rather than live objects. Pre-order, same layout as
+    :meth:`Tracer._emit_span` so stitched and local tracks look
+    identical in Perfetto."""
+    end = span["end"] if span.get("end") is not None else span["start"]
+    args = dict(span.get("attributes") or {})
+    if extra_args:
+        args.update(extra_args)
+    events.append({
+        "name": span["name"], "ph": "X", "cat": "request",
+        "pid": pid, "tid": tid,
+        "ts": round(float(span["start"]) * 1e6, 3),
+        "dur": round(max(end - span["start"], 0.0) * 1e6, 3),
+        "args": args,
+    })
+    for child in span.get("children") or []:
+        span_events_from_dict(events, child, pid, tid)
 
 
 class Tracer:

@@ -70,14 +70,31 @@ def run_replicated(eng, prompt, args):
         if st["disaggregated"]:
             extra = (f", swap-ins {row.get('host_tier_swap_ins', 0)}, "
                      f"gap {row.get('recent_gap_ms', 0.0)} ms")
+        stale = row.get("scrape_staleness_s")
         print(f"  replica {row['replica']} [{row['role']}]: "
               f"{row['health']}{dead} — routed {row['routed']}, "
               f"steps {row['steps']}, "
-              f"failovers-from {row['failovers_from']}{extra}")
+              f"failovers-from {row['failovers_from']}{extra}"
+              + (f", scrape stale {stale}s" if stale else ""))
+    # fleet observability (docs/observability.md "Fleet observability"):
+    # hop routing by cause plus the stitched-trace state; with
+    # --trace-dump the merged fleet timeline lands next to the
+    # per-server one — every replica as its own Perfetto process group,
+    # flow arrows joining a request's legs across them
+    hops = st["hops_by_cause"]
+    print(f"  fleet: stitching {'on' if st['stitching'] else 'off'}, "
+          f"hops " + ", ".join(f"{c}={n}" for c, n in hops.items()
+                               if n or c == "submit"))
+    if args.trace_dump and st["stitching"]:
+        path = args.trace_dump + ".fleet.json"
+        n = front.dump_timeline(path)
+        print(f"  fleet timeline: {n} events -> {path} "
+              "(load in ui.perfetto.dev)")
     if front.http_server is not None:
         port = front.http_server.port
-        input(f"pool state at http://127.0.0.1:{port}/debug/replicas "
-              "— press Enter to exit")
+        input(f"pool state at http://127.0.0.1:{port}/debug/replicas, "
+              f"fleet rollup at /debug/fleet, federated scrape at "
+              f"/metrics — press Enter to exit")
     front.close()
 
 

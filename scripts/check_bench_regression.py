@@ -78,6 +78,12 @@ METRICS = {
     # means the quantized layout (or its scale overhead) grew back
     # toward full precision
     "kv_tiering.capacity_ratio": "up",
+    # fleet observability (docs/observability.md "Fleet
+    # observability"): p90 wall of one federated /metrics scrape —
+    # frontend instruments plus every replica's snapshot merged under
+    # replica labels. A regression means the fleet view got too
+    # expensive to sit on a Prometheus scrape path
+    "fleet_obs.scrape_p90_ms": "down",
 }
 
 # same contract against the newest TRAIN phase record carrying a

@@ -103,6 +103,23 @@ _SLOW_BY_MODULE = {
     # (the op-level int8 round-trip/parity tests remain)
     "test_speculative_decoding": {"test_speculative_on_llama_layout"},
     "test_int8_training": {"test_bert_layer_int8_forward_and_grads_finite"},
+    # r17: the fleet plane rides the slow lane except its acceptance
+    # pins — federated parity + bounded cardinality, the snapshot
+    # bytes round-trip, and THE one-tree pin (handoff then failover in
+    # one request), plus the sub-second probes. The single-cause
+    # stitching variants (subsumed by the one-tree pin), the merged
+    # timeline, the staleness contract, the HTTP surface (also pinned
+    # by the exporter suite + bench smoke, which now carries a
+    # fleet_obs leg), /debug/memory registration, and the stranded-
+    # finish variant are full-suite-only.
+    "test_fleet_observability": {
+        "test_http_fleet_surface",
+        "test_replica_registry_bytes_in_debug_memory",
+        "test_stranded_request_trace_names_frontend_decision",
+        "test_stitched_trace_across_failover",
+        "test_stitched_trace_across_handoff",
+        "test_fleet_timeline_merged_and_monotonic",
+        "test_dead_replica_serves_stale_snapshot"},
 }
 
 

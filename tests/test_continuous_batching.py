@@ -461,6 +461,33 @@ def test_bench_serve_continuous_smoke():
     assert len(rows) == 2
     assert sum(1 for r in rows if r["health"] == "dead") == 1
     assert all(r["routed"] >= 1 for r in rows)
+    # fleet observability leg (auto in smoke, docs/observability.md
+    # "Fleet observability"): the role-split + seeded-kill run must
+    # exercise every stitching path (submit, handoff AND failover hop
+    # causes), every multi-leg request's kept trace must carry its hop
+    # spans (coverage 1.0 — a lost hop is a blind leg), the federated
+    # scrape's pool rollup must equal the per-replica sums even with
+    # one replica dead (the staleness contract: last snapshot still
+    # merges), replica label cardinality stays bounded by the pool
+    # size, and the scrape p90 (the fleet_obs.scrape_p90_ms regression
+    # gate's input) is a real measured wall
+    fo = rec["fleet_obs"]
+    assert fo["replicas"] == 2
+    assert fo["finished_ok"] == fo["requests"]
+    assert fo["scrapes"] >= 3
+    assert fo["scrape_p90_ms"] is not None and fo["scrape_p90_ms"] > 0
+    assert fo["hops_by_cause"]["submit"] >= 1
+    assert fo["hops_by_cause"]["handoff"] >= 1
+    assert fo["hops_by_cause"]["failover"] >= 1
+    assert fo["hops_total"] == sum(fo["hops_by_cause"].values())
+    assert fo["hops_total"] > fo["requests"]   # somebody crossed legs
+    assert fo["multi_leg_requests"] >= 1
+    assert fo["stitched_coverage"] == 1.0
+    assert fo["merged_parity"] is True
+    assert fo["dead_replicas"] == 1
+    labels = set(fo["replica_label_values"])
+    assert {"r0", "r1", "pool"} <= labels
+    assert len(labels) <= 2 * fo["replicas"] + 1   # bounded cardinality
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm

@@ -1,0 +1,508 @@
+"""Fleet observability plane (ISSUE 17).
+
+One frontend scrape must tell the whole pool's story, and every
+cross-replica read must survive a process boundary. The oracles:
+
+* **federation parity** — the frontend's federated ``/metrics`` view
+  carries every replica's instruments under bounded ``replica`` labels,
+  and the ``replica="pool"`` rollup equals the sum of the per-replica
+  series (counters) / the bucket-sum (histograms);
+* **bytes round-trip** — ``observability_state()`` survives
+  ``json.dumps(...).encode()`` → decode → ``import_state`` unchanged:
+  the plane reads serialized snapshots, never shared objects;
+* **stitching** — a request that failed over, or crossed a
+  prefill→decode handoff, reads as ONE trace tree whose hop spans name
+  replica, role, and cause, with the replica-side trace linking back
+  via the propagated trace-context;
+* **staleness** — a dead/draining replica's series serve its last
+  snapshot with a growing staleness mark instead of vanishing.
+
+Everything runs on the injectable frontend clock — ZERO real sleeps.
+"""
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
+                                     InferenceEngine, ServingFrontend)
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, init_params)
+from deepspeed_tpu.telemetry import (EventRing, FaultInjector,
+                                     MetricRegistry, get_event_ring,
+                                     get_registry, set_event_ring,
+                                     set_registry)
+from deepspeed_tpu.telemetry.memory import get_memory_monitor
+
+
+@pytest.fixture()
+def fresh_telemetry():
+    prev_reg = set_registry(MetricRegistry())
+    prev_ring = set_event_ring(EventRing(512))
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev_reg)
+        set_event_ring(prev_ring)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0, auto: float = 0.0):
+        self.t = t
+        self.auto = auto
+
+    def __call__(self) -> float:
+        v = self.t
+        self.t += self.auto
+        return v
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+_MCFG = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+             n_head=4, dtype=jnp.float32)
+
+TRACED = {"trace_sample_rate": 1.0, "trace_ring_capacity": 64}
+
+
+def make_engine(seed=0, max_out_tokens=256, block_size=32, num_slots=2,
+                replicas=2, repl_knobs=None, **knobs):
+    cfg = InferenceTransformerConfig(**_MCFG)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    repl = {"replicas": replicas}
+    repl.update(repl_knobs or {})
+    return InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=max_out_tokens,
+        block_size=block_size, num_slots=num_slots,
+        replication=repl, **knobs))
+
+
+def hops_of(trace):
+    return [s for s in trace.root.children if s.name == "hop"]
+
+
+def series_by_replica(view, family):
+    """{replica label value: summed counter value} for one family."""
+    out = {}
+    for s in view.export_state().get(family, {}).get("series", []):
+        lab = dict(s["labels"])
+        out[lab.get("replica")] = out.get(lab.get("replica"), 0.0) \
+            + s["value"]
+    return out
+
+
+# --------------------------------------------- registry federation core
+
+def test_export_import_merge_semantics(fresh_telemetry):
+    """Counters sum, histograms bucket-sum, gauges stay per-source,
+    extra labels bound cardinality — and mismatched histogram bounds
+    refuse to merge rather than corrupt quantiles."""
+    a = MetricRegistry()
+    a.counter("c_total", help="h").inc(3)
+    a.gauge("g", help="h").set(7.0)
+    h = a.histogram("lat_seconds", help="h", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    b = MetricRegistry()
+    b.counter("c_total", help="h").inc(4)
+    # the merge target: one registry importing two sources
+    view = MetricRegistry()
+    view.import_state(a.export_state(), extra_labels={"replica": "r0"})
+    view.import_state(b.export_state(), extra_labels={"replica": "r1"})
+    view.import_state(a.export_state(), extra_labels={"replica": "pool"})
+    view.import_state(b.export_state(), extra_labels={"replica": "pool"})
+    c = series_by_replica(view, "c_total")
+    assert c == {"r0": 3.0, "r1": 4.0, "pool": 7.0}
+    snap = view.snapshot()
+    hs = [s for s in snap["lat_seconds"]["series"]
+          if s["labels"].get("replica") == "r0"]
+    assert hs[0]["count"] == 3 and hs[0]["sum"] == pytest.approx(5.55)
+    # gauges keep per-source values — never summed
+    gs = {s["labels"]["replica"]: s["value"]
+          for s in snap["g"]["series"]}
+    assert gs["r0"] == 7.0 and gs["pool"] == 7.0
+    # bounds mismatch must raise, not mis-bucket
+    bad = MetricRegistry()
+    bad.histogram("lat_seconds", help="h",
+                  buckets=[0.25, 2.0]).observe(1)
+    with pytest.raises(ValueError):
+        view.import_state(bad.export_state(),
+                          extra_labels={"replica": "r9"})
+    # prometheus text renders the merged view with its labels
+    assert 'c_total{replica="pool"} 7' in view.prometheus_text()
+
+
+def test_observability_state_round_trips_through_bytes(fresh_telemetry):
+    """THE process-split pin: a replica's whole observability snapshot
+    ships as bytes — json encode → decode → import — and the imported
+    registry's totals match the replica's own."""
+    front = ServingFrontend(make_engine(replicas=1,
+                                        telemetry=TRACED))
+    rids = [front.submit([1 + i, 2, 3], max_new_tokens=4)
+            for i in range(3)]
+    front.drain()
+    srv = front.replicas[0].server
+    state = srv.observability_state()
+    blob = json.dumps(state, default=str).encode()
+    wired = json.loads(blob.decode())
+    assert wired["role"] == "mixed"
+    assert wired["tracing"] is True
+    assert len(wired["traces"]) == len(rids)
+    fresh = MetricRegistry()
+    fresh.import_state(wired["metrics"], extra_labels={"replica": "r0"})
+    got = series_by_replica(fresh, "serve_requests_finished_total")
+    want = sum(s["value"] for s in srv.telemetry.export_state()
+               ["serve_requests_finished_total"]["series"])
+    assert got == {"r0": want} and want == len(rids)
+    front.close()
+
+
+# -------------------------------------------------- federated scrape
+
+def test_fleet_scrape_parity_and_bounded_cardinality(fresh_telemetry):
+    """One frontend scrape covers the pool: every replica's serving
+    families appear under replica="r<i>", the pool rollup equals the
+    per-replica sum, and replica-label cardinality is bounded by the
+    pool size — independent of request volume."""
+    front = ServingFrontend(make_engine(replicas=2))
+    rids = [front.submit([1 + i, 2, 3], max_new_tokens=3)
+            for i in range(6)]
+    front.drain()
+    view = front._fleet_registry()
+    fin = series_by_replica(view, "serve_requests_finished_total")
+    assert fin["r0"] + fin["r1"] == fin["pool"] == len(rids)
+    assert fin["r0"] >= 1 and fin["r1"] >= 1     # least-loaded spread
+    # histogram bucket-sum parity: pool count == sum of replica counts
+    snap = view.snapshot()
+    fam = snap["serve_request_seconds"]["series"]
+    counts = {}
+    for s in fam:
+        r = s["labels"].get("replica")
+        counts[r] = counts.get(r, 0) + s["count"]
+    assert counts["pool"] == counts["r0"] + counts["r1"] == len(rids)
+    # bounded labels: exactly r0, r1, pool on replica-side families —
+    # whatever the request count
+    labels = {dict(s["labels"]).get("replica")
+              for s in view.export_state()
+              ["serve_requests_finished_total"]["series"]}
+    assert labels == {"r0", "r1", "pool"}
+    # the scrape metered itself
+    assert front.telemetry.snapshot()["serve_fleet_scrape_seconds"][
+        "series"][0]["count"] >= 1
+    front.close()
+
+
+def test_dead_replica_serves_stale_snapshot(fresh_telemetry):
+    """Staleness contract: a killed replica's series stay in the
+    federated view (last snapshot) and its staleness mark grows on the
+    frontend clock; a live replica's stays fresh."""
+    clk = FakeClock(t=100.0)
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(replicas=2), clock=clk,
+                            fault_injector=fi)
+    rids = [front.submit([1 + i, 2, 3], max_new_tokens=3)
+            for i in range(4)]
+    front.drain()
+    fi.kill_replica(0)
+    front.step()
+    clk.advance(7.5)
+    view = front._fleet_registry()
+    fin = series_by_replica(view, "serve_requests_finished_total")
+    assert fin["r0"] >= 1                       # dead but not invisible
+    assert fin["r0"] + fin["r1"] == fin["pool"] == len(rids)
+    rows = {r["replica"]: r
+            for r in front._fleet_snapshot()["replicas"]}
+    assert rows["r0"]["health"] == "dead"
+    assert rows["r0"]["scrape_staleness_s"] >= 7.5
+    assert rows["r1"]["scrape_staleness_s"] == 0.0
+    # the mark is also a gauge and a /debug/replicas field
+    ages = {s["labels"]["replica"]: s["value"]
+            for s in front.telemetry.snapshot()
+            ["serve_replica_scrape_age_seconds"]["series"]}
+    assert ages["r0"] >= 7.5 and ages["r1"] == 0.0
+    stat_rows = {r["replica"]: r for r in front.stats["replicas"]}
+    assert stat_rows[0]["scrape_staleness_s"] >= 7.5
+    # draining freezes the survivor's snapshot too
+    front.drain_replica(1)
+    clk.advance(2.0)
+    rows = {r["replica"]: r
+            for r in front._fleet_snapshot()["replicas"]}
+    assert rows["r1"]["draining"] is True
+    assert rows["r1"]["scrape_staleness_s"] >= 2.0
+    front.close()
+
+
+# ----------------------------------------------------- trace stitching
+
+def test_stitched_trace_across_failover(fresh_telemetry):
+    """A request killed mid-decode reads as ONE tree: hop 0 on the
+    victim (cause submit), hop 1 on the survivor (cause failover), the
+    replay explicit — and the survivor's own trace links back to the
+    frontend trace id."""
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(replicas=2, telemetry=TRACED),
+                            fault_injector=fi)
+    ids = [front.submit([1 + i, 2, 3], max_new_tokens=8)
+           for i in range(2)]
+    for _ in range(3):
+        front.step()                 # tokens committed on both replicas
+    victim = front._requests[ids[0]].replica
+    moved = [r for r in ids if front._requests[r].replica == victim]
+    fi.kill_replica(victim)
+    front.drain()
+    survivor = 1 - victim
+    traces = {t.trace_id: t for t in front.tracer.traces()}
+    assert set(traces) == set(ids)   # one tree per request, no more
+    for rid in moved:
+        tr = traces[rid]
+        hops = hops_of(tr)
+        assert len(hops) == 2
+        assert hops[0].attributes["cause"] == "submit"
+        assert hops[0].attributes["replica"] == victim
+        assert hops[0].attributes["outcome"] == "failover"
+        assert hops[1].attributes["cause"] == "failover"
+        assert hops[1].attributes["replica"] == survivor
+        assert hops[1].attributes["role"] == "mixed"
+        assert hops[1].attributes["committed"] >= 1   # replayed prefix
+        assert tr.root.attributes["hops"] == 2
+        assert tr.root.attributes["failovers"] == 1
+        assert tr.status == "ok"     # the request still finished
+    # hop counters tick per leg (and would even with tracing off)
+    by_cause = front.stats["hops_by_cause"]
+    assert by_cause["submit"] == len(ids)
+    assert by_cause["failover"] == len(moved)
+    # replica-side link-back: the survivor's replayed trace carries the
+    # propagated frontend trace-context as link_* attributes
+    linked = [t for t in front.replicas[survivor].server.tracer.traces()
+              if t.root.attributes.get("link_cause") == "failover"]
+    assert linked
+    assert linked[0].root.attributes["link_trace_id"] in moved
+    assert linked[0].root.attributes["link_hop"] == 1
+    front.close()
+
+
+def test_stitched_trace_across_handoff(fresh_telemetry):
+    """Disaggregated pool: every request's tree shows a prefill-role
+    hop then a decode-role hop with cause="handoff", and the decode
+    replica's trace links back with link_cause="handoff"."""
+    front = ServingFrontend(make_engine(
+        replicas=2, repl_knobs={"roles": ["prefill", "decode"]},
+        enable_prefix_caching=True, telemetry=TRACED))
+    prompt = [1 + (j % 90) for j in range(35)]    # block + tail
+    rid = front.submit(prompt, max_new_tokens=4)
+    front.drain()
+    (tr,) = [t for t in front.tracer.traces() if t.trace_id == rid]
+    hops = hops_of(tr)
+    assert [h.attributes["cause"] for h in hops] == ["submit", "handoff"]
+    assert [h.attributes["role"] for h in hops] == ["prefill", "decode"]
+    assert hops[0].attributes["outcome"] == "handoff"
+    assert hops[1].attributes["replica"] == 1
+    assert front.stats["hops_by_cause"]["handoff"] == 1
+    linked = [t for t in front.replicas[1].server.tracer.traces()
+              if t.root.attributes.get("link_cause") == "handoff"]
+    assert linked and linked[0].root.attributes["link_trace_id"] == rid
+    front.close()
+
+
+def test_one_tree_through_handoff_then_failover(fresh_telemetry):
+    """THE acceptance pin: one request driven through a prefill→decode
+    handoff AND a seeded failover is still exactly ONE trace tree —
+    three hops naming replica, role, and cause, in order."""
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(
+        replicas=2, repl_knobs={"roles": ["prefill", "decode"]},
+        enable_prefix_caching=True, telemetry=TRACED),
+        fault_injector=fi)
+    prompt = [1 + (j % 90) for j in range(35)]
+    rid = front.submit(prompt, max_new_tokens=8)
+    while front._requests[rid].replica != 1:     # leg 2: decode replica
+        front.step()
+    for _ in range(2):
+        front.step()                             # decode mid-flight
+    fi.kill_replica(1)
+    front.drain()
+    assert front.finish_reason(rid) in ("eos", "length")
+    trees = [t for t in front.tracer.traces() if t.trace_id == rid]
+    assert len(trees) == 1                       # exactly one tree
+    hops = hops_of(trees[0])
+    assert [(h.attributes["replica"], h.attributes["role"],
+             h.attributes["cause"]) for h in hops] == [
+        (0, "prefill", "submit"),
+        (1, "decode", "handoff"),
+        (0, "prefill", "failover")]              # last resort, explicit
+    assert hops[1].attributes["outcome"] == "failover"
+    assert hops[2].attributes["committed"] >= 1  # replayed the decode leg
+    assert trees[0].root.attributes["hops"] == 3
+    front.close()
+
+
+def test_frontend_decided_finishes_leave_error_traces(fresh_telemetry):
+    """Refusals and strandings the FRONTEND decides are as observable
+    as a replica-side rejection: always-keep error traces with the
+    rejection reason, on the frontend tracer."""
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(replicas=2, telemetry=TRACED),
+                            fault_injector=fi)
+    fi.kill_replica(0)
+    fi.kill_replica(1)
+    front.step()
+    with pytest.raises(RuntimeError):
+        front.submit([1, 2, 3], max_new_tokens=4)
+    (tr,) = front.tracer.traces()
+    assert tr.status == "rejected"
+    assert tr.root.attributes["error"] == "replicas_dead"
+    assert tr.keep_reason == "error"
+    front.close()
+
+
+def test_stranded_request_trace_names_frontend_decision(fresh_telemetry):
+    """A request stranded by the whole pool dying mid-flight finishes
+    status="stranded" with decided_by="frontend" on its root."""
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(
+        replicas=2, repl_knobs={"max_failovers": 0}, telemetry=TRACED),
+        fault_injector=fi)
+    rid = front.submit([1, 2, 3], max_new_tokens=8)
+    front.step()
+    fi.kill_replica(front._requests[rid].replica)
+    front.drain()
+    assert front.finish_reason(rid) == "failed"
+    (tr,) = [t for t in front.tracer.traces() if t.trace_id == rid]
+    assert tr.status == "failed"
+    assert tr.keep_reason == "error"
+    assert tr.root.attributes["decided_by"] == "frontend"
+    assert tr.root.attributes["finish_reason"] == "failed"
+    front.close()
+
+
+# ------------------------------------------------------ merged timeline
+
+def test_fleet_timeline_merged_and_monotonic(fresh_telemetry, tmp_path):
+    """dump_timeline renders one Perfetto file: per-replica process
+    groups fed by serialized snapshots, flow arrows between a stitched
+    request's legs, and per-track slices monotonic and non-overlapping."""
+    fi = FaultInjector()
+    front = ServingFrontend(make_engine(replicas=2, telemetry=TRACED),
+                            fault_injector=fi)
+    ids = [front.submit([1 + i, 2, 3], max_new_tokens=6)
+           for i in range(2)]
+    for _ in range(3):
+        front.step()
+    fi.kill_replica(front._requests[ids[0]].replica)
+    front.drain()
+    path = tmp_path / "fleet.json"
+    n = front.dump_timeline(str(path))
+    payload = json.loads(path.read_text())
+    events = payload["traceEvents"]
+    assert n == len(events)
+    # every replica is its own process group, named role + health
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "replica r0" in names[10] and "replica r1" in names[11]
+    assert sum(1 for nm in (names[10], names[11]) if "dead" in nm) == 1
+    # the failover hop pair is joined by a flow arrow (s at the dead
+    # leg's end, f at the survivor leg's start, same id)
+    flows = [e for e in events if e["ph"] in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    pair = next(v for v in by_id.values() if len(v) == 2)
+    start = {e["ph"]: e for e in pair}
+    assert start["s"]["ts"] <= start["f"]["ts"]
+    # per-replica tracks, time-sorted (the Perfetto view): the flat
+    # step-phase track is monotonic and non-overlapping — phase slices
+    # within a sampled step abut exactly, successive sampled steps
+    # never interleave (1 ms tolerance for the wall-vs-ring clock
+    # skew); replica-side trace tracks NEST — every child span is
+    # contained in its root "request" span
+    tracks = {}
+    for e in events:
+        if e["ph"] == "X":
+            tracks.setdefault((e["pid"], e["tid"]), []).append(e)
+    assert any(pid in (10, 11) and tid == 1 for pid, tid in tracks)
+    assert any(pid in (10, 11) and tid >= 100 for pid, tid in tracks)
+    for (pid, tid), evs in tracks.items():
+        if pid not in (10, 11):
+            continue
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        if tid == 1:
+            for a, b in zip(evs, evs[1:]):
+                assert a["ts"] <= b["ts"]
+                assert a["ts"] + a["dur"] <= b["ts"] + 1e3
+        else:
+            root = evs[0]
+            assert root["name"] == "request"
+            for e in evs[1:]:
+                assert e["ts"] >= root["ts"] - 1.0
+                assert e["ts"] + e["dur"] <= \
+                    root["ts"] + root["dur"] + 1.0
+    front.close()
+
+
+def test_dump_timeline_requires_tracing(fresh_telemetry, tmp_path):
+    front = ServingFrontend(make_engine(replicas=2))
+    with pytest.raises(RuntimeError, match="trace_sample_rate"):
+        front.dump_timeline(str(tmp_path / "x.json"))
+    front.close()
+
+
+# ------------------------------------------------- scrape-surface wiring
+
+def test_http_fleet_surface(fresh_telemetry):
+    """End-to-end over HTTP: /metrics is the federated view, /debug/
+    fleet the rollup, /debug/replicas rows carry scrape_staleness_s,
+    and the 404 body advertises the fleet route."""
+    front = ServingFrontend(make_engine(
+        replicas=2, telemetry={**TRACED, "http_port": 0}))
+    front.submit([1, 2, 3], max_new_tokens=3)
+    front.drain()
+    port = front.http_server.port
+
+    def get(p):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{p}", timeout=10) as r:
+            return r.read().decode()
+
+    prom = get("/metrics")
+    assert 'serve_requests_finished_total{replica="pool"}' in prom
+    assert 'replica="r0"' in prom and 'replica="r1"' in prom
+    js = json.loads(get("/metrics.json"))
+    assert any(s["labels"].get("replica") == "pool"
+               for s in js["serve_requests_finished_total"]["series"])
+    fleet = json.loads(get("/debug/fleet"))
+    assert fleet["stitching"] is True
+    assert {r["replica"] for r in fleet["replicas"]} == {"r0", "r1"}
+    assert all("scrape_staleness_s" in r for r in fleet["replicas"])
+    assert set(fleet["hops_by_cause"]) == {
+        "submit", "handoff", "failover", "drain_reroute"}
+    reps = json.loads(get("/debug/replicas"))
+    assert all("scrape_staleness_s" in r for r in reps["replicas"])
+    try:
+        get("/nope")
+        raise AssertionError("404 expected")
+    except urllib.error.HTTPError as e:
+        assert "/debug/fleet" in e.read().decode()
+    front.close()
+
+
+def test_replica_registry_bytes_in_debug_memory(fresh_telemetry):
+    """Each replica's private registry is a host component in
+    /debug/memory while the frontend lives — and unregisters on
+    close() (no leak into the next pool's accounting)."""
+    front = ServingFrontend(make_engine(replicas=2))
+    front.submit([1, 2, 3], max_new_tokens=3)
+    front.drain()
+    host = get_memory_monitor().snapshot(
+        registry=MetricRegistry())["host_components"]
+    assert host["replica0_telemetry"]["bytes"] > 0
+    assert host["replica1_telemetry"]["bytes"] > 0
+    front.close()
+    host = get_memory_monitor().snapshot(
+        registry=MetricRegistry())["host_components"]
+    assert "replica0_telemetry" not in host
+    assert "replica1_telemetry" not in host
