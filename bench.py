@@ -1027,7 +1027,7 @@ def phase_serve(args) -> dict:
 
     # warm the traces so the replay measures steady-state serving, not
     # compiles (the one-shot leg below is warmed by its own first call)
-    srv.submit(reqs[0][0], max_new_tokens=2)
+    warm_rid = srv.submit(reqs[0][0], max_new_tokens=2)
     srv.drain()
     steps0 = srv.stats["decode_steps"]
     active0 = srv.stats["active_slot_steps"]
@@ -1038,7 +1038,8 @@ def phase_serve(args) -> dict:
     vclock = 0   # decode-step time; jumps over idle gaps in the trace
     while nxt < n_req or not srv.scheduler.idle:
         while nxt < n_req and arrive_at[nxt] <= vclock:
-            rid = srv.submit(reqs[nxt][0], max_new_tokens=reqs[nxt][1])
+            rid = srv.submit(reqs[nxt][0], max_new_tokens=reqs[nxt][1],
+                             tenant=("acme", "beta", "corp")[nxt % 3])
             ids.append(rid)
             submit_t[rid] = time.time()
             nxt += 1
@@ -1180,6 +1181,58 @@ def phase_serve(args) -> dict:
                     "series") else None),
         },
     }
+    # request-level cost accounting + capacity blob (docs/
+    # observability.md "Cost accounting & capacity"): every replay
+    # request's bill harvested non-destructively, the closure residual
+    # (per-request device-seconds vs the profiler's device-attributed
+    # wall — both from the same monotonic clock, so the residual is
+    # only distribution carry and should be tiny), the per-tenant
+    # device split (the replay cycles three tenants; shares sum to 1
+    # because the unmetered warmup holds no tenant device time), and
+    # the live capacity model's view of the drained pool. The unit-cost
+    # number (device-seconds per 1k generated tokens) is the round-
+    # over-round efficiency gate in check_bench_regression.py.
+    recs = [srv.request_cost(r) for r in (warm_rid, *ids)]
+    recs = [r for r in recs if r is not None]
+    acct = srv.stats["accounting"]
+    # force a fresh evaluation so the rate window spans the replay just
+    # run (the step-cadence eval may be mid-interval at drain)
+    cap = (srv._capacity.evaluate() if srv._capacity is not None
+           else {"enabled": False})
+    dev_sum = sum(r["device_s"] for r in recs)
+    tok_out = sum(r["tokens_out"] for r in recs)
+    ten_dev = {t: v.get("serve_tenant_device_seconds_total", 0.0)
+               for t, v in acct["tenants"].items()}
+    out["cost"] = {
+        "requests_billed": len(recs),
+        "device_seconds_per_1k_tokens": round(
+            dev_sum / max(tok_out, 1) * 1000.0, 6),
+        "device_seconds_total": round(acct["device_s_total"], 6),
+        "closure_residual": round(
+            abs(dev_sum - spf["device_s"])
+            / max(spf["device_s"], 1e-12), 6),
+        "kv_block_seconds_total": round(
+            sum(r["kv_block_s"] for r in recs), 6),
+        "queued_seconds_total": round(
+            sum(r["queued_s"] for r in recs), 6),
+        "tenant_device_share": {
+            t: round(v / max(sum(ten_dev.values()), 1e-12), 4)
+            for t, v in sorted(ten_dev.items())},
+        "capacity": {
+            "enabled": bool(cap.get("enabled")),
+            "slot_occupancy": cap.get("slot_occupancy"),
+            "block_utilization": cap.get("block_utilization"),
+            "tokens_per_s": cap.get("tokens_per_s"),
+            "sustainable_tokens_per_s":
+                cap.get("sustainable_tokens_per_s"),
+            "admissible_requests_per_s":
+                cap.get("admissible_requests_per_s"),
+        },
+    }
+    log(f"cost: {out['cost']['device_seconds_per_1k_tokens']} device-s "
+        f"per 1k tokens, closure residual "
+        f"{out['cost']['closure_residual']}, tenants "
+        f"{sorted(out['cost']['tenant_device_share'])}")
     print(json.dumps({**out, "partial": True}), flush=True)  # salvage
 
     # one-shot comparator on the SAME trace: batches of num_slots in

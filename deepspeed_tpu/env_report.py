@@ -186,6 +186,16 @@ def telemetry_info():
             "cross-replica request legs into one trace, and serves "
             "/debug/fleet + a merged timeline; docs/observability.md "
             "'Fleet observability')")
+        out["serve_accounting"] = (
+            f"on by default config (per-request device-second ledger "
+            f"closing against the step profiler, KV block-seconds, "
+            f"tenant metering top-{cfg.accounting.max_tenants}, live "
+            f"capacity model window {cfg.accounting.window_s}s at "
+            f"/debug/capacity)"
+            if cfg.accounting.enabled and cfg.step_profile else
+            "off (needs telemetry.step_profile + "
+            "telemetry.accounting.enabled — docs/observability.md "
+            "'Cost accounting & capacity')")
         fic = cfg.fault_injection
         out["fault_injection"] = (
             f"ARMED (seed {fic.seed}; step latency "

@@ -94,9 +94,12 @@ from deepspeed_tpu.inference.server import (_LIFECYCLE_EVENTS,
                                             check_drain_timeout,
                                             submit_rejection)
 from deepspeed_tpu.telemetry import (FaultInjector, MetricRegistry,
-                                     ReplicaKilled, Tracer, Watchdog,
-                                     get_event_ring, get_registry,
-                                     start_http_server)
+                                     ReplicaKilled, TenantMeter, Tracer,
+                                     Watchdog, get_event_ring,
+                                     get_registry, merge_cost_legs,
+                                     new_cost_record,
+                                     register_cost_histograms,
+                                     rollup_capacity, start_http_server)
 from deepspeed_tpu.telemetry import events as telemetry_events
 from deepspeed_tpu.telemetry.memory import get_memory_monitor
 from deepspeed_tpu.telemetry.tracing import (ring_timeline_events,
@@ -114,6 +117,15 @@ DEGRADED = "degraded"
 DEAD = "dead"
 
 
+def _entries_nbytes(entries) -> int:
+    """Host bytes of one handoff publication: ``[(hash, payload)]``
+    where payload is a dict of numpy arrays (k/v, optional scales).
+    Computed from the payloads themselves — the publishing prefill
+    replica has no host tier to ask for a per-block size."""
+    return sum(int(a.nbytes) for _h, payload in entries
+               for a in payload.values())
+
+
 
 class _FrontRequest:
     """Frontend-side record of one request across replica lifetimes."""
@@ -122,7 +134,7 @@ class _FrontRequest:
                  "priority", "deadline_ts", "submit_ts", "replica",
                  "committed", "failovers", "retry_at_tick",
                  "prefill_only", "replay", "imported", "trace", "hop",
-                 "hops", "next_cause")
+                 "hops", "next_cause", "tenant", "cost_legs")
 
     def __init__(self, request_id: int, prompt: List[int],
                  max_new_tokens: int, eos_token_id: Optional[int],
@@ -166,6 +178,12 @@ class _FrontRequest:
         self.hop = None
         self.hops = 0
         self.next_cause = "submit"
+        # cost accounting (docs/observability.md "Cost accounting &
+        # capacity"): the metering label the request was submitted
+        # under, and the per-replica cost legs harvested at each leg
+        # boundary — _finalize merges them into ONE bill
+        self.tenant: Optional[str] = None
+        self.cost_legs: List[dict] = []
 
 
 class _Replica:
@@ -369,6 +387,24 @@ class ServingFrontend:
             help="wall time of one federated fleet scrape: refresh + "
                  "merge of every replica's registry snapshot into the "
                  "frontend's /metrics view")
+        # request-level cost accounting at the pool boundary (docs/
+        # observability.md "Cost accounting & capacity"): each replica
+        # runs its own RequestLedger; the frontend harvests one cost
+        # LEG per replica residency (finish, handoff, failover, drain
+        # re-route) and merges them into one bill per request at
+        # _finalize. The frontend-level tenant meter counts REQUESTS
+        # (replica-level tenant series count legs — recompute is real
+        # work and bills where it ran).
+        self._acct = tcfg is None or tcfg.accounting.enabled
+        self._costs: Dict[int, dict] = {}     # rid -> merged bill
+        self._tenants: Optional[TenantMeter] = None
+        if self._acct:
+            self._tenants = TenantMeter(
+                registry=reg,
+                max_tenants=(tcfg.accounting.max_tenants
+                             if tcfg is not None else 32))
+            (self._h_cost_device, self._h_cost_blocks,
+             self._h_cost_queued) = register_cost_histograms(reg)
         # per-replica observability snapshots, ALWAYS round-tripped
         # through json bytes (no cross-replica object sharing — the
         # process-per-replica transport ships the same bytes): index ->
@@ -460,7 +496,8 @@ class ServingFrontend:
                 tcfg.http_port, host=tcfg.http_host, registry=reg,
                 replicas=self._debug_snapshot, tracer=self.tracer,
                 fleet=self._fleet_snapshot,
-                metrics_view=self._fleet_registry)
+                metrics_view=self._fleet_registry,
+                capacity=self._capacity_snapshot)
 
     # ------------------------------------------------------------ API
 
@@ -468,21 +505,28 @@ class ServingFrontend:
                eos_token_id: Optional[int] = None,
                request_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               tenant: Optional[str] = None) -> int:
         """Queue one request with the server's submit contract (same
         validation, same finish-reason vocabulary); the frontend routes
         it to the least-loaded healthy replica, holding it in a bounded
-        frontend queue only when no replica can take it right now."""
+        frontend queue only when no replica can take it right now.
+
+        ``tenant`` threads through to every replica leg (docs/
+        observability.md "Cost accounting & capacity"): the frontend's
+        tenant series count requests, each replica's count its own
+        legs, and the merged cost bill carries the label."""
         rej = submit_rejection(prompt, max_new_tokens,
                                max(1, self.engine.config.min_out_tokens),
                                deadline_s)
         if rej is not None:
-            self._count_rejection(rej[0], request_id)
+            self._count_rejection(rej[0], request_id, tenant=tenant)
             raise ValueError(rej[1])
         if request_id is None:
             request_id = self._next_id
         elif request_id in self._requests or request_id in self._results:
-            self._count_rejection("duplicate_id", request_id)
+            self._count_rejection("duplicate_id", request_id,
+                                  tenant=tenant)
             raise ValueError(
                 f"request_id {request_id} is already outstanding or "
                 "finished — a duplicate would silently overwrite its "
@@ -492,6 +536,7 @@ class ServingFrontend:
         fr = _FrontRequest(
             request_id, prompt, max_new_tokens, eos_token_id, priority,
             None if deadline_s is None else now + deadline_s, now)
+        fr.tenant = tenant
         if self.tracer is not None:
             # the STITCHED trace is born at the pool boundary: every
             # replica leg the request ever runs becomes a hop span
@@ -507,6 +552,8 @@ class ServingFrontend:
             # permanent refusal (span/pool/...): identical on every
             # replica — the frontend has nothing to hold
             del self._requests[request_id]
+            if self._tenants is not None:
+                self._tenants.count_rejection(tenant)
             if fr.trace is not None:
                 fr.trace.root.set("error", str(e))
                 self.tracer.finish(fr.trace, status="rejected")
@@ -515,24 +562,30 @@ class ServingFrontend:
             if all(r.health == DEAD for r in self.replicas):
                 del self._requests[request_id]
                 self._count_rejection("replicas_dead", request_id,
-                                      trace=fr.trace)
+                                      trace=fr.trace, tenant=tenant)
                 raise RuntimeError(
                     "every replica is dead — the pool can never serve "
                     "this request (restart the frontend)")
             if len(self._pending) >= self._max_pending:
                 del self._requests[request_id]
                 self._count_rejection("queue_full", request_id,
-                                      trace=fr.trace)
+                                      trace=fr.trace, tenant=tenant)
                 raise RuntimeError(
                     f"frontend queue is full ({self._max_pending}); "
                     "step() the pool before submitting more, or raise "
                     "max_queued_requests")
             self._pending.append(fr)
+        if self._tenants is not None and tenant is not None:
+            # the frontend meters accepted REQUESTS once, at the pool
+            # boundary (replica series meter legs)
+            self._tenants.count_request(self._tenants.fold(tenant),
+                                        len(prompt))
         return request_id
 
     def _count_rejection(self, reason: str,
                          request_id: Optional[int] = None,
-                         trace=None) -> None:
+                         trace=None,
+                         tenant: Optional[str] = None) -> None:
         """Pool-level refusals mirror the server's accounting (same
         counter family, same ring event, same always-kept error trace)
         so a frontend rejection is as visible as a bare server's."""
@@ -540,6 +593,8 @@ class ServingFrontend:
             "serve_admission_rejections_total",
             help="refused submit() calls, by reason",
             labels={"reason": reason}).inc()
+        if self._tenants is not None:
+            self._tenants.count_rejection(tenant)
         get_event_ring().record(telemetry_events.ADMISSION_REJECT,
                                 reason=reason, source="frontend")
         if self.tracer is not None:
@@ -618,6 +673,7 @@ class ServingFrontend:
             why = rep.server.finish_reason(request_id)
             if why is not None:
                 tokens = rep.server.result(request_id)
+                self._harvest_leg(rep, fr)
                 if self._handoff_point(fr, why, tokens):
                     # the replica finished only the prefill-only LEG —
                     # pool-wise the request is still mid-flight, so
@@ -629,6 +685,7 @@ class ServingFrontend:
                 self._finalize(fr, tokens, why,
                                self._deferred_finished)
             return False
+        self._harvest_leg(rep, fr)
         self._finalize(fr, rep.server.result(request_id), "cancelled",
                        self._deferred_finished)
         return True
@@ -738,6 +795,24 @@ class ServingFrontend:
         return (fr.prefill_only and reason == "length"
                 and len(tokens) < len(fr.prompt) + fr.max_new_tokens)
 
+    def _harvest_leg(self, rep: _Replica, fr: _FrontRequest):
+        """Pop the replica-side cost record for one finished (or
+        abandoned) leg and stash it on the frontend request; the merged
+        bill lands at :meth:`_finalize`. Returns the harvested leg (or
+        None) so the handoff path can top up its bytes. Best-effort:
+        a replica mid-death may refuse the scrape — the merged bill
+        then simply misses that leg's device time (the abandon path in
+        :meth:`_kill_replica` covers the common death shape)."""
+        if not self._acct:
+            return None
+        try:
+            leg = rep.server.pop_request_cost(fr.request_id)
+        except Exception:  # noqa: BLE001 — billing never blocks serving
+            return None
+        if leg is not None:
+            fr.cost_legs.append(leg)
+        return leg
+
     def _collect_finish(self, rep: _Replica, fr: _FrontRequest,
                         tokens: List[int], reason: str,
                         finished: List[int]) -> None:
@@ -747,6 +822,10 @@ class ServingFrontend:
         finishes, a first-token EOS, lifecycle terminations, and a
         prefill leg that already satisfied the whole request) finalizes
         as before."""
+        # harvest the leg's cost NOW — both downstream paths destroy
+        # the replica-side record (_handoff_request forgets it, a
+        # finalize leaves it to reclaim()/forget())
+        self._harvest_leg(rep, fr)
         if self._handoff_point(fr, reason, tokens):
             self._handoff_request(rep, fr, tokens, finished)
             return
@@ -840,6 +919,12 @@ class ServingFrontend:
                 # publisher, only the replica dies
                 killed = e
         if entries:
+            if self._acct and fr.cost_legs:
+                # bill the published bytes to the prefill leg that just
+                # produced them (harvested in _collect_finish, so it is
+                # the newest leg) — payload nbytes, not a tier estimate
+                fr.cost_legs[-1]["handoff_bytes"] += \
+                    _entries_nbytes(entries)
             expired = self._handoff.publish(rid, entries, t0)
             self._c_handoff_pub.inc(len(entries))
             if expired:
@@ -891,6 +976,39 @@ class ServingFrontend:
         self.finish_reasons[rid] = reason
         self._requests.pop(rid, None)
         finished.append(rid)
+        if self._acct:
+            # the merged bill: ONE cost record per request, summing
+            # every harvested replica leg (prefill, decode, each
+            # failover replay — recompute bills where it ran). A
+            # request that never closed a leg (expired in the frontend
+            # queue, every harvest refused) still bills an empty
+            # synthesized record, so coverage is exactly one record
+            # per finished request.
+            folded = (self._tenants.fold(fr.tenant)
+                      if self._tenants is not None else fr.tenant)
+            legs = fr.cost_legs or [
+                new_cost_record(rid, folded, len(fr.prompt))]
+            rec = merge_cost_legs(legs)
+            rec["finish_reason"] = reason
+            # token totals come from the frontend's truth — an
+            # abandoned leg reports tokens_out=0 and a replayed leg
+            # re-counts its fold; device/KV/bytes columns still sum
+            # across legs (the device really ran them)
+            rec["tokens_in"] = len(fr.prompt)
+            rec["tokens_out"] = max(
+                0, len(self._results[rid]) - len(fr.prompt))
+            rec["tenant"] = folded
+            self._costs[rid] = rec
+            fr.cost_legs = []
+            self._h_cost_device.observe(rec["device_s"])
+            self._h_cost_blocks.observe(rec["kv_block_s"])
+            self._h_cost_queued.observe(rec["queued_s"])
+            if self._tenants is not None and folded is not None:
+                self._tenants.count_finish(folded, rec["tokens_out"],
+                                           rec["device_s"])
+            get_event_ring().record(
+                telemetry_events.REQUEST_COST, source="frontend",
+                **rec)
         if fr.trace is not None:
             # close the stitched trace: an eos/length finish is "ok"
             # (head-sampling decides retention); everything else —
@@ -1025,7 +1143,8 @@ class ServingFrontend:
                     trace_context=(None if fr.trace is None else
                                    {"trace_id": fr.trace.trace_id,
                                     "hop": fr.hops,
-                                    "cause": fr.next_cause}))
+                                    "cause": fr.next_cause}),
+                    tenant=fr.tenant)
             except RuntimeError:
                 continue          # that queue is full — try the next
             except ValueError:
@@ -1167,6 +1286,17 @@ class ServingFrontend:
                 moved.append((fr, list(fr.prompt) + list(fr.committed)))
         for fr, partial in moved:
             rep.failovers += 1
+            if self._acct:
+                # the dead leg's charges still bill: force-close its
+                # open ledger record and keep it for the merged bill
+                # (replay recompute bills on the NEXT replica — the
+                # device really does run those tokens twice)
+                try:
+                    leg = srv.abandon_cost(fr.request_id)
+                except Exception:  # noqa: BLE001 — a dying replica may
+                    leg = None     # refuse even the billing scrape
+                if leg is not None:
+                    fr.cost_legs.append(leg)
             self._failover(fr, partial, finished, cause=reason)
         # final observability capture BEFORE teardown: the dead
         # replica's last registry/trace state keeps serving from the
@@ -1438,6 +1568,9 @@ class ServingFrontend:
             partial = rep.server.reclaim(req.request_id)
             if partial is None:
                 continue
+            # reclaim leaves the leg's closed cost record harvestable
+            # (queue-wait and any prefill charges bill where they ran)
+            self._harvest_leg(rep, fr)
             fr.committed = list(partial)[len(fr.prompt):]
             fr.replica = None
             fr.prefill_only = False
@@ -1588,6 +1721,32 @@ class ServingFrontend:
                               for c in HOP_CAUSES},
         }
 
+    def cost(self, request_id: int) -> Optional[dict]:
+        """The merged cost record for a finished request — every
+        replica leg summed (docs/observability.md "Cost accounting &
+        capacity"). None when accounting is off or the id never
+        finished here."""
+        return self._costs.get(request_id)
+
+    def _capacity_snapshot(self) -> dict:
+        """``GET /debug/capacity`` payload (and ``stats["capacity"]``):
+        one row per live replica plus the pool rollup. Scrape-thread
+        safe — each row is the replica's own host-side snapshot, and a
+        replica mid-death that refuses the scrape is simply absent
+        (the rollup covers whoever answered)."""
+        rows = []
+        for rep in self.replicas:
+            if rep.health == DEAD:
+                continue
+            try:
+                row = rep.server.capacity_snapshot()
+            except Exception:  # noqa: BLE001 — a scrape never kills
+                continue
+            row["replica"] = rep.index
+            row["role"] = rep.role
+            rows.append(row)
+        return {"replicas": rows, "pool": rollup_capacity(rows)}
+
     @property
     def stats(self) -> dict:
         """Pool-level supervision stats. ``replicas`` carries one row
@@ -1602,5 +1761,12 @@ class ServingFrontend:
                 1 for r in self.replicas if r.health == DEAD),
             "fault_injection": (self._fi.snapshot()
                                 if self._fi is not None else None),
+            "capacity": self._capacity_snapshot(),
+            "accounting": {
+                "enabled": self._acct,
+                "requests_billed": len(self._costs),
+                "tenants": (self._tenants.snapshot()
+                            if self._tenants is not None else {}),
+            },
         })
         return snap

@@ -569,6 +569,13 @@ class HostKVTier:
         one pool block across all layers)."""
         return len(self._store) * self._block_nbytes
 
+    @property
+    def block_nbytes(self) -> int:
+        """Payload bytes of ONE tiered block (0 until the first put
+        teaches the tier its geometry) — the cost ledger prices
+        swap-in traffic with this (swap-ins x block_nbytes)."""
+        return self._block_nbytes
+
     def has(self, h: bytes) -> bool:
         return h in self._store
 

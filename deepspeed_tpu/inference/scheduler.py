@@ -62,6 +62,11 @@ class Request:
     # absolute deadline on the server's clock (None = no deadline);
     # expired requests are reaped, never admitted
     deadline_ts: Optional[float] = None
+    # tenant-metering label (telemetry/accounting.py): rides the request
+    # through preemption requeues untouched; None = unmetered. The
+    # scheduler never reads it — cardinality folding happens at the
+    # ledger, ordering stays priority-then-FIFO regardless of tenant.
+    tenant: Optional[str] = None
     # recompute-preemption state: tokens already generated before the
     # last preemption (re-admission prefills prompt + committed), how
     # often this request was preempted, and the decode-step clock tick

@@ -57,9 +57,10 @@ from deepspeed_tpu.inference.speculation import (LookupIndex,
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill, paged_prefill_chunk,
     paged_verify_step)
-from deepspeed_tpu.telemetry import (NULL_STEP_HANDLE, FaultInjector,
-                                     KVPoolAccountant, MetricRegistry,
-                                     PrefillFault, ProfilerCapture,
+from deepspeed_tpu.telemetry import (NULL_STEP_HANDLE, CapacityModel,
+                                     FaultInjector, KVPoolAccountant,
+                                     MetricRegistry, PrefillFault,
+                                     ProfilerCapture, RequestLedger,
                                      SLOMonitor, StepProfiler, Tracer,
                                      get_event_ring, get_registry,
                                      start_http_server, watched_jit)
@@ -269,13 +270,45 @@ class ContinuousBatchingServer:
                 source=profile_source)
             self._pool_acct = KVPoolAccountant(
                 registry=self.telemetry, clock=self._clock)
+        # request-level cost accounting + capacity model (telemetry/
+        # accounting.py, telemetry/capacity.py — docs/observability.md
+        # "Cost accounting & capacity"): the ledger splits each worked
+        # step's device-attributed wall across resident slots by tokens
+        # processed, so it arms only when the step profiler exists
+        # (device attribution without one would be fiction) AND
+        # accounting is enabled. OFF builds neither object, registers
+        # none of the serve_request_*_seconds / serve_tenant_* families,
+        # and leaves the serving loop byte-identical (every hook sits
+        # behind a None check).
+        self._ledger = None
+        self._capacity = None
+        acct_on = tcfg is None or tcfg.accounting.enabled
+        if self._profiler is not None and acct_on:
+            self._ledger = RequestLedger(
+                registry=self.telemetry, clock=self._clock,
+                max_tenants=(tcfg.accounting.max_tenants
+                             if tcfg is not None else 32),
+                source=profile_source)
+            # the closure tap: each worked step's device attribution
+            # settles across that step's per-request token weights the
+            # moment the profiler records it
+            self._profiler.on_step_device = self._ledger.settle_step
+            self._capacity = CapacityModel(
+                registry=self.telemetry, clock=self._clock,
+                window_s=(tcfg.accounting.window_s
+                          if tcfg is not None else 60.0),
+                eval_interval_s=(tcfg.accounting.eval_interval_s
+                                 if tcfg is not None else 5.0),
+                levels=self._capacity_levels,
+                goodput=self._capacity_goodput)
         self.http_server = None
         if (tcfg is not None and enabled and tcfg.http_port is not None
                 and not supervised):
             self.http_server = start_http_server(
                 tcfg.http_port, host=tcfg.http_host,
                 registry=self.telemetry, tracer=self.tracer,
-                goodput=self._goodput_snapshot)
+                goodput=self._goodput_snapshot,
+                capacity=self.capacity_snapshot)
         self.profiler_capture = ProfilerCapture()
         reg = self.telemetry
         self._h_queue_wait = reg.histogram(
@@ -653,6 +686,77 @@ class ContinuousBatchingServer:
                         else {"enabled": False}),
         }
 
+    def _capacity_levels(self):
+        """CapacityModel ``levels`` callable: ``(active_slots,
+        num_slots, free_blocks, usable_blocks)``. getattr-guarded for
+        the window between the HTTP listener opening and ``__init__``
+        building the scheduler — a scrape landing there reads an empty
+        server, not an AttributeError."""
+        sched = getattr(self, "scheduler", None)
+        if sched is None:
+            return (0, self.num_slots, 0, 0)
+        alloc = sched.allocator
+        return (sched.active_slots, self.num_slots,
+                alloc.free_blocks, alloc.usable_blocks)
+
+    def _capacity_goodput(self) -> Optional[float]:
+        """CapacityModel ``goodput`` callable: lifetime device/wall
+        fraction from the step observatory (None before any step —
+        the model reports the field as null rather than inventing 1.0
+        efficiency for an idle server)."""
+        p = self._profiler
+        if p is None:
+            return None
+        snap = p.snapshot()
+        return snap.get("goodput_fraction")
+
+    def capacity_snapshot(self) -> dict:
+        """``GET /debug/capacity`` payload (and ``stats["capacity"]``):
+        the live capacity model's latest row — windowed throughput,
+        occupancy levels, goodput-derived sustainable token rate, and
+        the admissible request rate at the current traffic mix. A
+        supervising frontend calls this per replica and rolls the rows
+        up with :func:`rollup_capacity`. Report-only: nothing in
+        admission or scheduling reads it."""
+        if self._capacity is None:
+            return {"enabled": False,
+                    "hint": "accounting disabled "
+                            "(telemetry.accounting.enabled / "
+                            "telemetry.step_profile)"}
+        return self._capacity.snapshot()
+
+    # ------------------------------------------------- cost accounting
+
+    def request_cost(self, request_id: int) -> Optional[dict]:
+        """The closed cost record for a finished request (docs/
+        observability.md "Cost accounting & capacity"): device-seconds,
+        KV block-seconds, queue wait, swap/handoff bytes, speculation
+        counts, token totals. None when accounting is off or the id is
+        unknown/still running. Non-destructive — the record stays until
+        ``forget``/``pop_request_cost`` drops it."""
+        if self._ledger is None:
+            return None
+        return self._ledger.cost(request_id)
+
+    def pop_request_cost(self, request_id: int) -> Optional[dict]:
+        """Harvest-and-drop a finished request's cost record — the
+        frontend's per-leg collection path (each replica leg becomes
+        one entry in the merged bill)."""
+        if self._ledger is None:
+            return None
+        return self._ledger.pop_cost(request_id)
+
+    def abandon_cost(self, request_id: int) -> Optional[dict]:
+        """Force-close and harvest the cost record of a request this
+        server will never finish — the supervising frontend declared
+        the replica dead mid-flight and is failing the request over.
+        The leg's charges so far still bill; recompute on the new
+        replica charges there (the device really runs it twice)."""
+        if self._ledger is None:
+            return None
+        self._ledger.abandon(request_id)
+        return self._ledger.pop_cost(request_id)
+
     def observability_state(self) -> dict:
         """One replica's complete observability export: registry state
         (``MetricRegistry.export_state`` — the mergeable accumulator
@@ -867,10 +971,18 @@ class ContinuousBatchingServer:
                request_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
                priority: int = 0,
-               trace_context: Optional[dict] = None) -> int:
+               trace_context: Optional[dict] = None,
+               tenant: Optional[str] = None) -> int:
         """Queue one request; returns its id. Raises when the request can
         never be scheduled (block span beyond a slot) or the queue is
         full — admission control instead of a silent deadlock.
+
+        ``tenant`` labels the request for per-tenant metering (docs/
+        observability.md "Cost accounting & capacity"): tokens, device
+        seconds, requests, and rejections accumulate under a bounded
+        label set (``telemetry.accounting.max_tenants``; overflow folds
+        to ``tenant="other"``). ``None`` — the default — is unmetered
+        and creates no series; scheduling NEVER reads the tenant.
 
         ``deadline_s`` bounds the request's WHOLE lifetime (queue wait
         included) on the server clock: an expired request is reaped with
@@ -889,7 +1001,7 @@ class ContinuousBatchingServer:
         floor = max(1, self.engine.config.min_out_tokens)
         rej = submit_rejection(prompt, max_new_tokens, floor, deadline_s)
         if rej is not None:
-            self._count_rejection(rej[0], request_id)
+            self._count_rejection(rej[0], request_id, tenant=tenant)
             raise ValueError(rej[1])
         if request_id is None:
             request_id = self._next_id
@@ -898,7 +1010,8 @@ class ContinuousBatchingServer:
                      for s in self.scheduler.slots.values())
               or any(r.request_id == request_id
                      for r in self.scheduler.queue)):
-            self._count_rejection("duplicate_id", request_id)
+            self._count_rejection("duplicate_id", request_id,
+                                  tenant=tenant)
             raise ValueError(
                 f"request_id {request_id} is already queued, resident, "
                 "or finished — a duplicate would silently overwrite its "
@@ -906,10 +1019,21 @@ class ContinuousBatchingServer:
         self._next_id = max(self._next_id, request_id) + 1
         now = self._clock()
         deadline_ts = None if deadline_s is None else now + deadline_s
-        self.scheduler.submit(Request(
-            request_id=request_id, prompt=list(prompt),
-            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
-            priority=priority, deadline_ts=deadline_ts))
+        try:
+            self.scheduler.submit(Request(
+                request_id=request_id, prompt=list(prompt),
+                max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+                priority=priority, deadline_ts=deadline_ts,
+                tenant=tenant))
+        except Exception:
+            # scheduler-side refusals (span/pool/queue_full) count into
+            # the same per-tenant rejection series as server-side ones
+            if self._ledger is not None:
+                self._ledger.tenants.count_rejection(tenant)
+            raise
+        if self._ledger is not None:
+            self._ledger.open(request_id, tokens_in=len(prompt),
+                              tenant=tenant)
         self._submit_ts[request_id] = now
         self._queued_ts[request_id] = now
         if deadline_ts is not None:
@@ -937,13 +1061,16 @@ class ContinuousBatchingServer:
         return request_id
 
     def _count_rejection(self, reason: str,
-                         request_id: Optional[int] = None) -> None:
+                         request_id: Optional[int] = None,
+                         tenant: Optional[str] = None) -> None:
         """Server-side refusals; the scheduler counts its own (span/pool/
         queue_full) into the same family — one admission-failure metric."""
         self.telemetry.counter(
             "serve_admission_rejections_total",
             help="refused submit() calls, by reason",
             labels={"reason": reason}).inc()
+        if self._ledger is not None:
+            self._ledger.tenants.count_rejection(tenant)
         get_event_ring().record(telemetry_events.ADMISSION_REJECT,
                                 reason=reason, source="server")
         if self.tracer is not None:
@@ -995,6 +1122,10 @@ class ContinuousBatchingServer:
         device-side slot state — in that order (the chunk job reads the
         block table; the array reset assumes the slot is off the
         scheduler's books)."""
+        if self._ledger is not None:
+            state = self.scheduler.slots.get(slot)
+            if state is not None:
+                self._ledger.close_residency(state.request.request_id)
         self._drop_prefill_job(slot)
         self.scheduler.release(slot)
         self._reset_slot_arrays(slot)
@@ -1015,6 +1146,13 @@ class ContinuousBatchingServer:
         self._submit_ts.pop(rid, None)
         self._queued_ts.pop(rid, None)
         self._deadlines.pop(rid, None)
+        if self._ledger is not None:
+            # closes the record (and any still-open KV residency); the
+            # finishing step's own device share still lands on it via
+            # the pending-close window before it emits
+            self._ledger.finish(
+                rid, tokens_out=max(len(tokens) - len(req.prompt), 0),
+                reason=reason)
         if self._pool_acct is not None:
             # high-water pool blocks across the request's residencies
             # (zero = never admitted; skipped inside the accountant)
@@ -1098,6 +1236,8 @@ class ContinuousBatchingServer:
             return None
         out = self._results.pop(request_id)
         self.finish_reasons.pop(request_id, None)
+        # the cost record stays harvestable (pop_request_cost) — the
+        # reclaiming frontend folds it into the request's merged bill
         return out
 
     def forget(self, request_id: int) -> None:
@@ -1111,6 +1251,11 @@ class ContinuousBatchingServer:
         for work that FINISHED its leg instead of being taken away)."""
         self._results.pop(request_id, None)
         self.finish_reasons.pop(request_id, None)
+        if self._ledger is not None:
+            # harvest-or-drop the leg's cost record too: a frontend
+            # pops it BEFORE forgetting; anything left would shadow the
+            # id's next leg on this server
+            self._ledger.pop_cost(request_id)
 
     def _fail_request(self, req: Request, tokens: List[int],
                       error: str, finished: Optional[list]) -> None:
@@ -1228,6 +1373,12 @@ class ContinuousBatchingServer:
                 rt.prefill.set("preempted", True)
                 rt.trace.end_span(rt.prefill)
             rt.prefill = None
+        if self._ledger is not None:
+            # residency pauses while the request waits off-pool; the
+            # record stays OPEN — re-admission reopens it, and the
+            # recompute prefill is charged like any other work (the
+            # device really ran it)
+            self._ledger.close_residency(req.request_id)
         self.scheduler.preempt(slot, self._tick,
                                self._backoff_steps,
                                register_extension=not mid)
@@ -1283,6 +1434,8 @@ class ContinuousBatchingServer:
         so a long prompt never stalls the resident decoders."""
         while True:
             now = self._clock() if self._deadlines else None
+            swaps0 = (self.scheduler.allocator.swap_ins
+                      if self._ledger is not None else 0)
             adm = self.scheduler.admit_next(self._tick, now=now)
             if adm is None:
                 return
@@ -1294,6 +1447,23 @@ class ContinuousBatchingServer:
                 self._h_queue_wait.observe(
                     t_admit - self._submit_ts.get(req.request_id,
                                                   t_admit))
+            if self._ledger is not None:
+                # queue-wait charges EVERY admission (a preempted
+                # request's requeue wait is real queueing, reset at the
+                # preempt); block residency opens against the slot's
+                # full allocated span — blocks are claimed up-front, so
+                # the count is fixed for the whole residency
+                self._ledger.note_queued(
+                    req.request_id,
+                    t_admit - self._queued_ts.get(req.request_id,
+                                                  t_admit))
+                self._ledger.open_residency(
+                    req.request_id, len(state.blocks), now=t_admit)
+                d_swaps = self.scheduler.allocator.swap_ins - swaps0
+                if d_swaps and self.host_tier is not None:
+                    self._ledger.note_swap_in_bytes(
+                        req.request_id,
+                        d_swaps * self.host_tier.block_nbytes)
             rt = (self._rt.get(req.request_id)
                   if self.tracer is not None else None)
             adm_span = None
@@ -1371,6 +1541,10 @@ class ContinuousBatchingServer:
                 jnp.int32(slot))
             self._prefills += 1
             self._prefill_token_units += T
+            if self._ledger is not None:
+                # weight = the PADDED bucket actually computed, so the
+                # step's device split follows the work the device did
+                self._ledger.add_weight(req.request_id, T)
             tok0 = int(np.asarray(tok0)[0])   # host sync: prefill done
             now_t = self._clock()
             # prefill compute runs inside the admission phase; its
@@ -1446,6 +1620,8 @@ class ContinuousBatchingServer:
             jnp.asarray([plen], jnp.int32), self._cache, jnp.int32(slot))
         self._prefill_chunks += 1
         self._prefill_token_units += C
+        if self._ledger is not None:
+            self._ledger.add_weight(req.request_id, C)
         job["start"] = start + C
         if job["start"] < plen:
             # NON-final chunk: its logits are chunk-tail garbage the
@@ -1550,6 +1726,12 @@ class ContinuousBatchingServer:
         self._deadlines.pop(req.request_id, None)
         if ts is not None:
             self._h_request.observe(self._clock() - ts)
+        if self._ledger is not None:
+            # moves the record to pending-close: the retiring step's
+            # own device share still settles onto it before it emits
+            self._ledger.finish(req.request_id,
+                                tokens_out=len(state.generated),
+                                reason=reason)
         if self._pool_acct is not None:
             self._pool_acct.observe_request_peak(req.peak_blocks)
         self._c_finished.inc()
@@ -1654,6 +1836,8 @@ class ContinuousBatchingServer:
             # with shedding armed, _maybe_shed already refreshed the
             # monitor this step — don't pay a second registry snapshot
             self.slo.maybe_evaluate()
+        if self._capacity is not None:
+            self._capacity.maybe_evaluate()
         sp.mark("publish")
         # live=False when this step retired the last resident: the gap
         # to the NEXT dispatch would measure traffic, not host tax
@@ -1710,6 +1894,8 @@ class ContinuousBatchingServer:
             self._pipelined_decode(finished, sp)
         if self.slo is not None and not self._shedding:
             self.slo.maybe_evaluate()
+        if self._capacity is not None:
+            self._capacity.maybe_evaluate()
         sp.mark("publish")
         sp.finish(live=bool(self.scheduler.slots))
         return finished
@@ -1993,6 +2179,10 @@ class ContinuousBatchingServer:
             committed_total += n_committed
             per_slot_commits.append(n_committed)
             adv[slot] = n_committed
+            if self._ledger is not None:
+                rid_ = state.request.request_id
+                self._ledger.add_weight(rid_, n_committed)
+                self._ledger.note_spec(rid_, K - 1, m)
             if done:
                 retire.append(slot)
             else:
@@ -2169,6 +2359,8 @@ class ContinuousBatchingServer:
         cannot drift between the paths (the byte-identical
         sync-fallback oracle depends on exactly this)."""
         state.generated.append(tok)
+        if self._ledger is not None:
+            self._ledger.add_weight(state.request.request_id, 1)
         if self.tracer is not None:
             rt = self._rt.get(state.request.request_id)
             if rt is not None and rt.decode is not None:
@@ -2283,6 +2475,10 @@ class ContinuousBatchingServer:
             # retiring slot's lengths are reset right below, so its
             # adv value never matters.
             adv[slot] = n_committed
+            if self._ledger is not None:
+                rid_ = state.request.request_id
+                self._ledger.add_weight(rid_, n_committed)
+                self._ledger.note_spec(rid_, K - 1, m)
             if done:
                 retire.append(slot)
             else:
@@ -2381,6 +2577,11 @@ class ContinuousBatchingServer:
         # it and drain the publish worker, so a drained server has no
         # device work outstanding and fully-published metrics
         self._flush_pipeline(self._deferred_finished, reason="drain")
+        if self._ledger is not None:
+            # drained = no further worked step is coming: emit every
+            # pending-close cost record NOW so the histograms/ring a
+            # post-drain reader scrapes are complete
+            self._ledger.flush_pending()
         return dict(self._results)
 
     def dump_timeline(self, path: str) -> int:
@@ -2435,6 +2636,8 @@ class ContinuousBatchingServer:
         # drain() must not silently drop a pipelined step's committed
         # tokens, finishes, or metrics
         self._flush_pipeline(self._deferred_finished, reason="close")
+        if self._ledger is not None:
+            self._ledger.flush_pending()
         self._worker.close()
         self._flight.close()
 
@@ -2564,4 +2767,11 @@ class ContinuousBatchingServer:
                             if self.tracer is not None else 0),
             "slo_compliance": (self.slo.compliance_ratio
                                if self.slo is not None else None),
+            # request-level cost accounting + live capacity model
+            # (docs/observability.md "Cost accounting & capacity");
+            # None = accounting off (report-only either way)
+            "accounting": (self._ledger.snapshot()
+                           if self._ledger is not None else None),
+            "capacity": (self._capacity.snapshot()
+                         if self._capacity is not None else None),
         }

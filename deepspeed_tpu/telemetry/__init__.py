@@ -16,13 +16,19 @@ Everything here is host-pure except ``capture``'s default hooks; no
 module imports jax at import time, so the registry is usable from config
 parsing and test collection alike.
 """
+from deepspeed_tpu.telemetry.accounting import (RequestLedger, TenantMeter,
+                                                merge_cost_legs,
+                                                new_cost_record,
+                                                register_cost_histograms)
+from deepspeed_tpu.telemetry.capacity import CapacityModel, rollup_capacity
 from deepspeed_tpu.telemetry.capture import ProfilerCapture
 from deepspeed_tpu.telemetry.compile_watch import (WatchedFunction,
                                                    all_watched,
                                                    compile_report,
                                                    executable_cost,
                                                    watched_jit)
-from deepspeed_tpu.telemetry.config import (FaultInjectionConfig,
+from deepspeed_tpu.telemetry.config import (AccountingConfig,
+                                            FaultInjectionConfig,
                                             SLOConfig, TelemetryConfig)
 from deepspeed_tpu.telemetry.events import (EventRing, dump_ring,
                                             get_event_ring,
@@ -87,4 +93,8 @@ __all__ = [
     "ReplicaKilled",
     # serving step observatory + KV-pool accounting
     "StepProfiler", "NULL_STEP_HANDLE", "KVPoolAccountant",
+    # request-level cost accounting + tenant metering + capacity model
+    "RequestLedger", "TenantMeter", "merge_cost_legs",
+    "new_cost_record", "register_cost_histograms",
+    "CapacityModel", "rollup_capacity", "AccountingConfig",
 ]

@@ -125,6 +125,55 @@ class FaultInjectionConfig(DeepSpeedConfigModel):
         return v
 
 
+class AccountingConfig(DeepSpeedConfigModel):
+    """Request-level cost accounting + live capacity model
+    (telemetry/accounting.py, telemetry/capacity.py — see
+    docs/observability.md "Cost accounting & capacity"). ON by default
+    like the step observatory it reads from: the per-step cost is a
+    dict update per resident slot, no device syncs, and the ledger only
+    arms when the step profiler exists (``telemetry.step_profile``) —
+    device attribution without a profiler would be fiction. OFF builds
+    neither the ledger nor the capacity model, registers none of the
+    serve_request_*_seconds / serve_tenant_* families, and leaves the
+    served tokens byte-identical."""
+    enabled: bool = True
+    # bounded tenant-label cardinality: the first max_tenants distinct
+    # tenant strings keep their label; later ones fold into
+    # tenant="other" so a hostile/mistaken client cannot explode the
+    # registry (PR 17's fleet federation multiplies every label by the
+    # replica count)
+    max_tenants: int = 32
+    # capacity model: sliding-window span the windowed rates are
+    # computed over, and the re-evaluation cadence (0 = every step)
+    window_s: float = 60.0
+    eval_interval_s: float = 5.0
+
+    @field_validator("max_tenants")
+    @classmethod
+    def _valid_tenants(cls, v):
+        if v < 1:
+            raise ValueError(
+                f"max_tenants must be >= 1 (overflow folds into "
+                f"tenant=\"other\"), got {v}")
+        return v
+
+    @field_validator("window_s")
+    @classmethod
+    def _positive_window(cls, v):
+        if v <= 0:
+            raise ValueError(
+                f"window_s must be > 0 seconds, got {v}")
+        return v
+
+    @field_validator("eval_interval_s")
+    @classmethod
+    def _valid_interval(cls, v):
+        if v < 0:
+            raise ValueError(
+                f"eval_interval_s must be >= 0 (0 = every step), got {v}")
+        return v
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """Registry recording is on by default (dict-lookup + float-add cost);
     the HTTP scrape endpoint is OFF by default and opens only when a port
@@ -206,6 +255,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # chaos hooks (telemetry/faultinject.py) — see FaultInjectionConfig
     fault_injection: FaultInjectionConfig = Field(
         default_factory=FaultInjectionConfig)
+    # request-level cost accounting + capacity model
+    # (telemetry/accounting.py, telemetry/capacity.py) — see the
+    # AccountingConfig schema
+    accounting: AccountingConfig = Field(default_factory=AccountingConfig)
 
     @field_validator("http_port")
     @classmethod

@@ -98,6 +98,12 @@ KV_HANDOFF = "kv_handoff"
 # so the pool is undersized for the working set; one event per
 # episode, re-armed when the rate recovers
 KV_SWAP_THRASH = "kv_swap_thrash"
+# request-level cost accounting (docs/observability.md "Cost accounting
+# & capacity"): one entry per finished request carrying its closed
+# ledger — device-seconds, KV block-seconds, queue wait, swapped/handoff
+# bytes, speculation counts, tenant — the forensic twin of the
+# serve_request_* cost histograms
+REQUEST_COST = "request_cost"
 
 
 class EventRing:

@@ -53,6 +53,11 @@ ROUTES = {
     "/debug/resilience": "training-supervisor restart/recovery state + "
                          "checkpoint-integrity report "
                          "(runtime/resilience.py TrainingSupervisor)",
+    "/debug/capacity": "live capacity model — windowed throughput, "
+                       "slot/block occupancy, goodput-derived "
+                       "sustainable rate, admissible request rate at "
+                       "the current mix; pool rollup beside per-replica "
+                       "rows on a frontend (telemetry/capacity.py)",
 }
 
 
@@ -71,7 +76,7 @@ class TelemetryHTTPServer:
                  registry: Optional[MetricRegistry] = None,
                  event_ring=None, memory=None, tracer=None,
                  goodput=None, replicas=None, resilience=None,
-                 fleet=None, metrics_view=None,
+                 fleet=None, metrics_view=None, capacity=None,
                  handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S):
         if handler_timeout_s is not None and handler_timeout_s <= 0:
             raise ValueError(
@@ -188,6 +193,20 @@ class TelemetryHTTPServer:
                                         "'Fleet observability')"})
                     body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
+                elif path == "/debug/capacity":
+                    # ``capacity`` is the owner's zero-arg snapshot
+                    # callable (a server's CapacityModel row, or a
+                    # ServingFrontend's per-replica rows + pool
+                    # rollup); an endpoint armed without one still
+                    # answers self-describingly
+                    payload = (capacity() if capacity is not None else
+                               {"enabled": False,
+                                "hint": "owner armed no capacity model "
+                                        "(telemetry.accounting — "
+                                        "docs/observability.md 'Cost "
+                                        "accounting & capacity')"})
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(
                         404, "unknown path (try " +
@@ -243,7 +262,7 @@ def start_http_server(port: int, host: str = "127.0.0.1",
                       registry: Optional[MetricRegistry] = None,
                       event_ring=None, memory=None, tracer=None,
                       goodput=None, replicas=None, resilience=None,
-                      fleet=None, metrics_view=None,
+                      fleet=None, metrics_view=None, capacity=None,
                       handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
@@ -252,4 +271,5 @@ def start_http_server(port: int, host: str = "127.0.0.1",
                                tracer=tracer, goodput=goodput,
                                replicas=replicas, resilience=resilience,
                                fleet=fleet, metrics_view=metrics_view,
+                               capacity=capacity,
                                handler_timeout_s=handler_timeout_s)

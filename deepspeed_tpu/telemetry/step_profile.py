@@ -317,6 +317,13 @@ class StepProfiler:
         # routing reads per admission (recomputing a histogram quantile
         # per routing decision would not be)
         self._recent_gaps: Deque[float] = deque(maxlen=32)
+        # cost-accounting tap (telemetry/accounting.py RequestLedger):
+        # called with each WORKED step's device-attributed seconds,
+        # right after they enter device_total — the ledger splits
+        # exactly what the profiler recorded, so per-request
+        # device-seconds sum to the profiler's device total by
+        # construction. None (default) costs one attribute read.
+        self.on_step_device: Optional[Callable[[float], None]] = None
         self._handle = _StepHandle(self)
         reg = self.registry
         self._h_wall = reg.histogram(
@@ -430,6 +437,8 @@ class StepProfiler:
             fraction = (self.device_total / self.wall_total
                         if self.wall_total > 0 else 0.0)
             step_no = self.steps
+        if self.on_step_device is not None:
+            self.on_step_device(handle.device)
         self._h_wall.observe(wall)
         for phase, dt in handle.acc.items():
             self._phase_h(phase).observe(dt)

@@ -15,6 +15,53 @@ ContinuousBatchingServer — docs/serving.md "Continuous batching"):
 import argparse
 
 
+def _tenant_cycle(args):
+    if not getattr(args, "tenants", None):
+        return None
+    return [t.strip() for t in args.tenants.split(",") if t.strip()] \
+        or None
+
+
+def _print_cost(st):
+    """Cost ledger + per-tenant metering table (docs/observability.md
+    "Cost accounting & capacity") — works off either a server's stats
+    (ledger snapshot) or a frontend's (merged-bill view)."""
+    acct = st.get("accounting")
+    if not acct or not acct.get("enabled"):
+        return
+    billed = acct.get("closed_records", acct.get("requests_billed", 0))
+    head = f"cost ledger: {billed} bills"
+    if acct.get("device_s_total") is not None:
+        head += (f", {acct['device_s_total']:.3f} device-s attributed "
+                 f"(unattributed carry "
+                 f"{acct['residual_carry_s']:.2e} s)")
+    print(head)
+    ten = acct.get("tenants") or {}
+    if ten:
+        print(f"  {'tenant':<14}{'requests':>9}{'tok_in':>8}"
+              f"{'tok_out':>9}{'device_s':>10}{'rejected':>9}")
+        for name in sorted(ten):
+            row = ten[name]
+            dev = row.get("serve_tenant_device_seconds_total", 0.0)
+            print(
+                f"  {name:<14}"
+                f"{int(row.get('serve_tenant_requests_total', 0)):>9}"
+                f"{int(row.get('serve_tenant_tokens_in_total', 0)):>8}"
+                f"{int(row.get('serve_tenant_tokens_out_total', 0)):>9}"
+                f"{dev:>10.3f}"
+                f"{int(row.get('serve_tenant_rejections_total', 0)):>9}")
+    cap = st.get("capacity") or {}
+    cap = cap.get("pool", cap)      # frontend nests the rollup
+    if cap.get("enabled"):
+        tps = cap.get("tokens_per_s")
+        adm = cap.get("admissible_requests_per_s")
+        print(f"capacity: occupancy {cap.get('slot_occupancy')}, "
+              f"block utilization {cap.get('block_utilization')}, "
+              f"{'-' if tps is None else round(tps, 1)} tok/s in "
+              f"window, admissible "
+              f"{'-' if adm is None else round(adm, 2)} req/s")
+
+
 def run_replicated(eng, prompt, args):
     """Drive a --replicas N pool end-to-end through the ServingFrontend
     (docs/serving.md "Replicated serving & failover"): staggered
@@ -32,6 +79,7 @@ def run_replicated(eng, prompt, args):
         fi = FaultInjector(seed=0, wedge_nth_request=5,
                            prefill_failure_rate=0.1, replica_kill_step=6)
     front = ServingFrontend(eng, fault_injector=fi)
+    tenants = _tenant_cycle(args)
     ids = []
     for i in range(args.continuous):
         if args.roles:
@@ -45,7 +93,9 @@ def run_replicated(eng, prompt, args):
         ids.append(front.submit(p, max_new_tokens=2 + args.max_new_tokens
                                 * (i % 3) // 2,
                                 deadline_s=args.deadline_s,
-                                priority=i % 2 if args.chaos else 0))
+                                priority=i % 2 if args.chaos else 0,
+                                tenant=(tenants[i % len(tenants)]
+                                        if tenants else None)))
         front.step()
     out = front.drain(timeout_s=60.0 if args.chaos else None)
     for rid in ids:
@@ -85,6 +135,7 @@ def run_replicated(eng, prompt, args):
     print(f"  fleet: stitching {'on' if st['stitching'] else 'off'}, "
           f"hops " + ", ".join(f"{c}={n}" for c, n in hops.items()
                                if n or c == "submit"))
+    _print_cost(st)
     if args.trace_dump and st["stitching"]:
         path = args.trace_dump + ".fleet.json"
         n = front.dump_timeline(path)
@@ -113,6 +164,7 @@ def run_continuous(eng, prompt, args):
         fi = FaultInjector(seed=0, wedge_nth_request=5,
                            prefill_failure_rate=0.1)
     srv = ContinuousBatchingServer(eng, fault_injector=fi)
+    tenants = _tenant_cycle(args)
     ids = []
     for i in range(args.continuous):
         if srv.prefix_caching:
@@ -128,7 +180,9 @@ def run_continuous(eng, prompt, args):
         ids.append(srv.submit(p, max_new_tokens=2 + args.max_new_tokens
                               * (i % 3) // 2,
                               deadline_s=args.deadline_s,
-                              priority=i % 2 if args.chaos else 0))
+                              priority=i % 2 if args.chaos else 0,
+                              tenant=(tenants[i % len(tenants)]
+                                      if tenants else None)))
         srv.step()   # arrivals interleave with decoding
     # chaos mode needs the bounded drain — a wedged slot would spin the
     # unbounded loop forever (docs/serving.md "Request lifecycle")
@@ -196,6 +250,7 @@ def run_continuous(eng, prompt, args):
               f"{sp['acceptance_rate']}, {sp['committed_tokens']} "
               f"tokens over {sp['verify_steps']} verify steps, "
               f"{sp['verify_traces']} trace(s)")
+    _print_cost(st)
     # registry view of the same run (docs/observability.md)
     snap = srv.telemetry.snapshot()
     for h in ("serve_ttft_seconds", "serve_queue_wait_seconds",
@@ -337,6 +392,14 @@ def main():
                          "(telemetry/faultinject.py) — watch the "
                          "lifecycle layer degrade gracefully under a "
                          "bounded drain (continuous mode)")
+    ap.add_argument("--tenants", default=None, metavar="T1,T2,...",
+                    help="cycle requests across these tenant labels "
+                         "(continuous mode, plain or replicated) and "
+                         "print the per-tenant metering table after "
+                         "the drain — requests, tokens in/out, ledger-"
+                         "attributed device-seconds, rejections "
+                         "(docs/observability.md 'Cost accounting & "
+                         "capacity')")
     ap.add_argument("--slo", action="store_true",
                     help="arm default SLO gates (TTFT p90 1s, per-token "
                          "p50 100ms, queue-wait p90 1s, error rate 5%%) "

@@ -84,6 +84,12 @@ METRICS = {
     # replica labels. A regression means the fleet view got too
     # expensive to sit on a Prometheus scrape path
     "fleet_obs.scrape_p90_ms": "down",
+    # request-level cost accounting (docs/observability.md "Cost
+    # accounting & capacity"): ledger-attributed device-seconds per
+    # 1k generated tokens on the replay — the unit-cost number the
+    # ledger exists to produce. A regression means serving got more
+    # expensive per token (or attribution started over-charging)
+    "cost.device_seconds_per_1k_tokens": "down",
 }
 
 # same contract against the newest TRAIN phase record carrying a

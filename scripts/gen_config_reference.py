@@ -242,6 +242,16 @@ def generate() -> str:
                note=("See docs/observability.md \"Request tracing & "
                      "SLOs\" for the evaluation semantics and metric "
                      "names."))
+    from deepspeed_tpu.telemetry.config import AccountingConfig
+    emit_model(buf, "telemetry.accounting", AccountingConfig,
+               note=("Request-level cost accounting, tenant metering, "
+                     "and the live capacity model — see "
+                     "docs/observability.md \"Cost accounting & "
+                     "capacity\". The ledger arms only when the step "
+                     "profiler is on (`telemetry.step_profile`); "
+                     "disabled accounting is byte-identical and "
+                     "registers no `serve_request_*`/`serve_tenant_*` "
+                     "families."))
 
     from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
                                                 ReplicationConfig)

@@ -1,0 +1,418 @@
+"""Request-level cost accounting, tenant metering, capacity (ISSUE 18).
+
+The ledger must CLOSE: the sum of per-request device-seconds equals the
+step profiler's device-attributed wall exactly (fake clock — the last
+participant of every settle absorbs the float dust), across the mixed
+workload that exercises every attribution path: chunked prefill,
+speculation's verify commits, recompute preemption, and the
+prefill→decode handoff. The other pins:
+
+* accounting OFF is byte-identical — same greedy tokens, same
+  executable counts (zero new traces), no serve_request_*/serve_tenant_*
+  families registered;
+* tenant labels are bounded-cardinality: the first ``max_tenants``
+  distinct names keep themselves, later ones fold into ``"other"``;
+* a request that was preempted AND failed over AND handed off ends
+  with ONE merged cost record covering every leg — no double-charge,
+  no lost leg;
+* ``GET /debug/capacity`` serves valid JSON whose pool row equals
+  ``rollup_capacity`` of the per-replica rows (pure-function pin);
+* the exporter's ROUTES table, its 404 body, and
+  docs/observability.md agree on the endpoint surface.
+"""
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference import (ContinuousBatchingServer,
+                                     DeepSpeedInferenceConfig,
+                                     InferenceEngine, ServingFrontend)
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, init_params)
+from deepspeed_tpu.telemetry import (EventRing, FaultInjector,
+                                     MetricRegistry, RequestLedger,
+                                     TenantMeter, get_event_ring,
+                                     get_registry, merge_cost_legs,
+                                     rollup_capacity, set_event_ring,
+                                     set_registry)
+from deepspeed_tpu.telemetry import events as ev
+from deepspeed_tpu.telemetry.exporter import ROUTES
+
+
+@pytest.fixture()
+def fresh_telemetry():
+    prev_reg = set_registry(MetricRegistry())
+    prev_ring = set_event_ring(EventRing(512))
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev_reg)
+        set_event_ring(prev_ring)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0, auto: float = 0.0):
+        self.t = t
+        self.auto = auto
+
+    def __call__(self) -> float:
+        v = self.t
+        self.t += self.auto
+        return v
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+_MCFG = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+             n_head=4, dtype=jnp.float32)
+BS = 32
+
+
+def make_engine(seed=0, num_slots=2, roles=None, replicas=None,
+                repl_knobs=None, **knobs):
+    cfg = InferenceTransformerConfig(**_MCFG)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    extra = {}
+    if roles is not None or replicas is not None:
+        repl = {"replicas": (len(roles) if roles and replicas is None
+                             else (replicas or 1)), "roles": roles}
+        repl.update(repl_knobs or {})
+        extra["replication"] = repl
+    return InferenceEngine((cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=256, block_size=BS,
+        num_slots=num_slots, **extra, **knobs))
+
+
+def harvest_all(srv, ids):
+    recs = [srv.request_cost(r) for r in ids]
+    assert all(r is not None for r in recs), recs
+    return recs
+
+
+def assert_closed(srv, ids):
+    """THE closure invariant: per-request device-seconds sum to the
+    profiler's device-attributed wall, exactly (fake clock)."""
+    recs = harvest_all(srv, ids)
+    prof = srv.stats["step_profile"]
+    total = sum(r["device_s"] for r in recs)
+    assert total == pytest.approx(prof["device_s"], abs=1e-9), \
+        (total, prof["device_s"])
+    acct = srv.stats["accounting"]
+    assert acct["residual_carry_s"] == pytest.approx(0.0, abs=1e-12)
+    assert acct["device_s_total"] == pytest.approx(prof["device_s"],
+                                                   abs=1e-9)
+    return recs
+
+
+# ------------------------------------------------------ ledger closure
+
+def test_ledger_unit_closure_and_fallbacks(fresh_telemetry):
+    """Pure-unit settlement semantics: proportional split is
+    remainder-corrected (exact), finish keeps the record reachable for
+    its own step's settle, an empty-weight settle falls back to open
+    records, and a truly unattributable settle carries forward."""
+    clk = FakeClock(auto=0.0)
+    led = RequestLedger(registry=fresh_telemetry, clock=clk)
+    led.open(1, tokens_in=4)
+    led.open(2, tokens_in=2)
+    led.open_residency(1, blocks=3, now=0.0)
+    led.add_weight(1, 32.0)
+    led.add_weight(2, 1.0)
+    led.settle_step(0.99)                    # split 32:1, exact
+    led.add_weight(1, 1.0)
+    clk.t = 2.0
+    led.finish(1, tokens_out=5, reason="eos")    # closes residency @2.0
+    led.settle_step(0.01)                    # finishing step's settle
+    rec1 = led.cost(1)
+    assert rec1["kv_block_s"] == pytest.approx(6.0)      # 3 blocks * 2s
+    assert rec1["finish_reason"] == "eos" and rec1["tokens_out"] == 5
+    # empty-weight settle lands on the remaining OPEN record
+    led.settle_step(0.5)
+    led.finish(2, tokens_out=1, reason="length")
+    led.flush_pending()
+    rec2 = led.cost(2)
+    total = rec1["device_s"] + rec2["device_s"]
+    assert total == pytest.approx(1.5, abs=1e-12)
+    assert led.device_s_total == pytest.approx(1.5, abs=1e-12)
+    # nothing account-able left: device time carries, not vanishes
+    led.pop_cost(1), led.pop_cost(2)
+    led.settle_step(0.25)
+    assert led.snapshot()["residual_carry_s"] == pytest.approx(0.25)
+
+
+def test_closure_chunked_prefill_and_preemption(fresh_telemetry):
+    """Integration closure over chunked prefill + recompute preemption:
+    every worked step's device attribution lands on exactly the
+    resident requests, including the victim's recompute replay."""
+    eng = make_engine(num_slots=1, enable_prefix_caching=True,
+                      prefill_chunk_tokens=BS)
+    srv = ContinuousBatchingServer(eng, clock=FakeClock(auto=1e-4))
+    prompt = [1 + (i % 100) for i in range(40)]        # > one block
+    a = srv.submit(prompt, max_new_tokens=10, priority=0)
+    for _ in range(6):
+        srv.step()
+    b = srv.submit([4, 5, 6], max_new_tokens=4, priority=5)  # preempts a
+    out = srv.drain()
+    assert srv.stats["preempted"] == 1
+    assert out[a] == eng.generate([prompt], max_new_tokens=10)[0]
+    recs = assert_closed(srv, [a, b])
+    ra = recs[0]
+    assert ra["legs"] == 1                    # one server = one leg
+    assert ra["device_s"] > recs[1]["device_s"]   # 50 tokens vs 7
+    assert ra["kv_block_s"] > 0 and ra["queued_s"] >= 0
+    assert ra["tokens_in"] == len(prompt) and ra["tokens_out"] == 10
+    # the ring carries one request_cost event per finish
+    costs = [e for e in get_event_ring().snapshot()
+             if e["kind"] == ev.REQUEST_COST]
+    assert {e["data"]["request_id"] for e in costs} == {a, b}
+    srv.close()
+
+
+def test_closure_speculation_charges_proposals(fresh_telemetry):
+    """Closure holds through the verify path, and the ledger sees the
+    speculation economics: proposals >= acceptances, accepted tokens
+    weigh into the device split."""
+    eng = make_engine(seed=2, speculation_tokens=4)
+    srv = ContinuousBatchingServer(eng, clock=FakeClock(auto=1e-4))
+    prompts = [[1, 2, 3, 1, 2, 3, 1, 2], [7, 8, 7, 8, 7, 8]]
+    ids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+    out = srv.drain()
+    for rid, p in zip(ids, prompts):
+        assert out[rid] == eng.generate([p], max_new_tokens=8)[0]
+    recs = assert_closed(srv, ids)
+    assert sum(r["spec_proposed"] for r in recs) > 0
+    for r in recs:
+        assert r["spec_accepted"] <= r["spec_proposed"]
+    srv.close()
+
+
+# ---------------------------------------------------- OFF byte-identity
+
+def test_accounting_off_byte_identical(fresh_telemetry):
+    """The OFF oracle: same greedy tokens, same executable counts, no
+    ledger families registered — accounting must be observability,
+    never behavior."""
+    prompts = [[1, 2, 3, 4], [7, 8], [5, 6, 7, 8, 9, 10]]
+    eng_on = make_engine()
+    srv_on = ContinuousBatchingServer(eng_on)
+    ids_on = [srv_on.submit(p, max_new_tokens=6) for p in prompts]
+    out_on = srv_on.drain()
+    on_traces = (srv_on.stats["decode_traces"],
+                 srv_on.stats["prefill_traces"])
+    srv_on.close()
+    reg_off = MetricRegistry()
+    eng_off = make_engine(telemetry={"accounting": {"enabled": False}})
+    srv_off = ContinuousBatchingServer(eng_off, registry=reg_off)
+    ids_off = [srv_off.submit(p, max_new_tokens=6) for p in prompts]
+    out_off = srv_off.drain()
+    assert [out_on[i] for i in ids_on] == [out_off[i] for i in ids_off]
+    assert (srv_off.stats["decode_traces"],
+            srv_off.stats["prefill_traces"]) == on_traces
+    assert srv_off.stats["accounting"] is None
+    assert srv_off.stats["capacity"] is None
+    assert srv_off.request_cost(ids_off[0]) is None
+    assert srv_off.capacity_snapshot()["enabled"] is False
+    snap = reg_off.snapshot()
+    assert not any(
+        n.startswith("serve_tenant_")
+        or n in ("serve_request_device_seconds",
+                 "serve_request_kv_block_seconds",
+                 "serve_request_queued_seconds")
+        for n in snap)
+    srv_off.close()
+
+
+# ------------------------------------------------------ tenant metering
+
+def test_tenant_meter_topk_fold(fresh_telemetry):
+    m = TenantMeter(registry=fresh_telemetry, max_tenants=2)
+    assert m.fold("a") == "a" and m.fold("b") == "b"
+    assert m.fold("c") == "other" and m.fold("d") == "other"
+    assert m.fold("a") == "a"          # established names stay stable
+    assert m.fold(None) is None        # unmetered: no series at all
+    m.count_rejection(None)
+    assert m.snapshot() == {}
+
+
+def test_server_tenant_series_and_device_split(fresh_telemetry):
+    """Per-tenant counters on the server registry: requests/tokens by
+    tenant, device-seconds summing to the ledger total when every
+    request carries a tenant, overflow folding live."""
+    eng = make_engine(telemetry={"accounting": {"max_tenants": 2}})
+    srv = ContinuousBatchingServer(eng, clock=FakeClock(auto=1e-4))
+    ids = [srv.submit([1 + i, 2, 3], max_new_tokens=4, tenant=t)
+           for i, t in enumerate(["acme", "beta", "acme", "zeta"])]
+    srv.drain()
+    ten = srv.stats["accounting"]["tenants"]
+    assert set(ten) == {"acme", "beta", "other"}     # zeta folded
+    assert ten["acme"]["serve_tenant_requests_total"] == 2
+    assert ten["acme"]["serve_tenant_tokens_in_total"] == 6
+    assert ten["acme"]["serve_tenant_tokens_out_total"] == 8
+    dev = sum(t.get("serve_tenant_device_seconds_total", 0.0)
+              for t in ten.values())
+    assert dev == pytest.approx(
+        srv.stats["accounting"]["device_s_total"], abs=1e-9)
+    recs = harvest_all(srv, ids)
+    assert [r["tenant"] for r in recs] == ["acme", "beta", "acme",
+                                           "other"]
+    srv.close()
+
+
+def test_frontend_tenant_rejection_metered(fresh_telemetry):
+    front = ServingFrontend(make_engine(replicas=1))
+    with pytest.raises(ValueError):
+        front.submit([], max_new_tokens=2, tenant="acme")
+    snap = fresh_telemetry.snapshot()
+    series = snap["serve_tenant_rejections_total"]["series"]
+    assert [(s["labels"]["tenant"], s["value"])
+            for s in series] == [("acme", 1.0)]
+    front.close()
+
+
+# --------------------------------------- one merged bill per request
+
+def test_one_bill_across_preempt_failover_handoff(fresh_telemetry):
+    """Satellite pin: a request that chunk-prefilled on a prefill
+    replica, handed off, was PREEMPTED on its decode replica, then
+    FAILED OVER when that replica died, ends with ONE merged cost
+    record covering every leg — device/KV/bytes sum across legs
+    (recompute is real work, charged where it ran), token totals from
+    the frontend's truth, and the output still greedy-exact."""
+    eng = make_engine(num_slots=1, roles=["prefill", "decode"],
+                      enable_prefix_caching=True)
+    fi = FaultInjector()
+    front = ServingFrontend(eng, fault_injector=fi)
+    prompt = [1 + (i % 90) for i in range(40)]         # > one block
+    a = front.submit(prompt, max_new_tokens=16, tenant="acme",
+                     priority=0)
+    # run the prefill leg + handoff; stop while a decodes on r1
+    for _ in range(30):
+        front.step()
+        if front._requests[a].replica == 1 \
+                and not front._requests[a].prefill_only \
+                and 0 in front.replicas[1].server.scheduler.slots:
+            break
+    assert front.stats["handoffs"] >= 1
+    # a high-priority arrival preempts a on the (only) decode replica
+    b = front.submit([9, 9, 9], max_new_tokens=4, priority=5,
+                     tenant="beta")
+    preempted = False
+    for _ in range(40):
+        front.step()
+        if front.replicas[1].server.stats["preempted"] >= 1:
+            preempted = True
+            break
+    assert preempted
+    # kill the decode replica: everything it holds fails over to the
+    # prefill replica (wrong-role last resort — availability wins)
+    fi.kill_replica(1)
+    out = front.drain()
+    ref = eng.generate([prompt], max_new_tokens=16)[0]
+    assert out[a] == ref[:len(out[a])]
+    assert len(out[a]) == len(prompt) + 16
+    bill = front.cost(a)
+    assert bill is not None
+    # every leg in ONE record: prefill leg + abandoned decode leg +
+    # the failover leg that finished it
+    assert bill["legs"] >= 3, bill
+    assert bill["device_s"] > 0 and bill["kv_block_s"] > 0
+    assert bill["handoff_bytes"] > 0          # published KV was billed
+    assert bill["tokens_in"] == len(prompt)
+    assert bill["tokens_out"] == 16
+    assert bill["tenant"] == "acme"
+    assert bill["finish_reason"] == front.finish_reason(a)
+    assert front.cost(b)["tenant"] == "beta"
+    # merging is associative bookkeeping, not invention: the merged
+    # bill of [bill] is bill itself
+    assert merge_cost_legs([bill]) == bill
+    # frontend-level tenant series count REQUESTS (not legs)
+    ten = front.stats["accounting"]["tenants"]
+    assert ten["acme"]["serve_tenant_requests_total"] == 1
+    front.close()
+
+
+# ------------------------------------------------- capacity over HTTP
+
+def test_capacity_http_pool_equals_rollup(fresh_telemetry):
+    """``GET /debug/capacity`` is valid JSON whose pool row is exactly
+    ``rollup_capacity`` of the per-replica rows — pinned by recomputing
+    the rollup client-side from the served rows."""
+    front = ServingFrontend(make_engine(
+        replicas=2, telemetry={"http_port": 0}))
+    ids = [front.submit([1 + i, 2, 3], max_new_tokens=4)
+           for i in range(4)]
+    front.drain()
+    port = front.http_server.port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/capacity", timeout=10) as r:
+        payload = json.loads(r.read().decode())
+    rows = payload["replicas"]
+    assert len(rows) == 2
+    assert {r["replica"] for r in rows} == {0, 1}
+    for row in rows:
+        assert row["enabled"] is True
+        assert row["num_slots"] == 2
+        assert row["total_blocks"] > 0
+    pool = payload["pool"]
+    assert pool == rollup_capacity(rows)
+    assert pool["replicas"] == 2 and pool["num_slots"] == 4
+    # the same snapshot serves in stats (report-only, no admission use)
+    st = front.stats["capacity"]
+    assert st["pool"]["replicas"] == 2
+    assert front.cost(ids[0])["legs"] >= 1
+    front.close()
+
+
+def test_capacity_rates_windowed_under_fake_clock(fresh_telemetry):
+    """The windowed rates are deltas over the registry, driven entirely
+    by the injected clock: finishing work then forcing an evaluation
+    yields finite tokens/s and a sane admissible-rate derivation."""
+    clk = FakeClock(auto=1e-3)
+    eng = make_engine()
+    srv = ContinuousBatchingServer(eng, clock=clk)
+    for i in range(3):
+        srv.submit([1 + i, 2, 3], max_new_tokens=4)
+    srv.drain()
+    clk.advance(10.0)
+    row = srv._capacity.evaluate()
+    assert row["tokens_per_s"] > 0
+    assert row["requests_per_s"] > 0
+    assert row["mean_tokens_per_request"] == pytest.approx(
+        row["tokens_per_s"] / row["requests_per_s"])
+    if row["goodput_fraction"]:
+        assert row["sustainable_tokens_per_s"] >= row["tokens_per_s"]
+    assert 0.0 <= row["slot_occupancy"] <= 1.0
+    assert 0.0 <= row["block_utilization"] <= 1.0
+    srv.close()
+
+
+# ------------------------------------------------------ route inventory
+
+def test_route_inventory_404_and_docs(fresh_telemetry):
+    """The ROUTES table is the single source of truth: every route is
+    advertised by the 404 body AND documented in docs/observability.md
+    (adding an endpoint without docs fails here)."""
+    assert "/debug/capacity" in ROUTES
+    front = ServingFrontend(make_engine(
+        replicas=1, telemetry={"http_port": 0}))
+    port = front.http_server.port
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/definitely-not-a-route",
+            timeout=10)
+        raise AssertionError("404 expected")
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        for route in ROUTES:
+            assert route in body, route
+    docs = (Path(__file__).resolve().parents[1]
+            / "docs" / "observability.md").read_text()
+    for route in ROUTES:
+        assert route in docs, f"{route} missing from observability.md"
+    front.close()

@@ -488,6 +488,28 @@ def test_bench_serve_continuous_smoke():
     labels = set(fo["replica_label_values"])
     assert {"r0", "r1", "pool"} <= labels
     assert len(labels) <= 2 * fo["replicas"] + 1   # bounded cardinality
+    # cost accounting blob (docs/observability.md "Cost accounting &
+    # capacity"): every replay request billed (requests + warmup), the
+    # closure residual within the wall-clock tolerance (fake-clock
+    # exactness is pinned by tests/test_accounting.py — here the replay
+    # runs on the monotonic clock), per-tenant device shares summing to
+    # 1 across the three cycled tenants, unit cost positive (the
+    # cost.device_seconds_per_1k_tokens regression gate's input), and
+    # the capacity model evaluated with real post-replay rates
+    co = rec["cost"]
+    assert co["requests_billed"] == rec["requests"] + 1   # + warmup
+    assert co["device_seconds_per_1k_tokens"] > 0
+    assert co["device_seconds_total"] > 0
+    assert co["closure_residual"] <= 0.05
+    assert co["kv_block_seconds_total"] > 0
+    assert set(co["tenant_device_share"]) == {"acme", "beta", "corp"}
+    assert sum(co["tenant_device_share"].values()) == \
+        pytest.approx(1.0, abs=0.01)
+    cap = co["capacity"]
+    assert cap["enabled"] is True
+    assert cap["tokens_per_s"] > 0
+    assert cap["sustainable_tokens_per_s"] > 0
+    assert cap["admissible_requests_per_s"] > 0
     # the whole record (snapshot included) survives a JSON round-trip
     import json
     assert json.loads(json.dumps(rec))["telemetry"] == tm
