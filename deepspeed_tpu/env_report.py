@@ -123,6 +123,34 @@ def telemetry_info():
             f"{', '.join(slo_targets)}; window {cfg.slo.window_s}s)"
             if cfg.slo.enabled and slo_targets
             else "off (set telemetry.slo.enabled + objectives)")
+        # the SLO closed loop (docs/observability.md "SLOs, alerting &
+        # incidents"): declared burn-rate rules + canary/incident arm
+        # state from the default config, live firing count from the
+        # process registry, and the newest bundle path any recorder in
+        # this process wrote
+        from deepspeed_tpu.telemetry import last_incident_path
+        rules = sorted(cfg.slo.objectives)
+        firing = 0
+        fam = reg.snapshot().get("serve_alert_firing")
+        if fam:
+            firing = sum(1 for s in fam["series"] if s["value"] >= 1.0)
+        parts = [
+            (f"{len(rules)} alert rule(s): {', '.join(rules)}"
+             if cfg.slo.enabled and rules else
+             "no alert rules (set telemetry.slo.enabled + "
+             "slo.objectives)"),
+            (f"canary every {cfg.canary.interval_s}s"
+             if cfg.canary.enabled else
+             "canary off (set telemetry.canary.enabled)"),
+            (f"incident bundles -> {cfg.incident.dir or 'in-memory'}"
+             if cfg.incident.enabled else
+             "incident bundles off (set telemetry.incident.enabled)"),
+            f"{firing} rule(s) firing now",
+        ]
+        last = last_incident_path()
+        if last:
+            parts.append(f"last incident {last}")
+        out["serve_slo"] = "; ".join(parts)
         from deepspeed_tpu.inference.config import \
             DeepSpeedInferenceConfig
         k = DeepSpeedInferenceConfig().speculation_tokens

@@ -93,11 +93,13 @@ from deepspeed_tpu.inference.server import (_LIFECYCLE_EVENTS,
                                             ContinuousBatchingServer,
                                             check_drain_timeout,
                                             submit_rejection)
-from deepspeed_tpu.telemetry import (FaultInjector, MetricRegistry,
+from deepspeed_tpu.telemetry import (CANARY_TENANT, AlertEngine,
+                                     CanaryProber, FaultInjector,
+                                     IncidentRecorder, MetricRegistry,
                                      ReplicaKilled, TenantMeter, Tracer,
-                                     Watchdog, get_event_ring,
-                                     get_registry, merge_cost_legs,
-                                     new_cost_record,
+                                     Watchdog, config_fingerprint,
+                                     get_event_ring, get_registry,
+                                     merge_cost_legs, new_cost_record,
                                      register_cost_histograms,
                                      rollup_capacity, start_http_server)
 from deepspeed_tpu.telemetry import events as telemetry_events
@@ -192,7 +194,7 @@ class _Replica:
     __slots__ = ("index", "server", "watchdog", "health", "draining",
                  "dead_reason", "missed_beats", "last_beat_ts",
                  "last_step_s", "routed", "failovers",
-                 "steps", "gauge", "stepped", "role")
+                 "steps", "gauge", "stepped", "role", "failover_rids")
 
     def __init__(self, index: int, server: ContinuousBatchingServer,
                  watchdog: Watchdog, now: float, gauge,
@@ -217,6 +219,10 @@ class _Replica:
         self.steps = 0
         self.gauge = gauge       # serve_replica_healthy{replica=index}
         self.stepped = False     # did this frontend tick step it?
+        # requests failed over off this replica at death — once none
+        # is still outstanding, the pool has RECOVERED from the loss
+        # (the availability SLO signal's resolve condition)
+        self.failover_rids: set = set()
 
     @property
     def routable(self) -> bool:
@@ -490,6 +496,53 @@ class ServingFrontend:
         self._replay_tokens = 0
         self._drain_reroutes = 0
         self._closed = False
+        # SLO burn-rate alerting + canary probes + incident bundles at
+        # the POOL boundary (docs/observability.md "SLOs, alerting &
+        # incidents"): the frontend is the availability authority (its
+        # replica health state machine), its canary crosses the
+        # prefill->decode handoff on a role-split pool, and its bundles
+        # carry the replica rows + stitched traces. All default OFF —
+        # a default-config pool builds none of these and registers zero
+        # new instruments (byte-identity pinned).
+        self.alerts = None
+        self.canary = None
+        self.incidents = None
+        if tcfg is not None and enabled:
+            if tcfg.incident.enabled:
+                self.incidents = IncidentRecorder(
+                    tcfg.incident, collect=self._incident_collect,
+                    registry=reg, clock=self._clock,
+                    fingerprint=config_fingerprint(cfg),
+                    name="pool_incidents")
+                for rep in self.replicas:
+                    # unify each replica's heartbeat-watchdog stall dump
+                    # with the pool's incident recorder (same episode
+                    # machinery as an alert firing)
+                    rep.watchdog.set_on_dump(
+                        lambda dump, idx=rep.index:
+                        self.incidents.capture(
+                            "watchdog",
+                            info={"replica": idx,
+                                  "watchdog": dump.get("watchdog"),
+                                  "idle_seconds":
+                                      dump.get("idle_seconds")}))
+            if tcfg.slo.enabled and tcfg.slo.objectives:
+                # same master switch as the server: slo.enabled=false
+                # arms no engine whatever the objectives say
+                self.alerts = AlertEngine(
+                    tcfg.slo, registry=reg, clock=self._clock,
+                    sources={"availability": self._availability,
+                             "goodput": self._pool_goodput},
+                    on_fire=self._on_alert_fire,
+                    on_resolve=self._on_alert_resolve)
+            if tcfg.canary.enabled:
+                self.canary = CanaryProber(
+                    tcfg.canary, submit=self.submit, result=self.result,
+                    finish_reason=self.finish_reason,
+                    cancel=self.cancel, registry=reg,
+                    clock=self._clock,
+                    vocab_size=getattr(engine.model_config,
+                                       "vocab_size", None))
         self.http_server = None
         if tcfg is not None and enabled and tcfg.http_port is not None:
             self.http_server = start_http_server(
@@ -497,7 +550,8 @@ class ServingFrontend:
                 replicas=self._debug_snapshot, tracer=self.tracer,
                 fleet=self._fleet_snapshot,
                 metrics_view=self._fleet_registry,
-                capacity=self._capacity_snapshot)
+                capacity=self._capacity_snapshot,
+                incidents=self.incidents_snapshot)
 
     # ------------------------------------------------------------ API
 
@@ -577,9 +631,11 @@ class ServingFrontend:
             self._pending.append(fr)
         if self._tenants is not None and tenant is not None:
             # the frontend meters accepted REQUESTS once, at the pool
-            # boundary (replica series meter legs)
-            self._tenants.count_request(self._tenants.fold(tenant),
-                                        len(prompt))
+            # boundary (replica series meter legs); fold() returns None
+            # for the unmetered canary tenant
+            label = self._tenants.fold(tenant)
+            if label is not None:
+                self._tenants.count_request(label, len(prompt))
         return request_id
 
     def _count_rejection(self, reason: str,
@@ -705,6 +761,15 @@ class ServingFrontend:
             finished.extend(self._deferred_finished)
             self._deferred_finished.clear()
         self._tick += 1
+        # canary probes self-inject through the REAL submit path ahead
+        # of routing (the probe rides this very round's dispatch, and
+        # on a role-split pool crosses the prefill->decode handoff);
+        # alert evaluation is cadence-gated internally — at the top so
+        # an idle pool still evaluates (silence is a signal)
+        if self.canary is not None:
+            self.canary.tick()
+        if self.alerts is not None:
+            self.alerts.maybe_evaluate()
         now = self._clock()
         self._reap_pending_deadlines(finished, now)
         self._route_pending(finished)
@@ -976,7 +1041,14 @@ class ServingFrontend:
         self.finish_reasons[rid] = reason
         self._requests.pop(rid, None)
         finished.append(rid)
-        if self._acct:
+        if self._acct and fr.tenant == CANARY_TENANT:
+            # synthetic probes are unmetered by design: no merged bill,
+            # no cost histograms, no REQUEST_COST event. The harvested
+            # legs drop here — their device time was already settled
+            # exactly into OTHER requests' bills via the excluded
+            # ledger records on the replica side.
+            fr.cost_legs = []
+        elif self._acct:
             # the merged bill: ONE cost record per request, summing
             # every harvested replica leg (prefill, decode, each
             # failover replay — recompute bills where it ran). A
@@ -1284,6 +1356,10 @@ class ServingFrontend:
                                      finished)
             else:
                 moved.append((fr, list(fr.prompt) + list(fr.committed)))
+        # the availability signal's resolve condition: this replica
+        # counts against availability until every request it lost here
+        # has left the in-flight table (failed over to completion)
+        rep.failover_rids.update(fr.request_id for fr, _ in moved)
         for fr, partial in moved:
             rep.failovers += 1
             if self._acct:
@@ -1370,6 +1446,102 @@ class ServingFrontend:
                 pass
             self._finalize(fr, list(fr.prompt) + list(fr.committed),
                            "failed", finished, frontend_decided=True)
+
+    # ------------------------------- alerting / canary / incidents
+
+    def _availability(self) -> float:
+        """The ``availability`` SLO signal: alive replicas over the
+        replicas the pool still OWES — a dead replica stops counting
+        against availability once every request it lost has been failed
+        over to completion (the pool recovered; in-process death is
+        permanent, so `alive/total` would pin the alert firing
+        forever). 2 replicas: a kill reads 0.5 while its work is
+        re-running elsewhere, then 1.0 once the last failover finishes
+        — the pending -> firing -> resolved arc the chaos suite pins."""
+        total = len(self.replicas)
+        alive = sum(1 for r in self.replicas if r.health != DEAD)
+        recovered = sum(
+            1 for r in self.replicas
+            if r.health == DEAD
+            and not (r.failover_rids & self._requests.keys()))
+        return alive / max(total - recovered, 1)
+
+    def _pool_goodput(self) -> Optional[float]:
+        """The ``goodput`` SLO signal at the pool level: the capacity
+        rollup's token-weighted goodput fraction (None before any
+        replica reports one — no data holds the rule)."""
+        try:
+            return self._capacity_snapshot()["pool"].get(
+                "goodput_fraction")
+        except Exception:  # noqa: BLE001 — a dying source never pages
+            return None
+
+    def _on_alert_fire(self, rule: str, info: dict) -> None:
+        if self.incidents is not None:
+            self.incidents.capture("alert", rule=rule, info=info)
+
+    def _on_alert_resolve(self, rule: str, info: dict) -> None:
+        if self.incidents is not None:
+            self.incidents.resolve(rule, info=info)
+
+    def _incident_collect(self) -> dict:
+        """The pool incident bundle's body: replica rows, capacity,
+        kept (stitched) traces, recent ring events, and the live
+        alert/canary rows — everything an operator re-assembles by
+        hand in the first minutes of a page, captured at the instant
+        of the transition."""
+        return {
+            "replicas": self._debug_snapshot(),
+            "capacity": self._capacity_snapshot(),
+            "events": get_event_ring().snapshot(),
+            "traces": ([t.to_dict() for t in self.tracer.traces()]
+                       if self.tracer is not None else []),
+            "alerts": (self.alerts.snapshot()
+                       if self.alerts is not None else None),
+            "canary": (self.canary.snapshot()
+                       if self.canary is not None else None),
+            "availability": self._availability(),
+        }
+
+    def incidents_snapshot(self) -> dict:
+        """``GET /debug/incidents`` payload (and ``stats`` rows): live
+        alert/canary state beside the retained bundles."""
+        if (self.incidents is None and self.alerts is None
+                and self.canary is None):
+            return {"enabled": False,
+                    "hint": "no slo.objectives / canary / incident "
+                            "knobs armed (docs/observability.md "
+                            "'SLOs, alerting & incidents')"}
+        return {
+            "enabled": True,
+            "alerts": (self.alerts.snapshot()
+                       if self.alerts is not None else None),
+            "canary": (self.canary.snapshot()
+                       if self.canary is not None else None),
+            "incidents": (self.incidents.snapshot()
+                          if self.incidents is not None else None),
+        }
+
+    def dump_incident(self, path: Optional[str] = None) -> dict:
+        """On-demand forensic bundle — exactly what an alert-fire
+        capture grabs, never rate-limited. ``path`` defaults into
+        ``telemetry.incident.dir``."""
+        if self.incidents is None:
+            raise RuntimeError(
+                "incident capture is off — set telemetry.incident."
+                "enabled (docs/observability.md 'SLOs, alerting & "
+                "incidents')")
+        if path is None:
+            if not self.incidents.cfg.dir:
+                raise ValueError(
+                    "pass a path, or set telemetry.incident.dir for "
+                    "the default location")
+            import os
+            path = os.path.join(
+                self.incidents.cfg.dir,
+                f"incident_manual_{self.incidents.captured_total + 1}"
+                ".json")
+        return self.incidents.dump(path)
 
     # ------------------------------------------- fleet observability
 
@@ -1768,5 +1940,11 @@ class ServingFrontend:
                 "tenants": (self._tenants.snapshot()
                             if self._tenants is not None else {}),
             },
+            "alerts": (self.alerts.snapshot()
+                       if self.alerts is not None else None),
+            "canary": (self.canary.snapshot()
+                       if self.canary is not None else None),
+            "incidents": (self.incidents.snapshot()
+                          if self.incidents is not None else None),
         })
         return snap

@@ -57,13 +57,16 @@ from deepspeed_tpu.inference.speculation import (LookupIndex,
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill, paged_prefill_chunk,
     paged_verify_step)
-from deepspeed_tpu.telemetry import (NULL_STEP_HANDLE, CapacityModel,
-                                     FaultInjector, KVPoolAccountant,
-                                     MetricRegistry, PrefillFault,
-                                     ProfilerCapture, RequestLedger,
-                                     SLOMonitor, StepProfiler, Tracer,
-                                     get_event_ring, get_registry,
-                                     start_http_server, watched_jit)
+from deepspeed_tpu.telemetry import (NULL_STEP_HANDLE, AlertEngine,
+                                     CanaryProber, CapacityModel,
+                                     FaultInjector, IncidentRecorder,
+                                     KVPoolAccountant, MetricRegistry,
+                                     PrefillFault, ProfilerCapture,
+                                     RequestLedger, SLOMonitor,
+                                     StepProfiler, Tracer,
+                                     config_fingerprint, get_event_ring,
+                                     get_registry, start_http_server,
+                                     watched_jit)
 from deepspeed_tpu.telemetry import events as telemetry_events
 
 # finish reason -> event-ring kind (every lifecycle finish leaves a
@@ -301,6 +304,47 @@ class ContinuousBatchingServer:
                                  if tcfg is not None else 5.0),
                 levels=self._capacity_levels,
                 goodput=self._capacity_goodput)
+        # SLO burn-rate alerting + canary probes + incident bundles
+        # (telemetry/alerts.py, canary.py, incident.py — docs/
+        # observability.md "SLOs, alerting & incidents"): the closed
+        # loop. All three default OFF (objectives={}, canary.enabled /
+        # incident.enabled False) — a default-config server builds none
+        # of these objects and registers zero new instruments, so the
+        # serving path stays byte-identical.
+        # A supervised replica builds NONE of them: the pool boundary
+        # (ServingFrontend) owns the closed loop — a per-replica canary
+        # would collide with the frontend's request-id namespace and
+        # double-probe, and per-replica bundles would fragment the one
+        # incident an operator needs.
+        self.alerts = None
+        self.canary = None
+        self.incidents = None
+        if tcfg is not None and enabled and not supervised:
+            if tcfg.incident.enabled:
+                self.incidents = IncidentRecorder(
+                    tcfg.incident, collect=self._incident_collect,
+                    registry=self.telemetry, clock=self._clock,
+                    fingerprint=config_fingerprint(cfg),
+                    name=f"{profile_source}_incidents")
+            if tcfg.slo.enabled and tcfg.slo.objectives:
+                # objectives ride under the slo.enabled master switch:
+                # slo.enabled=false is byte-identical serving with zero
+                # serve_alert* instruments, objectives or not (pinned)
+                self.alerts = AlertEngine(
+                    tcfg.slo, registry=self.telemetry,
+                    clock=self._clock,
+                    sources={"goodput": self._capacity_goodput},
+                    on_fire=self._on_alert_fire,
+                    on_resolve=self._on_alert_resolve)
+            if tcfg.canary.enabled:
+                self.canary = CanaryProber(
+                    tcfg.canary, submit=self.submit,
+                    result=self.result,
+                    finish_reason=self.finish_reason,
+                    cancel=self.cancel,
+                    registry=self.telemetry, clock=self._clock,
+                    vocab_size=getattr(engine.model_config,
+                                       "vocab_size", None))
         self.http_server = None
         if (tcfg is not None and enabled and tcfg.http_port is not None
                 and not supervised):
@@ -308,7 +352,8 @@ class ContinuousBatchingServer:
                 tcfg.http_port, host=tcfg.http_host,
                 registry=self.telemetry, tracer=self.tracer,
                 goodput=self._goodput_snapshot,
-                capacity=self.capacity_snapshot)
+                capacity=self.capacity_snapshot,
+                incidents=self.incidents_snapshot)
         self.profiler_capture = ProfilerCapture()
         reg = self.telemetry
         self._h_queue_wait = reg.histogram(
@@ -661,6 +706,11 @@ class ContinuousBatchingServer:
             tcfg, self.telemetry, "serve_watchdog",
             [("kv_block_pool", _pool), ("params", _params)])
         self.watchdog = self._flight.watchdog
+        if self.watchdog is not None and self.incidents is not None:
+            # unify the stall-dump path with the incident recorder: a
+            # watchdog dump is a forensic trigger like an alert firing
+            # — same episode machinery, same once-per-episode limit
+            self.watchdog.set_on_dump(self._on_watchdog_dump)
 
     def _goodput_snapshot(self) -> dict:
         """``GET /debug/goodput`` payload: the step observatory's phase
@@ -724,6 +774,80 @@ class ContinuousBatchingServer:
                             "(telemetry.accounting.enabled / "
                             "telemetry.step_profile)"}
         return self._capacity.snapshot()
+
+    # ------------------------------- alerting / canary / incidents
+
+    def _on_alert_fire(self, rule: str, info: dict) -> None:
+        """AlertEngine ``on_fire`` hook: a rule entering firing is the
+        incident recorder's capture trigger (rate-limited to one bundle
+        per episode; a second rule joining the storm attaches)."""
+        if self.incidents is not None:
+            self.incidents.capture("alert", rule=rule, info=info)
+
+    def _on_alert_resolve(self, rule: str, info: dict) -> None:
+        """AlertEngine ``on_resolve`` hook: closes the open episode once
+        every joined rule resolved (appending the post-recovery
+        snapshot) and re-arms capture for the next incident."""
+        if self.incidents is not None:
+            self.incidents.resolve(rule, info=info)
+
+    def _on_watchdog_dump(self, dump: dict) -> None:
+        """Watchdog ``on_dump`` hook — the unified stall-forensics
+        trigger (the bulky thread stacks stay in the watchdog's own
+        dump; the bundle carries the stall coordinates)."""
+        if self.incidents is not None:
+            self.incidents.capture(
+                "watchdog",
+                info={"watchdog": dump.get("watchdog"),
+                      "idle_seconds": dump.get("idle_seconds")})
+
+    def _incident_collect(self) -> dict:
+        """The incident bundle's body for a bare server (the frontend
+        supplies its own pool-wide collect). Scrape-thread-safe on
+        purpose — the watchdog trigger runs on the checker thread, so
+        everything here reads lock-guarded telemetry structures, never
+        live scheduler internals."""
+        ring = get_event_ring()
+        return {
+            "observability": self.observability_state(),
+            "events": ring.snapshot(),
+            "capacity": self.capacity_snapshot(),
+            "alerts": (self.alerts.snapshot()
+                       if self.alerts is not None else None),
+            "canary": (self.canary.snapshot()
+                       if self.canary is not None else None),
+        }
+
+    def incidents_snapshot(self) -> dict:
+        """``GET /debug/incidents`` payload (and ``stats["incidents"]``):
+        the live alert/canary state beside the retained bundles."""
+        if (self.incidents is None and self.alerts is None
+                and self.canary is None):
+            return {"enabled": False,
+                    "hint": "no slo.objectives / canary / incident "
+                            "knobs armed (docs/observability.md "
+                            "'SLOs, alerting & incidents')"}
+        return {
+            "enabled": True,
+            "alerts": (self.alerts.snapshot()
+                       if self.alerts is not None else None),
+            "canary": (self.canary.snapshot()
+                       if self.canary is not None else None),
+            "incidents": (self.incidents.snapshot()
+                          if self.incidents is not None else None),
+        }
+
+    def dump_incident(self, path: str) -> dict:
+        """On-demand forensic bundle to ``path`` — the operator's
+        manual pull of exactly what an alert-fire capture would have
+        grabbed (never rate-limited). Requires ``telemetry.incident``
+        to be armed."""
+        if self.incidents is None:
+            raise RuntimeError(
+                "incident capture is off — set telemetry.incident."
+                "enabled (docs/observability.md 'SLOs, alerting & "
+                "incidents')")
+        return self.incidents.dump(path)
 
     # ------------------------------------------------- cost accounting
 
@@ -1787,6 +1911,16 @@ class ContinuousBatchingServer:
         finished: List[int] = []
         self._take_deferred(finished)
         self._tick += 1
+        if self.canary is not None:
+            # the prober self-injects through the REAL submit path ahead
+            # of this round's admission, and scores its outstanding
+            # probe; runs even on an otherwise-idle server — a wedged
+            # loop that serves nobody is exactly what it detects
+            self.canary.tick()
+        if self.alerts is not None:
+            # cadence-gated like slo/capacity; sits at the top so every
+            # step shape (sync, pipelined, idle early-return) evaluates
+            self.alerts.maybe_evaluate()
         if self._fi is not None:
             self._fi.apply_famine(self.scheduler.allocator)
         self._reap_deadlines(finished)
@@ -2774,4 +2908,13 @@ class ContinuousBatchingServer:
                            if self._ledger is not None else None),
             "capacity": (self._capacity.snapshot()
                          if self._capacity is not None else None),
+            # SLO alerting + canary + incident bundles (docs/
+            # observability.md "SLOs, alerting & incidents"); None =
+            # the closed loop is unarmed
+            "alerts": (self.alerts.snapshot()
+                       if self.alerts is not None else None),
+            "canary": (self.canary.snapshot()
+                       if self.canary is not None else None),
+            "incidents": (self.incidents.snapshot()
+                          if self.incidents is not None else None),
         }

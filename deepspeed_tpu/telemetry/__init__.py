@@ -20,6 +20,8 @@ from deepspeed_tpu.telemetry.accounting import (RequestLedger, TenantMeter,
                                                 merge_cost_legs,
                                                 new_cost_record,
                                                 register_cost_histograms)
+from deepspeed_tpu.telemetry.alerts import AlertEngine
+from deepspeed_tpu.telemetry.canary import CANARY_TENANT, CanaryProber
 from deepspeed_tpu.telemetry.capacity import CapacityModel, rollup_capacity
 from deepspeed_tpu.telemetry.capture import ProfilerCapture
 from deepspeed_tpu.telemetry.compile_watch import (WatchedFunction,
@@ -28,8 +30,11 @@ from deepspeed_tpu.telemetry.compile_watch import (WatchedFunction,
                                                    executable_cost,
                                                    watched_jit)
 from deepspeed_tpu.telemetry.config import (AccountingConfig,
+                                            CanaryConfig,
                                             FaultInjectionConfig,
-                                            SLOConfig, TelemetryConfig)
+                                            IncidentConfig, SLOConfig,
+                                            SLOObjectiveConfig,
+                                            TelemetryConfig)
 from deepspeed_tpu.telemetry.events import (EventRing, dump_ring,
                                             get_event_ring,
                                             install_fault_dump,
@@ -40,6 +45,9 @@ from deepspeed_tpu.telemetry.faultinject import (CkptWriteFault, DataStall,
                                                  ReplicaKilled, StepCrash,
                                                  TrainingPreempted)
 from deepspeed_tpu.telemetry.goodput import GoodputMeter
+from deepspeed_tpu.telemetry.incident import (IncidentRecorder,
+                                              config_fingerprint,
+                                              last_incident_path)
 from deepspeed_tpu.telemetry.exporter import (TelemetryHTTPServer,
                                               start_http_server)
 from deepspeed_tpu.telemetry.memory import (KVPoolAccountant,
@@ -97,4 +105,8 @@ __all__ = [
     "RequestLedger", "TenantMeter", "merge_cost_legs",
     "new_cost_record", "register_cost_histograms",
     "CapacityModel", "rollup_capacity", "AccountingConfig",
+    # SLO alerting + canary probes + incident bundles (the closed loop)
+    "AlertEngine", "CanaryProber", "CANARY_TENANT", "IncidentRecorder",
+    "config_fingerprint", "last_incident_path",
+    "SLOObjectiveConfig", "CanaryConfig", "IncidentConfig",
 ]

@@ -42,6 +42,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.telemetry import events as _ev
+from deepspeed_tpu.telemetry.canary import CANARY_TENANT
 from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
 
 # the label every overflow tenant folds into once max_tenants distinct
@@ -140,7 +141,11 @@ class TenantMeter:
         self._mirror: Dict[str, Dict[str, float]] = {}
 
     def fold(self, tenant: Optional[str]) -> Optional[str]:
-        if tenant is None:
+        # the canary probe's reserved tenant is UNMETERED by design:
+        # folding it to None here excludes it from every metered path
+        # at once (requests, finishes, rejections) — byte-identical
+        # tenant series with the prober on or off, test-pinned
+        if tenant is None or tenant == CANARY_TENANT:
             return None
         tenant = str(tenant)
         with self._lock:
@@ -256,14 +261,24 @@ class RequestLedger:
              tenant: Optional[str] = None) -> None:
         """Start a record at submit(). Idempotent for a request id the
         ledger already tracks (a preemption requeue re-enters through
-        the same open record, not a new one)."""
+        the same open record, not a new one).
+
+        ``tenant="__canary"`` (telemetry/canary.py CANARY_TENANT) opens
+        an EXCLUDED record: it still exists — settle attributes the
+        probe's device seconds to it, so nobody else's bill absorbs
+        them — but it never meters a tenant and is dropped at emit
+        (no cost histograms, no ring event, no bill), keeping the money
+        paths byte-identical to a canary-off run."""
         if (request_id in self._open or request_id in self._pending):
             return
         # a resubmitted id (forget() then reuse) starts a fresh record
         self._closed.pop(request_id, None)
         self._harvested.discard(request_id)
-        label = self.tenants.fold(tenant)
+        excluded = tenant == CANARY_TENANT
+        label = None if excluded else self.tenants.fold(tenant)
         rec = new_cost_record(request_id, label, int(tokens_in))
+        if excluded:
+            rec["excluded"] = True
         self._open[request_id] = rec
         if label is not None:
             self.tenants.count_request(label, int(tokens_in))
@@ -433,6 +448,14 @@ class RequestLedger:
     def _emit(self, request_id: int) -> None:
         rec = self._pending.pop(request_id, None)
         if rec is None:
+            return
+        if rec.get("excluded"):
+            # canary probe: the record absorbed its own device seconds
+            # (so nobody else's bill did) but emits NO bill — no cost
+            # histograms, no tenant counters, no request_cost event, not
+            # counted as a closed bill. It still parks in _closed so the
+            # harvest paths (cost/pop_cost) stay id-coherent.
+            self._closed[request_id] = rec
             return
         self._h_device.observe(rec["device_s"])
         self._h_blocks.observe(rec["kv_block_s"])

@@ -43,6 +43,18 @@ _RATE_COUNTERS = {
     "serve_requests_finished_total": "requests",
 }
 
+# synthetic-probe attribution subtracted from the rates when a canary
+# prober is armed (telemetry/canary.py): the probes run through the real
+# step path — their tokens land in serve_tokens_total like anyone's —
+# but capacity is a statement about TENANT traffic, so the canary's
+# settled counters net them back out (rate-counter name -> canary
+# counter). With no prober these families never register and the
+# subtraction reads 0 — byte-identical rates.
+_CANARY_COUNTERS = {
+    "serve_tokens_total": "serve_canary_tokens_total",
+    "serve_requests_finished_total": "serve_canary_requests_total",
+}
+
 
 def _ratio(num: Optional[float], den: Optional[float]
            ) -> Optional[float]:
@@ -88,11 +100,16 @@ class CapacityModel:
 
     def _collect(self) -> Dict[str, float]:
         snap = self.registry.snapshot()
+
+        def _total(name):
+            fam = snap.get(name)
+            return (sum(s["value"] for s in fam["series"])
+                    if fam else 0.0)
+
         state: Dict[str, float] = {}
         for name, stem in _RATE_COUNTERS.items():
-            fam = snap.get(name)
-            state[stem] = (sum(s["value"] for s in fam["series"])
-                           if fam else 0.0)
+            state[stem] = max(
+                _total(name) - _total(_CANARY_COUNTERS[name]), 0.0)
         return state
 
     # ---------------------------------------------------------- evaluate

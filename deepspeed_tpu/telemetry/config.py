@@ -3,11 +3,68 @@
 — one schema, both engines."""
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Literal, Optional
 
 from pydantic import Field, field_validator
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+
+# every signal an alerting objective can watch (telemetry/alerts.py):
+# windowed quantiles over the serving histograms, windowed ratios over
+# the admission/canary counters, and the instantaneous pool levels the
+# owner provides as gauge sources
+ALERT_SIGNALS = ("decode_p90_s", "ttft_p90_s", "queue_wait_p90_s",
+                 "error_rate", "availability", "goodput",
+                 "canary_success")
+
+# signals where LOWER is worse (a floor): the objective fires when the
+# observation drops below the threshold; everything else is a ceiling
+_FLOOR_SIGNALS = {"availability", "goodput", "canary_success"}
+
+
+class SLOObjectiveConfig(DeepSpeedConfigModel):
+    """One declared alerting objective (telemetry/alerts.py): a signal
+    observed over a fast AND a slow window (multi-window burn rate —
+    both must breach before the rule leaves ``ok``, so a one-sample
+    blip never pages), compared against ``threshold``, driving a
+    pending -> firing -> resolved state machine on the server clock.
+    ``bound`` defaults by signal: latency/error signals are ceilings
+    (fire above), availability/goodput/canary_success are floors (fire
+    below)."""
+    signal: Literal["decode_p90_s", "ttft_p90_s", "queue_wait_p90_s",
+                    "error_rate", "availability", "goodput",
+                    "canary_success"]
+    threshold: float
+    # null = inferred from the signal (see _FLOOR_SIGNALS)
+    bound: Optional[Literal["above", "below"]] = None
+    # burn-rate windows: the fast window catches a sharp burn, the slow
+    # window confirms it is sustained — both must breach
+    fast_window_s: float = 10.0
+    slow_window_s: float = 60.0
+    # dwell before pending escalates to firing (0 = same evaluation)
+    pending_for_s: float = 0.0
+    # dwell of healthy evaluations before firing resolves
+    resolve_for_s: float = 0.0
+
+    @field_validator("fast_window_s", "slow_window_s")
+    @classmethod
+    def _positive_window(cls, v, info):
+        if v <= 0:
+            raise ValueError(
+                f"{info.field_name} must be > 0 seconds, got {v}")
+        return v
+
+    @field_validator("pending_for_s", "resolve_for_s")
+    @classmethod
+    def _valid_dwell(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"{info.field_name} must be >= 0 seconds, got {v}")
+        return v
+
+    def resolved_bound(self) -> str:
+        return self.bound or (
+            "below" if self.signal in _FLOOR_SIGNALS else "above")
 
 
 class SLOConfig(DeepSpeedConfigModel):
@@ -28,6 +85,13 @@ class SLOConfig(DeepSpeedConfigModel):
     window_s: float = 60.0
     # re-evaluation cadence; 0 evaluates at every serving step
     eval_interval_s: float = 5.0
+    # named burn-rate alert rules (telemetry/alerts.py), riding under
+    # the same ``enabled`` master switch as the gates: empty (the
+    # default) — or enabled=false — arms NO alert engine and registers
+    # no serve_alert* instruments. Keys are rule names (they become
+    # the {rule=...} label value).
+    objectives: Dict[str, SLOObjectiveConfig] = Field(
+        default_factory=dict)
 
     @field_validator("ttft_p90_s", "token_p50_s", "queue_wait_p90_s",
                      "window_s")
@@ -53,6 +117,71 @@ class SLOConfig(DeepSpeedConfigModel):
         if v < 0:
             raise ValueError(
                 f"eval_interval_s must be >= 0 (0 = every step), got {v}")
+        return v
+
+
+class CanaryConfig(DeepSpeedConfigModel):
+    """Synthetic end-to-end probe (telemetry/canary.py): the serving
+    loop periodically self-injects a tiny request through the REAL
+    submit/step/result path, marked ``tenant="__canary"`` — excluded
+    from request bills, tenant metering, and the capacity model's
+    windowed rates — and scores end-to-end latency plus token-exactness
+    against the pinned expected output (the first successful probe's
+    tokens). The success ratio feeds the ``canary_success`` alert
+    signal. Off by default: disabled, no prober is built and no
+    serve_canary_* instruments register."""
+    enabled: bool = False
+    # probe cadence (server clock); a new probe is injected only after
+    # the previous one scored
+    interval_s: float = 10.0
+    # synthetic prompt: tokens [1 .. prompt_tokens], mod vocab
+    prompt_tokens: int = 4
+    # decode budget — >= 2 so a role-split pool's probe crosses the
+    # prefill -> decode handoff (the riskiest path)
+    max_new_tokens: int = 2
+    # end-to-end latency beyond this scores the probe as failed (and a
+    # probe still unfinished past it is cancelled + scored)
+    timeout_s: float = 30.0
+
+    @field_validator("interval_s", "timeout_s")
+    @classmethod
+    def _positive_seconds(cls, v, info):
+        if v <= 0:
+            raise ValueError(
+                f"{info.field_name} must be > 0 seconds, got {v}")
+        return v
+
+    @field_validator("prompt_tokens", "max_new_tokens")
+    @classmethod
+    def _positive_tokens(cls, v, info):
+        if v < 1:
+            raise ValueError(
+                f"{info.field_name} must be >= 1, got {v}")
+        return v
+
+
+class IncidentConfig(DeepSpeedConfigModel):
+    """One-shot incident bundles (telemetry/incident.py): when an alert
+    rule enters firing — or the hang watchdog fires its stall dump —
+    capture ONE self-contained JSON artifact (observability snapshot,
+    recent ring events, kept error traces, replica/capacity/alert
+    rows, config fingerprint), rate-limited to one bundle per episode
+    (overlapping firings join the open bundle; the recorder re-arms
+    when the episode resolves). Served at ``GET /debug/incidents`` and
+    writable on demand via ``dump_incident()``. Off by default."""
+    enabled: bool = False
+    # directory bundles are also written to as incident_<n>.json;
+    # null = in-memory only (still listed at /debug/incidents)
+    dir: Optional[str] = None
+    # bounded in-memory retention (oldest bundles drop first)
+    max_incidents: int = 8
+
+    @field_validator("max_incidents")
+    @classmethod
+    def _valid_max(cls, v):
+        if v < 1:
+            raise ValueError(
+                f"max_incidents must be >= 1, got {v}")
         return v
 
 
@@ -252,6 +381,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
     step_profile_events_every: int = 32
     # serving SLO gates (telemetry/slo.py) — see the SLOConfig schema
     slo: SLOConfig = Field(default_factory=SLOConfig)
+    # synthetic canary prober (telemetry/canary.py) — see CanaryConfig
+    canary: CanaryConfig = Field(default_factory=CanaryConfig)
+    # incident bundles (telemetry/incident.py) — see IncidentConfig
+    incident: IncidentConfig = Field(default_factory=IncidentConfig)
     # chaos hooks (telemetry/faultinject.py) — see FaultInjectionConfig
     fault_injection: FaultInjectionConfig = Field(
         default_factory=FaultInjectionConfig)

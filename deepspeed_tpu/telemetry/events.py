@@ -104,6 +104,22 @@ KV_SWAP_THRASH = "kv_swap_thrash"
 # bytes, speculation counts, tenant — the forensic twin of the
 # serve_request_* cost histograms
 REQUEST_COST = "request_cost"
+# SLO burn-rate alerting (docs/observability.md "SLOs, alerting &
+# incidents"): one entry when a rule's state machine enters firing —
+# naming the rule, the signal, the breaching fast/slow observations,
+# and the threshold…
+ALERT_FIRE = "alert_fire"
+# …and one when that rule resolves (healthy dwell satisfied), carrying
+# how long the episode burned — the pair brackets every alert episode
+ALERT_RESOLVE = "alert_resolve"
+# one entry per captured incident bundle (telemetry/incident.py):
+# the trigger (alert rule or watchdog), the bundle id, and the on-disk
+# path when telemetry.incident.dir is set
+INCIDENT_CAPTURE = "incident_capture"
+# synthetic canary prober (telemetry/canary.py): one entry per FAILED
+# probe (mismatch against the pinned tokens, timeout, or submit
+# rejection) — successful probes only tick counters
+CANARY_FAIL = "canary_fail"
 
 
 class EventRing:

@@ -58,6 +58,9 @@ ROUTES = {
                        "sustainable rate, admissible request rate at "
                        "the current mix; pool rollup beside per-replica "
                        "rows on a frontend (telemetry/capacity.py)",
+    "/debug/incidents": "retained incident bundles + alert/canary "
+                        "state — SLO rules, probe health, episode "
+                        "accounting (telemetry/incident.py)",
 }
 
 
@@ -77,6 +80,7 @@ class TelemetryHTTPServer:
                  event_ring=None, memory=None, tracer=None,
                  goodput=None, replicas=None, resilience=None,
                  fleet=None, metrics_view=None, capacity=None,
+                 incidents=None,
                  handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S):
         if handler_timeout_s is not None and handler_timeout_s <= 0:
             raise ValueError(
@@ -207,6 +211,20 @@ class TelemetryHTTPServer:
                                         "accounting & capacity')"})
                     body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
+                elif path == "/debug/incidents":
+                    # ``incidents`` is the owner's zero-arg snapshot
+                    # callable (IncidentRecorder + alert engine + canary
+                    # prober rows); an endpoint armed without one still
+                    # answers self-describingly
+                    payload = (incidents() if incidents is not None else
+                               {"enabled": False,
+                                "hint": "owner armed no incident "
+                                        "recorder (telemetry.slo / "
+                                        "telemetry.incident — docs/"
+                                        "observability.md 'SLOs, "
+                                        "alerting & incidents')"})
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(
                         404, "unknown path (try " +
@@ -263,6 +281,7 @@ def start_http_server(port: int, host: str = "127.0.0.1",
                       event_ring=None, memory=None, tracer=None,
                       goodput=None, replicas=None, resilience=None,
                       fleet=None, metrics_view=None, capacity=None,
+                      incidents=None,
                       handler_timeout_s: float = DEFAULT_HANDLER_TIMEOUT_S
                       ) -> TelemetryHTTPServer:
     """Convenience spelling mirroring prometheus_client's entry point."""
@@ -271,5 +290,5 @@ def start_http_server(port: int, host: str = "127.0.0.1",
                                tracer=tracer, goodput=goodput,
                                replicas=replicas, resilience=resilience,
                                fleet=fleet, metrics_view=metrics_view,
-                               capacity=capacity,
+                               capacity=capacity, incidents=incidents,
                                handler_timeout_s=handler_timeout_s)

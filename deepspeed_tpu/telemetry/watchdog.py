@@ -73,6 +73,12 @@ class Watchdog:
 
     # ------------------------------------------------------------ progress
 
+    def set_on_dump(self, on_dump) -> None:
+        """Install/replace the advisory dump callback after
+        construction — the incident recorder (telemetry/incident.py)
+        unifies the stall-dump path with alert-fire capture this way."""
+        self._on_dump = on_dump
+
     def notify_progress(self) -> None:
         """Call at every step/decode completion — a host attribute write
         under an uncontended lock, nothing the hot path can feel."""

@@ -62,6 +62,51 @@ def _print_cost(st):
               f"{'-' if adm is None else round(adm, 2)} req/s")
 
 
+def _hook_alert_prints(owner):
+    """Chain a live print in FRONT of the owner's own fire/resolve
+    hooks (which capture incident bundles), so a --slo run narrates
+    every rule transition the moment it happens."""
+    al = getattr(owner, "alerts", None)
+    if al is None:
+        return
+
+    def _noisy(label, chain):
+        def cb(rule, info):
+            print(f"  ALERT {label}: {rule} [{info.get('signal')}] "
+                  f"fast {info.get('observed_fast')} / slow "
+                  f"{info.get('observed_slow')} vs threshold "
+                  f"{info.get('threshold')}")
+            if chain is not None:
+                chain(rule, info)
+        return cb
+
+    al._on_fire = _noisy("firing", al._on_fire)
+    al._on_resolve = _noisy("resolved", al._on_resolve)
+
+
+def _print_slo_loop(owner, args):
+    """Post-drain closed-loop report + forensic bundle dump."""
+    al = getattr(owner, "alerts", None)
+    if al is None:
+        return
+    snap = al.snapshot()
+    line = (f"  slo loop: {snap['fired_total']} alert(s) fired, "
+            f"{snap['resolved_total']} resolved")
+    canary = getattr(owner, "canary", None)
+    if canary is not None:
+        cs = canary.snapshot()
+        line += (f"; canary {cs['probes']} probes, success "
+                 f"{cs['success_ratio']}, p90 {cs['latency_p90_ms']} ms")
+    print(line)
+    if getattr(owner, "incidents", None) is not None:
+        inc = owner.incidents.snapshot()
+        print(f"  incidents: {inc['captured_total']} captured, "
+              f"{inc['suppressed_total']} suppressed within episodes")
+        bundle = owner.dump_incident("slo_incident_bundle.json")
+        print(f"  forensic bundle ({len(bundle)} sections) -> "
+              "slo_incident_bundle.json")
+
+
 def run_replicated(eng, prompt, args):
     """Drive a --replicas N pool end-to-end through the ServingFrontend
     (docs/serving.md "Replicated serving & failover"): staggered
@@ -79,6 +124,7 @@ def run_replicated(eng, prompt, args):
         fi = FaultInjector(seed=0, wedge_nth_request=5,
                            prefill_failure_rate=0.1, replica_kill_step=6)
     front = ServingFrontend(eng, fault_injector=fi)
+    _hook_alert_prints(front)
     tenants = _tenant_cycle(args)
     ids = []
     for i in range(args.continuous):
@@ -136,6 +182,7 @@ def run_replicated(eng, prompt, args):
           f"hops " + ", ".join(f"{c}={n}" for c, n in hops.items()
                                if n or c == "submit"))
     _print_cost(st)
+    _print_slo_loop(front, args)
     if args.trace_dump and st["stitching"]:
         path = args.trace_dump + ".fleet.json"
         n = front.dump_timeline(path)
@@ -164,6 +211,7 @@ def run_continuous(eng, prompt, args):
         fi = FaultInjector(seed=0, wedge_nth_request=5,
                            prefill_failure_rate=0.1)
     srv = ContinuousBatchingServer(eng, fault_injector=fi)
+    _hook_alert_prints(srv)
     tenants = _tenant_cycle(args)
     ids = []
     for i in range(args.continuous):
@@ -274,6 +322,7 @@ def run_continuous(eng, prompt, args):
             state = "VIOLATED" if r["violated"] else "ok"
             print(f"  {name}: observed {obs} vs target "
                   f"{r['target']} [{state}]")
+    _print_slo_loop(srv, args)
     if srv.http_server is not None:
         port = srv.http_server.port
         input(f"scrape endpoint live at http://127.0.0.1:{port}/metrics "
@@ -404,7 +453,11 @@ def main():
                     help="arm default SLO gates (TTFT p90 1s, per-token "
                          "p50 100ms, queue-wait p90 1s, error rate 5%%) "
                          "and print windowed compliance after the drain "
-                         "(continuous mode)")
+                         "(continuous mode); also arms the closed loop "
+                         "— burn-rate alert rules, canary probes and "
+                         "incident bundles — printing each rule "
+                         "transition live and dumping a forensic "
+                         "bundle after the drain (pair with --chaos)")
     args = ap.parse_args()
 
     import deepspeed_tpu
@@ -423,9 +476,29 @@ def main():
         # --trace-dump renders a gap-free server-host track
         telemetry["step_profile_events_every"] = 1
     if args.slo:
+        # compliance gates PLUS the closed loop (docs/observability.md
+        # "SLOs, alerting & incidents"): burn-rate alert rules, the
+        # synthetic canary probing the real serving path, and one-shot
+        # incident bundles on rule-fire; combine with --chaos to watch
+        # a rule walk pending -> firing -> resolved live (availability
+        # only observes a --replicas pool; error_rate works everywhere)
         telemetry["slo"] = {"enabled": True, "ttft_p90_s": 1.0,
                             "token_p50_s": 0.1, "queue_wait_p90_s": 1.0,
-                            "error_rate": 0.05}
+                            "error_rate": 0.05,
+                            "eval_interval_s": 0.25,
+                            "objectives": {
+                                "availability": {
+                                    "signal": "availability",
+                                    "threshold": 0.99,
+                                    "fast_window_s": 2.0,
+                                    "slow_window_s": 10.0},
+                                "errors": {
+                                    "signal": "error_rate",
+                                    "threshold": 0.05,
+                                    "fast_window_s": 2.0,
+                                    "slow_window_s": 10.0}}}
+        telemetry["canary"] = {"enabled": True, "interval_s": 2.0}
+        telemetry["incident"] = {"enabled": True}
     if telemetry:
         knobs["telemetry"] = telemetry
     if args.prefix_cache or args.kv_host_offload:

@@ -90,6 +90,14 @@ METRICS = {
     # ledger exists to produce. A regression means serving got more
     # expensive per token (or attribution started over-charging)
     "cost.device_seconds_per_1k_tokens": "down",
+    # SLO closed loop (docs/observability.md "SLOs, alerting &
+    # incidents"): canary probe end-to-end p90 through the real
+    # submit/step/result path — the synthetic user's tail latency;
+    # and alerts fired on the UNDISTURBED serve-continuous leg, which
+    # must stay 0 (a false page is a regression in the alerting
+    # semantics, not a tuning knob)
+    "slo.canary_p90_ms": "down",
+    "slo.false_positive_alerts": "down",
 }
 
 # same contract against the newest TRAIN phase record carrying a

@@ -1,0 +1,92 @@
+"""Debug-route drift check: the scrape surface vs its documentation.
+
+Two invariants, both cheap enough for tier-1:
+
+1. **Coverage** — every route in ``telemetry/exporter.py`` ``ROUTES``
+   (the single source of truth that renders the ``/`` help page and
+   the 404 body) must appear as a backticked ``GET <path>`` entry in
+   docs/observability.md "Scrape endpoint", so a new route cannot ship
+   undocumented.
+2. **Liveness** — every route answers over a real listener (ephemeral
+   port, default registry, no owner callables wired) with a parseable
+   body: JSON for the JSON routes, non-empty text for the text routes
+   (``/``, ``/metrics``, ``/debug/compile``). This is exactly the
+   degraded configuration an operator curls first — a route that
+   500s or returns unserializable state when its owner is absent is a
+   bug here, not during an outage.
+
+Usage: python scripts/check_debug_routes.py   (exit 1 on drift)
+Wired as tier-1 via tests/test_docs_consistency.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(ROOT, "docs", "observability.md")
+sys.path.insert(0, ROOT)
+
+# routes whose body is intentionally plain text, not JSON
+TEXT_ROUTES = {"/", "/metrics", "/debug/compile"}
+
+
+def doc_routes(text: str) -> set:
+    """Backticked ``GET /path`` entries of the docs' route list."""
+    return set(re.findall(r"`GET (/[^`\s]*)`", text))
+
+
+def check() -> list:
+    """Returns a list of human-readable drift errors (empty = clean)."""
+    from deepspeed_tpu.telemetry.exporter import (ROUTES,
+                                                  TelemetryHTTPServer)
+    errors = []
+    documented = doc_routes(open(DOC).read())
+    for path in sorted(ROUTES):
+        if path not in documented:
+            errors.append(
+                f"route {path!r} (telemetry/exporter.py ROUTES) is not "
+                "in docs/observability.md 'Scrape endpoint' — add a "
+                "`GET " + path + "` entry")
+    srv = TelemetryHTTPServer(port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        for path in ["/"] + sorted(ROUTES):
+            try:
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    body = r.read()
+            except Exception as e:  # noqa: BLE001 — the error IS the find
+                errors.append(f"GET {path} failed over a live "
+                              f"listener: {e!r}")
+                continue
+            if path in TEXT_ROUTES:
+                if not body.strip():
+                    errors.append(f"GET {path} returned an empty body")
+                continue
+            try:
+                json.loads(body)
+            except ValueError as e:
+                errors.append(
+                    f"GET {path} did not return valid JSON ({e}): "
+                    f"{body[:120]!r}")
+    finally:
+        srv.close()
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        print("\n".join(errors), file=sys.stderr)
+        return 1
+    from deepspeed_tpu.telemetry.exporter import ROUTES
+    print(f"check_debug_routes: {len(ROUTES)} routes documented and "
+          "answering over a live listener")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

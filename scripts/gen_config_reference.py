@@ -242,6 +242,30 @@ def generate() -> str:
                note=("See docs/observability.md \"Request tracing & "
                      "SLOs\" for the evaluation semantics and metric "
                      "names."))
+    from deepspeed_tpu.telemetry.config import SLOObjectiveConfig
+    emit_model(buf, "telemetry.slo.objectives.<rule>", SLOObjectiveConfig,
+               note=("One named burn-rate alert rule "
+                     "(telemetry/alerts.py) — see docs/observability.md "
+                     "\"SLOs, alerting & incidents\". Rules ride under "
+                     "the `slo.enabled` master switch; an empty "
+                     "`objectives` dict (the default) arms no alert "
+                     "engine and registers no `serve_alert*` "
+                     "instruments."))
+    from deepspeed_tpu.telemetry.config import CanaryConfig
+    emit_model(buf, "telemetry.canary", CanaryConfig,
+               note=("Synthetic end-to-end probe through the real "
+                     "submit/step/result path, `tenant=\"__canary\"`, "
+                     "excluded byte-identically from bills, tenant "
+                     "metering, and capacity rates — see "
+                     "docs/observability.md \"SLOs, alerting & "
+                     "incidents\"."))
+    from deepspeed_tpu.telemetry.config import IncidentConfig
+    emit_model(buf, "telemetry.incident", IncidentConfig,
+               note=("One-shot incident bundles captured when an alert "
+                     "fires or the hang watchdog dumps, rate-limited "
+                     "per episode and re-armed on resolve; listed at "
+                     "`GET /debug/incidents` — see docs/observability.md "
+                     "\"SLOs, alerting & incidents\"."))
     from deepspeed_tpu.telemetry.config import AccountingConfig
     emit_model(buf, "telemetry.accounting", AccountingConfig,
                note=("Request-level cost accounting, tenant metering, "

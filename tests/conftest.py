@@ -174,6 +174,14 @@ _SLOW_BY_MODULE = {
         "test_wedge_degrades_then_deadline_failover",
         "test_heartbeat_loss_false_positive_failover_still_exact",
         "test_slow_step_trips_and_clears_breaker"},
+    # r19 closed loop: the acceptance pins stay fast — the headline
+    # kill-fires-resolves-one-bundle oracle, the undisturbed
+    # zero-alerts leg, the canary money-path byte identity, and the
+    # default-config zero-instruments pin; the manual-dump/stats
+    # surface variant rides the slow lane (the route shape is pinned
+    # by check_debug_routes in test_docs_consistency, the bundle
+    # round-trip by the headline oracle)
+    "test_alerting": {"test_dump_incident_and_stats_rows"},
     # disagg arch sweep: the handoff/one-bill pins (test_accounting),
     # the all-mixed==roleless byte identity, and the bench disagg leg
     # stay fast

@@ -1,0 +1,673 @@
+"""SLO burn-rate alerting, canary probes, incident bundles (ISSUE 19).
+
+The closed observability loop: config-declared objectives evaluated as
+multi-window burn rates drive ``ok -> pending -> firing -> resolved``
+state machines; a synthetic canary probes the REAL submit/step/result
+path under a reserved tenant; a rule entering firing captures ONE
+self-contained forensic bundle per episode. Everything runs on the
+injectable clock — ZERO real sleeps. The oracles:
+
+* the headline: a seeded replica kill walks the availability rule
+  through firing -> resolved on a fake clock with EXACTLY ONE bundle
+  captured (episode rate limit, re-armed after resolve), and the
+  bundle JSON round-trips with the firing rule, replica rows and the
+  post-recovery resolution snapshot;
+* an undisturbed pool fires ZERO alerts (a false page is a semantics
+  regression);
+* the canary leaves tenant metering and request bills byte-identical
+  to a canary-off run (``tenant="__canary"`` is excluded end to end);
+* a default-config server builds NONE of the loop and registers ZERO
+  new instruments — ``slo.enabled=false`` is byte-identical serving
+  whatever ``objectives`` says.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference import (ContinuousBatchingServer,
+                                     DeepSpeedInferenceConfig,
+                                     InferenceEngine, ServingFrontend)
+from deepspeed_tpu.model_implementations.transformer import (
+    InferenceTransformerConfig, init_params)
+from deepspeed_tpu.telemetry import (CANARY_TENANT, AlertEngine,
+                                     CanaryConfig, CanaryProber,
+                                     EventRing, IncidentConfig,
+                                     IncidentRecorder, MetricRegistry,
+                                     Watchdog, get_event_ring,
+                                     get_registry, set_event_ring,
+                                     set_registry)
+from deepspeed_tpu.telemetry.config import SLOConfig
+
+# every instrument the closed loop registers — the zero-new-instruments
+# pin greps a default server's registry snapshot for these
+_LOOP_METRICS = (
+    "serve_alerts_total", "serve_alert_firing",
+    "serve_canary_probes_started_total", "serve_canary_success_total",
+    "serve_canary_probes_total", "serve_canary_latency_seconds",
+    "serve_canary_tokens_total", "serve_canary_requests_total",
+)
+
+
+@pytest.fixture()
+def fresh_telemetry():
+    prev_reg = set_registry(MetricRegistry())
+    prev_ring = set_event_ring(EventRing(512))
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev_reg)
+        set_event_ring(prev_ring)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def events_of(kind):
+    return [e for e in get_event_ring().snapshot() if e["kind"] == kind]
+
+
+_MCFG = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+             n_head=4, dtype=jnp.float32)
+
+
+def make_engine(replicas=1, telemetry=None, **knobs):
+    cfg = InferenceTransformerConfig(**_MCFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    scfg = dict(dtype="float32", max_out_tokens=256, block_size=32,
+                num_slots=2, **knobs)
+    if replicas > 1:
+        scfg["replication"] = {"replicas": replicas}
+    if telemetry is not None:
+        scfg["telemetry"] = telemetry
+    return InferenceEngine((cfg, params),
+                           DeepSpeedInferenceConfig(**scfg))
+
+
+def _slo_cfg(**objective):
+    obj = dict(signal="availability", threshold=0.99, fast_window_s=1.0,
+               slow_window_s=5.0, pending_for_s=0.0, resolve_for_s=0.0)
+    obj.update(objective)
+    return SLOConfig(enabled=True, eval_interval_s=0.0,
+                     objectives={"rule": obj})
+
+
+# ---------------------------------------------------------------------
+# AlertEngine state machine (host-pure, gauge source, fake clock)
+# ---------------------------------------------------------------------
+
+
+def test_alert_dwell_lifecycle(fresh_telemetry):
+    """Breach opens pending; sustained past pending_for_s it fires
+    (counter + gauge + ring event + callback); a healthy dwell of
+    resolve_for_s resolves it the same way."""
+    clock = FakeClock()
+    val = {"v": 1.0}
+    fired, resolved = [], []
+    eng = AlertEngine(
+        _slo_cfg(pending_for_s=2.0, resolve_for_s=2.0),
+        registry=fresh_telemetry, clock=clock,
+        sources={"availability": lambda: val["v"]},
+        on_fire=lambda r, i: fired.append((r, i)),
+        on_resolve=lambda r, i: resolved.append((r, i)))
+
+    assert eng.evaluate()["rule"]["state"] == "ok"
+    clock.advance(1.0)
+    val["v"] = 0.5
+    assert eng.evaluate()["rule"]["state"] == "pending"
+    clock.advance(1.5)                       # 1.5s of breach < 2s dwell
+    assert eng.evaluate()["rule"]["state"] == "pending"
+    assert not fired
+    clock.advance(1.0)                       # 2.5s of breach >= dwell
+    assert eng.evaluate()["rule"]["state"] == "firing"
+    assert [r for r, _ in fired] == ["rule"]
+    assert fired[0][1]["observed_fast"] == 0.5
+    snap = fresh_telemetry.snapshot()
+    firing_rows = snap["serve_alert_firing"]["series"]
+    assert [s["value"] for s in firing_rows] == [1.0]
+    states = {s["labels"]["state"]: s["value"]
+              for s in snap["serve_alerts_total"]["series"]}
+    assert states == {"pending": 1.0, "firing": 1.0}
+    assert len(events_of("alert_fire")) == 1
+
+    val["v"] = 1.0
+    clock.advance(1.0)
+    assert eng.evaluate()["rule"]["state"] == "firing"   # dwell not met
+    clock.advance(1.5)
+    assert eng.evaluate()["rule"]["state"] == "firing"   # 1.5s < 2s
+    clock.advance(1.0)
+    assert eng.evaluate()["rule"]["state"] == "resolved"
+    assert [r for r, _ in resolved] == ["rule"]
+    assert eng.fired_total == 1 and eng.resolved_total == 1
+    snap = fresh_telemetry.snapshot()
+    assert snap["serve_alert_firing"]["series"][0]["value"] == 0.0
+    ev = events_of("alert_resolve")
+    assert len(ev) == 1 and ev[0]["data"]["burn_seconds"] > 0
+
+
+def test_pending_blip_never_pages(fresh_telemetry):
+    """A breach shorter than pending_for_s folds back to ok quietly —
+    no fire, no event, no gauge."""
+    clock = FakeClock()
+    val = {"v": 0.5}
+    eng = AlertEngine(_slo_cfg(pending_for_s=5.0),
+                      registry=fresh_telemetry, clock=clock,
+                      sources={"availability": lambda: val["v"]})
+    assert eng.evaluate()["rule"]["state"] == "pending"
+    clock.advance(1.0)
+    val["v"] = 1.0
+    assert eng.evaluate()["rule"]["state"] == "ok"
+    clock.advance(10.0)
+    assert eng.evaluate()["rule"]["state"] == "ok"
+    assert eng.fired_total == 0
+    assert not events_of("alert_fire")
+    snap = fresh_telemetry.snapshot()
+    assert snap["serve_alert_firing"]["series"][0]["value"] == 0.0
+
+
+def test_no_data_holds_firing(fresh_telemetry):
+    """A None observation HOLDS the verdict: a burning alert must not
+    auto-clear because the signal's source went quiet."""
+    clock = FakeClock()
+    val = {"v": 0.5}
+    eng = AlertEngine(_slo_cfg(), registry=fresh_telemetry, clock=clock,
+                      sources={"availability": lambda: val["v"]})
+    assert eng.evaluate()["rule"]["state"] == "firing"
+    val["v"] = None
+    for _ in range(5):
+        clock.advance(10.0)
+        res = eng.evaluate()["rule"]
+        assert res["state"] == "firing" and res["no_data"]
+    assert eng.resolved_total == 0
+    assert eng.firing == ["rule"]
+
+
+def test_multi_window_requires_sustained_burn(fresh_telemetry):
+    """The burn-rate idiom: a sharp error burst breaches the fast
+    window immediately, but the rule stays ok until the SLOW window
+    confirms the burn is sustained — only then does it fire."""
+    clock = FakeClock()
+    eng = AlertEngine(
+        SLOConfig(enabled=True, eval_interval_s=0.0, objectives={
+            "errors": {"signal": "error_rate", "threshold": 0.5,
+                       "fast_window_s": 2.0, "slow_window_s": 10.0,
+                       "pending_for_s": 0.0}}),
+        registry=fresh_telemetry, clock=clock)
+    submitted = fresh_telemetry.counter("serve_requests_submitted_total")
+    rejected = fresh_telemetry.counter(
+        "serve_admission_rejections_total")
+    # 10s of clean traffic builds the slow window's healthy history
+    while clock() < 10.0:
+        submitted.inc(4)
+        eng.evaluate()
+        clock.advance(0.5)
+    # the burst starts: rejections only. The fast window flips above
+    # the threshold within ~2s while the slow window still remembers
+    # the clean 10s — the rule must hold at ok.
+    saw_fast_breach_while_ok = False
+    while clock() < 14.0:
+        rejected.inc(4)
+        res = eng.evaluate()["errors"]
+        if (res["observed_fast"] is not None
+                and res["observed_fast"] > 0.5
+                and res["state"] == "ok"):
+            saw_fast_breach_while_ok = True
+        clock.advance(0.5)
+    assert saw_fast_breach_while_ok
+    assert eng.fired_total == 0
+    # sustain the burst until the slow window confirms -> fires
+    while clock() < 30.0 and eng.fired_total == 0:
+        rejected.inc(4)
+        eng.evaluate()
+        clock.advance(0.5)
+    assert eng.fired_total == 1
+    res = eng.evaluate()["errors"]
+    assert res["observed_slow"] > 0.5
+
+
+# ---------------------------------------------------------------------
+# CanaryProber scoring (fake owner callables, fake clock)
+# ---------------------------------------------------------------------
+
+
+class _FakeOwner:
+    """Scriptable submit/result/finish_reason triple."""
+
+    def __init__(self):
+        self.next_rid = 0
+        self.finished = {}        # rid -> tokens (None = still running)
+        self.cancelled = []
+        self.submit_error = None
+        self.tenants = []
+
+    def submit(self, prompt, max_new_tokens, tenant=None):
+        if self.submit_error is not None:
+            raise self.submit_error
+        self.tenants.append(tenant)
+        rid = self.next_rid
+        self.next_rid += 1
+        self.finished[rid] = None
+        self.prompt = list(prompt)
+        return rid
+
+    def finish(self, rid, extra):
+        self.finished[rid] = self.prompt + list(extra)
+
+    def result(self, rid):
+        return self.finished.get(rid)
+
+    def finish_reason(self, rid):
+        return "eos" if self.finished.get(rid) is not None else None
+
+    def cancel(self, rid):
+        self.cancelled.append(rid)
+
+
+def _prober(owner, clock, registry, **cfg):
+    knobs = dict(enabled=True, interval_s=5.0, prompt_tokens=3,
+                 max_new_tokens=2, timeout_s=10.0)
+    knobs.update(cfg)
+    return CanaryProber(CanaryConfig(**knobs), submit=owner.submit,
+                        result=owner.result,
+                        finish_reason=owner.finish_reason,
+                        cancel=owner.cancel, registry=registry,
+                        clock=clock, vocab_size=128)
+
+
+def test_canary_pins_first_success_then_detects_drift(fresh_telemetry):
+    """The first timely finish pins the expected tokens; a later probe
+    reproducing them scores success, one drifting scores mismatch with
+    a canary_fail ring event."""
+    clock, owner = FakeClock(), _FakeOwner()
+    probe = _prober(owner, clock, fresh_telemetry)
+    assert probe.tick() is None               # injects probe 0
+    assert owner.tenants == [CANARY_TENANT]
+    clock.advance(0.25)
+    owner.finish(0, [7, 8])
+    assert probe.tick() == "success"
+    assert probe.expected == owner.prompt + [7, 8]
+
+    clock.advance(5.0)
+    probe.tick()                              # probe 1
+    owner.finish(1, [7, 8])
+    assert probe.tick() == "success"
+
+    clock.advance(5.0)
+    probe.tick()                              # probe 2 drifts
+    owner.finish(2, [7, 99])
+    assert probe.tick() == "mismatch"
+    snap = probe.snapshot()
+    assert snap["probes"] == 3 and snap["pinned"]
+    assert snap["results"] == {"success": 2, "mismatch": 1,
+                               "timeout": 0, "error": 0}
+    assert snap["success_ratio"] == pytest.approx(2 / 3)
+    assert snap["latency_p50_ms"] is not None
+    fails = events_of("canary_fail")
+    assert len(fails) == 1
+    assert fails[0]["data"]["outcome"] == "mismatch"
+    reg = fresh_telemetry.snapshot()
+    by_result = {s["labels"]["result"]: s["value"]
+                 for s in reg["serve_canary_probes_total"]["series"]}
+    assert by_result == {"success": 2.0, "mismatch": 1.0}
+    assert reg["serve_canary_success_total"]["series"][0]["value"] == 2.0
+    assert (reg["serve_canary_probes_started_total"]["series"][0]
+            ["value"] == 3.0)
+
+
+def test_canary_timeout_and_submit_error(fresh_telemetry):
+    """A probe past timeout_s scores timeout (and is cancelled); a
+    submit that raises — a shedding server — scores error instead of
+    crashing the prober."""
+    clock, owner = FakeClock(), _FakeOwner()
+    probe = _prober(owner, clock, fresh_telemetry, timeout_s=3.0)
+    probe.tick()                              # probe 0, never finishes
+    clock.advance(3.5)
+    assert probe.tick() == "timeout"
+    assert owner.cancelled == [0]
+
+    clock.advance(5.0)
+    owner.submit_error = RuntimeError("shed")
+    assert probe.tick() is None               # injection itself scored
+    snap = probe.snapshot()
+    assert snap["results"]["timeout"] == 1
+    assert snap["results"]["error"] == 1
+    assert snap["success_ratio"] == 0.0
+    kinds = [e["data"]["outcome"] for e in events_of("canary_fail")]
+    assert kinds == ["timeout", "error"]
+
+
+# ---------------------------------------------------------------------
+# IncidentRecorder episodes + watchdog unification (host-pure)
+# ---------------------------------------------------------------------
+
+
+def test_incident_episode_rate_limit_and_rearm(fresh_telemetry,
+                                               tmp_path):
+    """One bundle per episode: the first trigger captures, later
+    triggers attach (suppressed), resolve closes only when every joined
+    rule resolved — appending the post-recovery snapshot — and re-arms
+    the recorder for the next incident."""
+    clock = FakeClock()
+    state = {"phase": "broken"}
+    rec = IncidentRecorder(
+        IncidentConfig(enabled=True, dir=str(tmp_path),
+                       max_incidents=2),
+        collect=lambda: dict(state), clock=clock,
+        fingerprint="cafecafecafecafe", name="t")
+    b = rec.capture("alert", rule="a", info={"observed_fast": 0.1})
+    assert b is not None and b["incident"] == 1
+    assert b["phase"] == "broken" and not b["resolved"]
+    assert b["config_fingerprint"] == "cafecafecafecafe"
+    # a second rule joins the storm: attach, don't re-capture
+    assert rec.capture("alert", rule="b") is None
+    assert rec.capture("watchdog") is None
+    snap = rec.snapshot()
+    assert snap["captured_total"] == 1
+    assert snap["suppressed_total"] == 2
+    assert snap["open_rules"] == ["a", "b"]
+    # the episode closes only when BOTH rules resolved
+    assert rec.resolve("a") is None
+    state["phase"] = "recovered"
+    clock.advance(9.0)
+    closed = rec.resolve("b")
+    assert closed is not None and closed["resolved"]
+    assert closed["resolution"]["phase"] == "recovered"
+    assert len(closed["triggers"]) == 3
+    with open(closed["path"]) as f:
+        assert json.load(f)["resolution"]["phase"] == "recovered"
+    # re-armed: the next trigger captures a FRESH bundle...
+    assert rec.capture("alert", rule="a")["incident"] == 2
+    rec.resolve("a")
+    assert rec.capture("alert", rule="a")["incident"] == 3
+    # ...and retention stays bounded at max_incidents
+    assert [i["incident"] for i in rec.snapshot()["incidents"]] == [2, 3]
+    assert rec.snapshot()["captured_total"] == 3
+
+
+def test_watchdog_dump_joins_alert_episode(fresh_telemetry):
+    """The stall-dump path is unified with alert capture: a watchdog
+    dump is a forensic trigger under the SAME episode machinery —
+    a stall that also pages yields one bundle, not two."""
+    clock = FakeClock()
+    rec = IncidentRecorder(IncidentConfig(enabled=True),
+                           collect=lambda: {"ok": True}, clock=clock)
+    wd = Watchdog(deadline_s=2.0, clock=clock,
+                  registry=get_registry())
+    wd.set_on_dump(lambda dump: rec.capture(
+        "watchdog", info={"idle_seconds": dump["idle_seconds"]}))
+    clock.advance(3.0)
+    assert wd.check() is True
+    assert rec.snapshot()["captured_total"] == 1
+    bundle = rec.snapshot()["incidents"][0]
+    assert bundle["trigger"] == "watchdog"
+    assert bundle["triggers"][0]["info"]["idle_seconds"] == 3.0
+    # the stall trips a rule too: it attaches to the open episode
+    assert rec.capture("alert", rule="avail") is None
+    assert rec.snapshot()["suppressed_total"] == 1
+    # recovery resolves the joined rule -> closed + re-armed
+    assert rec.resolve("avail") is not None
+    assert not rec.snapshot()["episode_open"]
+
+
+# ---------------------------------------------------------------------
+# Server / frontend integration
+# ---------------------------------------------------------------------
+
+
+def test_default_config_builds_nothing(fresh_telemetry):
+    """A default-config server builds NONE of the closed loop and
+    registers ZERO of its instruments; slo.enabled=false is
+    byte-identical whatever objectives says."""
+    reg = MetricRegistry()
+    srv = ContinuousBatchingServer(make_engine(), registry=reg)
+    try:
+        assert srv.alerts is None and srv.canary is None
+        assert srv.incidents is None
+        rid = srv.submit([1, 2, 3], max_new_tokens=4)
+        srv.drain()
+        assert srv.finish_reason(rid) in ("eos", "length")
+        for name in _LOOP_METRICS:
+            assert name not in reg.snapshot()
+        assert srv.incidents_snapshot()["enabled"] is False
+        with pytest.raises(RuntimeError, match="incident"):
+            srv.dump_incident("/tmp/never-written.json")
+    finally:
+        srv.close()
+
+    # the master switch: objectives declared but slo.enabled=false
+    reg2 = MetricRegistry()
+    srv2 = ContinuousBatchingServer(make_engine(telemetry={
+        "slo": {"enabled": False, "objectives": {
+            "avail": {"signal": "goodput", "threshold": 0.5}}}}),
+        registry=reg2)
+    try:
+        assert srv2.alerts is None
+        for name in _LOOP_METRICS:
+            assert name not in reg2.snapshot()
+    finally:
+        srv2.close()
+
+
+def _closed_loop_telemetry(tmp_path=None, kill_step=0):
+    t = {
+        "slo": {"enabled": True, "eval_interval_s": 0.0,
+                "objectives": {"availability": {
+                    "signal": "availability", "threshold": 0.99,
+                    "fast_window_s": 1.0, "slow_window_s": 5.0,
+                    "pending_for_s": 0.0, "resolve_for_s": 0.0}}},
+        "canary": {"enabled": True, "interval_s": 1.0},
+        "incident": {"enabled": True,
+                     **({"dir": str(tmp_path)} if tmp_path else {})},
+    }
+    if kill_step:
+        t["fault_injection"] = {"enabled": True, "seed": 3,
+                                "replica_kill_step": kill_step}
+    return t
+
+
+def test_headline_replica_kill_closed_loop(fresh_telemetry, tmp_path):
+    """THE oracle: a seeded decode-replica kill walks the availability
+    rule ok -> firing -> resolved on the fake clock, captures EXACTLY
+    ONE bundle (re-armed after resolve), the bundle round-trips with
+    the firing rule + replica rows + post-recovery resolution, every
+    request still finishes via failover, and the canary stays green and
+    unbilled throughout."""
+    eng = make_engine(replicas=2,
+                      telemetry=_closed_loop_telemetry(tmp_path,
+                                                       kill_step=6))
+    clock = FakeClock()
+    front = ServingFrontend(eng, clock=clock)
+    ids = [front.submit([1 + i, 2, 3], max_new_tokens=8)
+           for i in range(4)]
+    states = []
+    for step in range(40):
+        front.step()
+        clock.advance(0.5)
+        states.append(front.alerts.snapshot()["rules"]["availability"]
+                      ["state"])
+        if not front._requests and states[-1] == "ok" and step > 12:
+            break
+    try:
+        # state walk: healthy before the kill, firing after it, and the
+        # failover's recovery resolves it (resolved counts as healthy;
+        # a later evaluate may re-enter ok)
+        assert states[0] == "ok"
+        assert "firing" in states
+        assert states[-1] in ("resolved", "ok")
+        assert states.index("firing") > 0
+        assert front.alerts.fired_total == 1
+        assert front.alerts.resolved_total == 1
+        assert len(events_of("alert_fire")) == 1
+        assert len(events_of("alert_resolve")) == 1
+
+        # EXACTLY ONE bundle for the whole episode
+        inc = front.incidents.snapshot()
+        assert inc["captured_total"] == 1
+        assert not inc["episode_open"]
+        bundle = inc["incidents"][0]
+        assert bundle["rule"] == "availability"
+        assert bundle["trigger"] == "alert"
+        assert bundle["resolved"] is True
+        assert bundle["config_fingerprint"]
+        # pool forensics: replica rows, capacity, events, alert rows,
+        # and the post-recovery resolution snapshot
+        assert len(bundle["replicas"]["replicas"]) == 2
+        assert "capacity" in bundle and "events" in bundle
+        assert bundle["alerts"]["rules"]["availability"]["fired"] == 1
+        res = bundle["resolution"]
+        assert res["availability"] == 1.0
+        assert any(r["health"] == "dead"
+                   for r in res["replicas"]["replicas"])
+        json.dumps(bundle)                    # JSON round-trip holds
+        with open(bundle["path"]) as f:
+            assert json.load(f)["incident"] == bundle["incident"]
+
+        # re-armed: the NEXT incident captures fresh
+        assert front.incidents.capture("alert", rule="availability") \
+            is not None
+        assert front.incidents.snapshot()["captured_total"] == 2
+
+        # no request lost across the kill...
+        for rid in ids:
+            assert front.finish_reason(rid) in ("eos", "length")
+            assert front.result(rid)
+        # ...and the canary probed the broken pool green + unbilled
+        cs = front.canary.snapshot()
+        assert cs["probes"] >= 4 and cs["success_ratio"] == 1.0
+        assert front.stats["accounting"]["requests_billed"] == 4
+    finally:
+        front.close()
+
+
+def test_undisturbed_pool_fires_zero_alerts(fresh_telemetry):
+    """The false-positive pin: the same closed-loop config over a
+    healthy pool must never leave ok — zero fires, zero bundles, the
+    firing gauge flat at 0."""
+    eng = make_engine(replicas=2, telemetry=_closed_loop_telemetry())
+    clock = FakeClock()
+    front = ServingFrontend(eng, clock=clock)
+    ids = [front.submit([1 + i, 2, 3], max_new_tokens=8)
+           for i in range(4)]
+    for _ in range(24):
+        front.step()
+        clock.advance(0.5)
+        if not front._requests and \
+                front.canary.snapshot()["probes"] >= 4:
+            break
+    try:
+        assert front.alerts.fired_total == 0
+        assert front.alerts.firing == []
+        assert front.incidents.snapshot()["captured_total"] == 0
+        assert not events_of("alert_fire")
+        reg = front.telemetry.snapshot()
+        assert all(s["value"] == 0.0
+                   for s in reg["serve_alert_firing"]["series"])
+        assert "firing" not in {
+            s["labels"]["state"]
+            for s in reg.get("serve_alerts_total",
+                             {"series": []})["series"]}
+        for rid in ids:
+            assert front.finish_reason(rid) in ("eos", "length")
+        assert front.canary.snapshot()["success_ratio"] == 1.0
+    finally:
+        front.close()
+
+
+def _run_billed_workload(telemetry):
+    """Three tenant requests through a server; returns the comparable
+    (integer/label) halves of the money paths."""
+    reg = MetricRegistry()
+    srv = ContinuousBatchingServer(
+        make_engine(telemetry=telemetry), registry=reg)
+    try:
+        rids = [srv.submit([1 + i, 2, 3], max_new_tokens=4,
+                           tenant=f"t{i % 2}") for i in range(3)]
+        srv.drain()
+        bills = {rid: srv.request_cost(rid) for rid in rids}
+        acct = srv.stats["accounting"]
+        snap = reg.snapshot()
+        # device-seconds are wall-timing floats — never comparable
+        # across runs; every OTHER tenant quantity is integral and
+        # must match byte-for-byte
+        tenant_series = {
+            name: sorted((s["labels"]["tenant"], s["value"])
+                         for s in snap[name]["series"])
+            for name in snap if name.startswith("serve_tenant_")
+            and "device_seconds" not in name}
+        return {
+            "closed_records": acct["closed_records"],
+            "tenants": {t: {k: v for k, v in m.items()
+                            if "device_seconds" not in k}
+                        for t, m in acct["tenants"].items()},
+            "tenant_series": tenant_series,
+            "bill_tokens": {rid: (b["tokens_in"], b["tokens_out"])
+                            for rid, b in bills.items()},
+            "canary_probes": (srv.canary.snapshot()["probes"]
+                              if srv.canary is not None else 0),
+        }
+    finally:
+        srv.close()
+
+
+def test_canary_excluded_from_money_paths(fresh_telemetry):
+    """Byte-identity pin: with the canary probing hard (interval 0 — a
+    probe in flight at all times), tenant metering, bills and the
+    tenant counter series are IDENTICAL to a canary-off run, and no
+    ``__canary`` label leaks anywhere."""
+    base = {"accounting": {"enabled": True}}
+    off = _run_billed_workload(dict(base))
+    on_cfg = dict(base)
+    # interval must be > 0 (config validator); 1 microsecond on the
+    # real clock means a fresh probe is in flight essentially always
+    on_cfg["canary"] = {"enabled": True, "interval_s": 1e-6,
+                        "max_new_tokens": 2}
+    on = _run_billed_workload(on_cfg)
+    assert on["canary_probes"] > 0            # the canary really ran
+    for key in ("closed_records", "tenants", "tenant_series",
+                "bill_tokens"):
+        assert on[key] == off[key], key
+    assert CANARY_TENANT not in json.dumps(on["tenant_series"])
+
+
+def test_dump_incident_and_stats_rows(fresh_telemetry, tmp_path):
+    """The operator's manual pull: ``dump_incident`` writes a bundle
+    outside the episode rate limit, and ``stats`` exposes the
+    alerts/canary/incidents rows the /debug/incidents route serves."""
+    srv = ContinuousBatchingServer(
+        make_engine(telemetry=_closed_loop_telemetry()),
+        registry=MetricRegistry())
+    try:
+        rid = srv.submit([1, 2, 3], max_new_tokens=4)
+        srv.drain()
+        assert srv.finish_reason(rid) in ("eos", "length")
+        path = str(tmp_path / "manual.json")
+        bundle = srv.dump_incident(path)
+        assert bundle["trigger"] == "manual"
+        with open(path) as f:
+            ondisk = json.load(f)
+        assert ondisk["incident"] == bundle["incident"]
+        assert "observability" in ondisk and "capacity" in ondisk
+        # manual dumps are never rate limited
+        assert srv.dump_incident(str(tmp_path / "m2.json"))
+        assert srv.incidents.snapshot()["captured_total"] == 2
+        body = srv.incidents_snapshot()
+        assert body["enabled"] is True
+        assert body["alerts"]["rules"]["availability"]["state"]
+        assert body["canary"]["probes"] >= 0
+        st = srv.stats
+        assert st["alerts"] is not None
+        assert st["canary"] is not None
+        assert st["incidents"]["captured_total"] == 2
+        from deepspeed_tpu.telemetry import last_incident_path
+        assert last_incident_path() == str(tmp_path / "m2.json")
+    finally:
+        srv.close()

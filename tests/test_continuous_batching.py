@@ -303,6 +303,20 @@ def test_bench_serve_continuous_smoke():
                                      "queue_wait_p90", "error_rate"}
     for obj in sb["objectives"].values():
         assert obj["violated"] is False
+    # closed-loop mini-legs (docs/observability.md "SLOs, alerting &
+    # incidents"): the undisturbed leg must not page — any false
+    # positive is a semantics regression — while the seeded-kill leg
+    # must walk the availability rule through firing -> resolved with
+    # EXACTLY ONE incident bundle (episode rate limit) and still finish
+    # every request via failover; the canary probes the same pool
+    # throughout and must stay green on both legs
+    assert sb["false_positive_alerts"] == 0
+    assert sb["alerts_fired"] >= 1
+    assert sb["alerts_resolved"] >= 1
+    assert sb["bundle_captured"] == 1
+    assert sb["chaos_finished"] == 4
+    assert sb["canary_success_ratio"] == 1.0
+    assert 0 < sb["canary_p50_ms"] <= sb["canary_p90_ms"]
     # shared-prefix replay (auto 8 requests in smoke mode): prefix
     # caching must actually hit, skip prefill compute vs the cold
     # baseline, and stay token-identical to caching-off

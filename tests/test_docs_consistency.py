@@ -57,6 +57,23 @@ def test_metric_catalog_in_sync():
     assert not errors, "\n".join(errors)
 
 
+def test_debug_routes_in_sync_and_live():
+    """Every route in telemetry/exporter.py ROUTES is documented in
+    docs/observability.md 'Scrape endpoint' AND answers with a
+    parseable body over a live ephemeral listener with no owner
+    callables wired — scripts/check_debug_routes.py as a tier-1 gate,
+    so a new route can neither ship undocumented nor 500 in the
+    degraded configuration an operator curls first."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_debug_routes",
+        os.path.join(ROOT, "scripts", "check_debug_routes.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    errors = mod.check()
+    assert not errors, "\n".join(errors)
+
+
 def test_config_reference_up_to_date():
     """docs/config.md is GENERATED from the pydantic config models
     (scripts/gen_config_reference.py); regeneration must be byte-identical,
