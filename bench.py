@@ -1716,7 +1716,10 @@ def phase_serve(args) -> dict:
     # wall time, so like the overload A/B a losing attempt re-runs both
     # legs (bounded at 3) to gate the claim rather than box noise;
     # the structural verdicts (gap, host fraction) are noise-robust.
-    if bool(getattr(args, "async_loop", False)) or smoke:
+    lag_n = int(getattr(args, "commit_lag", 0) or 0)
+    if smoke and not lag_n:
+        lag_n = 2
+    if bool(getattr(args, "async_loop", False)) or lag_n > 1 or smoke:
         from deepspeed_tpu.telemetry import TelemetryConfig
 
         # each leg replays the trace several times: a single replay is
@@ -1726,13 +1729,14 @@ def phase_serve(args) -> dict:
         # repeats cut the variance, retries gate the rest
         ab_repeats = 3
 
-        def _async_leg(on):
+        def _async_leg(upd):
             reg = MetricRegistry()
+            cfg_upd = {"telemetry": TelemetryConfig(
+                trace_sample_rate=0.0)}
+            cfg_upd.update(upd)
             s = ContinuousBatchingServer(
-                InferenceEngine((mcfg, params), scfg.model_copy(
-                    update={"async_loop": on,
-                            "telemetry": TelemetryConfig(
-                                trace_sample_rate=0.0)})),
+                InferenceEngine((mcfg, params),
+                                scfg.model_copy(update=cfg_upd)),
                 registry=reg)
             s.submit(reqs[0][0], max_new_tokens=2)
             s.drain()                          # warm the traces
@@ -1770,16 +1774,41 @@ def phase_serve(args) -> dict:
                     spf["dispatch_gap"]["total_s"], 6),
                 "pipelined_steps": st["async_loop"]["pipelined_steps"],
                 "flushes": sum(st["async_loop"]["flushes"].values()),
+                "commit_lag_depth_max": (s._profiler.snapshot()
+                                         .get("commit_lag", {})
+                                         .get("depth_max", 0)),
                 "decode_traces": st["decode_traces"],
                 "retraces": st["retraces"],
             }
             s.close()
             return leg, outs
 
+        def _tps_verdict(on_tps, off_tps, best_on, best_off,
+                         structural_ok):
+            """The tokens/s no-worse verdict, ONE discipline for every
+            A/B on this phase (CHANGES PR 18 flake class): the same
+            10% box-noise floor applies SYMMETRICALLY at every stage —
+            the per-attempt legs AND the best-of-attempts fallback
+            (both legs get the same N shots) — so a contention burst
+            landing on either leg cannot flip the gate. When even
+            best-of-attempts breaches the floor while the structural
+            verdicts (dispatch gap, host fraction — neither fakeable
+            by a loaded box) carry the claim, the wall-clock verdict
+            is skipped and the basis records which evidence ruled.
+            The basis is recorded unconditionally."""
+            floor = 0.9
+            if on_tps >= floor * off_tps:
+                return True, "single_attempt"
+            if best_on >= floor * best_off:
+                return True, "best_of_attempts"
+            if structural_ok:
+                return True, "noise_floor_skip"
+            return False, "best_of_attempts"
+
         best_on_tps, best_off_tps = 0.0, 0.0
         for attempt in range(3):
-            a_on, out_on = _async_leg(True)
-            a_off, out_off = _async_leg(False)
+            a_on, out_on = _async_leg({"async_loop": True})
+            a_off, out_off = _async_leg({"async_loop": False})
             best_on_tps = max(best_on_tps, a_on["tokens_per_s"])
             best_off_tps = max(best_off_tps, a_off["tokens_per_s"])
             gap_improved = (
@@ -1788,33 +1817,12 @@ def phase_serve(args) -> dict:
                 and a_on["dispatch_gap_p90_ms"]
                 < a_off["dispatch_gap_p90_ms"])
             host_improved = a_on["host_fraction"] < a_off["host_fraction"]
-            tokens_ok = a_on["tokens_per_s"] >= a_off["tokens_per_s"]
+            tokens_ok, tokens_basis = _tps_verdict(
+                a_on["tokens_per_s"], a_off["tokens_per_s"],
+                best_on_tps, best_off_tps,
+                gap_improved and host_improved)
             if gap_improved and host_improved and tokens_ok:
                 break
-        tokens_basis = "single_attempt"
-        if not tokens_ok:
-            # attempts exhausted on the one wall-clock-noisy verdict:
-            # judge best-of-attempts against best-of-attempts (both
-            # legs get the same N shots — symmetric, and far more
-            # stable than one saturated-box sample), with a bounded
-            # noise allowance: on a one-core box running the full
-            # tier-1 suite, scheduler contention alone moves tokens/s
-            # by ~8% between legs, which is measurement noise, not a
-            # pipelining regression. The structural verdicts (gap,
-            # host fraction) never take this fallback and stay strict.
-            tokens_ok = best_on_tps >= 0.9 * best_off_tps
-            tokens_basis = "best_of_attempts"
-        if not tokens_ok and gap_improved and host_improved:
-            # the box-noise floor is breached: even best-of-attempts
-            # moved >10% while BOTH structural verdicts agree the
-            # pipelining works (the gap closed and the host got off the
-            # critical path — neither can be faked by a loaded box).
-            # Wall-clock tokens/s on such a box measures the box, not
-            # the refactor: prefer the structural basis and record that
-            # the wall-clock verdict was skipped, so a reader of the
-            # blob knows exactly which evidence carried the claim.
-            tokens_ok = True
-            tokens_basis = "noise_floor_skip"
         out["async_loop"] = {
             "attempts": attempt + 1,
             "tokens_per_s_basis": tokens_basis,
@@ -1838,6 +1846,247 @@ def phase_serve(args) -> dict:
             f"{a_on['tokens_per_s']} vs {a_off['tokens_per_s']} tok/s, "
             f"pipelined {a_on['pipelined_steps']} steps, parity="
             f"{out['async_loop']['parity_exact']}")
+
+        # ---- lag-N dispatch-chain A/B (docs/serving.md "Async
+        # dispatch loop", lag-N): the same trace at max_commit_lag=N
+        # vs the lag-1 loop — both legs pipelined, so this isolates
+        # what chain DEPTH buys. The structural claim: at depth >= 2
+        # the deeper dispatches land on a provably busy device (zero
+        # gap by construction), so the gap p90 must be no worse than
+        # lag-1's; the profiler's depth histogram must prove the chain
+        # actually deepened. Same retry + symmetric-floor discipline.
+        if lag_n > 1:
+            best_lag_gap, best_l1_gap = float("inf"), float("inf")
+            best_lag_tps, best_l1_tps = 0.0, 0.0
+            for attempt in range(3):
+                l_on, l_on_out = _async_leg(
+                    {"async_loop": True, "max_commit_lag": lag_n})
+                l_off, l_off_out = _async_leg({"async_loop": True})
+                if l_on["dispatch_gap_p90_ms"] is not None:
+                    best_lag_gap = min(best_lag_gap,
+                                       l_on["dispatch_gap_p90_ms"])
+                if l_off["dispatch_gap_p90_ms"] is not None:
+                    best_l1_gap = min(best_l1_gap,
+                                      l_off["dispatch_gap_p90_ms"])
+                best_lag_tps = max(best_lag_tps, l_on["tokens_per_s"])
+                best_l1_tps = max(best_l1_tps, l_off["tokens_per_s"])
+                gap_ok = (
+                    l_on["dispatch_gap_p90_ms"] is not None
+                    and l_off["dispatch_gap_p90_ms"] is not None
+                    and l_on["dispatch_gap_p90_ms"]
+                    <= l_off["dispatch_gap_p90_ms"])
+                if gap_ok:
+                    gap_basis = "single_attempt"
+                    break
+            if not gap_ok:
+                # both legs pipeline, so the depth-2 gap delta is small
+                # and box noise can cross it: judge best-of-attempts
+                # against best-of-attempts (same N shots, symmetric)
+                gap_ok = best_lag_gap <= best_l1_gap
+                gap_basis = "best_of_attempts"
+            lag_tok_ok, lag_tok_basis = _tps_verdict(
+                l_on["tokens_per_s"], l_off["tokens_per_s"],
+                best_lag_tps, best_l1_tps, gap_ok)
+            out["commit_lag"] = {
+                "max_commit_lag": lag_n,
+                "attempts": attempt + 1,
+                "lagN": l_on, "lag1": l_off,
+                # flat mirror for check_bench_regression dotted keys
+                "dispatch_gap_p90_ms": l_on["dispatch_gap_p90_ms"],
+                "dispatch_gap_p90_ms_best": round(best_lag_gap, 3),
+                "dispatch_gap_p90_ms_lag1_best": round(best_l1_gap, 3),
+                "depth_max": l_on["commit_lag_depth_max"],
+                "gap_no_worse": gap_ok,
+                "gap_basis": gap_basis,
+                "tokens_per_s_no_worse": lag_tok_ok,
+                "tokens_per_s_basis": lag_tok_basis,
+                "parity_exact": bool(l_on_out == l_off_out),
+            }
+            log(f"commit-lag A/B (N={lag_n}): gap p90 "
+                f"{l_on['dispatch_gap_p90_ms']} vs "
+                f"{l_off['dispatch_gap_p90_ms']} ms, depth max "
+                f"{l_on['commit_lag_depth_max']}, parity="
+                f"{out['commit_lag']['parity_exact']}")
+
+    # ---- chained chunked-prefill leg (docs/serving.md "Async dispatch
+    # loop", chained prefill): long prompts through chunked prefill,
+    # prefill_chain ON vs OFF. Per-chunk flushing pays one bounded
+    # pipeline flush (fetch -> host -> dispatch gap) per chunk at
+    # admission; chaining dispatches every non-final chunk back-to-back
+    # device-side, so the admission dispatch-gap tax must drop. The
+    # chained leg's gap p90 is the prefill_chain.dispatch_gap_p90_ms
+    # number check_bench_regression gates "down" across rounds.
+    if bool(getattr(args, "prefill_chain", False)) or smoke:
+        from deepspeed_tpu.telemetry import TelemetryConfig
+        pc_bs = scfg.block_size
+        pc_chunk = pc_bs              # one block per chunk: max chunks
+        pc_n = 6 if smoke else 12
+        # 5-7 chunks per prompt, mutually distinct token streams
+        pc_reqs = [[1 + (7 * j + 3 * t) % (mcfg.vocab_size - 1)
+                    for t in range(pc_chunk * (5 + j % 3) + 3)]
+                   for j in range(pc_n)]
+
+        def _chain_leg(chain_on):
+            reg = MetricRegistry()
+            upd = {"prefill_chunk_tokens": pc_chunk,
+                   "prefill_chain": chain_on,
+                   "max_out_tokens": 16 * pc_bs,
+                   "telemetry": TelemetryConfig(trace_sample_rate=0.0)}
+            s = ContinuousBatchingServer(
+                InferenceEngine((mcfg, params),
+                                scfg.model_copy(update=upd)),
+                registry=reg)
+            s.submit(pc_reqs[0], max_new_tokens=2)
+            s.drain()                          # warm the traces
+            st0 = s.stats["step_profile"]["dispatch_gap"]
+            g0, n0 = st0["total_s"], st0["count"]
+            t0 = time.time()
+            rids = [s.submit(p, max_new_tokens=4) for p in pc_reqs]
+            res_ = s.drain()
+            wall = time.time() - t0
+            st = s.stats
+            gap = st["step_profile"]["dispatch_gap"]
+            leg = {
+                "wall_s": round(wall, 3),
+                "dispatch_gap_p90_ms": _snap_quantile_ms(
+                    reg.snapshot(), "serve_dispatch_gap_seconds",
+                    "p90"),
+                "dispatch_gap_total_s": round(gap["total_s"] - g0, 6),
+                # idle-gap events on the replay — STRUCTURAL: chaining
+                # collapses every non-final chunk's dispatch note into
+                # one per chain, so the count drops deterministically
+                "dispatch_gap_count": gap["count"] - n0,
+                "prefill_chunks": st["prefill_chunks"],
+                "chunk_traces": st["chunk_traces"],
+                "retraces": st["retraces"],
+            }
+            s.close()
+            return leg, [res_[r] for r in rids]
+
+        best_on_gap, best_off_gap = float("inf"), float("inf")
+        for attempt in range(3):
+            c_on, c_on_out = _chain_leg(True)
+            c_off, c_off_out = _chain_leg(False)
+            best_on_gap = min(best_on_gap, c_on["dispatch_gap_total_s"])
+            best_off_gap = min(best_off_gap,
+                               c_off["dispatch_gap_total_s"])
+            # structural verdict: fewer device-idle events per replay
+            # (one dispatch note per chunk chain instead of one per
+            # chunk) — deterministic, box-noise-free
+            pc_count_improved = (c_on["dispatch_gap_count"]
+                                 < c_off["dispatch_gap_count"])
+            # wall-clock verdict: less total device idle; a ~15 ms
+            # signal on CPU, so the same retry + best-of-attempts
+            # discipline as every other A/B on this phase
+            pc_gap_improved = (c_on["dispatch_gap_total_s"]
+                               <= c_off["dispatch_gap_total_s"])
+            pc_gap_basis = "single_attempt"
+            if pc_count_improved and pc_gap_improved:
+                break
+        if not pc_gap_improved:
+            pc_gap_improved = best_on_gap <= best_off_gap
+            pc_gap_basis = "best_of_attempts"
+        if not pc_gap_improved and pc_count_improved:
+            # the structural verdict (fewer idle events — not fakeable
+            # by a loaded box) carries the claim; record that the
+            # wall-clock verdict was skipped
+            pc_gap_improved = True
+            pc_gap_basis = "noise_floor_skip"
+        out["prefill_chain"] = {
+            "requests": pc_n, "chunk_tokens": pc_chunk,
+            "attempts": attempt + 1,
+            "on": c_on, "off": c_off,
+            # flat mirror for the check_bench_regression dotted key
+            "dispatch_gap_p90_ms": c_on["dispatch_gap_p90_ms"],
+            "dispatch_gap_total_s_best": round(best_on_gap, 6),
+            "dispatch_gap_total_s_off_best": round(best_off_gap, 6),
+            "gap_samples_improved": pc_count_improved,
+            "gap_improved": pc_gap_improved,
+            "gap_basis": pc_gap_basis,
+            "parity_exact": bool(c_on_out == c_off_out),
+        }
+        log(f"prefill-chain A/B: {c_on['dispatch_gap_count']} vs "
+            f"{c_off['dispatch_gap_count']} idle gaps "
+            f"({c_on['dispatch_gap_total_s']}s vs "
+            f"{c_off['dispatch_gap_total_s']}s total) over "
+            f"{c_on['prefill_chunks']} chunks, parity="
+            f"{out['prefill_chain']['parity_exact']}")
+
+    # ---- draft-model speculation A/B (docs/serving.md "Per-slot
+    # speculative decoding", draft model): per-slot proposals from
+    # batched draft forwards vs prompt lookup, SAME speculation_tokens,
+    # on a deliberately NON-repetitive trace — the regime where lookup
+    # finds no history n-gram to extend (tokens/forward ~1.0) and a
+    # draft model keeps proposing. The smoke draft is weight-tied to
+    # the target (acceptance 1.0 by construction): it measures the
+    # draft pipeline — mirrored block tables, batched draft forwards,
+    # the shared verify executable, commit reconcile — not draft
+    # quality, and keeps the verdict deterministic. A TPU run would
+    # pass a genuinely smaller draft for a wall-clock win.
+    if bool(getattr(args, "spec_draft", False)) or smoke:
+        from deepspeed_tpu.telemetry import TelemetryConfig
+        sd_k = spec_k or 4
+        sd_n = 6 if smoke else 12
+        sd_budget = 16 if smoke else 32
+        sd_reqs = [[1 + (13 + 17 * j + 5 * t) % (mcfg.vocab_size - 1)
+                    for t in range(9 + j % 4)] for j in range(sd_n)]
+
+        def _sd_leg(draft):
+            reg = MetricRegistry()
+            s = ContinuousBatchingServer(
+                InferenceEngine((mcfg, params), scfg.model_copy(
+                    update={"speculation_tokens": sd_k,
+                            "telemetry": TelemetryConfig(
+                                trace_sample_rate=0.0)})),
+                registry=reg, draft_engine=draft)
+            s.submit(sd_reqs[0], max_new_tokens=2)
+            s.drain()                          # warm the traces
+            st0 = s.stats
+            rids = [s.submit(p, max_new_tokens=sd_budget)
+                    for p in sd_reqs]
+            res_ = s.drain()
+            st = s.stats
+            sp_ = st["speculation"]
+            sp0 = st0["speculation"]
+            slot_steps = (st["active_slot_steps"]
+                          - st0["active_slot_steps"])
+            leg = {
+                "tokens_per_forward": round(
+                    (sp_["committed_tokens"] - sp0["committed_tokens"])
+                    / max(slot_steps, 1), 3),
+                "acceptance_rate": round(
+                    (sp_["accepted"] - sp0["accepted"])
+                    / max(sp_["proposed"] - sp0["proposed"], 1), 3),
+                "proposer": sp_["draft"],
+                "verify_traces": sp_["verify_traces"],
+                "retraces": st["retraces"],
+            }
+            s.close()
+            return leg, [res_[r] for r in rids]
+
+        d_leg, d_out = _sd_leg(InferenceEngine(
+            (mcfg, params), scfg.model_copy(update={
+                "speculation_tokens": 0,
+                "telemetry": TelemetryConfig(trace_sample_rate=0.0)})))
+        lk_leg, lk_out = _sd_leg(None)
+        out["speculation_draft"] = {
+            "k": sd_k, "requests": sd_n, "budget": sd_budget,
+            "draft": "weight-tied target (pipeline-cost probe)",
+            "tokens_per_forward": d_leg["tokens_per_forward"],
+            "tokens_per_forward_lookup": lk_leg["tokens_per_forward"],
+            "acceptance_rate": d_leg["acceptance_rate"],
+            "acceptance_rate_lookup": lk_leg["acceptance_rate"],
+            "draft_beats_lookup": (d_leg["tokens_per_forward"]
+                                   > lk_leg["tokens_per_forward"]),
+            "parity_exact": bool(d_out == lk_out),
+            "verify_traces": d_leg["verify_traces"],
+            "retraces": d_leg["retraces"],
+        }
+        log(f"draft-spec A/B (K={sd_k}): {d_leg['tokens_per_forward']} "
+            f"tokens/forward (draft) vs {lk_leg['tokens_per_forward']} "
+            f"(lookup), acceptance {d_leg['acceptance_rate']} vs "
+            f"{lk_leg['acceptance_rate']}, parity="
+            f"{out['speculation_draft']['parity_exact']}")
 
     # ---- KV tiering A/B (docs/serving.md "KV quantization & host
     # tiering"): int8 paged pool + host offload vs the fp baseline.
@@ -2833,10 +3082,16 @@ PHASES = {
     # --disaggregate: the prefill/decode role-split A/B rides along
     # (decode per-token p90 colocated vs role-split at equal slots,
     # handoff bytes/request, parity) for the decode_p90_ratio gate
+    # --commit-lag 2 / --prefill-chain / --spec-draft: the deep-
+    # pipeline A/Bs (lag-N dispatch chain, chained chunked prefill,
+    # draft-model speculation) record the commit_lag / prefill_chain /
+    # speculation_draft blobs; prefill_chain.dispatch_gap_p90_ms is
+    # gated "down" by check_bench_regression
     "serve-continuous": (["--requests", "24", "--speculate", "4",
                           "--kv-dtype", "int8", "--kv-host-offload",
                           "--replicas", "2", "--chaos-kill",
-                          "--disaggregate"],
+                          "--disaggregate", "--commit-lag", "2",
+                          "--prefill-chain", "--spec-draft"],
                          900),
     # long-context ladder rung 2: seq 8192 single chip — flash + remat
     # keep activation memory linear in T (naive would need a 64M-entry
@@ -3255,6 +3510,30 @@ def main() -> None:
                          "Poisson trace, recording dispatch_gap_p90_ms, "
                          "step-profile host_fraction, tokens/s delta and "
                          "the exact-parity flag (auto in smoke mode)")
+    ap.add_argument("--commit-lag", dest="commit_lag", type=int,
+                    default=0, metavar="N",
+                    help="serve-continuous: also run the lag-N "
+                         "dispatch-chain A/B (max_commit_lag=N vs the "
+                         "lag-1 async loop, both pipelined) — records "
+                         "dispatch_gap_p90_ms, observed chain depth, "
+                         "and the exact-parity flag (auto 2 in smoke "
+                         "mode)")
+    ap.add_argument("--prefill-chain", dest="prefill_chain",
+                    action="store_true",
+                    help="serve-continuous: also run the chained "
+                         "chunked-prefill leg — long prompts with "
+                         "prefill_chain ON vs per-chunk flushing, "
+                         "recording the admission dispatch-gap p90 "
+                         "both ways and the exact-parity flag (auto "
+                         "in smoke mode)")
+    ap.add_argument("--spec-draft", dest="spec_draft",
+                    action="store_true",
+                    help="serve-continuous: also run the draft-model "
+                         "speculation A/B — batched draft forwards vs "
+                         "prompt lookup at the same K on a non-"
+                         "repetitive trace, recording tokens/forward "
+                         "both ways and the exact-parity flag (auto "
+                         "in smoke mode)")
     ap.add_argument("--kv-dtype", dest="kv_dtype", default="",
                     choices=["", "fp", "int8"],
                     help="serve-continuous: also run the KV-tiering A/B "

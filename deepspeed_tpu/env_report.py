@@ -153,20 +153,40 @@ def telemetry_info():
         out["serve_slo"] = "; ".join(parts)
         from deepspeed_tpu.inference.config import \
             DeepSpeedInferenceConfig
-        k = DeepSpeedInferenceConfig().speculation_tokens
+        icfg = DeepSpeedInferenceConfig()
+        k = icfg.speculation_tokens
         out["serve_speculation"] = (
             f"on by default config (speculation_tokens={k}, "
-            "prompt-lookup proposals, batched paged verify)"
+            "prompt-lookup or draft-model proposals "
+            "(speculation_draft), batched paged verify)"
             if k else
             "off (set DeepSpeedInferenceConfig.speculation_tokens>=2 — "
             "docs/serving.md 'Per-slot speculative decoding')")
-        out["serve_async_loop"] = (
-            "on by default config (pipelined dispatch, lag-1 host "
-            "commit, worker-thread publish, flush on host actions — "
-            "docs/serving.md 'Async dispatch loop')"
-            if DeepSpeedInferenceConfig().async_loop else
-            "off (set DeepSpeedInferenceConfig.async_loop=true)")
-        icfg = DeepSpeedInferenceConfig()
+        if icfg.async_loop:
+            # configured vs OBSERVED lag: the step profiler's
+            # serve_commit_lag_depth histogram records the chain depth
+            # at every dispatch in this process — report its deepest
+            # bucket beside the config knob when any server has run
+            blurb = (f"on by default config (pipelined dispatch, "
+                     f"lag-{icfg.max_commit_lag} host commit "
+                     f"(max_commit_lag), worker-thread publish, flush "
+                     f"on host actions — docs/serving.md 'Async "
+                     f"dispatch loop')")
+            fam = reg.snapshot().get("serve_commit_lag_depth")
+            if fam:
+                # buckets are [upper_bound, count] pairs; the deepest
+                # non-empty finite bucket's bound IS the observed depth
+                # (integer-valued observations on integer bounds)
+                depths = [b for s in fam["series"]
+                          for b, n in s.get("buckets", [])
+                          if n and b != float("inf")]
+                if depths:
+                    blurb += (f"; observed chain depth up to "
+                              f"{max(depths):g} this process")
+            out["serve_async_loop"] = blurb
+        else:
+            out["serve_async_loop"] = (
+                "off (set DeepSpeedInferenceConfig.async_loop=true)")
         out["serve_kv_dtype"] = (
             "int8 by default config (per-block-per-head scales, VMEM "
             "dequant in the paged kernels)"

@@ -4,16 +4,23 @@ The pieces behind ``inference.async_loop`` (docs/serving.md "Async
 dispatch loop") that are not scheduler policy:
 
 * :class:`InFlightStep` — the host-side record of ONE device program
-  whose results have not been fetched yet. The pipelined loop holds at
-  most one (lag-1 commit): the decode path dispatches step N+1 chained
-  from step N's device-resident outputs before fetching N; the verify
-  path dispatches the next round right after committing the previous
-  one. Everything commit needs later rides here: the output device
-  array, the slot→state snapshot taken at dispatch (identity-checked at
-  commit so a slot retired or recycled in between discards its lag-1
-  garbage token instead of corrupting a new resident), the proposals a
-  verify round was scored against, and the dispatch/fetch timestamps
-  the latency histograms are computed from.
+  whose results have not been fetched yet. The pipelined loop holds a
+  FIFO chain of up to ``max_commit_lag`` of them (lag-N commit; the
+  default of 1 is the original lag-1 loop): the decode path dispatches
+  step N+1 chained from step N's device-resident outputs, and only once
+  the chain is full does the host fetch + commit the OLDEST record; the
+  verify path dispatches the next round right after committing the
+  previous one (verify chains never deepen past one — proposals go
+  stale at commit boundaries). Everything commit needs later rides
+  here: the output device array, the slot→state snapshot taken at
+  dispatch (identity-checked at commit so a slot retired or recycled in
+  between discards its in-flight garbage tokens instead of corrupting a
+  new resident), the proposals a verify round was scored against
+  (per-slot host lists for prompt lookup, one device array for a draft
+  model), and the dispatch/fetch timestamps the latency histograms are
+  computed from. Committing a mid-chain record rethreads the next
+  record's ``prev_fetch`` so fetch-to-fetch latency attribution stays
+  honest at any depth.
 
 * :class:`PublishWorker` — the worker thread metric publishing moves to
   under the async loop. Commit computes every value on the owner thread
@@ -33,7 +40,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 # sentinel: wakes the worker thread for shutdown (task_done'd like any
 # job so a concurrent drain() can never hang on it)
@@ -48,12 +55,14 @@ class InFlightStep:
 
     def __init__(self, kind: str, tokens: Any, states: Dict[int, Any],
                  t_dispatch: float,
-                 props: Optional[Dict[int, List[int]]] = None,
+                 props: Optional[Any] = None,
                  prev_fetch: Optional[float] = None):
         self.kind = kind              # "decode" | "verify"
         self.tokens = tokens          # device array: [S] or [S, K]
         self.states = states          # slot -> SlotState AT DISPATCH
-        self.props = props            # verify: slot -> proposed tokens
+        # verify: slot -> proposed tokens (prompt lookup) or a
+        # [S, K-1] device array (draft model)
+        self.props = props
         self.t_dispatch = t_dispatch
         # when the PREVIOUS step's results landed on the host — the
         # honest per-step latency under pipelining is fetch-to-fetch

@@ -299,6 +299,32 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # loop (and to one-shot generate()). False = the PR-1 synchronous
     # loop, byte-identical to servers before this knob existed.
     async_loop: bool = True
+    # async dispatch-chain depth: up to this many decode steps chain
+    # device-side (each dispatched from the previous step's device-
+    # resident tokens) before one host commit drains the OLDEST fetch.
+    # 1 = the lag-1 loop above, byte-identical. Deeper chains absorb
+    # more host-side commit latency per device step; every flush rule
+    # is unchanged — any host-driven state change drains the whole
+    # chain, finishes surface <= N steps late, and a slot that finished
+    # mid-chain runs <= N-1 garbage rows that commit discards by
+    # SlotState identity. Greedy output is token-identical at any depth.
+    max_commit_lag: int = 1
+    # chain the NON-FINAL chunks of one prompt's chunked prefill as a
+    # single device-side dispatch chain instead of one chunk (and one
+    # bounded pipeline flush) per step() — only the final chunk, which
+    # produces the first token, fetches. Cuts the long-prompt admission
+    # dispatch-gap tax; token-identical output. Requires a chunked
+    # prefill mode (prefill_chunk_tokens or enable_prefix_caching).
+    prefill_chain: bool = False
+    # draft-model speculation on the paged path: a small
+    # InferenceEngine (same tokenizer/vocab, its own weights) whose
+    # batched forwards propose the speculation_tokens-1 candidates per
+    # slot instead of prompt lookup. Feeds the SAME batched paged
+    # verify executable and commit helpers; greedy output stays token-
+    # identical to plain decode. Requires speculation_tokens >= 2.
+    # Typically passed as the ContinuousBatchingServer draft_engine
+    # constructor argument; accepted here for config-driven wiring.
+    speculation_draft: Optional[Any] = Field(default=None, exclude=True)
     # replicated serving (docs/serving.md "Replicated serving &
     # failover"): pool sizing + health/failover knobs consumed by
     # inference/frontend.py ServingFrontend
@@ -380,6 +406,22 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
                 raise ValueError(
                     f"speculation_tokens ({self.speculation_tokens}) "
                     f"must not exceed block_size ({self.block_size})")
+        if self.max_commit_lag < 1:
+            raise ValueError(
+                f"max_commit_lag must be >= 1 (1 = the lag-1 async "
+                f"loop; the chain always holds at least the step being "
+                f"committed), got {self.max_commit_lag}")
+        if self.prefill_chain and not (self.prefill_chunk_tokens
+                                       or self.enable_prefix_caching):
+            raise ValueError(
+                "prefill_chain chains chunked-prefill dispatches — it "
+                "requires a chunked prefill mode (prefill_chunk_tokens "
+                "> 0 or enable_prefix_caching)")
+        if self.speculation_draft is not None and self.speculation_tokens < 2:
+            raise ValueError(
+                "speculation_draft proposes speculation_tokens-1 "
+                "candidates per slot — it requires speculation_tokens "
+                ">= 2")
         if self.replication.disaggregated and not self.enable_prefix_caching:
             raise ValueError(
                 "replication.roles (disaggregated prefill/decode) "

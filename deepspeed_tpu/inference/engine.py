@@ -81,6 +81,24 @@ def _fit_to_budget(need: int, budget: int) -> int:
     return min(_bucket(need), budget)
 
 
+def check_draft_compat(target, draft) -> None:
+    """Validate a draft engine against its speculation target: LM heads
+    on both sides and interchangeable token ids. Shared by the one-shot
+    ``generate_speculative(draft=...)`` path and the paged server's
+    ``speculation_draft`` wiring so both reject the same mismatches
+    with the same message."""
+    if target.model_config.head == "none" or \
+            draft.model_config.head == "none":
+        raise ValueError("speculative decoding needs LM heads on "
+                         "both engines")
+    if target.model_config.vocab_size != draft.model_config.vocab_size:
+        raise ValueError(
+            f"target/draft vocab sizes differ "
+            f"({target.model_config.vocab_size} vs "
+            f"{draft.model_config.vocab_size}) — token ids must be "
+            "interchangeable")
+
+
 class InferenceEngine:
     """Generation engine over the fused functional transformer.
 
@@ -642,8 +660,9 @@ class InferenceEngine:
         if draft_tokens < 2:
             raise ValueError(f"draft_tokens must be >= 2, got "
                              f"{draft_tokens} (1 draft proposal minimum)")
-        if self.model_config.head == "none" or (
-                draft is not None and draft.model_config.head == "none"):
+        if draft is not None:
+            check_draft_compat(self, draft)
+        elif self.model_config.head == "none":
             raise ValueError("speculative decoding needs LM heads on "
                              "both engines")
         if draft is None and float(temperature) > 0.0:
@@ -652,13 +671,6 @@ class InferenceEngine:
                 "greedy-only: its proposals are deterministic, so "
                 "rejection sampling degenerates — pass a draft engine "
                 "for sampled speculation")
-        if draft is not None and \
-                self.model_config.vocab_size != draft.model_config.vocab_size:
-            raise ValueError(
-                f"target/draft vocab sizes differ "
-                f"({self.model_config.vocab_size} vs "
-                f"{draft.model_config.vocab_size}) — token ids must be "
-                "interchangeable")
         ids, lengths = _pad_batch(input_ids, attention_mask)
         B, T = ids.shape
         if max_new_tokens <= 0:

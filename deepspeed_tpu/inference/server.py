@@ -46,13 +46,15 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.async_loop import InFlightStep, PublishWorker
-from deepspeed_tpu.inference.engine import InferenceEngine, _bucket
+from deepspeed_tpu.inference.engine import (InferenceEngine, _bucket,
+                                            check_draft_compat)
 from deepspeed_tpu.inference.kv_cache import (HostKVTier, PagedKVCache,
                                               init_paged_cache,
                                               paged_read_block,
                                               paged_swap_in)
 from deepspeed_tpu.inference.scheduler import Request, Scheduler
 from deepspeed_tpu.inference.speculation import (LookupIndex,
+                                                 draft_propose,
                                                  greedy_accept_host)
 from deepspeed_tpu.model_implementations.transformer import (
     paged_decode_step, paged_prefill, paged_prefill_chunk,
@@ -156,7 +158,8 @@ class ContinuousBatchingServer:
                  fault_injector: Optional[FaultInjector] = None,
                  supervised: bool = False, role: str = "mixed",
                  handoff_import: bool = False,
-                 profile_source: str = "serve"):
+                 profile_source: str = "serve",
+                 draft_engine: Optional[InferenceEngine] = None):
         if engine.model_config.head == "none":
             raise ValueError("continuous batching needs an LM head — "
                              "encoder models have nothing to decode")
@@ -206,10 +209,20 @@ class ContinuousBatchingServer:
             self.block_size if cfg.enable_prefix_caching else 0)
         # per-slot speculative decoding (docs/serving.md "Per-slot
         # speculative decoding"): K = chunk width of the batched verify
-        # forward (pending token + up to K-1 prompt-lookup proposals
-        # per active slot). 0 = off — the decode path is byte-identical
-        # to a server without this layer.
+        # forward (pending token + up to K-1 proposals per active
+        # slot — prompt-lookup by default, batched draft-model
+        # forwards when a draft engine is wired). 0 = off — the decode
+        # path is byte-identical to a server without this layer.
         self.spec_tokens = cfg.speculation_tokens
+        self.draft = draft_engine if draft_engine is not None \
+            else cfg.speculation_draft
+        if self.draft is not None:
+            if self.spec_tokens < 2:
+                raise ValueError(
+                    "draft_engine proposes speculation_tokens-1 "
+                    "candidates per slot — it requires "
+                    "speculation_tokens >= 2")
+            check_draft_compat(engine, self.draft)
         # telemetry: registry recording is always on (dict lookup + float
         # add per event); telemetry.enabled=False swaps in a private
         # registry, so cost is identical but nothing reaches the process
@@ -567,6 +580,37 @@ class ContinuousBatchingServer:
                                   mesh=engine.mesh),
                 name="serve_spec_verify", registry=self.telemetry,
                 donate_argnames=("cache",))
+        # draft-model speculation (docs/serving.md "Per-slot speculative
+        # decoding", draft-model option): the draft keeps its OWN paged
+        # pool with the target's geometry (same slots/blocks/block size)
+        # and the draft model's dims. Its block tables MIRROR the
+        # target's — copied per proposal round (tiny [S, MB] int32; a
+        # shared buffer would be invalidated when the target cache is
+        # donated) — so draft kv lands block-for-block beside the
+        # target kv it shadows and every allocator decision (prefix
+        # sharing, preemption, spec margin) covers both pools at once.
+        # Proposals come from speculation_tokens sequential batched
+        # draft decode steps (the last backfills the final proposal's
+        # kv, mirroring the one-shot engine's draft scan) and feed the
+        # SAME _verify_jit: the device-built [S, K] token block has the
+        # host-built path's exact aval, so the target gains zero new
+        # executables in draft mode.
+        self._draft_cache = None
+        self._draft_prefill_jit = None
+        self._draft_decode_jit = None
+        if self.draft is not None:
+            dcfg = self.draft.model_config
+            self._draft_cache = self._make_draft_pool(num_blocks)
+            self._draft_prefill_jit = watched_jit(
+                functools.partial(self._prefill_fn, cfg=dcfg,
+                                  mesh=self.draft.mesh),
+                name="serve_draft_prefill", registry=self.telemetry,
+                static_argnames=(), donate_argnames=("cache",))
+            self._draft_decode_jit = watched_jit(
+                functools.partial(self._decode_fn, cfg=dcfg,
+                                  mesh=self.draft.mesh),
+                name="serve_draft_decode", registry=self.telemetry,
+                donate_argnames=("cache",))
         self._results: Dict[int, List[int]] = {}
         self._next_id = 0
         self._step_clock = 0           # decode steps executed
@@ -610,12 +654,20 @@ class ContinuousBatchingServer:
         self._prefilling: Deque[dict] = deque()
         self._mid_prefill: set = set()
         # ---- async dispatch loop (docs/serving.md "Async dispatch
-        # loop"): pipelined dispatch with lag-1 host commit. At most
-        # ONE device program is ever in flight across step() calls;
-        # every host-driven state change flushes it first, so the
-        # scheduler only ever acts on committed state.
+        # loop"): pipelined dispatch with lag-N host commit. Up to
+        # max_commit_lag decode programs chain device-side across
+        # step() calls (each dispatched from the previous step's
+        # device-resident tokens), committed FIFO; every host-driven
+        # state change flushes the whole chain first, so the scheduler
+        # only ever acts on committed state. max_commit_lag=1 is the
+        # PR-10 lag-1 loop, byte-identical.
         self._async = cfg.async_loop
-        self._inflight: Optional[InFlightStep] = None
+        self._max_lag = max(int(cfg.max_commit_lag), 1)
+        self._inflight: Deque[InFlightStep] = deque()
+        # chained chunked prefill (docs/serving.md "Async dispatch
+        # loop"): dispatch ALL of the head prompt's non-final chunks as
+        # one device-side chain per step instead of one chunk per step
+        self._prefill_chain = cfg.prefill_chain and bool(self.chunk_tokens)
         # metric publishing rides a worker thread under the async loop
         # (drained at every flush / drain() / stats read); built even
         # when async is off so close()/stats stay uniform — the thread
@@ -637,9 +689,13 @@ class ContinuousBatchingServer:
         self._chunk_pending_t0: Optional[float] = None
         self._async_stats = {
             "pipeline_starts": 0,    # dispatch-without-fetch entries
-            "pipelined_steps": 0,    # lag-1 commits (decode) / rounds (verify)
+            "pipelined_steps": 0,    # lag-N commits (decode) / rounds (verify)
             "flushes": {},           # reason -> count
-            "discarded_tokens": 0,   # lag-1 garbage dropped at commit
+            # reason -> {chain depth at flush -> count}: which host
+            # actions drain deep chains (satellite: flushes-by-reason
+            # per depth)
+            "flush_depths": {},
+            "discarded_tokens": 0,   # in-flight garbage dropped at commit
             "garbage_steps": 0,      # in-flight steps with no survivor
         }
         self._init_flight_recorder(tcfg)
@@ -727,6 +783,7 @@ class ContinuousBatchingServer:
         before the scheduler exists). The fragmentation value is the
         last computed one — at most ``FRAG_EVERY`` transitions stale;
         :attr:`stats` (owner thread) refreshes it exactly."""
+        astats = getattr(self, "_async_stats", None)
         return {
             "step_profile": (self._profiler.snapshot()
                              if self._profiler is not None
@@ -734,6 +791,19 @@ class ContinuousBatchingServer:
             "kv_pool": (self._pool_acct.snapshot()
                         if self._pool_acct is not None
                         else {"enabled": False}),
+            # lag-N chain forensics beside the profiler's depth
+            # histogram: which host actions drain chains, and how deep
+            # the chain was when they did (plain dict reads — safe on
+            # the scrape thread)
+            "async_loop": ({
+                "max_commit_lag": self._max_lag,
+                "flushes": dict(astats["flushes"]),
+                "flush_depths": {
+                    reason: {str(d): n
+                             for d, n in sorted(depths.items())}
+                    for reason, depths in sorted(
+                        astats["flush_depths"].items())},
+            } if astats is not None else {"enabled": False}),
         }
 
     def _capacity_levels(self):
@@ -960,6 +1030,23 @@ class ContinuousBatchingServer:
                 cache = cache.replace(
                     k_scale=jax.device_put(cache.k_scale, ssh),
                     v_scale=jax.device_put(cache.v_scale, ssh))
+        return cache
+
+    def _make_draft_pool(self, num_blocks: int) -> PagedKVCache:
+        """Draft-model pool: the target pool's geometry (slots, blocks,
+        block size) with the draft model's layer/head dims, so the
+        target's block tables index it directly. Always fp storage —
+        the draft is small by design, and int8 would buy little."""
+        dcfg = self.draft.model_config
+        cache = init_paged_cache(
+            dcfg.n_layer, self.num_slots, num_blocks, self.block_size,
+            self.max_blocks_per_slot, dcfg.kv_heads, dcfg.head_dim,
+            dtype=self.draft._act_dtype, quantized=False)
+        mesh = self.draft.mesh
+        if mesh is not None:
+            sh = NamedSharding(mesh, P(None, None, None, "tensor", None))
+            cache = cache.replace(k=jax.device_put(cache.k, sh),
+                                  v=jax.device_put(cache.v, sh))
         return cache
 
     # -------------------------------------------------- host-tier copies
@@ -1216,6 +1303,12 @@ class ContinuousBatchingServer:
             lengths=self._cache.lengths.at[slot].set(0),
             block_tables=self._cache.block_tables.at[slot].set(
                 jnp.zeros((self.max_blocks_per_slot,), jnp.int32)))
+        if self._draft_cache is not None:
+            # the draft pool mirrors the target's tables at each use; a
+            # vacated slot only needs its length zeroed so stale draft
+            # KV can never be read as live context
+            self._draft_cache = self._draft_cache.replace(
+                lengths=self._draft_cache.lengths.at[slot].set(0))
         # every slot-vacating path (retire / cancel / preempt / fault)
         # runs through here — drop its lookup state with it
         self._spec_hist.pop(slot, None)
@@ -1322,13 +1415,14 @@ class ContinuousBatchingServer:
         slot = self.scheduler.find_slot(request_id)
         if slot is None:
             return False
-        if self._inflight is not None:
+        if self._inflight:
             # cancel takes effect at the COMMITTED boundary the caller
-            # observed: the target's in-flight token is discarded (its
-            # slot arrays are about to be reset anyway), everyone
-            # else's commits normally — no other request loses a token
-            # to this cancellation. Collateral finishes surface on the
-            # next step() (or via results/finish_reasons immediately).
+            # observed: the target's in-flight tokens (the whole chain's
+            # worth) are discarded (its slot arrays are about to be
+            # reset anyway), everyone else's commit normally — no other
+            # request loses a token to this cancellation. Collateral
+            # finishes surface on the next step() (or via
+            # results/finish_reasons immediately).
             self._flush_pipeline(self._deferred_finished,
                                  reason="cancel",
                                  discard_rid=request_id)
@@ -1699,6 +1793,7 @@ class ContinuousBatchingServer:
                 self.watchdog.notify_progress()
             if rt is not None:
                 rt.trace.end_span(rt.prefill)
+            self._draft_prefill_slot(slot, state)
             state.generated.append(tok0)
             state.pending = tok0
             if self._finished(state, tok0):
@@ -1714,7 +1809,13 @@ class ContinuousBatchingServer:
         prefill — the Sarathi-style interleave: each ``step()`` advances
         one prefill by ``prefill_chunk_tokens`` tokens and then decodes
         every active slot, so prefill latency is spread across steps
-        instead of stalling all residents for a whole prompt."""
+        instead of stalling all residents for a whole prompt.
+
+        With ``prefill_chain`` the prompt's NON-FINAL chunks dispatch as
+        one device-side chain in a single call (each chains on the
+        previous chunk's donated cache — no host boundary, no per-chunk
+        pipeline flush); only the final chunk, which fetches the first
+        token, stays on its own step boundary."""
         if not self._prefilling:
             return
         job = self._prefilling[0]
@@ -1729,25 +1830,29 @@ class ContinuousBatchingServer:
         if self._injected_prefill_fault(slot, state, finished,
                                         seeded=False):
             return
-        ids = np.zeros((1, C), np.int32)
-        valid = min(plen - start, C)
-        ids[0, :valid] = sched_prompt[start:start + valid]
         rt = (self._rt.get(req.request_id)
               if self.tracer is not None else None)
-        ck = None
-        if rt is not None:
-            ck = rt.trace.begin("prefill_chunk", parent=rt.prefill,
-                                start_token=start, tokens=valid)
-        t0 = self._clock()
-        tok, self._cache = self._chunk_jit(
-            self.engine.params, jnp.asarray(ids), jnp.int32(start),
-            jnp.asarray([plen], jnp.int32), self._cache, jnp.int32(slot))
-        self._prefill_chunks += 1
-        self._prefill_token_units += C
-        if self._ledger is not None:
-            self._ledger.add_weight(req.request_id, C)
-        job["start"] = start + C
-        if job["start"] < plen:
+        while True:
+            start = job["start"]
+            ids = np.zeros((1, C), np.int32)
+            valid = min(plen - start, C)
+            ids[0, :valid] = sched_prompt[start:start + valid]
+            ck = None
+            if rt is not None:
+                ck = rt.trace.begin("prefill_chunk", parent=rt.prefill,
+                                    start_token=start, tokens=valid)
+            t0 = self._clock()
+            tok, self._cache = self._chunk_jit(
+                self.engine.params, jnp.asarray(ids), jnp.int32(start),
+                jnp.asarray([plen], jnp.int32), self._cache,
+                jnp.int32(slot))
+            self._prefill_chunks += 1
+            self._prefill_token_units += C
+            if self._ledger is not None:
+                self._ledger.add_weight(req.request_id, C)
+            job["start"] = start + C
+            if job["start"] >= plen:
+                break             # final chunk: fall through to fetch
             # NON-final chunk: its logits are chunk-tail garbage the
             # host never reads, so there is nothing to fetch — forcing
             # np.asarray here existed only for "honest per-chunk
@@ -1770,7 +1875,19 @@ class ContinuousBatchingServer:
                 rt.trace.end_span(ck)
             if self.watchdog is not None:
                 self.watchdog.notify_progress()   # a chunk IS progress
-            return                # more chunks; logits were chunk-tail
+            if not self._prefill_chain:
+                return            # more chunks, one per step()
+            # prefill_chain: dispatch the prompt's REMAINING non-final
+            # chunks device-side right now — each chains on the previous
+            # chunk's donated cache, no host boundary between them. The
+            # pending-chunk note machinery above is already one-note-
+            # per-chain, so the whole chain realizes through the same
+            # single fetch as one deferred chunk. The final chunk still
+            # waits for the next step(): it fetches the first token, and
+            # keeping it on the step boundary preserves the Sarathi
+            # decode interleave exactly where the fetch cost lands.
+            if job["start"] + C >= plen:
+                return            # next chunk is final — next step's
         # final chunk: the prompt is resident, the first token is real —
         # this fetch is once per REQUEST (not per chunk) and the loop
         # needs the token to seed decoding
@@ -1805,12 +1922,65 @@ class ContinuousBatchingServer:
         self._prefills += 1
         if rt is not None:
             rt.trace.end_span(rt.prefill)
+        self._draft_prefill_slot(slot, state)
         state.generated.append(tok0)
         state.pending = tok0
         if self._finished(state, tok0):
             self._retire(slot, state, finished)
         elif rt is not None:
             rt.decode = rt.trace.begin("decode", slot=slot)
+
+    def _draft_prefill_slot(self, slot: int, state) -> None:
+        """Admit one slot's FULL scheduled prompt into the draft pool
+        (draft-model speculation). Runs once per admission, right after
+        the target prefill completes. The draft always prefills from
+        position 0, even under prefix caching or chunked prefill:
+        shared prefix blocks are rewritten with identical content (same
+        tokens, deterministic forward), so cross-slot sharing stays
+        exact, and a preemption re-admission rebuilds the whole draft
+        state the reset scrubbed. The mirrored tables are copied fresh
+        first so the scatter lands in this slot's just-allocated
+        blocks."""
+        if self.draft is None:
+            return
+        sched_prompt = state.request.sched_prompt
+        plen = len(sched_prompt)
+        T = min(max(_bucket(plen), self.block_size),
+                self.max_blocks_per_slot * self.block_size)
+        ids = np.zeros((1, T), np.int32)
+        ids[0, :plen] = sched_prompt
+        self._draft_cache = self._draft_cache.replace(
+            block_tables=jnp.copy(self._cache.block_tables))
+        _, self._draft_cache = self._draft_prefill_jit(
+            self.draft.params, jnp.asarray(ids),
+            jnp.asarray([plen], jnp.int32), self._draft_cache,
+            jnp.int32(slot))
+
+    def _draft_propose(self, states: Dict[int, object]):
+        """One draft proposal round for the given slot→state snapshot:
+        re-mirror the target's block tables (the target jits donate the
+        cache, so the draft must never hold an aliased buffer across a
+        target dispatch), then run ``speculation.draft_propose`` — K
+        chained draft decode forwards, all device-resident. Returns
+        ``(verify_tokens [S, K] device, props [S, K-1] device)``; the
+        verify input is built by device concatenation of the pending
+        column and the proposals, so its aval matches the host-built
+        prompt-lookup path exactly — the SAME target verify executable
+        serves both."""
+        K = self.spec_tokens
+        S = self.num_slots
+        pend = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        for slot, state in states.items():
+            pend[slot] = state.pending
+            active[slot] = True
+        self._draft_cache = self._draft_cache.replace(
+            block_tables=jnp.copy(self._cache.block_tables))
+        props, self._draft_cache = draft_propose(
+            self._draft_decode_jit, self.draft.params, self._draft_cache,
+            jnp.asarray(pend), jnp.asarray(active), K)
+        tokens = jnp.concatenate([jnp.asarray(pend)[:, None], props], 1)
+        return tokens, props
 
     def _finished(self, state, tok: int) -> bool:
         req = state.request
@@ -1897,12 +2067,14 @@ class ContinuousBatchingServer:
         no queued work, no chunked prefill in flight, no expired
         deadline — runs PIPELINED: the decode path dispatches step N+1
         chained from step N's device-resident outputs before fetching
-        N, and commits N's tokens lag-1 (docs/serving.md "Async
-        dispatch loop"); finishes therefore surface one ``step()`` call
-        after their device step. Any step with host-driven state change
-        flushes the pipeline first and runs the synchronous body below,
-        so admission, chunk scheduling, preemption, shedding, and fault
-        injection always act on committed state."""
+        N, and commits the OLDEST in-flight step once the chain is
+        ``max_commit_lag`` deep (docs/serving.md "Async dispatch
+        loop"); finishes therefore surface up to ``max_commit_lag``
+        ``step()`` calls after their device step. Any step with
+        host-driven state change flushes the whole chain first and runs
+        the synchronous body below, so admission, chunk scheduling,
+        preemption, shedding, and fault injection always act on
+        committed state."""
         # step observatory (telemetry/step_profile.py): phase marks at
         # boundaries the loop already crosses — monotonic-clock reads
         # only, zero new device syncs; OFF = the shared no-op handle
@@ -1931,10 +2103,11 @@ class ContinuousBatchingServer:
         if (self._async and not self.scheduler.queue
                 and not self._prefilling):
             return self._step_pipelined(sp, finished)
-        if self._inflight is not None:
+        if self._inflight:
             # host-driven state change ahead (admission / chunk
-            # scheduling / preemption ladder): commit the in-flight
-            # step FIRST so every decision below sees committed state
+            # scheduling / preemption ladder): commit the whole
+            # in-flight chain FIRST so every decision below sees
+            # committed state
             self._flush_pipeline(finished, sp, reason="host_action")
         self._admit(finished, sp)
         # degradation ladder, rung 2 (rung 1, prefix-LRU eviction,
@@ -2006,16 +2179,18 @@ class ContinuousBatchingServer:
 
     def _step_pipelined(self, sp, finished: List[int]) -> List[int]:
         """Steady-state async round: no queued work, no chunked prefill,
-        no lifecycle action — the only host work is the lag-1 commit, so
-        the device pipelines across step() calls."""
+        no lifecycle action — the only host work is the lag-N commit of
+        the oldest in-flight step, so the device pipelines across
+        step() calls."""
         sp.mark("admission")      # the reap/shed/famine checks above
         sp.mark("prefill_chunk")  # by definition: no chunk work here
         if not self.scheduler.slots:
-            if self._inflight is not None:
-                # every resident retired at the last lag-1 commit; the
-                # step dispatched beside that commit is pure garbage —
-                # fetch and discard it so its writes complete before
-                # any future admission reuses the released blocks
+            if self._inflight:
+                # every resident retired at the last commit; the steps
+                # dispatched beside and after that commit are pure
+                # garbage — fetch and discard them so their writes
+                # complete before any future admission reuses the
+                # released blocks
                 self._flush_pipeline(finished, sp, reason="drain_tail")
             if self.watchdog is not None:
                 # an IDLE server being polled is alive, not stalled
@@ -2046,8 +2221,17 @@ class ContinuousBatchingServer:
         commit discards it by state identity (advance-only rollback —
         the retire path reset its lengths/table, so the garbage KV sits
         masked in released blocks no one can reuse before the next
-        flush fetches N+1)."""
-        rec = self._inflight
+        flush fetches N+1).
+
+        With ``max_commit_lag`` N > 1 the dispatches CHAIN: each step
+        dispatches from the newest in-flight record's tokens and only
+        once the chain holds more than N programs does the oldest
+        commit — the host runs N steps behind the device, absorbing N
+        commits' worth of host latency into one device-busy window. A
+        slot that finished mid-chain runs <= N-1 garbage rows, each
+        discarded at its own commit by the same identity check."""
+        chain = self._inflight
+        rec = chain[-1] if chain else None
         S = self.num_slots
         active = np.zeros((S,), bool)
         states: Dict[int, object] = {}
@@ -2079,33 +2263,46 @@ class ContinuousBatchingServer:
         nxt, self._cache = self._decode_jit(
             self.engine.params, tok_in, self._cache, jnp.asarray(active))
         sp.mark("dispatch")
-        new_rec = InFlightStep("decode", nxt, states, t0)
+        chain.append(InFlightStep("decode", nxt, states, t0))
         if rec is None:
             self._async_stats["pipeline_starts"] += 1
             sp.mark("sync_wait")
             sp.mark("commit")
             if self.watchdog is not None:
                 self.watchdog.notify_progress()   # a dispatch IS progress
-        else:
-            new_rec.prev_fetch = self._commit_decode_record(rec,
-                                                            finished, sp)
+        elif len(chain) > self._max_lag:
+            # the chain is full: drain the OLDEST fetch (lag-N commit)
+            # and rethread the new-oldest record's latency baseline to
+            # this fetch, so its eventual fetch-to-fetch dt stays honest
+            oldest = chain.popleft()
+            t1 = self._commit_decode_record(oldest, finished, sp)
+            chain[0].prev_fetch = t1
             self._async_stats["pipelined_steps"] += 1
+        else:
+            # deepening the chain (depth < max_commit_lag): dispatch
+            # only — no fetch, no commit this step. The profiler's
+            # depth histogram records the dispatch-into-busy-device
+            self._async_stats["pipelined_steps"] += 1
+            sp.mark("sync_wait")
+            sp.mark("commit")
+            if self.watchdog is not None:
+                self.watchdog.notify_progress()   # a dispatch IS progress
         self.profiler_capture.step_end()
-        self._inflight = new_rec
 
     def _commit_decode_record(self, rec: InFlightStep,
                               finished: List[int], sp=NULL_STEP_HANDLE,
                               discard_rid: Optional[int] = None) -> float:
-        """Lag-1 host commit of one in-flight decode step: fetch its
-        tokens, append/EOS-check/retire for every slot whose SlotState
-        is still the one that was resident at dispatch, and hand the
-        metric publishing to the worker thread. ``discard_rid`` drops
+        """Lag-N host commit of one in-flight decode step (the chain's
+        oldest): fetch its tokens, append/EOS-check/retire for every
+        slot whose SlotState is still the one that was resident at
+        dispatch, and hand the metric publishing to the worker thread.
+        ``discard_rid`` drops
         one request's token on the floor (cancel/deadline teardown in
         progress: the caller observed the committed boundary, and the
         slot's arrays are about to be reset anyway). Returns the fetch
         timestamp."""
         in_step = sp is not NULL_STEP_HANDLE
-        nxt = np.asarray(rec.tokens)         # host sync: the lag-1 fetch
+        nxt = np.asarray(rec.tokens)         # host sync: the lagged fetch
         t1 = self._clock()
         if in_step:
             sp.mark("sync_wait", now=t1, fetch=True)
@@ -2126,7 +2323,8 @@ class ContinuousBatchingServer:
         for slot, state in rec.states.items():
             if self.scheduler.slots.get(slot) is not state:
                 # retired / torn down after this step dispatched: the
-                # lag-1 token is garbage (its KV was reset with the slot)
+                # in-flight token is garbage (its KV was reset with the
+                # slot)
                 self._async_stats["discarded_tokens"] += 1
                 continue
             if (discard_rid is not None
@@ -2185,43 +2383,55 @@ class ContinuousBatchingServer:
         dispatch gap. Commit-then-dispatch keeps proposals fresh and
         acceptance intact; the dispatch gap shrinks to accept+propose
         because publishing rides the worker. It also means a verify
-        round needs NO lag-1 reconciliation: the active set is computed
-        after commit, so no garbage rows are ever dispatched."""
-        rec = self._inflight
+        round needs NO lag-N reconciliation: the active set is computed
+        after commit, so no garbage rows are ever dispatched — and the
+        chain never deepens past one verify round regardless of
+        ``max_commit_lag`` (draft-model proposals would go equally
+        stale: the draft pool only advances at commit).
+
+        With a draft engine the proposals come from
+        ``speculation.draft_propose`` — K chained draft decode
+        forwards, all device-resident — instead of the LookupIndex,
+        and the [S, K] token block is built by device concatenation.
+        Same aval, SAME verify executable."""
+        chain = self._inflight
+        rec = chain[-1] if chain else None
         prev_fetch = None
         # device credit in this round rides explicit spans ([step begin
         # → fetch] at commit, [dispatch → step end] via pipelined())
         sp.pipelined_mode()
         if rec is not None:
             prev_fetch = self._commit_verify_record(rec, finished, sp)
-            self._inflight = None
+            chain.clear()          # verify chains are depth <= 1
             self._async_stats["pipelined_steps"] += 1
         K = self.spec_tokens
         S = self.num_slots
+        use_draft = self.draft is not None
         tokens = np.zeros((S, K), np.int32)
         props: Dict[int, List[int]] = {}
         states: Dict[int, object] = {}
         for slot, state in self.scheduler.slots.items():
             if slot in self._mid_prefill:
                 continue   # unreachable here (chunks force sync steps)
-            # proposal source = committed history ONLY (see
-            # _decode_speculative — this is the same incremental
-            # LookupIndex discipline)
-            entry = self._spec_hist.get(slot)
-            if entry is None or entry[0] is not state:
-                idx = LookupIndex(state.request.prompt)
-                idx.extend(state.generated)
-                self._spec_hist[slot] = (state, idx)
-            else:
-                idx = entry[1]
-                grown = (len(state.request.prompt)
-                         + len(state.generated) - len(idx.hist))
-                if grown > 0:
-                    idx.extend(state.generated[-grown:])
-            prop = idx.proposals(K - 1)
+            if not use_draft:
+                # proposal source = committed history ONLY (see
+                # _decode_speculative — this is the same incremental
+                # LookupIndex discipline)
+                entry = self._spec_hist.get(slot)
+                if entry is None or entry[0] is not state:
+                    idx = LookupIndex(state.request.prompt)
+                    idx.extend(state.generated)
+                    self._spec_hist[slot] = (state, idx)
+                else:
+                    idx = entry[1]
+                    grown = (len(state.request.prompt)
+                             + len(state.generated) - len(idx.hist))
+                    if grown > 0:
+                        idx.extend(state.generated[-grown:])
+                prop = idx.proposals(K - 1)
+                tokens[slot, 1:] = prop
+                props[slot] = prop
             tokens[slot, 0] = state.pending
-            tokens[slot, 1:] = prop
-            props[slot] = prop
             states[slot] = state
         if not states:
             # the commit above retired every resident — nothing to
@@ -2231,8 +2441,12 @@ class ContinuousBatchingServer:
         self.profiler_capture.step_begin()
         t0 = self._clock()
         sp.mark("propose", now=t0, dispatch=True)
+        if use_draft:
+            tok_arg, d_props = self._draft_propose(states)
+        else:
+            tok_arg, d_props = jnp.asarray(tokens), None
         t_toks, self._cache = self._verify_jit(
-            self.engine.params, jnp.asarray(tokens), self._cache)
+            self.engine.params, tok_arg, self._cache)
         sp.mark("dispatch")
         self.profiler_capture.step_end()
         if rec is None:
@@ -2242,8 +2456,10 @@ class ContinuousBatchingServer:
         # device busy from this dispatch through the step's end (the
         # [step-begin → fetch] half was credited at commit)
         sp.pipelined(since=t0)
-        self._inflight = InFlightStep("verify", t_toks, states, t0,
-                                      props=props, prev_fetch=prev_fetch)
+        chain.append(InFlightStep(
+            "verify", t_toks, states, t0,
+            props=d_props if use_draft else props,
+            prev_fetch=prev_fetch))
 
     def _commit_verify_record(self, rec: InFlightStep,
                               finished: List[int], sp=NULL_STEP_HANDLE,
@@ -2259,6 +2475,12 @@ class ContinuousBatchingServer:
         K = self.spec_tokens
         S = self.num_slots
         t_np = np.asarray(rec.tokens)       # host sync: the verify ran
+        # proposals: per-slot host lists (prompt lookup) or one [S, K-1]
+        # device array (draft model) — realize the latter once; rows
+        # index identically either way and greedy_accept_host
+        # int()-converts every committed token
+        props_src = (rec.props if isinstance(rec.props, dict)
+                     else np.asarray(rec.props))
         t1 = self._clock()
         if in_step and getattr(sp, "_pipelined_mode", False):
             sp.mark("sync_wait", now=t1)
@@ -2293,7 +2515,7 @@ class ContinuousBatchingServer:
                 self._async_stats["discarded_tokens"] += 1
                 continue
             m, committed = greedy_accept_host(t_np[slot],
-                                              rec.props[slot])
+                                              props_src[slot])
             accepted_total += m
             n_live += 1
             rt = (self._rt.get(state.request.request_id)
@@ -2323,6 +2545,24 @@ class ContinuousBatchingServer:
                 state.pending = committed[-1]
         self._cache = self._cache.replace(
             lengths=self._cache.lengths + jnp.asarray(adv))
+        if self._draft_cache is not None:
+            # the proposal round advanced the draft pool by K per active
+            # slot in-graph; reconcile each surviving slot to the
+            # committed prefix (adv - K <= 0) so both pools agree on
+            # every live length. Discarded/identity-dead rows stay at
+            # base+K until _reset_slot_arrays zeroes them — and this
+            # runs BEFORE the retire loop, which does exactly that for
+            # this round's finishers.
+            d_adj = np.zeros((S,), np.int32)
+            for slot, state in rec.states.items():
+                if self.scheduler.slots.get(slot) is not state:
+                    continue
+                if (discard_rid is not None
+                        and state.request.request_id == discard_rid):
+                    continue
+                d_adj[slot] = int(adv[slot]) - K
+            self._draft_cache = self._draft_cache.replace(
+                lengths=self._draft_cache.lengths + jnp.asarray(d_adj))
         for slot in retire:
             self._retire(slot, self.scheduler.slots[slot], finished)
         if in_step:
@@ -2404,19 +2644,26 @@ class ContinuousBatchingServer:
         """Commit whatever is in flight and drain the publish worker —
         the bounded flush every host-driven state change pays so the
         scheduler (and anyone reading results/metrics afterwards) acts
-        on committed state. Bounded by construction: the loop holds at
-        most ONE in-flight step."""
-        rec = self._inflight
-        if rec is not None:
-            self._inflight = None
-            if rec.kind == "decode":
-                self._commit_decode_record(rec, finished, sp,
-                                           discard_rid=discard_rid)
-            else:
-                self._commit_verify_record(rec, finished, sp,
-                                           discard_rid=discard_rid)
+        on committed state. Bounded by construction: the chain holds at
+        most ``max_commit_lag`` in-flight steps, committed here oldest
+        first (each commit rethreads the next record's prev_fetch so
+        per-step gap attribution stays honest across the drain)."""
+        if self._inflight:
+            depth = len(self._inflight)
+            while self._inflight:
+                rec = self._inflight.popleft()
+                if rec.kind == "decode":
+                    t1 = self._commit_decode_record(
+                        rec, finished, sp, discard_rid=discard_rid)
+                else:
+                    t1 = self._commit_verify_record(
+                        rec, finished, sp, discard_rid=discard_rid)
+                if self._inflight:
+                    self._inflight[0].prev_fetch = t1
             fl = self._async_stats["flushes"]
             fl[reason] = fl.get(reason, 0) + 1
+            fd = self._async_stats["flush_depths"].setdefault(reason, {})
+            fd[depth] = fd.get(depth, 0) + 1
         self._drain_publishing()
 
     def _decode_once(self, finished: List[int],
@@ -2517,36 +2764,45 @@ class ContinuousBatchingServer:
         slot's live length without advancing it; commit = advance the
         length over the accepted prefix only, so rejected KV is never
         rolled back, just left as masked garbage the next round
-        overwrites (the garbage-beyond-lengths invariant)."""
+        overwrites (the garbage-beyond-lengths invariant).
+
+        With a draft engine, proposals come from K-1 chained draft
+        decode forwards over the mirrored draft pool instead of the
+        lookup — same ``[S, K]`` verify input aval, SAME verify
+        executable, and greedy acceptance keeps the output exactly
+        greedy either way."""
         K = self.spec_tokens
         S = self.num_slots
+        use_draft = self.draft is not None
         tokens = np.zeros((S, K), np.int32)
         props: Dict[int, List[int]] = {}
         active_slots: List[int] = []
         for slot, state in self.scheduler.slots.items():
             if slot in self._mid_prefill:
                 continue   # resident but still prefilling: not decoded
-            # proposal source = committed history ONLY (prompt + every
-            # generated token incl. pending) — never the speculative
-            # garbage beyond it, so a preempted slot's requeue prompt
-            # (prompt + committed) replays the same proposals. The
-            # LookupIndex makes this O(1) per step: full build at the
-            # slot's first verify, tail-sync after.
-            entry = self._spec_hist.get(slot)
-            if entry is None or entry[0] is not state:
-                idx = LookupIndex(state.request.prompt)
-                idx.extend(state.generated)
-                self._spec_hist[slot] = (state, idx)
-            else:
-                idx = entry[1]
-                grown = (len(state.request.prompt)
-                         + len(state.generated) - len(idx.hist))
-                if grown > 0:
-                    idx.extend(state.generated[-grown:])
-            prop = idx.proposals(K - 1)
+            if not use_draft:
+                # proposal source = committed history ONLY (prompt +
+                # every generated token incl. pending) — never the
+                # speculative garbage beyond it, so a preempted slot's
+                # requeue prompt (prompt + committed) replays the same
+                # proposals. The LookupIndex makes this O(1) per step:
+                # full build at the slot's first verify, tail-sync
+                # after.
+                entry = self._spec_hist.get(slot)
+                if entry is None or entry[0] is not state:
+                    idx = LookupIndex(state.request.prompt)
+                    idx.extend(state.generated)
+                    self._spec_hist[slot] = (state, idx)
+                else:
+                    idx = entry[1]
+                    grown = (len(state.request.prompt)
+                             + len(state.generated) - len(idx.hist))
+                    if grown > 0:
+                        idx.extend(state.generated[-grown:])
+                prop = idx.proposals(K - 1)
+                tokens[slot, 1:] = prop
+                props[slot] = prop
             tokens[slot, 0] = state.pending
-            tokens[slot, 1:] = prop
-            props[slot] = prop
             active_slots.append(slot)
         if not active_slots:
             sp.mark("propose")
@@ -2558,12 +2814,20 @@ class ContinuousBatchingServer:
         # proposal scan ends, the batched verify dispatches (the
         # dispatch-gap boundary — see _decode_once)
         sp.mark("propose", now=t0, dispatch=True)
+        if use_draft:
+            tok_arg, d_props = self._draft_propose(
+                {slot: self.scheduler.slots[slot]
+                 for slot in active_slots})
+        else:
+            tok_arg, d_props = jnp.asarray(tokens), None
         t_toks, self._cache = self._verify_jit(
-            self.engine.params, jnp.asarray(tokens), self._cache)
+            self.engine.params, tok_arg, self._cache)
         sp.mark("dispatch")
         self._step_clock += 1
         self._active_slot_steps += n_active
         t_np = np.asarray(t_toks)         # host sync: the verify ran
+        if use_draft:
+            props_np = np.asarray(d_props)
         t1 = self._clock()
         dt = t1 - t0
         sp.mark("sync_wait", now=t1, fetch=True)
@@ -2581,7 +2845,8 @@ class ContinuousBatchingServer:
         retire: List[int] = []
         for slot in active_slots:
             state = self.scheduler.slots[slot]
-            m, committed = greedy_accept_host(t_np[slot], props[slot])
+            m, committed = greedy_accept_host(
+                t_np[slot], props_np[slot] if use_draft else props[slot])
             accepted_total += m
             rt = (self._rt.get(state.request.request_id)
                   if self.tracer is not None else None)
@@ -2619,6 +2884,15 @@ class ContinuousBatchingServer:
                 state.pending = committed[-1]
         self._cache = self._cache.replace(
             lengths=self._cache.lengths + jnp.asarray(adv))
+        if use_draft:
+            # reconcile the draft pool to the committed prefixes (the
+            # proposal round advanced it by K per active slot in-graph);
+            # runs before the retire loop, which zeroes finishers' rows
+            d_adj = np.zeros((S,), np.int32)
+            for slot in active_slots:
+                d_adj[slot] = int(adv[slot]) - K
+            self._draft_cache = self._draft_cache.replace(
+                lengths=self._draft_cache.lengths + jnp.asarray(d_adj))
         for slot in retire:
             self._retire(slot, self.scheduler.slots[slot], finished)
         sp.mark("commit")
@@ -2706,10 +2980,10 @@ class ContinuousBatchingServer:
                 break
             self.step()
         # the drain loop exits the moment the scheduler empties, which
-        # under the async loop can leave one garbage step in flight
-        # (dispatched beside the final lag-1 commit): fetch + discard
-        # it and drain the publish worker, so a drained server has no
-        # device work outstanding and fully-published metrics
+        # under the async loop can leave up to max_commit_lag garbage
+        # steps in flight (dispatched beside the final commits): fetch +
+        # discard them and drain the publish worker, so a drained server
+        # has no device work outstanding and fully-published metrics
         self._flush_pipeline(self._deferred_finished, reason="drain")
         if self._ledger is not None:
             # drained = no further worked step is coming: emit every
@@ -2849,6 +3123,14 @@ class ContinuousBatchingServer:
                 if self._spec_slot_steps else None,
                 "verify_traces": (_safe_cache_size(self._verify_jit)
                                   if self._verify_jit is not None else 0),
+                "draft": ("model" if self.draft is not None
+                          else "prompt-lookup"),
+                "draft_prefill_traces": (
+                    _safe_cache_size(self._draft_prefill_jit)
+                    if self._draft_prefill_jit is not None else 0),
+                "draft_decode_traces": (
+                    _safe_cache_size(self._draft_decode_jit)
+                    if self._draft_decode_jit is not None else 0),
             },
             # KV tiering (docs/serving.md "KV quantization & host
             # tiering"): storage dtype, device pool bytes (scales
@@ -2875,14 +3157,21 @@ class ContinuousBatchingServer:
             "fault_injection": (self._fi.snapshot()
                                 if self._fi is not None else None),
             # async dispatch loop (docs/serving.md "Async dispatch
-            # loop"): pipeline state, flush forensics by reason, lag-1
-            # reconciliation counters, and the publish worker's queue
+            # loop"): pipeline state, flush forensics by reason (and by
+            # chain depth at the flush), lag-N reconciliation counters,
+            # and the publish worker's queue
             "async_loop": {
                 "enabled": self._async,
-                "commit_lag": 1 if self._inflight is not None else 0,
+                "commit_lag": len(self._inflight),
+                "max_commit_lag": self._max_lag,
+                "prefill_chain": self._prefill_chain,
                 "pipeline_starts": self._async_stats["pipeline_starts"],
                 "pipelined_steps": self._async_stats["pipelined_steps"],
                 "flushes": dict(self._async_stats["flushes"]),
+                "flush_depths": {
+                    reason: {str(d): n for d, n in sorted(depths.items())}
+                    for reason, depths in sorted(
+                        self._async_stats["flush_depths"].items())},
                 "discarded_tokens":
                     self._async_stats["discarded_tokens"],
                 "garbage_steps": self._async_stats["garbage_steps"],

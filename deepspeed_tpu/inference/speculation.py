@@ -176,6 +176,27 @@ class LookupIndex:
         return out
 
 
+def draft_propose(step_fn, params, cache, pending, active, k: int):
+    """Batched draft-model proposals for the paged server: run ``k``
+    sequential draft decode steps over ALL resident slots, chaining
+    each step's argmax back as the next step's input — the per-slot
+    mirror of the one-shot engine's draft ``lax.scan`` (the ``k``-th
+    step writes the final proposal's kv so the draft cache covers every
+    proposed token; its output is never proposed). ``step_fn`` is the
+    server's jitted draft decode (same signature as the target decode:
+    ``(params, tokens [S], cache, active [S]) -> (argmax [S], cache)``,
+    lengths advanced in-graph per active slot). Returns
+    ``(props [S, k-1] int32, cache)`` — all device-resident: nothing
+    here forces a host sync, so the whole proposal chain dispatches
+    ahead of the verify forward that consumes it."""
+    toks = pending
+    outs = []
+    for _ in range(k):
+        toks, cache = step_fn(params, toks, cache, active)
+        outs.append(toks)
+    return jnp.stack(outs[:-1], axis=1), cache
+
+
 def greedy_accept_host(t_row: Sequence[int], props: Sequence[int]
                        ) -> Tuple[int, List[int]]:
     """Host mirror of :func:`greedy_accept` for ONE row: ``t_row`` is

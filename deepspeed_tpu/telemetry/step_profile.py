@@ -91,6 +91,21 @@ from deepspeed_tpu.telemetry.registry import MetricRegistry, get_registry
 DEVICE_PHASES = frozenset({"dispatch", "sync_wait"})
 
 
+def _hist_p50(hist: Dict[int, int]) -> int:
+    """Weighted median of an {value: count} histogram (0 when empty) —
+    the observed-chain-depth p50 the commit-lag snapshot reports."""
+    total = sum(hist.values())
+    if not total:
+        return 0
+    half = (total + 1) // 2
+    seen = 0
+    for value in sorted(hist):
+        seen += hist[value]
+        if seen >= half:
+            return value
+    return max(hist)
+
+
 class _NullStepHandle:
     """No-op handle the serving loop holds when profiling is off — the
     hot path keeps one shape (mark/finish calls) whether or not the
@@ -311,6 +326,14 @@ class StepProfiler:
         self.outstanding = 0
         self.pipelined_dispatches = 0   # dispatches issued into a busy device
         self.pipelined_steps = 0        # steps credited via pipelined()
+        # chain-depth accounting (lag-N dispatch chains): at each
+        # dispatch, the depth the chain reaches (outstanding AFTER the
+        # increment) and the dispatch gap attributed to that depth —
+        # depth-1 dispatches carry the real idle gaps (the device had
+        # drained), depth>=2 are 0-gap by construction, so the per-depth
+        # split shows exactly where lag-N closed gaps lag-1 could not
+        self.depth_hist: Dict[int, int] = {}
+        self.depth_gap_total: Dict[int, float] = {}
         # rolling window of the most recent gap observations (pipelined
         # 0-gaps included) — the cheap "how host-bound is this server
         # RIGHT NOW" signal the disaggregated frontend's telemetry
@@ -340,6 +363,14 @@ class StepProfiler:
             help="cumulative device-attributed share of serve step "
                  "wall time (dispatch + sync-wait + prefill device "
                  "intervals; 1.0 = the device never waits on the host)")
+        self._h_depth = reg.histogram(
+            "serve_commit_lag_depth",
+            help="dispatch-chain depth observed at each program "
+                 "dispatch (outstanding programs after the dispatch; "
+                 "1 = the device had drained, >= 2 = lag-N pipelining "
+                 "— ds_report compares this against the configured "
+                 "async_loop max_commit_lag)",
+            buckets=[float(i) for i in range(1, 17)])
         self._phase_hist: Dict[str, object] = {}
 
     # ------------------------------------------------------------ steps
@@ -361,14 +392,20 @@ class StepProfiler:
             # meaning "one observation per dispatch boundary" and the
             # p90 the async A/B gates on reflects the closed gaps.
             self.outstanding += 1
+            depth = self.outstanding
             self._h_gap.observe(0.0)
+            self._h_depth.observe(float(depth))
             with self._lock:
                 self.gap_count += 1
                 self.pipelined_dispatches += 1
                 self._recent_gaps.append(0.0)
+                self.depth_hist[depth] = self.depth_hist.get(depth, 0) + 1
             return
         self.outstanding = 1
+        self._h_depth.observe(1.0)
         if self._last_fetch is None:
+            with self._lock:
+                self.depth_hist[1] = self.depth_hist.get(1, 0) + 1
             return
         gap = max(now - self._last_fetch, 0.0)
         self._last_fetch = None      # one gap per idle span
@@ -378,6 +415,9 @@ class StepProfiler:
             self.gap_total += gap
             self.gap_max = max(self.gap_max, gap)
             self._recent_gaps.append(gap)
+            self.depth_hist[1] = self.depth_hist.get(1, 0) + 1
+            self.depth_gap_total[1] = \
+                self.depth_gap_total.get(1, 0.0) + gap
 
     def _note_fetch(self, now: float) -> None:
         self.outstanding = max(self.outstanding - 1, 0)
@@ -488,6 +528,19 @@ class StepProfiler:
                     "outstanding": self.outstanding,
                     "pipelined_dispatches": self.pipelined_dispatches,
                     "pipelined_steps": self.pipelined_steps,
+                    # observed chain-depth distribution (lag-N): keys
+                    # are the depth each dispatch landed at; p50/max
+                    # summarize it, gap_s_by_depth attributes the idle
+                    # gaps (all at depth 1 by construction — deeper
+                    # dispatches land on a busy device)
+                    "depth_hist": {str(d): n for d, n in
+                                   sorted(self.depth_hist.items())},
+                    "depth_p50": _hist_p50(self.depth_hist),
+                    "depth_max": max(self.depth_hist) if self.depth_hist
+                    else 0,
+                    "gap_s_by_depth": {str(d): t for d, t in
+                                       sorted(self.depth_gap_total
+                                              .items())},
                 },
                 "events_every": self.events_every,
             }

@@ -249,10 +249,12 @@ def run_continuous(eng, prompt, args):
     print(f"decode steps {st['decode_steps']}, occupancy "
           f"{st['slot_occupancy']:.2f}, traces {st['decode_traces']}")
     al = st["async_loop"]
-    print(f"async loop: {'on' if al['enabled'] else 'off (sync)'} — "
+    lag = al.get("max_commit_lag", 1) if al["enabled"] else 1
+    print(f"async loop: {'on' if al['enabled'] else 'off (sync)'}"
+          + (f" (lag {lag})" if lag > 1 else "") + " — "
           f"{al['pipelined_steps']} pipelined steps, "
           f"{sum(al['flushes'].values())} flushes, "
-          f"{al['discarded_tokens']} lag-1 tokens discarded, "
+          f"{al['discarded_tokens']} in-flight tokens discarded, "
           f"worker published {al['worker']['published']}")
     if st["prefix_caching"]:
         print(f"prefix cache: {st['prefix_cache_hits']} hits / "
@@ -260,7 +262,9 @@ def run_continuous(eng, prompt, args):
               f"{st['prefix_tokens_skipped']} prefill tokens skipped, "
               f"{st['prefix_cached_blocks']} blocks cached")
     if st["prefill_chunk_tokens"]:
-        print(f"chunked prefill: {st['prefill_chunks']} chunks of "
+        chained = al["enabled"] and al.get("prefill_chain")
+        print(f"chunked prefill{' (chained)' if chained else ''}: "
+              f"{st['prefill_chunks']} chunks of "
               f"{st['prefill_chunk_tokens']} tokens, "
               f"{st['chunk_traces']} trace(s)")
     kt = st["kv_tier"]
@@ -293,7 +297,7 @@ def run_continuous(eng, prompt, args):
               f"{pool['famine_episodes']} famine episode(s)")
     sp = st["speculation"]
     if sp["k"]:
-        print(f"speculation (K={sp['k']}): "
+        print(f"speculation (K={sp['k']}, {sp['draft']}): "
               f"{sp['tokens_per_forward']} tokens/forward, acceptance "
               f"{sp['acceptance_rate']}, {sp['committed_tokens']} "
               f"tokens over {sp['verify_steps']} verify steps, "
@@ -404,6 +408,25 @@ def main():
                          "slot per step, greedy output unchanged "
                          "(continuous mode; docs/serving.md 'Per-slot "
                          "speculative decoding')")
+    ap.add_argument("--draft", default=None, metavar="PATH",
+                    help="HF checkpoint dir for a draft model: "
+                         "propose the K-1 tokens with its batched "
+                         "forwards instead of prompt lookup, verified "
+                         "by the same paged verify program (requires "
+                         "--speculate; docs/serving.md 'Draft-model "
+                         "proposals')")
+    ap.add_argument("--commit-lag", type=int, default=None, metavar="N",
+                    help="let the async loop dispatch up to N device "
+                         "steps ahead of the host commit "
+                         "(inference.max_commit_lag; default 1 = the "
+                         "classic lag-1 pipeline — docs/serving.md "
+                         "'Lag-N dispatch chains')")
+    ap.add_argument("--prefill-chain", action="store_true",
+                    help="dispatch all of a prompt's non-final prefill "
+                         "chunks as one device-side chain instead of "
+                         "one chunk per step (requires --prefill-chunk "
+                         "or --prefix-cache; docs/serving.md 'Chunked "
+                         "prefill')")
     ap.add_argument("--async-loop", dest="async_loop",
                     action="store_true", default=True,
                     help="pipelined dispatch with lag-1 host commit "
@@ -511,6 +534,15 @@ def main():
         knobs["prefill_chunk_tokens"] = args.prefill_chunk
     if args.speculate:
         knobs["speculation_tokens"] = args.speculate
+    if args.draft:
+        # a second, smaller engine over the same tokenizer/vocab; the
+        # config route reaches every replica of a replicated pool
+        knobs["speculation_draft"] = deepspeed_tpu.init_inference(
+            args.draft, dtype=args.dtype)
+    if args.commit_lag is not None:
+        knobs["max_commit_lag"] = args.commit_lag
+    if args.prefill_chain:
+        knobs["prefill_chain"] = True
     knobs["async_loop"] = args.async_loop
     roles = None
     if args.roles:

@@ -61,6 +61,11 @@ METRICS = {
     # pipelined-leg device-idle p90 from the ON/OFF A/B — a regression
     # means the loop stopped closing the gap it exists to close
     "async_loop.dispatch_gap_p90_ms": "down",
+    # chained chunked prefill (docs/serving.md "Async dispatch loop",
+    # lag-N): the chained leg's admission dispatch-gap p90 on the
+    # long-prompt trace — a regression means chunk dispatches stopped
+    # chaining and the per-chunk flush tax came back
+    "prefill_chain.dispatch_gap_p90_ms": "down",
     # replicated serving (docs/serving.md "Replicated serving &
     # failover"): fraction of submitted requests that still finish
     # eos/length under the seeded mid-decode replica kill — anything

@@ -229,6 +229,25 @@ _SLOW_BY_MODULE = {
     "test_flight_recorder": {
         "test_served_two_shapes_report_and_debug_routes"},
     "test_diffusers": {"test_unet_multi_transformer_layers"},
+    # r20 deep pipeline: the fast lane keeps one representative per
+    # contract — lag-3 parity + chain-depth telemetry, one chaos rep
+    # per event at a mid-chain position, chained-prefill parity at the
+    # batch size (+ the one-step chain mechanism pin), and the
+    # constructor-arg draft-spec oracle. The full chain-position chaos
+    # matrix (4 events x 4 depths), the lag sweep, the TP=2 variant,
+    # the BS-1/BS+1/2BS sweep legs, the draft chaos/config-field serve
+    # variants (same pool + reset paths as the fast oracle), and the
+    # knob-composition legs ride the slow lane.
+    "test_deep_pipeline": {
+        "test_lag3_chaos_full_matrix",
+        "test_lag_matrix_outputs_identical_to_lag1",
+        "test_lag2_tp2_parity_single_trace",
+        "test_prefill_chain_parity_around_batch_size",
+        "test_prefill_chain_composes_with_lag_and_prefix_cache",
+        "test_draft_via_config_field_serves_parity",
+        "test_draft_spec_chaos_cancel_and_preempt",
+        "test_draft_spec_async_identical_to_sync",
+        "test_draft_spec_with_chunked_prefill_and_prefix_cache"},
 }
 
 

@@ -404,6 +404,59 @@ def test_bench_serve_continuous_smoke():
     assert al["on"]["retraces"] == 0
     assert al["on"]["decode_traces"] == 1     # zero new executables
     assert al["off"]["pipelined_steps"] == 0  # the off-leg never chains
+    # the flake-class fix: the tokens/s basis is recorded
+    # unconditionally so a reader always knows which evidence (single
+    # attempt inside the symmetric floor, best-of-attempts, or the
+    # structural skip) carried the no-worse verdict
+    assert al["tokens_per_s_basis"] in (
+        "single_attempt", "best_of_attempts", "noise_floor_skip")
+    # lag-N dispatch-chain A/B (auto N=2 in smoke): deeper chains keep
+    # exact parity through the SAME decode executable, the profiler's
+    # depth histogram proves the chain deepened past lag-1, and the
+    # chained dispatches land on a busy device (gap p90 no worse)
+    cl = rec["commit_lag"]
+    assert cl["max_commit_lag"] == 2
+    assert cl["parity_exact"] is True
+    assert cl["gap_no_worse"] is True
+    assert cl["gap_basis"] in ("single_attempt", "best_of_attempts")
+    assert cl["tokens_per_s_no_worse"] is True
+    assert cl["tokens_per_s_basis"] in (
+        "single_attempt", "best_of_attempts", "noise_floor_skip")
+    # the lag-2 chain demonstrably deepened past the lag-1 loop's
+    # steady state (dispatch-over-one-outstanding records depth 2)
+    assert cl["depth_max"] >= 3
+    assert cl["lag1"]["commit_lag_depth_max"] <= 2
+    assert cl["lagN"]["decode_traces"] == 1   # zero new executables
+    assert cl["lagN"]["retraces"] == 0
+    assert cl["dispatch_gap_p90_ms"] is not None
+    # chained chunked-prefill leg (auto in smoke): chaining the
+    # non-final chunks must cut the admission dispatch-gap tax —
+    # structurally (fewer device-idle events per replay,
+    # deterministic) and in total idle seconds (noise-disciplined) —
+    # at byte-identical outputs and the same ONE chunk executable
+    pfc = rec["prefill_chain"]
+    assert pfc["parity_exact"] is True
+    assert pfc["gap_samples_improved"] is True
+    assert pfc["on"]["dispatch_gap_count"] < \
+        pfc["off"]["dispatch_gap_count"]
+    assert pfc["gap_improved"] is True
+    assert pfc["gap_basis"] in (
+        "single_attempt", "best_of_attempts", "noise_floor_skip")
+    assert pfc["dispatch_gap_p90_ms"] is not None
+    assert pfc["on"]["prefill_chunks"] == pfc["off"]["prefill_chunks"]
+    assert pfc["on"]["chunk_traces"] == 1
+    assert pfc["on"]["retraces"] == 0
+    # draft-model speculation A/B (auto in smoke): on the
+    # non-repetitive trace the draft proposals must convert verify
+    # width into committed tokens where lookup cannot, token-identical
+    # outputs, through the SAME verify executable
+    sd = rec["speculation_draft"]
+    assert sd["parity_exact"] is True
+    assert sd["draft_beats_lookup"] is True
+    assert sd["tokens_per_forward"] > sd["tokens_per_forward_lookup"]
+    assert sd["tokens_per_forward"] > 1.0
+    assert sd["verify_traces"] == 1
+    assert sd["retraces"] == 0
     # KV tiering A/B (auto int8+offload in smoke, docs/serving.md "KV
     # quantization & host tiering"): the int8 pool at 2x the slots
     # costs LESS device memory than the fp baseline (capacity ratio
